@@ -1,0 +1,133 @@
+"""Benchmark: BERT-base pretraining step throughput + MFU on one chip.
+
+BASELINE.md config 3 (BERT-base, Fleet collective DP): measures
+samples/sec/chip and MFU for a full jitted train step (fwd+bwd+AdamW) in
+bf16.  vs_baseline = achieved MFU / 0.40 (the north-star target — the
+reference publishes no numbers, BASELINE.md).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def peak_flops_per_chip() -> float:
+    import jax
+
+    kind = jax.devices()[0].device_kind.lower()
+    table = {
+        "v4": 275e12,
+        "v5 lite": 197e12,
+        "v5e": 197e12,
+        "v5p": 459e12,
+        "v5": 459e12,
+        "v6 lite": 918e12,
+        "v6e": 918e12,
+    }
+    for k, v in sorted(table.items(), key=lambda kv: -len(kv[0])):
+        if k in kind:
+            return v
+    return 275e12  # default to v4 per BASELINE.md
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import paddle_tpu as paddle
+    import paddle_tpu.nn as nn
+    from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+
+    on_tpu = jax.default_backend() != "cpu"
+    # BERT-base: L12 H768 A12 I3072, seq 128
+    if on_tpu:
+        L, H, A, I, S, B, V = 12, 768, 12, 3072, 128, 32, 30522
+    else:  # smoke config for CPU dev runs
+        L, H, A, I, S, B, V = 2, 128, 4, 256, 64, 8, 1000
+
+    paddle.seed(0)
+
+    class Bert(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.embed = nn.Embedding(V, H)
+            self.pos = nn.Embedding(S, H)
+            layer = nn.TransformerEncoderLayer(H, A, I, dropout=0.0,
+                                               activation="gelu")
+            self.encoder = nn.TransformerEncoder(layer, L)
+            self.head = nn.Linear(H, V)
+
+        def forward(self, ids):
+            pos_ids = paddle.arange(ids.shape[1])
+            x = self.embed(ids) + self.pos(pos_ids)
+            x = self.encoder(x)
+            return self.head(x)
+
+    model = Bert()
+    if on_tpu:
+        model.astype("bfloat16")  # AMP-O2 pure bf16 params
+    model.train()
+    params, buffers = state_pytrees(model)
+    opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
+    opt_state = opt.init_pytree(params)
+
+    def train_step(params, opt_state, ids, labels):
+        def loss_fn(p):
+            out, _ = functional_call(model, p, (paddle.Tensor(ids),),
+                                     buffers=buffers)
+            logits = out.value.astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, -1)
+            picked = jnp.take_along_axis(logp, labels[..., None], -1)
+            return -picked.mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        new_params, new_state = opt.apply_pytree(params, grads, opt_state,
+                                                 lr=1e-4, step=1)
+        return new_params, new_state, loss
+
+    step = jax.jit(train_step, donate_argnums=(0, 1))
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
+
+    # warmup/compile
+    params, opt_state, loss = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+
+    iters = 20 if on_tpu else 3
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, opt_state, loss = step(params, opt_state, ids, labels)
+    jax.block_until_ready(loss)
+    dt = (time.perf_counter() - t0) / iters
+
+    n_params = sum(int(np.prod(v.shape)) for v in
+                   jax.tree_util.tree_leaves(params))
+    tokens = B * S
+    # training FLOPs ≈ 6 * N * tokens (fwd 2N + bwd 4N) + attention term
+    attn_flops = L * 12 * S * S * H * B  # qk^T, softmax*v fwd+bwd
+    flops = 6.0 * n_params * tokens + attn_flops
+    mfu = flops / dt / peak_flops_per_chip() if on_tpu else 0.0
+    samples_per_sec = B / dt
+
+    result = {
+        "metric": "bert_base_samples_per_sec_per_chip" if on_tpu
+                  else "bert_smoke_samples_per_sec_cpu",
+        "value": round(samples_per_sec, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(dt * 1e3, 2),
+        "params": n_params,
+        "loss": float(loss),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

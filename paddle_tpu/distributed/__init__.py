@@ -1,0 +1,33 @@
+"""paddle.distributed — collectives, mesh, parallel training.
+
+Reference parity: python/paddle/distributed/* (SURVEY.md §2.10).
+"""
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    new_group,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .mesh import (  # noqa: F401
+    P,
+    build_mesh,
+    ensure_mesh,
+    get_mesh,
+    mesh_guard,
+    named_sharding,
+    set_mesh,
+)
+from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401

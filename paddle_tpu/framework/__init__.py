@@ -1,0 +1,53 @@
+import jax as _jax
+
+# paddle's dtype surface includes float64/int64 as first-class citizens
+# (framework.proto VarType); jax disables 64-bit by default — enable it.
+# float32/bfloat16 remain the working dtypes on the TPU hot path.
+_jax.config.update("jax_enable_x64", True)
+
+from . import dtype as dtypes
+from .dtype import (
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    convert_dtype,
+    dtype_name,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_floating,
+    is_integer,
+    set_default_dtype,
+    uint8,
+)
+from .errors import (
+    EnforceError,
+    InvalidArgumentError,
+    NotFoundError,
+    OutOfRangeError,
+    UnimplementedError,
+    enforce,
+    enforce_eq,
+)
+from .flags import define_flag, flag, get_flags, set_flags
+from .place import (
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    Place,
+    TPUPlace,
+    XPUPlace,
+    device_count,
+    get_device,
+    get_place,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    set_device,
+)
+from .random import get_seed, in_rng_guard, rng_guard, seed, split_key

@@ -1,0 +1,64 @@
+"""Seeded RNG state.
+
+Reference parity: paddle/fluid/framework/generator.cc (per-device seeded
+generator) + paddle.seed.  TPU-native: a splittable JAX PRNG key chain.  Eager
+ops draw fresh subkeys by splitting a global state; traced/functional code must
+run under `rng_guard(key)` so randomness is explicit and reproducible under jit
+(no hidden state inside a compiled function).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _GeneratorState(threading.local):
+    def __init__(self):
+        self.key = jax.random.PRNGKey(0)
+        self.seed_value = 0
+        # stack of explicitly-provided keys for traced code
+        self.guard_stack: list = []
+
+
+_state = _GeneratorState()
+
+
+def seed(s: int):
+    _state.key = jax.random.PRNGKey(s)
+    _state.seed_value = int(s)
+    return _state
+
+
+def get_seed() -> int:
+    return _state.seed_value
+
+
+def split_key(n: int = 1):
+    """Draw fresh subkey(s). Inside an rng_guard, split the guarded key
+    (pure w.r.t. the trace); otherwise advance the global eager chain."""
+    if _state.guard_stack:
+        key = _state.guard_stack[-1]
+        keys = jax.random.split(key, n + 1)
+        _state.guard_stack[-1] = keys[0]
+        return keys[1] if n == 1 else keys[1:]
+    _state.key, *sub = jax.random.split(_state.key, n + 1)
+    return sub[0] if n == 1 else sub
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Make `key` the source of randomness for the enclosed (usually traced)
+    region. `key` may be a PRNGKey or an int seed."""
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+    _state.guard_stack.append(key)
+    try:
+        yield
+    finally:
+        _state.guard_stack.pop()
+
+
+def in_rng_guard() -> bool:
+    return bool(_state.guard_stack)

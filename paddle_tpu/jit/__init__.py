@@ -1,0 +1,161 @@
+"""paddle.jit — to_static / save / load.
+
+Reference parity: python/paddle/fluid/dygraph/jit.py (@declarative,
+TracedLayer) and dygraph_to_static/program_translator.py:233 StaticFunction.
+
+TPU-native: there is no AST rewriting — jax tracing IS program capture.
+`to_static(fn)` returns a StaticFunction that jit-compiles the function with
+the owning Layer's parameters/buffers passed as *arguments* (swapped in via
+the layer_base functional bridge), so later in-place param updates
+(optimizer.step) are picked up without recompilation — the same contract as
+the reference's partial_program parameter binding.  Input-shape-keyed compile
+caching comes from jax.jit itself (≙ ConcreteProgram cache keyed on
+InputSpec, program_translator.py:719).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import jax
+import numpy as np
+
+from ..autograd import suspend_tape
+from ..framework import random as _random
+from ..nn.layer_base import Layer, _swapped_state, state_pytrees
+from ..tensor import Tensor
+
+
+class InputSpec:
+    def __init__(self, shape=None, dtype="float32", name=None):
+        self.shape = shape
+        self.dtype = dtype
+        self.name = name
+
+    def __repr__(self):
+        return f"InputSpec(shape={self.shape}, dtype={self.dtype})"
+
+
+class StaticFunction:
+    """Compiled callable. If the target is a Layer method, parameters and
+    buffers are jit arguments (not baked constants)."""
+
+    def __init__(self, function, input_spec=None):
+        self._input_spec = input_spec
+        self._layer = None
+        if isinstance(function, Layer):
+            self._layer = function
+            self._method = type(function).forward
+        elif hasattr(function, "__self__") and isinstance(function.__self__, Layer):
+            self._layer = function.__self__
+            self._method = function.__func__
+        else:
+            self._method = function
+        self._build_compiled()
+
+    def _build_compiled(self):
+        layer = self._layer
+        method = self._method
+        if layer is not None:
+            @jax.jit
+            def compiled(params, buffers, rng, args, kwargs):
+                with suspend_tape(), _random.rng_guard(rng), \
+                        _swapped_state(layer, params, buffers) as bmap:
+                    out = method(layer, *args, **kwargs)
+                    new_buffers = {k: t.value for k, t in bmap.items()}
+                return out, new_buffers
+        else:
+            @jax.jit
+            def compiled(rng, args, kwargs):
+                with suspend_tape(), _random.rng_guard(rng):
+                    return method(*args, **kwargs)
+
+        self._compiled = compiled
+
+    def __get__(self, instance, owner):
+        if instance is None:
+            return self
+        key = "_jit_cache_" + self._method.__name__
+        cached = instance.__dict__.get(key)
+        if cached is None:
+            cached = StaticFunction(self._method.__get__(instance),
+                                    self._input_spec)
+            instance.__dict__[key] = cached
+        return cached
+
+    def __call__(self, *args, **kwargs):
+        rng = _random.split_key()
+        if self._layer is not None:
+            params, buffers = state_pytrees(self._layer)
+            out, new_buffers = self._compiled(params, buffers, rng, args,
+                                              kwargs)
+            bmap = dict(self._layer.named_buffers())
+            for name, val in new_buffers.items():
+                bmap[name]._value = val
+            return out
+        return self._compiled(rng, args, kwargs)
+
+    @property
+    def inner_function(self):
+        return self._method
+
+
+def to_static(function=None, input_spec=None, build_strategy=None, **kwargs):
+    def deco(fn):
+        if isinstance(fn, Layer):
+            fn.forward = StaticFunction(fn, input_spec)
+            return fn
+        return StaticFunction(fn, input_spec)
+
+    if function is not None:
+        return deco(function)
+    return deco
+
+
+declarative = to_static  # fluid-era alias
+
+
+def not_to_static(fn):
+    return fn
+
+
+def save(layer, path, input_spec=None, **config):
+    """Serialize a Layer (architecture via pickle + weights as numpy arrays).
+    Reference: paddle.jit.save → TranslatedLayer artifact
+    (.pdmodel/.pdiparams); AOT compilation is served by jax.export in
+    paddle_tpu.inference."""
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    state = {k: np.asarray(v.numpy()) for k, v in layer.state_dict().items()}
+    with open(path + ".pdiparams", "wb") as f:
+        pickle.dump(state, f)
+    with open(path + ".pdmodel", "wb") as f:
+        pickle.dump(layer, f)
+
+
+def load(path, **config):
+    with open(path + ".pdmodel", "rb") as f:
+        layer = pickle.load(f)
+    with open(path + ".pdiparams", "rb") as f:
+        state = pickle.load(f)
+    layer.set_state_dict(state)
+    return layer
+
+
+class TracedLayer:
+    """Reference: fluid/dygraph/jit.py TracedLayer (trace once, run static)."""
+
+    def __init__(self, layer, static_fn):
+        self._layer = layer
+        self._fn = static_fn
+
+    @staticmethod
+    def trace(layer, inputs):
+        sf = StaticFunction(layer)
+        out = sf(*inputs)
+        return out, TracedLayer(layer, sf)
+
+    def __call__(self, *args, **kwargs):
+        return self._fn(*args, **kwargs)
+
+    def save_inference_model(self, path, feed=None, fetch=None):
+        save(self._layer, path)

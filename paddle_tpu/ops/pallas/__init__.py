@@ -1,0 +1,1 @@
+"""Pallas TPU kernels for the fused transformer path (SURVEY.md §7 step 8)."""

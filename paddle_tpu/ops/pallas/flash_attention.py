@@ -1,0 +1,6 @@
+"""Pallas flash-attention kernel (placeholder until the TPU kernel lands;
+ops/fused.py falls back to the XLA softmax path on NotImplementedError)."""
+
+
+def flash_attention(q, k, v, causal=False):
+    raise NotImplementedError("pallas flash attention kernel pending")

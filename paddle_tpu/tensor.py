@@ -1,0 +1,330 @@
+"""Tensor: the eager array type.
+
+Reference parity: paddle/fluid/imperative/layer.h VarBase (eager tensor wrapping
+a framework::Variable + grad var) and the python Tensor surface
+(python/paddle/fluid/framework.py:978 Variable / dygraph core.VarBase methods).
+
+TPU-native design: a Tensor is a thin, pytree-registered wrapper over a
+`jax.Array` plus autograd metadata (`stop_gradient`, `.grad`).  Every eager op
+funnels through `apply(fn, *args)`, which either (a) just runs the pure jax
+function, or (b) when taping, runs `jax.vjp` to get primal + backward closure
+in one pass and records a GradNode (the TraceOp/CreateGradOpNode analog,
+tracer.cc:131,185).  Under `jax.jit` tracing the wrapper is transparent: value
+may be a tracer, taping is suspended, and the op is just the jax function —
+so the SAME layer code serves both dygraph and compiled static mode.
+"""
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from . import autograd
+from .framework import dtype as _dtype_mod
+from .framework.dtype import convert_dtype, get_default_dtype, is_floating
+
+
+def _is_tracer(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+class Tensor:
+    __array_priority__ = 100  # beat numpy in mixed dunder dispatch
+
+    def __init__(self, value, dtype=None, stop_gradient: bool = True, name: str | None = None):
+        if isinstance(value, Tensor):
+            value = value.value
+        if not isinstance(value, (jax.Array, jax.core.Tracer)):
+            dt = convert_dtype(dtype)
+            if dt is None and isinstance(value, (float,)):
+                dt = get_default_dtype()
+            if dt is None and isinstance(value, np.ndarray) and value.dtype == np.float64:
+                dt = get_default_dtype()
+            value = jnp.asarray(value, dtype=dt)
+        elif dtype is not None and convert_dtype(dtype) != value.dtype:
+            value = value.astype(convert_dtype(dtype))
+        self._value = value
+        self.stop_gradient = bool(stop_gradient)
+        self._grad: Tensor | None = None
+        self._produced_by_op = False
+        self.name = name
+
+    # -- basic properties --------------------------------------------------
+    @property
+    def value(self):
+        return self._value
+
+    @property
+    def shape(self):
+        return list(self._value.shape)
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def dtype(self):
+        return self._value.dtype
+
+    @property
+    def size(self) -> int:
+        return int(np.prod(self._value.shape)) if self._value.shape else 1
+
+    @property
+    def place(self):
+        from .framework.place import CPUPlace, TPUPlace
+
+        if _is_tracer(self._value):
+            return TPUPlace(0)
+        dev = next(iter(self._value.devices()), None)
+        if dev is not None and dev.platform.lower() == "cpu":
+            return CPUPlace(dev.id)
+        return TPUPlace(getattr(dev, "id", 0))
+
+    @property
+    def is_leaf(self) -> bool:
+        return not self._produced_by_op
+
+    @property
+    def grad(self):
+        return self._grad
+
+    @grad.setter
+    def grad(self, g):
+        if g is None:
+            self._grad = None
+        else:
+            self._grad = g if isinstance(g, Tensor) else Tensor(g)
+
+    @property
+    def T(self):
+        return apply(jnp.transpose, self)
+
+    @property
+    def mT(self):
+        return apply(lambda x: jnp.swapaxes(x, -1, -2), self)
+
+    @property
+    def real(self):
+        return apply(jnp.real, self)
+
+    @property
+    def imag(self):
+        return apply(jnp.imag, self)
+
+    # -- autograd ----------------------------------------------------------
+    def backward(self, grad_tensor=None, retain_graph: bool = False):
+        autograd.backward([self], [grad_tensor], retain_graph=retain_graph)
+
+    def _accumulate_grad(self, g):
+        if self.stop_gradient:
+            return
+        if self._grad is None:
+            self._grad = Tensor(g)
+        else:
+            self._grad = Tensor(self._grad.value + g)
+
+    def clear_grad(self):
+        self._grad = None
+
+    def clear_gradient(self):
+        self._grad = None
+
+    def detach(self) -> "Tensor":
+        return Tensor(self._value, stop_gradient=True, name=self.name)
+
+    def clone(self) -> "Tensor":
+        return apply(lambda x: x + 0, self)
+
+    def register_hook(self, hook):
+        raise NotImplementedError("tensor hooks land with the full hook system")
+
+    # -- host bridge -------------------------------------------------------
+    def numpy(self) -> np.ndarray:
+        if _is_tracer(self._value):
+            raise RuntimeError("Cannot call .numpy() inside a jit-traced function")
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = self.numpy()
+        return a.astype(dtype) if dtype is not None else a
+
+    def item(self):
+        return self.numpy().item()
+
+    def tolist(self):
+        return self.numpy().tolist()
+
+    def numel(self) -> int:
+        return self.size
+
+    def element_size(self) -> int:
+        return np.dtype(self.dtype).itemsize
+
+    # -- dtype / device ----------------------------------------------------
+    def astype(self, dtype) -> "Tensor":
+        dt = convert_dtype(dtype)
+        return apply(lambda x: x.astype(dt), self)
+
+    cast = astype
+
+    def cpu(self) -> "Tensor":
+        return Tensor(jax.device_put(self._value, jax.devices("cpu")[0]),
+                      stop_gradient=self.stop_gradient)
+
+    def to(self, *args, **kwargs):
+        # minimal: dtype and/or device string
+        out = self
+        for a in args:
+            if isinstance(a, str) and a.split(":")[0] in ("cpu", "tpu", "gpu", "cuda"):
+                from .framework.place import set_device, get_place
+                prev = get_place()
+                try:
+                    place = set_device(a)
+                finally:
+                    set_device(prev)
+                out = Tensor(jax.device_put(out.value, place.jax_device()),
+                             stop_gradient=out.stop_gradient)
+            else:
+                out = out.astype(a)
+        if "dtype" in kwargs:
+            out = out.astype(kwargs["dtype"])
+        return out
+
+    # -- python protocol ---------------------------------------------------
+    def __len__(self):
+        if not self._value.shape:
+            raise TypeError("len() of a 0-d tensor")
+        return self._value.shape[0]
+
+    def __repr__(self):
+        if _is_tracer(self._value):
+            return f"Tensor(traced, shape={self.shape}, dtype={self.dtype})"
+        return (
+            f"Tensor(shape={self.shape}, dtype={_dtype_mod.dtype_name(self.dtype)}, "
+            f"stop_gradient={self.stop_gradient},\n       {np.asarray(self._value)!r})"
+        )
+
+    def __bool__(self):
+        return bool(self._value)
+
+    def __int__(self):
+        return int(self._value)
+
+    def __float__(self):
+        return float(self._value)
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield self[i]
+
+    def __getitem__(self, idx):
+        idx = _unwrap_index(idx)
+        return apply(lambda x: x[idx], self)
+
+    def __setitem__(self, idx, val):
+        idx = _unwrap_index(idx)
+        v = val.value if isinstance(val, Tensor) else val
+        self._value = self._value.at[idx].set(v)
+
+    def __hash__(self):
+        return id(self)
+
+    def dim(self):
+        return self.ndim
+
+    def rank(self):
+        return self.ndim
+
+
+def _unwrap_index(idx):
+    if isinstance(idx, Tensor):
+        return idx.value
+    if isinstance(idx, tuple):
+        return tuple(i.value if isinstance(i, Tensor) else i for i in idx)
+    return idx
+
+
+# -- pytree registration ---------------------------------------------------
+def _tensor_flatten(t: Tensor):
+    return (t._value,), (t.stop_gradient, t.name)
+
+
+def _tensor_unflatten(aux, children):
+    stop_gradient, name = aux
+    return Tensor(children[0], stop_gradient=stop_gradient, name=name)
+
+
+jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)
+
+
+# -- generic eager op dispatch ---------------------------------------------
+def unwrap(x):
+    return x._value if isinstance(x, Tensor) else x
+
+
+def _needs_grad(x) -> bool:
+    return isinstance(x, Tensor) and not x.stop_gradient and is_floating(x.dtype)
+
+
+def apply(fn, *args, _multi_out: bool = False, **kwargs):
+    """Run pure jax function `fn` over (possibly Tensor) args.
+
+    This is the single Python/XLA boundary for eager mode — the TraceOp analog.
+    When the tape is live and any input requires grad, use jax.vjp so the
+    backward closure is captured (one forward pass total).
+    """
+    jvals = [unwrap(a) for a in args]
+    tensor_args = [a for a in args if isinstance(a, Tensor)]
+    any_tracer = any(_is_tracer(v) for v in jvals)
+
+    if any_tracer or not autograd.tape_enabled() or not any(_needs_grad(a) for a in args):
+        out = fn(*jvals, **kwargs)
+        # under no_grad / inside traces outputs do not require grad
+        rg = (any_tracer or autograd.tape_enabled()) and \
+            any(_needs_grad(a) for a in args)
+        return _wrap_out(out, tensor_args, produced=True, multi=_multi_out,
+                         requires_grad=rg)
+
+    diff_pos = [i for i, a in enumerate(args) if _needs_grad(a)]
+    diff_vals = [jvals[i] for i in diff_pos]
+
+    def closed(*dvals):
+        vals = list(jvals)
+        for i, v in zip(diff_pos, dvals):
+            vals[i] = v
+        return fn(*vals, **kwargs)
+
+    primal, vjp_fn = jax.vjp(closed, *diff_vals)
+    out = _wrap_out(primal, tensor_args, produced=True, multi=_multi_out, requires_grad=True)
+
+    outs = out if isinstance(out, (list, tuple)) else (out,)
+    out_tensors = [o for o in outs if isinstance(o, Tensor)]
+    node = autograd.GradNode(
+        vjp_fn,
+        [args[i] for i in diff_pos],
+        [id(o) for o in out_tensors],
+        [(tuple(o.shape), o.dtype) for o in out_tensors],
+        multi_out=len(out_tensors) > 1,
+    )
+    autograd.record(node)
+    return out
+
+
+def _wrap_out(out, tensor_args, produced: bool, multi: bool, requires_grad: bool | None = None):
+    if requires_grad is None:
+        requires_grad = any(_needs_grad(a) for a in tensor_args)
+
+    def mk(v):
+        if not isinstance(v, (jax.Array, jax.core.Tracer, np.ndarray)):
+            return v
+        t = Tensor(v, stop_gradient=not requires_grad)
+        t._produced_by_op = produced
+        return t
+
+    if isinstance(out, (tuple, list)):
+        wrapped = type(out)(mk(v) for v in out)
+        return wrapped
+    return mk(out)

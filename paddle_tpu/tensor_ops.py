@@ -1,0 +1,1117 @@
+"""Tensor math / manipulation / linalg / search / logic ops.
+
+Reference parity: python/paddle/tensor/{math,manipulation,linalg,search,logic,
+stat}.py (~9k LoC re-exported as Tensor methods) over the dense C++ op zoo
+(paddle/fluid/operators/*.cc — SURVEY.md §2.4).  TPU-native: every op is a
+direct jnp/lax lowering dispatched through tensor.apply (one table, no
+kernel-per-op registration); XLA fuses elementwise chains so there is no need
+for the reference's fusion_group codegen here.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework.dtype import convert_dtype, get_default_dtype
+from .tensor import Tensor, apply, unwrap
+
+
+def _axis(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, Tensor):
+        axis = axis.tolist()
+    if isinstance(axis, (list, tuple)):
+        return tuple(int(a) for a in axis)
+    return int(axis)
+
+
+# ---------------------------------------------------------------------------
+# elementwise binary (broadcasting) — elementwise/* ops in the reference
+# ---------------------------------------------------------------------------
+def add(x, y, name=None):
+    return apply(jnp.add, x, y)
+
+
+def subtract(x, y, name=None):
+    return apply(jnp.subtract, x, y)
+
+
+def multiply(x, y, name=None):
+    return apply(jnp.multiply, x, y)
+
+
+def divide(x, y, name=None):
+    return apply(jnp.true_divide, x, y)
+
+
+def floor_divide(x, y, name=None):
+    return apply(jnp.floor_divide, x, y)
+
+
+def mod(x, y, name=None):
+    return apply(jnp.mod, x, y)
+
+
+remainder = mod
+
+
+def pow(x, y, name=None):
+    return apply(jnp.power, x, y)
+
+
+def maximum(x, y, name=None):
+    return apply(jnp.maximum, x, y)
+
+
+def minimum(x, y, name=None):
+    return apply(jnp.minimum, x, y)
+
+
+def fmax(x, y, name=None):
+    return apply(jnp.fmax, x, y)
+
+
+def fmin(x, y, name=None):
+    return apply(jnp.fmin, x, y)
+
+
+def atan2(x, y, name=None):
+    return apply(jnp.arctan2, x, y)
+
+
+def hypot(x, y, name=None):
+    return apply(jnp.hypot, x, y)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None, name=None):
+    s, b = unwrap(scale), unwrap(bias)
+
+    def f(v):
+        return v * s + b if bias_after_scale else (v + b) * s
+
+    out = apply(f, x)
+    if act:
+        from .nn import functional as F
+
+        out = getattr(F, act)(out)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# elementwise unary — activations live in nn.functional; these are math
+# ---------------------------------------------------------------------------
+_UNARY = {
+    "abs": jnp.abs,
+    "acos": jnp.arccos,
+    "acosh": jnp.arccosh,
+    "asin": jnp.arcsin,
+    "asinh": jnp.arcsinh,
+    "atan": jnp.arctan,
+    "atanh": jnp.arctanh,
+    "ceil": jnp.ceil,
+    "conj": jnp.conj,
+    "cos": jnp.cos,
+    "cosh": jnp.cosh,
+    "digamma": jax.scipy.special.digamma,
+    "erf": jax.scipy.special.erf,
+    "erfinv": jax.scipy.special.erfinv,
+    "exp": jnp.exp,
+    "expm1": jnp.expm1,
+    "floor": jnp.floor,
+    "i0": lambda x: jax.scipy.special.i0(x),
+    "lgamma": jax.scipy.special.gammaln,
+    "log": jnp.log,
+    "log10": jnp.log10,
+    "log1p": jnp.log1p,
+    "log2": jnp.log2,
+    "neg": jnp.negative,
+    "reciprocal": jnp.reciprocal,
+    "rsqrt": jax.lax.rsqrt,
+    "sign": jnp.sign,
+    "sin": jnp.sin,
+    "sinh": jnp.sinh,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "tan": jnp.tan,
+    "trunc": jnp.trunc,
+}
+
+_g = globals()
+for _name, _fn in _UNARY.items():
+    def _mk(fn):
+        def op(x, name=None):
+            return apply(fn, x)
+        return op
+    _g[_name] = _mk(_fn)
+    _g[_name].__name__ = _name
+
+
+def round(x, decimals=0, name=None):  # noqa: A001
+    return apply(lambda v: jnp.round(v, decimals), x)
+
+
+def frac(x, name=None):
+    return apply(lambda v: v - jnp.trunc(v), x)
+
+
+def angle(x, name=None):
+    return apply(jnp.angle, x)
+
+
+def nan_to_num(x, nan=0.0, posinf=None, neginf=None, name=None):
+    return apply(lambda v: jnp.nan_to_num(v, nan=nan, posinf=posinf, neginf=neginf), x)
+
+
+def clip(x, min=None, max=None, name=None):
+    return apply(lambda v: jnp.clip(v, unwrap(min), unwrap(max)), x)
+
+
+def lerp(x, y, weight, name=None):
+    return apply(lambda a, b, w: a + w * (b - a), x, y, weight)
+
+
+def isfinite(x, name=None):
+    return apply(jnp.isfinite, x)
+
+
+def isinf(x, name=None):
+    return apply(jnp.isinf, x)
+
+
+def isnan(x, name=None):
+    return apply(jnp.isnan, x)
+
+
+# ---------------------------------------------------------------------------
+# reductions — reduce_ops/* in the reference
+# ---------------------------------------------------------------------------
+def sum(x, axis=None, dtype=None, keepdim=False, name=None):  # noqa: A001
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.sum(v, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def mean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.mean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def max(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.max(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def min(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.min(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def amax(x, axis=None, keepdim=False, name=None):
+    return max(x, axis, keepdim)
+
+
+def amin(x, axis=None, keepdim=False, name=None):
+    return min(x, axis, keepdim)
+
+
+def prod(x, axis=None, keepdim=False, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.prod(v, axis=_axis(axis), dtype=dt, keepdims=keepdim), x)
+
+
+def logsumexp(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jax.scipy.special.logsumexp(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def all(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.all(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def any(x, axis=None, keepdim=False, name=None):  # noqa: A001
+    return apply(lambda v: jnp.any(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def std(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.std(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def var(x, axis=None, unbiased=True, keepdim=False, name=None):
+    return apply(lambda v: jnp.var(v, axis=_axis(axis), ddof=1 if unbiased else 0,
+                                   keepdims=keepdim), x)
+
+
+def median(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.median(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def quantile(x, q, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.quantile(v, jnp.asarray(unwrap(q)), axis=_axis(axis),
+                                        keepdims=keepdim), x)
+
+
+def nanmean(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nanmean(v, axis=_axis(axis), keepdims=keepdim), x)
+
+
+def nansum(x, axis=None, dtype=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.nansum(v, axis=_axis(axis), dtype=convert_dtype(dtype),
+                                      keepdims=keepdim), x)
+
+
+def count_nonzero(x, axis=None, keepdim=False, name=None):
+    return apply(lambda v: jnp.count_nonzero(v, axis=_axis(axis), keepdims=keepdim)
+                 .astype(jnp.int64), x)
+
+
+def cumsum(x, axis=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.cumsum(v if axis is not None else v.ravel(),
+                                      axis=axis if axis is not None else 0, dtype=dt), x)
+
+
+def cumprod(x, dim=None, dtype=None, name=None):
+    dt = convert_dtype(dtype)
+    return apply(lambda v: jnp.cumprod(v, axis=dim, dtype=dt), x)
+
+
+def cummax(x, axis=None, dtype="int64", name=None):
+    def f(v):
+        a = 0 if axis is None else axis
+        vv = v.ravel() if axis is None else v
+        out = jax.lax.associative_scan(jnp.maximum, vv, axis=a)
+        idx = jnp.argmax(jnp.cumsum(jnp.ones_like(vv, jnp.int32), a) *
+                         (vv == out), axis=a)
+        return out, idx
+    o, i = apply(f, x, _multi_out=True)
+    return o, i
+
+
+def logcumsumexp(x, axis=None, name=None):
+    def f(v):
+        a = 0 if axis is None else axis
+        vv = v.ravel() if axis is None else v
+        return jax.lax.associative_scan(jnp.logaddexp, vv, axis=a)
+    return apply(f, x)
+
+
+# ---------------------------------------------------------------------------
+# comparison / logic
+# ---------------------------------------------------------------------------
+def equal(x, y, name=None):
+    return apply(jnp.equal, x, y)
+
+
+def not_equal(x, y, name=None):
+    return apply(jnp.not_equal, x, y)
+
+
+def less_than(x, y, name=None):
+    return apply(jnp.less, x, y)
+
+
+def less_equal(x, y, name=None):
+    return apply(jnp.less_equal, x, y)
+
+
+def greater_than(x, y, name=None):
+    return apply(jnp.greater, x, y)
+
+
+def greater_equal(x, y, name=None):
+    return apply(jnp.greater_equal, x, y)
+
+
+def equal_all(x, y, name=None):
+    return apply(lambda a, b: jnp.array_equal(a, b), x, y)
+
+
+def allclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                           equal_nan=equal_nan), x, y)
+
+
+def isclose(x, y, rtol=1e-05, atol=1e-08, equal_nan=False, name=None):
+    return apply(lambda a, b: jnp.isclose(a, b, rtol=rtol, atol=atol,
+                                          equal_nan=equal_nan), x, y)
+
+
+def logical_and(x, y, out=None, name=None):
+    return apply(jnp.logical_and, x, y)
+
+
+def logical_or(x, y, out=None, name=None):
+    return apply(jnp.logical_or, x, y)
+
+
+def logical_xor(x, y, out=None, name=None):
+    return apply(jnp.logical_xor, x, y)
+
+
+def logical_not(x, out=None, name=None):
+    return apply(jnp.logical_not, x)
+
+
+def bitwise_and(x, y, out=None, name=None):
+    return apply(jnp.bitwise_and, x, y)
+
+
+def bitwise_or(x, y, out=None, name=None):
+    return apply(jnp.bitwise_or, x, y)
+
+
+def bitwise_xor(x, y, out=None, name=None):
+    return apply(jnp.bitwise_xor, x, y)
+
+
+def bitwise_not(x, out=None, name=None):
+    return apply(jnp.bitwise_not, x)
+
+
+# ---------------------------------------------------------------------------
+# manipulation — reshape/transpose/concat/split/... ops
+# ---------------------------------------------------------------------------
+def reshape(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    xs = x.shape if isinstance(x, Tensor) else list(np.shape(unwrap(x)))
+    # paddle semantics: 0 means "copy this dim from input"
+    shape = [xs[i] if s == 0 else int(s) for i, s in enumerate(shape)] if 0 in list(shape) \
+        else [int(s) for s in shape]
+    return apply(lambda v: jnp.reshape(v, shape), x)
+
+
+def transpose(x, perm, name=None):
+    return apply(lambda v: jnp.transpose(v, perm), x)
+
+
+def moveaxis(x, source, destination, name=None):
+    return apply(lambda v: jnp.moveaxis(v, source, destination), x)
+
+
+def swapaxes(x, axis1, axis2, name=None):
+    return apply(lambda v: jnp.swapaxes(v, axis1, axis2), x)
+
+
+def squeeze(x, axis=None, name=None):
+    return apply(lambda v: jnp.squeeze(v, _axis(axis)), x)
+
+
+def unsqueeze(x, axis, name=None):
+    ax = _axis(axis)
+    return apply(lambda v: jnp.expand_dims(v, ax), x)
+
+
+def flatten(x, start_axis=0, stop_axis=-1, name=None):
+    def f(v):
+        nd = v.ndim
+        s = start_axis % nd if nd else 0
+        e = stop_axis % nd if nd else 0
+        new_shape = list(v.shape[:s]) + [-1] + list(v.shape[e + 1:])
+        return jnp.reshape(v, new_shape)
+    return apply(f, x)
+
+
+def concat(x, axis=0, name=None):
+    xs = list(x)
+    tensor_inputs = [t for t in xs if isinstance(t, Tensor)]
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *xs) if tensor_inputs else \
+        Tensor(jnp.concatenate([unwrap(v) for v in xs], axis=ax))
+
+
+def stack(x, axis=0, name=None):
+    xs = list(x)
+    return apply(lambda *vs: jnp.stack(vs, axis=axis), *xs)
+
+
+def unstack(x, axis=0, num=None, name=None):
+    n = num if num is not None else x.shape[axis]
+    outs = apply(lambda v: tuple(jnp.moveaxis(v, axis, 0)[i] for i in range(n)),
+                 x, _multi_out=True)
+    return list(outs)
+
+
+def split(x, num_or_sections, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+
+    def f(v):
+        if isinstance(num_or_sections, int):
+            return tuple(jnp.split(v, num_or_sections, axis=ax))
+        secs = [int(unwrap(s)) for s in num_or_sections]
+        total = v.shape[ax]
+        known = builtins_sum(s for s in secs if s != -1)
+        secs = [s if s != -1 else total - known for s in secs]
+        idx = np.cumsum(secs)[:-1]
+        return tuple(jnp.split(v, idx, axis=ax))
+
+    outs = apply(f, x, _multi_out=True)
+    return list(outs)
+
+
+builtins_sum = __import__("builtins").sum
+
+
+def chunk(x, chunks, axis=0, name=None):
+    return split(x, chunks, axis)
+
+
+def tile(x, repeat_times, name=None):
+    rt = [int(unwrap(r)) for r in repeat_times] if not isinstance(repeat_times, int) \
+        else repeat_times
+    return apply(lambda v: jnp.tile(v, rt), x)
+
+
+def expand(x, shape, name=None):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    xs = list(np.shape(unwrap(x)))
+    tgt = list(shape)
+    # -1 means keep input dim (aligned from the right)
+    off = len(tgt) - len(xs)
+    tgt = [xs[i - off] if (s == -1 and i >= off) else int(s) for i, s in enumerate(tgt)]
+    return apply(lambda v: jnp.broadcast_to(v, tgt), x)
+
+
+def expand_as(x, y, name=None):
+    return apply(lambda v, w: jnp.broadcast_to(v, w.shape), x, y)
+
+
+def broadcast_to(x, shape, name=None):
+    return expand(x, shape)
+
+
+def broadcast_tensors(inputs, name=None):
+    vs = [unwrap(v) for v in inputs]
+    shape = np.broadcast_shapes(*[v.shape for v in vs])
+    return [apply(lambda v: jnp.broadcast_to(v, shape), t) for t in inputs]
+
+
+def flip(x, axis, name=None):
+    return apply(lambda v: jnp.flip(v, _axis(axis)), x)
+
+
+def roll(x, shifts, axis=None, name=None):
+    return apply(lambda v: jnp.roll(v, shifts, _axis(axis)), x)
+
+
+def rot90(x, k=1, axes=(0, 1), name=None):
+    return apply(lambda v: jnp.rot90(v, k, axes), x)
+
+
+def repeat_interleave(x, repeats, axis=None, name=None):
+    r = unwrap(repeats)
+    return apply(lambda v: jnp.repeat(v, r, axis=axis), x)
+
+
+def take_along_axis(arr, indices, axis, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i, axis=axis), arr, indices)
+
+
+def put_along_axis(arr, indices, values, axis, reduce="assign", name=None):
+    def f(v, i, val):
+        val = jnp.broadcast_to(val, i.shape).astype(v.dtype)
+        dims = [d for d in range(v.ndim)]
+        # build full index grid
+        idxs = jnp.meshgrid(*[jnp.arange(s) for s in i.shape], indexing="ij")
+        idxs[axis] = i
+        if reduce == "assign":
+            return v.at[tuple(idxs)].set(val)
+        if reduce == "add":
+            return v.at[tuple(idxs)].add(val)
+        if reduce == "multiply" or reduce == "mul":
+            return v.at[tuple(idxs)].multiply(val)
+        raise ValueError(reduce)
+    return apply(f, arr, indices, values)
+
+
+def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
+    if isinstance(pad, Tensor):
+        pad = pad.tolist()
+
+    def f(v):
+        p = list(pad)
+        if len(p) == 2 * v.ndim:
+            # paddle flat format: [d0_l, d0_r, d1_l, d1_r, ...] over ALL dims
+            width = [(p[2 * i], p[2 * i + 1]) for i in range(v.ndim)]
+        else:
+            # partial spec applies to trailing spatial dims, like F.pad
+            nsp = len(p) // 2
+            width = [(0, 0)] * (v.ndim - nsp)
+            # paddle F.pad lists from last dim backwards in pairs
+            if data_format.endswith("C"):  # NHWC/NLC/NDHWC: spatial before channel
+                width = [(0, 0)] + [(p[2 * i], p[2 * i + 1]) for i in range(nsp)] + [(0, 0)]
+                width = [(0, 0)] * (v.ndim - len(width)) + width
+            else:
+                width += [(p[2 * i], p[2 * i + 1]) for i in range(nsp)]
+        jmode = {"constant": "constant", "reflect": "reflect",
+                 "replicate": "edge", "circular": "wrap"}[mode]
+        kw = {"constant_values": value} if jmode == "constant" else {}
+        return jnp.pad(v, width, mode=jmode, **kw)
+
+    return apply(f, x)
+
+
+def gather(x, index, axis=0, name=None):
+    ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=ax), x, index)
+
+
+def gather_nd(x, index, name=None):
+    def f(v, i):
+        idx = tuple(jnp.moveaxis(i, -1, 0))
+        return v[idx]
+    return apply(f, x, index)
+
+
+def scatter(x, index, updates, overwrite=True, name=None):
+    def f(v, i, u):
+        i = i.astype(jnp.int32)
+        if overwrite:
+            return v.at[i].set(u)
+        # paddle overwrite=False: zero the rows then accumulate
+        z = v.at[i].set(jnp.zeros_like(u))
+        return z.at[i].add(u)
+    return apply(f, x, index, updates)
+
+
+def scatter_nd_add(x, index, updates, name=None):
+    def f(v, i, u):
+        idx = tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))
+        return v.at[idx].add(u)
+    return apply(f, x, index, updates)
+
+
+def scatter_nd(index, updates, shape, name=None):
+    def f(i, u):
+        base = jnp.zeros(tuple(shape), u.dtype)
+        idx = tuple(jnp.moveaxis(i.astype(jnp.int32), -1, 0))
+        return base.at[idx].add(u)
+    return apply(f, index, updates)
+
+
+def index_select(x, index, axis=0, name=None):
+    return apply(lambda v, i: jnp.take(v, i.astype(jnp.int32), axis=axis), x, index)
+
+
+def index_sample(x, index, name=None):
+    return apply(lambda v, i: jnp.take_along_axis(v, i.astype(jnp.int32), axis=1),
+                 x, index)
+
+
+def masked_select(x, mask, name=None):
+    # dynamic shape: eager only (documented; inside jit use where())
+    return apply(lambda v, m: v[m], x, mask)
+
+
+def masked_fill(x, mask, value, name=None):
+    return apply(lambda v, m: jnp.where(m, jnp.asarray(unwrap(value), v.dtype), v), x, mask)
+
+
+def where(condition, x=None, y=None, name=None):
+    if x is None and y is None:
+        return nonzero(condition, as_tuple=True)
+    return apply(lambda c, a, b: jnp.where(c, a, b), condition, x, y)
+
+
+def nonzero(x, as_tuple=False):
+    v = unwrap(x)
+    outs = jnp.nonzero(v)  # eager only (dynamic shape)
+    if as_tuple:
+        return tuple(Tensor(o[:, None]) for o in outs)
+    return Tensor(jnp.stack(outs, axis=1))
+
+
+def shard_index(input, index_num, nshards, shard_id, ignore_value=-1):
+    """TP embedding helper (reference distributed/collective.py:526)."""
+    def f(ids):
+        shard_size = (index_num + nshards - 1) // nshards
+        lo = shard_id * shard_size
+        local = ids - lo
+        ok = (ids >= lo) & (ids < lo + shard_size)
+        return jnp.where(ok, local, ignore_value)
+    return apply(f, input)
+
+
+def unique(x, return_index=False, return_inverse=False, return_counts=False,
+           axis=None, dtype="int64", name=None):
+    v = unwrap(x)  # eager only
+    res = jnp.unique(v, return_index=return_index, return_inverse=return_inverse,
+                     return_counts=return_counts, axis=axis)
+    if not isinstance(res, tuple):
+        return Tensor(res)
+    return tuple(Tensor(r) for r in res)
+
+
+def unbind(x, axis=0):
+    return unstack(x, axis)
+
+
+def as_complex(x, name=None):
+    return apply(lambda v: jax.lax.complex(v[..., 0], v[..., 1]), x)
+
+
+def as_real(x, name=None):
+    return apply(lambda v: jnp.stack([jnp.real(v), jnp.imag(v)], axis=-1), x)
+
+
+def crop(x, shape=None, offsets=None, name=None):
+    def f(v):
+        off = [int(unwrap(o)) for o in (offsets or [0] * v.ndim)]
+        shp = [int(unwrap(s)) for s in (shape or v.shape)]
+        shp = [v.shape[i] - off[i] if s == -1 else s for i, s in enumerate(shp)]
+        return jax.lax.dynamic_slice(v, off, shp)
+    return apply(f, x)
+
+
+# ---------------------------------------------------------------------------
+# search / sort — topk/argsort ops
+# ---------------------------------------------------------------------------
+def argmax(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmax(v, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(convert_dtype(dtype))
+    return apply(f, x)
+
+
+def argmin(x, axis=None, keepdim=False, dtype="int64", name=None):
+    def f(v):
+        out = jnp.argmin(v, axis=axis, keepdims=keepdim if axis is not None else False)
+        return out.astype(convert_dtype(dtype))
+    return apply(f, x)
+
+
+def argsort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        idx = jnp.argsort(-v if descending else v, axis=axis, stable=True)
+        return idx.astype(jnp.int64)
+    return apply(f, x)
+
+
+def sort(x, axis=-1, descending=False, name=None):
+    def f(v):
+        s = jnp.sort(v, axis=axis, stable=True)
+        return jnp.flip(s, axis=axis) if descending else s
+    return apply(f, x)
+
+
+def topk(x, k, axis=-1, largest=True, sorted=True, name=None):  # noqa: A002
+    k = int(unwrap(k))
+
+    def f(v):
+        ax = axis % v.ndim
+        vm = jnp.moveaxis(v, ax, -1)
+        if largest:
+            vals, idx = jax.lax.top_k(vm, k)
+        else:
+            vals, idx = jax.lax.top_k(-vm, k)
+            vals = -vals
+        return jnp.moveaxis(vals, -1, ax), jnp.moveaxis(idx.astype(jnp.int64), -1, ax)
+
+    vals, idx = apply(f, x, _multi_out=True)
+    return vals, idx
+
+
+def kthvalue(x, k, axis=-1, keepdim=False, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        s = jnp.sort(v, axis=ax)
+        i = jnp.argsort(v, axis=ax).astype(jnp.int64)
+        val = jnp.take(s, k - 1, axis=ax)
+        ind = jnp.take(i, k - 1, axis=ax)
+        if keepdim:
+            val, ind = jnp.expand_dims(val, ax), jnp.expand_dims(ind, ax)
+        return val, ind
+    return apply(f, x, _multi_out=True)
+
+
+def mode(x, axis=-1, keepdim=False, name=None):
+    def f(v):
+        ax = axis % v.ndim
+        s = jnp.sort(v, axis=ax)
+        # mode = most frequent; approximate via run-length on sorted values
+        eq = jnp.concatenate([jnp.ones_like(jnp.take(s, jnp.array([0]), ax),
+                                            dtype=jnp.int32),
+                              (jnp.diff(s, axis=ax) == 0).astype(jnp.int32)], axis=ax)
+        run = jax.lax.associative_scan(lambda a, b: (a + b) * (b > 0).astype(a.dtype),
+                                       eq, axis=ax)
+        idx = jnp.argmax(run, axis=ax, keepdims=True)
+        val = jnp.take_along_axis(s, idx, axis=ax)
+        if not keepdim:
+            val, idx = jnp.squeeze(val, ax), jnp.squeeze(idx, ax)
+        return val, idx.astype(jnp.int64)
+    return apply(f, x, _multi_out=True)
+
+
+def searchsorted(sorted_sequence, values, out_int32=False, right=False, name=None):
+    def f(s, v):
+        side = "right" if right else "left"
+        if s.ndim == 1:
+            out = jnp.searchsorted(s, v, side=side)
+        else:
+            out = jax.vmap(lambda a, b: jnp.searchsorted(a, b, side=side))(
+                s.reshape(-1, s.shape[-1]), v.reshape(-1, v.shape[-1]))
+            out = out.reshape(v.shape)
+        return out.astype(jnp.int32 if out_int32 else jnp.int64)
+    return apply(f, sorted_sequence, values)
+
+
+def bucketize(x, sorted_sequence, out_int32=False, right=False, name=None):
+    return searchsorted(sorted_sequence, x, out_int32, right)
+
+
+# ---------------------------------------------------------------------------
+# linalg — matmul/mul ops + math/blas.h dispatch (→ MXU via XLA dot)
+# ---------------------------------------------------------------------------
+def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+    def f(a, b):
+        from .amp import white_cast
+
+        a, b = white_cast(a, b)
+        if transpose_x:
+            a = jnp.swapaxes(a, -1, -2) if a.ndim > 1 else a
+        if transpose_y:
+            b = jnp.swapaxes(b, -1, -2) if b.ndim > 1 else b
+        return jnp.matmul(a, b)
+    return apply(f, x, y)
+
+
+def mm(x, y, name=None):
+    return matmul(x, y)
+
+
+def bmm(x, y, name=None):
+    return matmul(x, y)
+
+
+def dot(x, y, name=None):
+    return apply(lambda a, b: jnp.sum(a * b, axis=-1), x, y)
+
+
+def inner(x, y, name=None):
+    return apply(jnp.inner, x, y)
+
+
+def outer(x, y, name=None):
+    return apply(lambda a, b: jnp.outer(a, b), x, y)
+
+
+def cross(x, y, axis=None, name=None):
+    ax = 9 if axis is None else axis  # numpy default resolution
+
+    def f(a, b):
+        use_ax = axis
+        if use_ax is None:
+            # paddle: first axis with dim 3
+            for i, s in enumerate(a.shape):
+                if s == 3:
+                    use_ax = i
+                    break
+        return jnp.cross(a, b, axis=use_ax)
+    return apply(f, x, y)
+
+
+def t(x, name=None):
+    return apply(lambda v: v.T if v.ndim <= 2 else jnp.swapaxes(v, -1, -2), x)
+
+
+def norm(x, p="fro", axis=None, keepdim=False, name=None):
+    def f(v):
+        if p == "fro" and axis is None:
+            return jnp.sqrt(jnp.sum(v * v))
+        if axis is None:
+            return jnp.linalg.norm(v.ravel(), ord=p, keepdims=keepdim)
+        ax = _axis(axis)
+        return jnp.linalg.norm(v, ord="fro" if p == "fro" else p, axis=ax,
+                               keepdims=keepdim)
+    return apply(f, x)
+
+
+def dist(x, y, p=2, name=None):
+    return apply(lambda a, b: jnp.linalg.norm((a - b).ravel(), ord=p), x, y)
+
+
+def histogram(x, bins=100, min=0, max=0, name=None):  # noqa: A002
+    v = unwrap(x)
+    lo, hi = (float(jnp.min(v)), float(jnp.max(v))) if min == 0 and max == 0 else (min, max)
+    h, _ = jnp.histogram(v, bins=bins, range=(lo, hi))
+    return Tensor(h.astype(jnp.int64))
+
+
+def bincount(x, weights=None, minlength=0, name=None):
+    v = unwrap(x)
+    return Tensor(jnp.bincount(v, unwrap(weights), minlength=minlength))
+
+
+def einsum(equation, *operands):
+    return apply(lambda *ops: jnp.einsum(equation, *ops), *operands)
+
+
+def tensordot(x, y, axes=2, name=None):
+    return apply(lambda a, b: jnp.tensordot(a, b, axes=axes), x, y)
+
+
+def kron(x, y, name=None):
+    return apply(jnp.kron, x, y)
+
+
+def trace(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.trace(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def diagonal(x, offset=0, axis1=0, axis2=1, name=None):
+    return apply(lambda v: jnp.diagonal(v, offset=offset, axis1=axis1, axis2=axis2), x)
+
+
+def mv(x, vec, name=None):
+    return apply(lambda a, b: a @ b, x, vec)
+
+
+def multiplex(inputs, index, name=None):
+    def f(idx, *vs):
+        stacked = jnp.stack(vs, axis=0)
+        return jnp.take_along_axis(
+            stacked, idx.reshape(1, -1, *([1] * (stacked.ndim - 2))).astype(jnp.int32),
+            axis=0)[0]
+    return apply(f, index, *inputs)
+
+
+class _Linalg:
+    """paddle.linalg namespace."""
+
+    @staticmethod
+    def norm(x, p="fro", axis=None, keepdim=False, name=None):
+        return norm(x, p, axis, keepdim)
+
+    @staticmethod
+    def matmul(x, y, transpose_x=False, transpose_y=False, name=None):
+        return matmul(x, y, transpose_x, transpose_y)
+
+    @staticmethod
+    def inv(x, name=None):
+        return apply(jnp.linalg.inv, x)
+
+    @staticmethod
+    def pinv(x, rcond=1e-15, hermitian=False, name=None):
+        return apply(lambda v: jnp.linalg.pinv(v, rcond=rcond, hermitian=hermitian), x)
+
+    @staticmethod
+    def det(x, name=None):
+        return apply(jnp.linalg.det, x)
+
+    @staticmethod
+    def slogdet(x, name=None):
+        def f(v):
+            sign, logdet = jnp.linalg.slogdet(v)
+            return jnp.stack([sign, logdet])
+        return apply(f, x)
+
+    @staticmethod
+    def svd(x, full_matrices=False, name=None):
+        return apply(lambda v: jnp.linalg.svd(v, full_matrices=full_matrices),
+                     x, _multi_out=True)
+
+    @staticmethod
+    def qr(x, mode="reduced", name=None):
+        return apply(lambda v: jnp.linalg.qr(v, mode=mode), x, _multi_out=True)
+
+    @staticmethod
+    def eig(x, name=None):
+        return apply(jnp.linalg.eig, x, _multi_out=True)
+
+    @staticmethod
+    def eigh(x, UPLO="L", name=None):
+        return apply(lambda v: jnp.linalg.eigh(v, UPLO=UPLO), x, _multi_out=True)
+
+    @staticmethod
+    def eigvals(x, name=None):
+        return apply(jnp.linalg.eigvals, x)
+
+    @staticmethod
+    def eigvalsh(x, UPLO="L", name=None):
+        return apply(lambda v: jnp.linalg.eigvalsh(v, UPLO=UPLO), x)
+
+    @staticmethod
+    def cholesky(x, upper=False, name=None):
+        def f(v):
+            c = jnp.linalg.cholesky(v)
+            return jnp.swapaxes(c, -1, -2) if upper else c
+        return apply(f, x)
+
+    @staticmethod
+    def solve(x, y, name=None):
+        return apply(jnp.linalg.solve, x, y)
+
+    @staticmethod
+    def triangular_solve(x, y, upper=True, transpose=False, unitriangular=False,
+                         name=None):
+        return apply(lambda a, b: jax.scipy.linalg.solve_triangular(
+            a, b, lower=not upper, trans=1 if transpose else 0,
+            unit_diagonal=unitriangular), x, y)
+
+    @staticmethod
+    def lstsq(x, y, rcond=None, driver=None, name=None):
+        return apply(lambda a, b: jnp.linalg.lstsq(a, b, rcond=rcond),
+                     x, y, _multi_out=True)
+
+    @staticmethod
+    def matrix_power(x, n, name=None):
+        return apply(lambda v: jnp.linalg.matrix_power(v, n), x)
+
+    @staticmethod
+    def matrix_rank(x, tol=None, hermitian=False, name=None):
+        return apply(lambda v: jnp.linalg.matrix_rank(v, tol=tol), x)
+
+    @staticmethod
+    def multi_dot(xs, name=None):
+        return apply(lambda *vs: jnp.linalg.multi_dot(vs), *xs)
+
+    @staticmethod
+    def cond(x, p=None, name=None):
+        return apply(lambda v: jnp.linalg.cond(v, p=p), x)
+
+
+linalg = _Linalg()
+
+
+# ---------------------------------------------------------------------------
+# dtype casting helper (paddle.cast)
+# ---------------------------------------------------------------------------
+def cast(x, dtype):
+    return x.astype(dtype) if isinstance(x, Tensor) else Tensor(unwrap(x)).astype(dtype)
+
+
+def increment(x, value=1.0, name=None):
+    out = apply(lambda v: v + jnp.asarray(value, v.dtype), x)
+    x._value = out.value
+    return x
+
+
+def numel(x, name=None):
+    return Tensor(jnp.asarray(x.size, jnp.int64))
+
+
+def rank(x):
+    return Tensor(jnp.asarray(unwrap(x).ndim, jnp.int32))
+
+
+def shape(x):
+    return Tensor(jnp.asarray(np.asarray(unwrap(x).shape), jnp.int32))
+
+
+def is_tensor(x):
+    return isinstance(x, Tensor)
+
+
+def is_complex(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.complexfloating)
+
+
+def is_integer(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.integer)
+
+
+def is_floating_point(x):
+    return jnp.issubdtype(unwrap(x).dtype, jnp.floating)
+
+
+def real(x, name=None):
+    return apply(jnp.real, x)
+
+
+def imag(x, name=None):
+    return apply(jnp.imag, x)
+
+
+def is_empty(x, name=None):
+    return Tensor(jnp.asarray(unwrap(x).size == 0))
+
+
+# ---------------------------------------------------------------------------
+# method installation on Tensor
+# ---------------------------------------------------------------------------
+_METHOD_NAMES = [
+    "abs", "acos", "acosh", "add", "all", "allclose", "amax", "amin", "angle",
+    "any", "argmax", "argmin", "argsort", "asin", "asinh", "astype", "atan",
+    "atan2", "atanh", "bincount", "bitwise_and", "bitwise_not", "bitwise_or",
+    "bitwise_xor", "bmm", "broadcast_to", "bucketize", "cast", "ceil", "chunk",
+    "clip", "concat", "conj", "cos", "cosh", "count_nonzero", "cross", "cumprod",
+    "cumsum", "diagonal", "digamma", "dist", "divide", "dot", "einsum", "equal",
+    "equal_all", "erf", "erfinv", "exp", "expand", "expand_as", "expm1",
+    "flatten", "flip", "floor", "floor_divide", "fmax", "fmin", "frac",
+    "gather", "gather_nd", "greater_equal", "greater_than", "histogram",
+    "imag", "index_sample", "index_select", "inner", "isclose", "isfinite",
+    "isinf", "isnan", "kron", "kthvalue", "lerp", "less_equal", "less_than",
+    "lgamma", "log", "log10", "log1p", "log2", "logcumsumexp", "logical_and",
+    "logical_not", "logical_or", "logical_xor", "logsumexp", "masked_fill",
+    "masked_select", "matmul", "max", "maximum", "mean", "median", "min",
+    "minimum", "mm", "mod", "mode", "moveaxis", "multiplex", "multiply", "mv",
+    "nan_to_num", "nanmean", "nansum", "neg", "nonzero", "norm", "not_equal",
+    "numel", "outer", "pad", "pow", "prod", "put_along_axis", "quantile",
+    "real", "reciprocal", "remainder", "repeat_interleave", "reshape", "roll",
+    "rot90", "round", "rsqrt", "scale", "scatter", "scatter_nd_add", "sign",
+    "sin", "sinh", "sort", "split", "sqrt", "square", "squeeze", "stack",
+    "std", "subtract", "sum", "swapaxes", "t", "take_along_axis", "tan",
+    "tanh_", "tensordot", "tile", "topk", "trace", "transpose", "tril", "triu",
+    "trunc", "unbind", "unique", "unsqueeze", "unstack", "var", "where",
+]
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x)
+
+
+def _install_methods():
+    mod = globals()
+    for name in _METHOD_NAMES + ["tanh", "sigmoid", "tril", "triu", "diag"]:
+        fn = mod.get(name)
+        if fn is None:
+            from . import creation
+
+            fn = getattr(creation, name, None)
+        if fn is None:
+            continue
+        if not hasattr(Tensor, name):
+            setattr(Tensor, name, fn)
+
+    # dunders
+    def _binop(fn, reflected=False):
+        def op(self, other):
+            if reflected:
+                return fn(other if isinstance(other, Tensor) else Tensor(np.asarray(other)), self)
+            return fn(self, other)
+        return op
+
+    Tensor.__add__ = _binop(add)
+    Tensor.__radd__ = _binop(add, True)
+    Tensor.__sub__ = _binop(subtract)
+    Tensor.__rsub__ = _binop(subtract, True)
+    Tensor.__mul__ = _binop(multiply)
+    Tensor.__rmul__ = _binop(multiply, True)
+    Tensor.__truediv__ = _binop(divide)
+    Tensor.__rtruediv__ = _binop(divide, True)
+    Tensor.__floordiv__ = _binop(floor_divide)
+    Tensor.__rfloordiv__ = _binop(floor_divide, True)
+    Tensor.__mod__ = _binop(mod)
+    Tensor.__rmod__ = _binop(mod, True)
+    Tensor.__pow__ = _binop(pow)
+    Tensor.__rpow__ = _binop(pow, True)
+    Tensor.__matmul__ = _binop(matmul)
+    Tensor.__rmatmul__ = _binop(matmul, True)
+    Tensor.__neg__ = lambda self: apply(jnp.negative, self)
+    Tensor.__abs__ = lambda self: apply(jnp.abs, self)
+    Tensor.__invert__ = lambda self: apply(jnp.logical_not, self)
+    Tensor.__eq__ = _binop(equal)
+    Tensor.__ne__ = _binop(not_equal)
+    Tensor.__lt__ = _binop(less_than)
+    Tensor.__le__ = _binop(less_equal)
+    Tensor.__gt__ = _binop(greater_than)
+    Tensor.__ge__ = _binop(greater_equal)
+    Tensor.__and__ = _binop(logical_and)
+    Tensor.__or__ = _binop(logical_or)
+    Tensor.__xor__ = _binop(logical_xor)
+
+
+_install_methods()

@@ -1,0 +1,63 @@
+"""Profiler.
+
+Reference parity: paddle/fluid/platform/profiler.* (RecordEvent RAII scopes,
+EnableProfiler/DisableProfiler, chrome-trace via tools/timeline.py) and
+python fluid/profiler.py.
+
+TPU-native: jax.profiler does the heavy lifting — traces carry XLA/TPU
+device activity and land in TensorBoard/perfetto format (the
+CUPTI DeviceTracer + timeline.py analog).  RecordEvent maps to
+jax.profiler.TraceAnnotation so named scopes appear inside device traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class RecordEvent:
+    """Named scope visible in profiler traces (platform/profiler.cc:53)."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self.begin = None
+
+    def __enter__(self):
+        self.begin = time.perf_counter()
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        self.elapsed = time.perf_counter() - self.begin
+        return False
+
+
+_trace_dir = None
+
+
+def start_profiler(log_dir="/tmp/paddle_tpu_profile", state=None,
+                   tracer_option=None):
+    global _trace_dir
+    _trace_dir = log_dir
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    jax.profiler.stop_trace()
+    print(f"profiler trace written to {_trace_dir} "
+          "(open with TensorBoard or perfetto)")
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile",
+             tracer_option=None):
+    """fluid.profiler.profiler context-manager parity (profiler.py:255)."""
+    start_profiler(profile_path, state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

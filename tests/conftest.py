@@ -1,0 +1,26 @@
+"""Test config: force a deterministic 8-device CPU mesh (SURVEY.md §4 —
+multi-process NCCL tests are replaced by virtual-device mesh tests)."""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+
+# The environment's sitecustomize may have imported jax already (TPU tunnel
+# plugin) with jax_platforms baked to the accelerator; tests are CPU-only, so
+# force the platform through jax.config — env vars alone are read too early.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed():
+    import paddle_tpu as paddle
+
+    paddle.seed(42)
+    yield

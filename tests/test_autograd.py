@@ -1,0 +1,137 @@
+"""Autograd tape tests — analytic grads vs numeric finite differences
+(the OpTest check_grad contract, reference op_test.py:1329/101)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def numeric_grad(fn, x, eps=1e-3):
+    g = np.zeros_like(x)
+    flat = x.reshape(-1)
+    gf = g.reshape(-1)
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        hi = fn(x.copy().reshape(x.shape))
+        flat[i] = orig - eps
+        lo = fn(x.copy().reshape(x.shape))
+        flat[i] = orig
+        gf[i] = (hi - lo) / (2 * eps)
+    return g
+
+
+def test_backward_simple():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    y = (x * x).sum()
+    y.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 4.0, 6.0])
+
+
+def test_backward_chain():
+    x = paddle.to_tensor([0.5, 1.5], stop_gradient=False)
+    y = paddle.exp(paddle.sin(x)).sum()
+    y.backward()
+    ref = np.exp(np.sin([0.5, 1.5])) * np.cos([0.5, 1.5])
+    np.testing.assert_allclose(x.grad.numpy(), ref, rtol=1e-5)
+
+
+def test_grad_accumulation():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    (x * 2).sum().backward()
+    (x * 3).sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [5.0])
+
+
+def test_shared_input_two_paths():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x + x * 3  # dy/dx = 2x + 3 = 7
+    y.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [7.0])
+
+
+def test_stop_gradient():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    y = paddle.to_tensor([2.0])  # stop_gradient True
+    z = (x * y).sum()
+    z.backward()
+    np.testing.assert_allclose(x.grad.numpy(), [2.0])
+    assert y.grad is None
+
+
+def test_detach():
+    x = paddle.to_tensor([3.0], stop_gradient=False)
+    y = x * 2
+    z = y.detach() * x
+    z.sum().backward()
+    np.testing.assert_allclose(x.grad.numpy(), [6.0])  # only the direct path
+
+
+def test_no_grad():
+    x = paddle.to_tensor([1.0], stop_gradient=False)
+    with paddle.no_grad():
+        y = x * 5
+    assert y.stop_gradient
+
+
+def test_non_scalar_backward_requires_grad_tensor():
+    x = paddle.to_tensor([1.0, 2.0], stop_gradient=False)
+    y = x * 2
+    with pytest.raises(RuntimeError):
+        y.backward()
+    y.backward(paddle.ones_like(y))
+    np.testing.assert_allclose(x.grad.numpy(), [2.0, 2.0])
+
+
+def test_matmul_grad_vs_numeric():
+    a = np.random.rand(3, 4).astype(np.float32)
+    b = np.random.rand(4, 2).astype(np.float32)
+    ta = paddle.to_tensor(a.copy(), stop_gradient=False)
+    tb = paddle.to_tensor(b.copy(), stop_gradient=False)
+    loss = paddle.sum(ta @ tb)
+    loss.backward()
+    ga = numeric_grad(lambda x: float((x @ b).sum()), a.astype(np.float64))
+    gb = numeric_grad(lambda x: float((a @ x).sum()), b.astype(np.float64))
+    np.testing.assert_allclose(ta.grad.numpy(), ga, rtol=1e-2, atol=1e-3)
+    np.testing.assert_allclose(tb.grad.numpy(), gb, rtol=1e-2, atol=1e-3)
+
+
+def test_softmax_xent_grad_vs_numeric():
+    import paddle_tpu.nn.functional as F
+
+    logits = np.random.randn(4, 5).astype(np.float64)
+    label = np.array([0, 2, 4, 1])
+
+    def ref(z):
+        zz = z - z.max(-1, keepdims=True)
+        logp = zz - np.log(np.exp(zz).sum(-1, keepdims=True))
+        return -logp[np.arange(4), label].mean()
+
+    t = paddle.to_tensor(logits.astype(np.float32), stop_gradient=False)
+    loss = F.cross_entropy(t, paddle.to_tensor(label))
+    loss.backward()
+    g = numeric_grad(ref, logits.copy())
+    np.testing.assert_allclose(t.grad.numpy(), g, rtol=1e-2, atol=1e-4)
+
+
+def test_paddle_grad_api():
+    x = paddle.to_tensor([2.0], stop_gradient=False)
+    y = x * x * x
+    (gx,) = [paddle.grad(y, [x])] if False else [paddle.grad(y.sum(), [x])]
+    np.testing.assert_allclose(gx.numpy(), [12.0])
+    assert x.grad is None  # grad() must not touch .grad
+
+
+def test_multi_output_op_grad():
+    x = paddle.to_tensor(np.arange(6, dtype=np.float32).reshape(2, 3),
+                         stop_gradient=False)
+    a, b = paddle.split(x, 2, axis=0)
+    (a.sum() * 2 + b.sum() * 3).backward()
+    np.testing.assert_allclose(x.grad.numpy(),
+                               [[2, 2, 2], [3, 3, 3]])
+
+
+def test_getitem_grad():
+    x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
+    x[1].backward()
+    np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0])

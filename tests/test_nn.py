@@ -1,0 +1,214 @@
+"""nn.Layer system + layer zoo tests."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_shapes_and_params():
+    l = nn.Linear(4, 3)
+    assert l.weight.shape == [4, 3]
+    assert l.bias.shape == [3]
+    out = l(paddle.randn([2, 4]))
+    assert out.shape == [2, 3]
+    assert len(l.parameters()) == 2
+
+
+def test_layer_registration_and_state_dict():
+    class Net(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.fc1 = nn.Linear(4, 8)
+            self.fc2 = nn.Linear(8, 2)
+
+        def forward(self, x):
+            return self.fc2(F.relu(self.fc1(x)))
+
+    net = Net()
+    sd = net.state_dict()
+    assert set(sd) == {"fc1.weight", "fc1.bias", "fc2.weight", "fc2.bias"}
+    # round trip
+    sd2 = {k: paddle.zeros(v.shape) for k, v in sd.items()}
+    net.set_state_dict(sd2)
+    assert float(net.fc1.weight.numpy().sum()) == 0.0
+
+
+def test_sequential_and_layerlist():
+    s = nn.Sequential(nn.Linear(4, 4), nn.ReLU(), nn.Linear(4, 2))
+    assert s(paddle.randn([1, 4])).shape == [1, 2]
+    ll = nn.LayerList([nn.Linear(2, 2) for _ in range(3)])
+    assert len(ll) == 3
+    assert len(list(ll.parameters())) == 6
+
+
+def test_conv2d_matches_reference():
+    import jax.numpy as jnp
+
+    conv = nn.Conv2D(2, 4, 3, padding=1)
+    x = paddle.randn([1, 2, 8, 8])
+    out = conv(x)
+    assert out.shape == [1, 4, 8, 8]
+    # stride + no padding
+    conv2 = nn.Conv2D(2, 4, 3, stride=2, padding=0)
+    assert conv2(x).shape == [1, 4, 3, 3]
+    # groups
+    conv3 = nn.Conv2D(4, 4, 3, padding=1, groups=2)
+    assert conv3(paddle.randn([1, 4, 5, 5])).shape == [1, 4, 5, 5]
+
+
+def test_conv2d_transpose():
+    deconv = nn.Conv2DTranspose(3, 2, 4, stride=2, padding=1)
+    out = deconv(paddle.randn([1, 3, 8, 8]))
+    assert out.shape == [1, 2, 16, 16]
+
+
+def test_pooling():
+    x = paddle.randn([1, 2, 8, 8])
+    assert nn.MaxPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AvgPool2D(2, 2)(x).shape == [1, 2, 4, 4]
+    assert nn.AdaptiveAvgPool2D(1)(x).shape == [1, 2, 1, 1]
+    a = np.random.rand(1, 1, 4, 4).astype(np.float32)
+    out = nn.AvgPool2D(2, 2)(paddle.to_tensor(a)).numpy()
+    ref = a.reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_batchnorm_train_eval():
+    bn = nn.BatchNorm2D(3)
+    x = paddle.randn([4, 3, 5, 5]) * 3 + 1
+    bn.train()
+    out = bn(x)
+    # normalized output: near zero mean, unit var per channel
+    o = out.numpy()
+    assert abs(o.mean()) < 1e-2
+    assert abs(o.std() - 1) < 5e-2
+    # running stats moved off init
+    assert not np.allclose(bn._mean.numpy(), 0.0)
+    bn.eval()
+    out_eval = bn(x)
+    assert out_eval.shape == [4, 3, 5, 5]
+
+
+def test_layernorm_matches_numpy():
+    ln = nn.LayerNorm(8)
+    x = np.random.rand(2, 4, 8).astype(np.float32)
+    out = ln(paddle.to_tensor(x)).numpy()
+    mean = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ref = (x - mean) / np.sqrt(var + 1e-5)
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_embedding_and_padding_idx():
+    emb = nn.Embedding(10, 4, padding_idx=0)
+    ids = paddle.to_tensor(np.array([[0, 1, 2]]))
+    out = emb(ids)
+    assert out.shape == [1, 3, 4]
+    np.testing.assert_allclose(out.numpy()[0, 0], 0.0)
+
+
+def test_dropout_train_vs_eval():
+    d = nn.Dropout(0.5)
+    x = paddle.ones([1000])
+    d.train()
+    y = d(x)
+    dropped = float((y.numpy() == 0).mean())
+    assert 0.3 < dropped < 0.7
+    d.eval()
+    np.testing.assert_allclose(d(x).numpy(), x.numpy())
+
+
+def test_activations():
+    x = paddle.to_tensor(np.linspace(-2, 2, 9, dtype=np.float32))
+    np.testing.assert_allclose(nn.ReLU()(x).numpy(),
+                               np.maximum(x.numpy(), 0))
+    assert nn.GELU()(x).shape == [9]
+    np.testing.assert_allclose(nn.Sigmoid()(x).numpy(),
+                               1 / (1 + np.exp(-x.numpy())), rtol=1e-5)
+    sm = nn.Softmax(-1)(paddle.randn([3, 5]))
+    np.testing.assert_allclose(sm.numpy().sum(-1), 1.0, rtol=1e-5)
+
+
+def test_losses():
+    logits = paddle.randn([4, 5])
+    label = paddle.to_tensor(np.array([0, 1, 2, 3]))
+    ce = nn.CrossEntropyLoss()(logits, label)
+    assert ce.shape == []
+    mse = nn.MSELoss()(paddle.ones([3]), paddle.zeros([3]))
+    np.testing.assert_allclose(mse.numpy(), 1.0)
+    l1 = nn.L1Loss()(paddle.ones([3]) * 2, paddle.zeros([3]))
+    np.testing.assert_allclose(l1.numpy(), 2.0)
+    bce = nn.BCEWithLogitsLoss()(paddle.zeros([4]), paddle.ones([4]) * 0.5)
+    np.testing.assert_allclose(bce.numpy(), np.log(2), rtol=1e-5)
+
+
+def test_lstm_and_gru():
+    lstm = nn.LSTM(8, 16, num_layers=2)
+    x = paddle.randn([4, 10, 8])  # [B, S, I]
+    out, (h, c) = lstm(x)
+    assert out.shape == [4, 10, 16]
+    assert h.shape == [2, 4, 16]
+    assert c.shape == [2, 4, 16]
+
+    gru = nn.GRU(8, 16, direction="bidirect")
+    out, h = gru(x)
+    assert out.shape == [4, 10, 32]
+    assert h.shape == [2, 4, 16]
+
+
+def test_rnn_grad_flows():
+    lstm = nn.LSTM(4, 8)
+    x = paddle.randn([2, 5, 4])
+    out, _ = lstm(x)
+    out.sum().backward()
+    for p in lstm.parameters():
+        assert p.grad is not None
+
+
+def test_multihead_attention():
+    mha = nn.MultiHeadAttention(16, 4)
+    q = paddle.randn([2, 6, 16])
+    out = mha(q, q, q)
+    assert out.shape == [2, 6, 16]
+
+
+def test_transformer_encoder():
+    layer = nn.TransformerEncoderLayer(d_model=16, nhead=4, dim_feedforward=32)
+    enc = nn.TransformerEncoder(layer, num_layers=2)
+    src = paddle.randn([2, 8, 16])
+    out = enc(src)
+    assert out.shape == [2, 8, 16]
+    # each stacked layer must have independent params
+    p0 = enc.layers[0].linear1.weight.numpy()
+    p1 = enc.layers[1].linear1.weight.numpy()
+    assert not np.allclose(p0, p1)
+
+
+def test_full_transformer():
+    t = nn.Transformer(d_model=16, nhead=4, num_encoder_layers=2,
+                       num_decoder_layers=2, dim_feedforward=32)
+    src = paddle.randn([2, 6, 16])
+    tgt = paddle.randn([2, 4, 16])
+    out = t(src, tgt)
+    assert out.shape == [2, 4, 16]
+
+
+def test_grad_clip_global_norm():
+    clip = nn.ClipGradByGlobalNorm(1.0)
+    p = paddle.to_tensor([3.0, 4.0], stop_gradient=False)
+    pg = clip([(p, paddle.to_tensor([3.0, 4.0]))])
+    np.testing.assert_allclose(np.linalg.norm(pg[0][1].numpy()), 1.0,
+                               rtol=1e-4)
+
+
+def test_hooks():
+    l = nn.Linear(2, 2)
+    calls = []
+    h = l.register_forward_post_hook(lambda layer, inp, out: calls.append(1))
+    l(paddle.randn([1, 2]))
+    assert calls == [1]
+    h.remove()
+    l(paddle.randn([1, 2]))
+    assert calls == [1]

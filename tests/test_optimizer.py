@@ -1,0 +1,164 @@
+"""Optimizer tests: update-rule math vs references + lr schedulers."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+def quad_problem(opt_cls, steps=100, **kw):
+    """Minimize ||x - c||^2; returns final distance."""
+    paddle.seed(0)
+    target = np.array([1.0, -2.0, 3.0], np.float32)
+    x = nn.Parameter(np.zeros(3, np.float32))
+    opt = opt_cls(parameters=[x], **kw)
+    for _ in range(steps):
+        loss = ((x - paddle.to_tensor(target)) ** 2).sum()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+    return float(np.abs(x.numpy() - target).max())
+
+
+def test_sgd_converges():
+    assert quad_problem(paddle.optimizer.SGD, learning_rate=0.1) < 1e-3
+
+
+def test_momentum_converges():
+    assert quad_problem(paddle.optimizer.Momentum, steps=200,
+                        learning_rate=0.05, momentum=0.9) < 1e-3
+
+
+def test_adam_converges():
+    assert quad_problem(paddle.optimizer.Adam, steps=300,
+                        learning_rate=0.1) < 1e-2
+
+
+def test_adamw_decay():
+    # with pure decay and zero grads, weights shrink
+    x = nn.Parameter(np.ones(3, np.float32))
+    opt = paddle.optimizer.AdamW(learning_rate=0.1, parameters=[x],
+                                 weight_decay=0.5)
+    loss = (x * 0.0).sum()
+    loss.backward()
+    opt.step()
+    assert np.all(x.numpy() < 1.0)
+
+
+def test_sgd_matches_manual():
+    x = nn.Parameter(np.array([2.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.5, parameters=[x])
+    (x * 3.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [2.0 - 0.5 * 3.0], rtol=1e-6)
+
+
+def test_adam_matches_manual_first_step():
+    x = nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[x])
+    (x * 2.0).sum().backward()
+    opt.step()
+    # first adam step ~ -lr * g/|g| = -0.1
+    np.testing.assert_allclose(x.numpy(), [0.9], atol=1e-5)
+
+
+def test_all_optimizers_run():
+    for cls, kw in [
+        (paddle.optimizer.Adagrad, dict(learning_rate=0.1)),
+        (paddle.optimizer.Adamax, dict(learning_rate=0.1)),
+        (paddle.optimizer.Adadelta, dict(learning_rate=1.0)),
+        (paddle.optimizer.RMSProp, dict(learning_rate=0.01)),
+        (paddle.optimizer.Lamb, dict(learning_rate=0.01)),
+        (paddle.optimizer.LarsMomentum, dict(learning_rate=0.1)),
+        (paddle.optimizer.Ftrl, dict(learning_rate=0.1)),
+    ]:
+        d = quad_problem(cls, steps=50, **kw)
+        assert np.isfinite(d), cls.__name__
+
+
+def test_weight_decay_l2():
+    x = nn.Parameter(np.array([1.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=0.1, parameters=[x],
+                               weight_decay=0.1)
+    (x * 0.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [1.0 - 0.1 * 0.1], rtol=1e-5)
+
+
+def test_grad_clip_in_optimizer():
+    x = nn.Parameter(np.array([0.0], np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=1.0, parameters=[x],
+                               grad_clip=nn.ClipGradByGlobalNorm(0.5))
+    (x * 100.0).sum().backward()
+    opt.step()
+    np.testing.assert_allclose(x.numpy(), [-0.5], rtol=1e-4)
+
+
+def test_lr_scheduler_step_decay():
+    sched = paddle.optimizer.lr.StepDecay(learning_rate=1.0, step_size=2,
+                                          gamma=0.1)
+    x = nn.Parameter(np.zeros(1, np.float32))
+    opt = paddle.optimizer.SGD(learning_rate=sched, parameters=[x])
+    lrs = []
+    for _ in range(4):
+        lrs.append(opt.get_lr())
+        sched.step()
+    np.testing.assert_allclose(lrs, [1.0, 1.0, 0.1, 0.1], rtol=1e-6)
+
+
+def test_lr_warmup():
+    sched = paddle.optimizer.lr.LinearWarmup(
+        learning_rate=0.1, warmup_steps=5, start_lr=0.0, end_lr=0.1)
+    vals = []
+    for _ in range(7):
+        vals.append(sched())
+        sched.step()
+    assert vals[0] == 0.0
+    np.testing.assert_allclose(vals[5], 0.1, rtol=1e-6)
+
+
+def test_cosine_annealing():
+    sched = paddle.optimizer.lr.CosineAnnealingDecay(learning_rate=1.0,
+                                                     T_max=10)
+    v0 = sched()
+    for _ in range(10):
+        sched.step()
+    np.testing.assert_allclose(v0, 1.0)
+    np.testing.assert_allclose(sched(), 0.0, atol=1e-6)
+
+
+def test_optimizer_state_dict_roundtrip():
+    x = nn.Parameter(np.ones(3, np.float32), name="p0")
+    opt = paddle.optimizer.Adam(learning_rate=0.1, parameters=[x])
+    (x * 2).sum().backward()
+    opt.step()
+    sd = opt.state_dict()
+    opt2 = paddle.optimizer.Adam(learning_rate=0.1, parameters=[x])
+    opt2.set_state_dict(sd)
+    assert opt2._step_count == 1
+    np.testing.assert_allclose(
+        np.asarray(opt2._slots[id(x)]["moment1"]),
+        np.asarray(opt._slots[id(x)]["moment1"]))
+
+
+def test_functional_apply_pytree_matches_eager():
+    import jax.numpy as jnp
+
+    paddle.seed(3)
+    w = np.random.rand(4, 2).astype(np.float32)
+    g = np.random.rand(4, 2).astype(np.float32)
+
+    # eager
+    p = nn.Parameter(w.copy())
+    opt = paddle.optimizer.Adam(learning_rate=0.01, parameters=[p])
+    p.grad = paddle.to_tensor(g.copy())
+    opt.step()
+
+    # functional
+    opt2 = paddle.optimizer.Adam(learning_rate=0.01)
+    params = {"w": jnp.asarray(w)}
+    state = opt2.init_pytree(params)
+    new_params, _ = opt2.apply_pytree(params, {"w": jnp.asarray(g)}, state,
+                                      lr=0.01, step=1)
+    np.testing.assert_allclose(p.numpy(), np.asarray(new_params["w"]),
+                               rtol=1e-5)

@@ -1,0 +1,143 @@
+"""Tensor surface tests (OpTest-style numpy-reference checks, SURVEY.md §4)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_to_tensor_roundtrip():
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    t = paddle.to_tensor(a)
+    assert t.shape == [3, 4]
+    assert str(t.dtype) == "float32"
+    np.testing.assert_allclose(t.numpy(), a)
+
+
+def test_default_float64_downcast():
+    t = paddle.to_tensor(np.zeros(3))  # float64 numpy -> default dtype
+    assert str(t.dtype) == "float32"
+
+
+def test_arithmetic_matches_numpy():
+    a = np.random.rand(4, 5).astype(np.float32)
+    b = np.random.rand(4, 5).astype(np.float32) + 0.5
+    ta, tb = paddle.to_tensor(a), paddle.to_tensor(b)
+    np.testing.assert_allclose((ta + tb).numpy(), a + b, rtol=1e-6)
+    np.testing.assert_allclose((ta - tb).numpy(), a - b, rtol=1e-6)
+    np.testing.assert_allclose((ta * tb).numpy(), a * b, rtol=1e-6)
+    np.testing.assert_allclose((ta / tb).numpy(), a / b, rtol=1e-5)
+    np.testing.assert_allclose((ta ** 2).numpy(), a ** 2, rtol=1e-6)
+    np.testing.assert_allclose((-ta).numpy(), -a)
+    np.testing.assert_allclose((ta @ tb.T).numpy(), a @ b.T, rtol=1e-5)
+
+
+def test_scalar_mixing():
+    t = paddle.to_tensor([1.0, 2.0])
+    np.testing.assert_allclose((2 * t + 1).numpy(), [3.0, 5.0])
+    np.testing.assert_allclose((1 - t).numpy(), [0.0, -1.0])
+
+
+def test_reductions():
+    a = np.random.rand(3, 4, 5).astype(np.float32)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.sum(t).numpy(), a.sum(), rtol=1e-5)
+    np.testing.assert_allclose(paddle.mean(t, axis=1).numpy(), a.mean(1),
+                               rtol=1e-5)
+    np.testing.assert_allclose(paddle.max(t, axis=-1, keepdim=True).numpy(),
+                               a.max(-1, keepdims=True))
+    np.testing.assert_allclose(paddle.prod(t, axis=0).numpy(), a.prod(0),
+                               rtol=1e-4)
+    np.testing.assert_allclose(paddle.std(t).numpy(), a.std(ddof=1), rtol=1e-4)
+
+
+def test_manipulation():
+    a = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t = paddle.to_tensor(a)
+    assert paddle.reshape(t, [0, -1]).shape == [2, 12]  # 0 = copy dim
+    assert paddle.transpose(t, [2, 0, 1]).shape == [4, 2, 3]
+    assert paddle.squeeze(paddle.unsqueeze(t, 0), 0).shape == [2, 3, 4]
+    assert paddle.flatten(t, 1).shape == [2, 12]
+    c = paddle.concat([t, t], axis=1)
+    assert c.shape == [2, 6, 4]
+    s = paddle.split(t, 3, axis=1)
+    assert len(s) == 3 and s[0].shape == [2, 1, 4]
+    s2 = paddle.split(t, [1, -1], axis=2)
+    assert s2[1].shape == [2, 3, 3]
+    st = paddle.stack([t, t], axis=0)
+    assert st.shape == [2, 2, 3, 4]
+    assert paddle.tile(t, [2, 1, 1]).shape == [4, 3, 4]
+
+
+def test_indexing_and_gather():
+    a = np.arange(20, dtype=np.float32).reshape(4, 5)
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(t[1].numpy(), a[1])
+    np.testing.assert_allclose(t[1:3, 2:].numpy(), a[1:3, 2:])
+    idx = paddle.to_tensor(np.array([0, 2]))
+    np.testing.assert_allclose(paddle.gather(t, idx, axis=0).numpy(), a[[0, 2]])
+    np.testing.assert_allclose(
+        paddle.index_select(t, idx, axis=1).numpy(), a[:, [0, 2]])
+
+
+def test_where_and_compare():
+    a = np.random.randn(3, 4).astype(np.float32)
+    t = paddle.to_tensor(a)
+    out = paddle.where(t > 0, t, paddle.zeros_like(t))
+    np.testing.assert_allclose(out.numpy(), np.where(a > 0, a, 0))
+    assert (t > 0).numpy().dtype == np.bool_
+
+
+def test_topk_argsort():
+    a = np.random.rand(5, 10).astype(np.float32)
+    t = paddle.to_tensor(a)
+    vals, idx = paddle.topk(t, 3)
+    ref = np.sort(a, axis=-1)[:, ::-1][:, :3]
+    np.testing.assert_allclose(vals.numpy(), ref, rtol=1e-6)
+    order = paddle.argsort(t, descending=True)
+    np.testing.assert_allclose(
+        np.take_along_axis(a, order.numpy(), -1)[:, :3], ref, rtol=1e-6)
+
+
+def test_cast_astype():
+    t = paddle.to_tensor([1.5, 2.5])
+    assert str(t.astype("int32").dtype) == "int32"
+    assert str(paddle.cast(t, "float64").dtype) == "float64"
+
+
+def test_creation_ops():
+    assert paddle.zeros([2, 3]).shape == [2, 3]
+    assert paddle.ones([2], dtype="int64").numpy().sum() == 2
+    np.testing.assert_allclose(paddle.arange(5).numpy(), np.arange(5))
+    assert paddle.eye(3).numpy().trace() == 3.0
+    assert paddle.full([2, 2], 7.0).numpy().sum() == 28.0
+    r = paddle.rand([100])
+    assert 0 <= r.numpy().min() and r.numpy().max() <= 1
+    assert paddle.randn([10, 10]).shape == [10, 10]
+    p = paddle.randperm(10).numpy()
+    assert sorted(p.tolist()) == list(range(10))
+
+
+def test_linalg():
+    a = np.random.rand(4, 4).astype(np.float32) + np.eye(4, dtype=np.float32) * 4
+    t = paddle.to_tensor(a)
+    np.testing.assert_allclose(paddle.linalg.inv(t).numpy(), np.linalg.inv(a),
+                               rtol=1e-3, atol=1e-4)
+    np.testing.assert_allclose(paddle.linalg.det(t).numpy(), np.linalg.det(a),
+                               rtol=1e-4)
+    c = paddle.linalg.cholesky(paddle.to_tensor(a @ a.T))
+    np.testing.assert_allclose((c @ c.T).numpy(), a @ a.T, rtol=1e-3, atol=1e-3)
+
+
+def test_einsum():
+    a = np.random.rand(2, 3).astype(np.float32)
+    b = np.random.rand(3, 4).astype(np.float32)
+    out = paddle.einsum("ij,jk->ik", paddle.to_tensor(a), paddle.to_tensor(b))
+    np.testing.assert_allclose(out.numpy(), a @ b, rtol=1e-5)
+
+
+def test_inplace_setitem():
+    t = paddle.zeros([3, 3])
+    t[1, 1] = 5.0
+    assert t.numpy()[1, 1] == 5.0
+    t[0] = paddle.ones([3])
+    np.testing.assert_allclose(t.numpy()[0], 1.0)
