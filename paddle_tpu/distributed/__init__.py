@@ -31,3 +31,12 @@ from .mesh import (  # noqa: F401
     set_mesh,
 )
 from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    param_sharding,
+    shard_constraint,
+    split,
+)
