@@ -31,6 +31,17 @@ from .mesh import (  # noqa: F401
     set_mesh,
 )
 from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_step_fn,
+    spmd_pipeline,
+    stack_stage_params,
+    unstack_stage_params,
+)
+from .sharding import zero_shardings, shard_spec  # noqa: F401
+# NOTE: the recompute FUNCTION is exported via fleet.utils (paddle parity);
+# re-exporting it here would shadow the .recompute submodule.
+from . import recompute as _recompute_mod  # noqa: F401
+from .grad_merge import gradient_merge, split_microbatches  # noqa: F401
 from .meta_parallel import (  # noqa: F401
     ColumnParallelLinear,
     ParallelCrossEntropy,
