@@ -38,8 +38,9 @@ from .pipeline import (  # noqa: F401
     unstack_stage_params,
 )
 from .sharding import zero_shardings, shard_spec  # noqa: F401
-# NOTE: the recompute FUNCTION is exported via fleet.utils (paddle parity);
-# re-exporting it here would shadow the .recompute submodule.
+# NOTE: the recompute FUNCTION lives at distributed.recompute.recompute
+# (and fleet.utils re-exports it for paddle parity); re-exporting it here
+# would shadow the .recompute submodule.
 from . import recompute as _recompute_mod  # noqa: F401
 from .grad_merge import gradient_merge, split_microbatches  # noqa: F401
 from .meta_parallel import (  # noqa: F401

@@ -28,8 +28,13 @@ POLICIES = {
 
 def checkpoint(function, policy=None, prevent_cse=True, static_argnums=()):
     """Wrap `function` so its activations are rematerialized in backward."""
-    pol = POLICIES.get(policy, policy) if isinstance(policy, (str, type(None))) \
-        else policy
+    if isinstance(policy, str):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown recompute policy {policy!r}; one of "
+                             f"{sorted(k for k in POLICIES if k)}")
+        pol = POLICIES[policy]
+    else:
+        pol = policy
     return jax.checkpoint(function, policy=pol, prevent_cse=prevent_cse,
                           static_argnums=static_argnums)
 
