@@ -14,17 +14,25 @@ import jax
 
 class ParallelEnv:
     def __init__(self):
-        self._rank = int(os.environ.get("PADDLE_TRAINER_ID",
-                                        str(jax.process_index())))
-        self._world_size = int(os.environ.get("PADDLE_TRAINERS_NUM",
-                                              str(jax.process_count())))
+        # jax.process_index() initializes the XLA backend, which must not
+        # happen before jax.distributed.initialize — consult it only when
+        # NEITHER env var is set (all-or-nothing: a partially-set
+        # PADDLE_TRAINER_* env must not touch the backend either)
+        rank = os.environ.get("PADDLE_TRAINER_ID")
+        world = os.environ.get("PADDLE_TRAINERS_NUM")
+        if rank is None and world is None:
+            self._rank = jax.process_index()
+            self._world_size = jax.process_count()
+        else:
+            self._rank = int(rank or 0)
+            self._world_size = int(world or 1)
         eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
         self._trainer_endpoints = eps.split(",") if eps else []
         self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
-        self._device_id = int(os.environ.get("FLAGS_selected_tpus",
-                                             os.environ.get("FLAGS_selected_gpus",
-                                                            "0").split(",")[0] or 0)
-                              if not isinstance(os.environ.get("FLAGS_selected_tpus"), int) else 0)
+        devs = os.environ.get("FLAGS_selected_tpus",
+                              os.environ.get("FLAGS_selected_gpus", "0"))
+        first = devs.split(",")[0].strip()
+        self._device_id = int(first) if first else 0
 
     @property
     def rank(self):

@@ -34,7 +34,10 @@ def init_parallel_env(mesh_shape=None):
     if _initialized:
         return ParallelEnv()
     env = ParallelEnv()
-    if env.world_size > 1 and jax.process_count() == 1:
+    # probe the coordination client WITHOUT jax.process_count(): that call
+    # initializes the XLA backend, after which initialize() is illegal
+    already = jax.distributed.is_initialized()
+    if env.world_size > 1 and not already:
         # PADDLE_TRAINER_* style launch: initialize jax.distributed from env
         coord = os.environ.get("PADDLE_MASTER",
                                (env.trainer_endpoints or [""])[0])

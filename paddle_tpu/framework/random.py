@@ -15,19 +15,37 @@ import jax
 
 
 class _GeneratorState(threading.local):
+    """Key creation is LAZY: touching jax.random at import time would
+    initialize the XLA backend and break a later
+    jax.distributed.initialize() (it must run before any backend use —
+    the multi-process fleet/launch path)."""
+
     def __init__(self):
-        self.key = jax.random.PRNGKey(0)
+        self._key = None
         self.seed_value = 0
         # stack of explicitly-provided keys for traced code
         self.guard_stack: list = []
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self.seed_value)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
 
 
 _state = _GeneratorState()
 
 
 def seed(s: int):
-    _state.key = jax.random.PRNGKey(s)
+    # lazy: materializing the key here would initialize the XLA backend,
+    # breaking a later jax.distributed.initialize() (seed-before-init is a
+    # normal reproducibility pattern)
     _state.seed_value = int(s)
+    _state._key = None
     return _state
 
 

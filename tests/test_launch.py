@@ -1,0 +1,172 @@
+"""Launcher tests — real subprocesses on localhost, mirroring the
+reference's TestDistBase style (SURVEY.md §4: multi-node is only ever
+exercised as multi-process on 127.0.0.1)."""
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from paddle_tpu.distributed.launch import _parse_args, get_cluster_from_args
+from paddle_tpu.distributed.launch_utils import (
+    Cluster,
+    Pod,
+    Trainer,
+    find_free_ports,
+    get_cluster,
+    start_local_trainers,
+    terminate_local_procs,
+    watch_local_trainers,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestClusterSpec:
+    def test_get_cluster(self):
+        ips = ["10.0.0.1", "10.0.0.2"]
+        eps = [f"{ip}:{p}" for ip in ips for p in (6070, 6071)]
+        cluster, pod = get_cluster(ips, "10.0.0.2", eps, 2)
+        assert cluster.trainers_nranks() == 4
+        assert cluster.trainers_endpoints() == eps
+        assert pod.rank == 1
+        assert [t.rank for t in pod.trainers] == [2, 3]
+
+    def test_parse_args_and_cluster(self):
+        args = _parse_args(["--ips=127.0.0.1", "--nproc_per_node=2",
+                            "--started_port=6170", "train.py", "--lr=0.1"])
+        assert args.training_script == "train.py"
+        assert args.training_script_args == ["--lr=0.1"]
+        cluster, pod = get_cluster_from_args(args)
+        assert cluster.trainers_nranks() == 2
+        assert cluster.trainers_endpoints() == ["127.0.0.1:6170",
+                                                "127.0.0.1:6171"]
+
+    def test_find_free_ports(self):
+        ports = find_free_ports(3)
+        assert len(set(ports)) == 3
+
+
+def _write_script(tmp_path, body):
+    script = tmp_path / "trainer.py"
+    script.write_text(textwrap.dedent(body))
+    return str(script)
+
+
+class TestLocalTrainers:
+    def test_env_contract_and_success(self, tmp_path):
+        """Each spawned trainer sees the PADDLE_TRAINER_* env schema."""
+        script = _write_script(tmp_path, """
+            import json, os, sys
+            rank = os.environ["PADDLE_TRAINER_ID"]
+            out = {
+                "rank": rank,
+                "nranks": os.environ["PADDLE_TRAINERS_NUM"],
+                "endpoint": os.environ["PADDLE_CURRENT_ENDPOINT"],
+                "endpoints": os.environ["PADDLE_TRAINER_ENDPOINTS"],
+                "master": os.environ["PADDLE_MASTER"],
+            }
+            open(os.path.join(os.path.dirname(__file__),
+                              f"out.{rank}.json"), "w").write(json.dumps(out))
+        """)
+        eps = [f"127.0.0.1:{p}" for p in find_free_ports(2)]
+        cluster, pod = get_cluster(["127.0.0.1"], "127.0.0.1", eps, 2)
+        procs = start_local_trainers(cluster, pod, script, [],
+                                     log_dir=str(tmp_path / "logs"))
+        codes = watch_local_trainers(procs, 2, poll_interval=0.1)
+        assert codes == [0, 0]
+        import json
+        for rank in (0, 1):
+            d = json.loads((tmp_path / f"out.{rank}.json").read_text())
+            assert d["rank"] == str(rank)
+            assert d["nranks"] == "2"
+            assert d["endpoint"] == eps[rank]
+            assert d["endpoints"] == ",".join(eps)
+            assert d["master"] == eps[0]
+        # log files exist
+        assert (tmp_path / "logs" / "workerlog.0").exists()
+
+    def test_failure_tears_down_pod(self, tmp_path):
+        """Reference policy: any trainer failure kills the pod
+        (launch_utils.py:517) — no elastic restart."""
+        script = _write_script(tmp_path, """
+            import os, sys, time
+            if os.environ["PADDLE_TRAINER_ID"] == "1":
+                sys.exit(3)
+            time.sleep(60)   # rank 0 would hang forever
+        """)
+        eps = [f"127.0.0.1:{p}" for p in find_free_ports(2)]
+        cluster, pod = get_cluster(["127.0.0.1"], "127.0.0.1", eps, 2)
+        procs = start_local_trainers(cluster, pod, script, [])
+        with pytest.raises(RuntimeError, match="trainer 1 failed"):
+            watch_local_trainers(procs, 2, poll_interval=0.1)
+        # rank 0 must have been terminated too
+        assert all(tp.proc.poll() is not None for tp in procs)
+
+
+class TestLaunchCLI:
+    def test_module_entrypoint(self, tmp_path):
+        script = _write_script(tmp_path, """
+            import os
+            assert os.environ["PADDLE_TRAINERS_NUM"] == "2"
+            print("trainer", os.environ["PADDLE_TRAINER_ID"], "ok")
+        """)
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=2", "--log_dir", str(tmp_path / "lg"),
+             script],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode == 0, r.stderr
+        logs = sorted((tmp_path / "lg").iterdir())
+        assert len(logs) == 2
+        assert "ok" in logs[0].read_text()
+
+
+class TestSpawn:
+    def test_spawn_env(self, tmp_path):
+        """spawn() runs func in N processes with the trainer env set."""
+        script = _write_script(tmp_path, """
+            import os, sys
+            sys.path.insert(0, %r)
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+            def work(out_dir):
+                import os
+                rank = os.environ["PADDLE_TRAINER_ID"]
+                open(os.path.join(out_dir, f"sp.{rank}"), "w").write(
+                    os.environ["PADDLE_TRAINERS_NUM"])
+
+            if __name__ == "__main__":
+                from paddle_tpu.distributed.spawn import spawn
+                spawn(work, args=(sys.argv[1],), nprocs=2)
+        """ % REPO)
+        r = subprocess.run([sys.executable, script, str(tmp_path)],
+                           capture_output=True, text=True, timeout=120,
+                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr
+        assert (tmp_path / "sp.0").read_text() == "2"
+        assert (tmp_path / "sp.1").read_text() == "2"
+
+    def test_spawn_failure_propagates(self, tmp_path):
+        script = _write_script(tmp_path, """
+            import sys
+            sys.path.insert(0, %r)
+
+            def bad():
+                raise ValueError("boom-42")
+
+            if __name__ == "__main__":
+                from paddle_tpu.distributed.spawn import spawn
+                try:
+                    spawn(bad, nprocs=2)
+                except RuntimeError as e:
+                    assert "boom-42" in str(e)
+                    sys.exit(0)
+                sys.exit(1)
+        """ % REPO)
+        r = subprocess.run([sys.executable, script], capture_output=True,
+                           text=True, timeout=120,
+                           env=dict(os.environ, JAX_PLATFORMS="cpu"))
+        assert r.returncode == 0, r.stderr + r.stdout
