@@ -1,0 +1,370 @@
+// paddle_tpu native runtime core.
+//
+// Reference parity (SURVEY.md §2.11): the C++ roles that survive on TPU —
+//   * flags registry            — platform/flags.cc + global_value_getter_setter
+//   * monitor                   — platform/monitor.cc (named int64 stats)
+//   * profiler events           — platform/profiler.cc RecordEvent +
+//                                 tools/timeline.py chrome-trace export
+//   * ring buffer               — operators/reader/buffered_reader.cc
+//                                 (double-buffer prefetch handoff)
+//   * batch assemble            — framework/data_feed.cc batch packing
+//                                 (parallel memcpy collate)
+// Exposed as a C ABI consumed via ctypes (no pybind11 in this image).
+// Device compute stays in XLA/Pallas; this library is host-side runtime.
+
+#include <atomic>
+#include <chrono>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+#define PT_API extern "C" __attribute__((visibility("default")))
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+int64_t now_us() {
+  return std::chrono::duration_cast<std::chrono::microseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+// ---------------------------------------------------------------------------
+// Flags registry (string-typed; Python side owns parsing/typing)
+// ---------------------------------------------------------------------------
+std::mutex g_flags_mu;
+std::map<std::string, std::string> g_flags;
+
+// ---------------------------------------------------------------------------
+// Monitor: named int64 stats
+// ---------------------------------------------------------------------------
+std::mutex g_stats_mu;
+std::map<std::string, int64_t> g_stats;
+
+// ---------------------------------------------------------------------------
+// Profiler: per-thread scope stacks -> completed event list
+// ---------------------------------------------------------------------------
+struct TraceEvent {
+  std::string name;
+  uint64_t tid;
+  int64_t begin_us;
+  int64_t end_us;
+};
+
+std::atomic<bool> g_prof_enabled{false};
+std::mutex g_events_mu;
+std::vector<TraceEvent> g_events;
+
+struct OpenScope {
+  std::string name;
+  int64_t begin_us;
+};
+thread_local std::vector<OpenScope> t_scope_stack;
+
+uint64_t this_tid() {
+  return std::hash<std::thread::id>{}(std::this_thread::get_id()) % 1000000;
+}
+
+// ---------------------------------------------------------------------------
+// Ring buffer: fixed-size byte slots, blocking acquire/release
+// ---------------------------------------------------------------------------
+struct Ring {
+  std::vector<std::vector<uint8_t>> slots;
+  std::vector<int64_t> sizes;  // committed payload bytes per slot
+  std::deque<int> free_q;      // writable slot indices
+  std::deque<int> ready_q;     // readable slot indices (FIFO)
+  std::mutex mu;
+  std::condition_variable cv_free, cv_ready;
+  bool closed = false;
+};
+
+std::mutex g_rings_mu;
+std::map<int64_t, Ring*> g_rings;
+int64_t g_next_ring = 1;
+
+Ring* get_ring(int64_t h) {
+  std::lock_guard<std::mutex> lk(g_rings_mu);
+  auto it = g_rings.find(h);
+  return it == g_rings.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// Flags
+// ---------------------------------------------------------------------------
+PT_API void pt_flag_set(const char* name, const char* value) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  g_flags[name] = value;
+}
+
+PT_API int pt_flag_get(const char* name, char* buf, int buflen) {
+  std::lock_guard<std::mutex> lk(g_flags_mu);
+  auto it = g_flags.find(name);
+  if (it == g_flags.end()) return -1;
+  int n = static_cast<int>(it->second.size());
+  if (n >= buflen) return -2;
+  std::memcpy(buf, it->second.c_str(), n + 1);
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Monitor
+// ---------------------------------------------------------------------------
+PT_API void pt_stat_add(const char* name, int64_t v) {
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  g_stats[name] += v;
+}
+
+PT_API void pt_stat_set(const char* name, int64_t v) {
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  g_stats[name] = v;
+}
+
+PT_API int64_t pt_stat_get(const char* name) {
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  auto it = g_stats.find(name);
+  return it == g_stats.end() ? 0 : it->second;
+}
+
+PT_API void pt_stat_reset(const char* name) {
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  g_stats.erase(name);
+}
+
+// JSON {"name": value, ...}; returns bytes written or -needed
+PT_API int pt_stat_list(char* buf, int buflen) {
+  std::lock_guard<std::mutex> lk(g_stats_mu);
+  std::string out = "{";
+  bool first = true;
+  for (auto& kv : g_stats) {
+    if (!first) out += ",";
+    first = false;
+    out += "\"" + kv.first + "\":" + std::to_string(kv.second);
+  }
+  out += "}";
+  int n = static_cast<int>(out.size());
+  if (n >= buflen) return -(n + 1);
+  std::memcpy(buf, out.c_str(), n + 1);
+  return n;
+}
+
+// ---------------------------------------------------------------------------
+// Profiler
+// ---------------------------------------------------------------------------
+PT_API void pt_profiler_enable(int on) { g_prof_enabled = on != 0; }
+
+PT_API int pt_profiler_enabled() { return g_prof_enabled ? 1 : 0; }
+
+PT_API void pt_event_push(const char* name) {
+  if (!g_prof_enabled) return;
+  t_scope_stack.push_back({name, now_us()});
+}
+
+PT_API void pt_event_pop() {
+  if (t_scope_stack.empty()) return;
+  OpenScope s = t_scope_stack.back();
+  t_scope_stack.pop_back();
+  if (!g_prof_enabled) return;
+  std::lock_guard<std::mutex> lk(g_events_mu);
+  g_events.push_back({std::move(s.name), this_tid(), s.begin_us, now_us()});
+}
+
+// instantaneous (complete) event, e.g. from Python timings
+PT_API void pt_event_complete(const char* name, int64_t begin_us,
+                              int64_t end_us) {
+  if (!g_prof_enabled) return;
+  std::lock_guard<std::mutex> lk(g_events_mu);
+  g_events.push_back({name, this_tid(), begin_us, end_us});
+}
+
+PT_API int64_t pt_event_count() {
+  std::lock_guard<std::mutex> lk(g_events_mu);
+  return static_cast<int64_t>(g_events.size());
+}
+
+PT_API void pt_trace_clear() {
+  std::lock_guard<std::mutex> lk(g_events_mu);
+  g_events.clear();
+}
+
+// chrome://tracing "traceEvents" JSON (tools/timeline.py output format)
+PT_API int pt_trace_export(const char* path) {
+  std::lock_guard<std::mutex> lk(g_events_mu);
+  FILE* f = std::fopen(path, "w");
+  if (!f) return -1;
+  std::fputs("{\"traceEvents\":[", f);
+  for (size_t i = 0; i < g_events.size(); ++i) {
+    const TraceEvent& e = g_events[i];
+    std::string name = e.name;
+    for (auto& c : name)
+      if (c == '"' || c == '\\') c = '\'';
+    std::fprintf(f,
+                 "%s{\"name\":\"%s\",\"ph\":\"X\",\"pid\":0,\"tid\":%llu,"
+                 "\"ts\":%lld,\"dur\":%lld}",
+                 i ? "," : "", name.c_str(),
+                 static_cast<unsigned long long>(e.tid),
+                 static_cast<long long>(e.begin_us),
+                 static_cast<long long>(e.end_us - e.begin_us));
+  }
+  std::fputs("]}", f);
+  std::fclose(f);
+  return static_cast<int>(g_events.size());
+}
+
+// ---------------------------------------------------------------------------
+// Ring buffer
+// ---------------------------------------------------------------------------
+PT_API int64_t pt_ring_create(int capacity, int64_t slot_bytes) {
+  if (capacity <= 0 || slot_bytes <= 0) return -1;
+  Ring* r = new Ring();
+  r->slots.resize(capacity);
+  r->sizes.assign(capacity, 0);
+  for (int i = 0; i < capacity; ++i) {
+    r->slots[i].resize(slot_bytes);
+    r->free_q.push_back(i);
+  }
+  std::lock_guard<std::mutex> lk(g_rings_mu);
+  int64_t h = g_next_ring++;
+  g_rings[h] = r;
+  return h;
+}
+
+// -1 timeout, -2 closed, else slot index
+PT_API int pt_ring_acquire_write(int64_t h, int timeout_ms) {
+  Ring* r = get_ring(h);
+  if (!r) return -3;
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->closed || !r->free_q.empty(); };
+  if (timeout_ms < 0) {
+    r->cv_free.wait(lk, pred);
+  } else if (!r->cv_free.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                  pred)) {
+    return -1;
+  }
+  if (r->closed) return -2;
+  int idx = r->free_q.front();
+  r->free_q.pop_front();
+  return idx;
+}
+
+PT_API void* pt_ring_slot_ptr(int64_t h, int idx) {
+  Ring* r = get_ring(h);
+  if (!r || idx < 0 || idx >= static_cast<int>(r->slots.size()))
+    return nullptr;
+  return r->slots[idx].data();
+}
+
+PT_API int64_t pt_ring_slot_bytes(int64_t h) {
+  Ring* r = get_ring(h);
+  return r ? static_cast<int64_t>(r->slots[0].size()) : -1;
+}
+
+PT_API void pt_ring_commit_write(int64_t h, int idx, int64_t nbytes) {
+  Ring* r = get_ring(h);
+  if (!r) return;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->sizes[idx] = nbytes;
+    r->ready_q.push_back(idx);
+  }
+  r->cv_ready.notify_one();
+}
+
+// -1 timeout, -2 closed-and-drained, else slot index (payload in *nbytes)
+PT_API int pt_ring_acquire_read(int64_t h, int timeout_ms, int64_t* nbytes) {
+  Ring* r = get_ring(h);
+  if (!r) return -3;
+  std::unique_lock<std::mutex> lk(r->mu);
+  auto pred = [&] { return r->closed || !r->ready_q.empty(); };
+  if (timeout_ms < 0) {
+    r->cv_ready.wait(lk, pred);
+  } else if (!r->cv_ready.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                   pred)) {
+    return -1;
+  }
+  if (r->ready_q.empty()) return r->closed ? -2 : -1;
+  int idx = r->ready_q.front();
+  r->ready_q.pop_front();
+  if (nbytes) *nbytes = r->sizes[idx];
+  return idx;
+}
+
+PT_API void pt_ring_release_read(int64_t h, int idx) {
+  Ring* r = get_ring(h);
+  if (!r) return;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->free_q.push_back(idx);
+  }
+  r->cv_free.notify_one();
+}
+
+PT_API void pt_ring_close(int64_t h) {
+  Ring* r = get_ring(h);
+  if (!r) return;
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->cv_free.notify_all();
+  r->cv_ready.notify_all();
+}
+
+PT_API void pt_ring_destroy(int64_t h) {
+  Ring* r = nullptr;
+  {
+    std::lock_guard<std::mutex> lk(g_rings_mu);
+    auto it = g_rings.find(h);
+    if (it == g_rings.end()) return;
+    r = it->second;
+    g_rings.erase(it);
+  }
+  {
+    std::lock_guard<std::mutex> lk(r->mu);
+    r->closed = true;
+  }
+  r->cv_free.notify_all();
+  r->cv_ready.notify_all();
+  delete r;
+}
+
+// ---------------------------------------------------------------------------
+// Batch assemble: parallel memcpy of n equal-size samples into one
+// contiguous destination (the collate hot loop of data_feed.cc)
+// ---------------------------------------------------------------------------
+PT_API void pt_batch_assemble(void* dst, const void** srcs, int n,
+                              int64_t sample_bytes, int nthreads) {
+  if (n <= 0 || sample_bytes <= 0) return;
+  auto copy_range = [&](int lo, int hi) {
+    for (int i = lo; i < hi; ++i) {
+      std::memcpy(static_cast<uint8_t*>(dst) +
+                      static_cast<int64_t>(i) * sample_bytes,
+                  srcs[i], sample_bytes);
+    }
+  };
+  int64_t total = static_cast<int64_t>(n) * sample_bytes;
+  if (nthreads <= 1 || total < (1 << 20)) {  // small: threads not worth it
+    copy_range(0, n);
+    return;
+  }
+  if (nthreads > n) nthreads = n;
+  std::vector<std::thread> ts;
+  int per = (n + nthreads - 1) / nthreads;
+  for (int t = 0; t < nthreads; ++t) {
+    int lo = t * per, hi = std::min(n, lo + per);
+    if (lo >= hi) break;
+    ts.emplace_back(copy_range, lo, hi);
+  }
+  for (auto& t : ts) t.join();
+}
+
+PT_API const char* pt_version() { return "paddle_tpu_core 0.1"; }

@@ -43,6 +43,15 @@ define_flag("FLAGS_selected_gpus", "", "inert; device selection via set_device")
 def set_flags(flags: dict[str, Any]):
     for k, v in flags.items():
         _REGISTRY[k] = v
+    # mirror into the native runtime core so C++ components see the same
+    # registry (platform/flags.cc role; no-op without the native lib)
+    try:
+        from .. import core as _native
+        if _native.available():
+            for k, v in flags.items():
+                _native.flag_set(k, v)
+    except Exception:
+        pass
 
 
 def get_flags(keys):

@@ -226,6 +226,67 @@ class DistributedBatchSampler(BatchSampler):
         return (self.num_samples + self.batch_size - 1) // self.batch_size
 
 
+def _numpy_collate(batch):
+    """Worker-side collate: numpy-first (device transfer happens in the
+    parent; Tensor samples are unwrapped to numpy so only plain arrays
+    cross the process queue)."""
+    sample = batch[0]
+    if isinstance(sample, (tuple, list)):
+        return [_numpy_collate([b[i] for b in batch])
+                for i in range(len(sample))]
+    if isinstance(sample, dict):
+        return {k: _numpy_collate([b[k] for b in batch]) for k in sample}
+    if isinstance(sample, Tensor):
+        return np.stack([np.asarray(b.numpy()) for b in batch])
+    if isinstance(sample, np.ndarray):
+        return np.stack(batch)
+    if isinstance(sample, (int, np.integer)):
+        return np.asarray(batch, np.int64)
+    if isinstance(sample, (float, np.floating)):
+        return np.asarray(batch, np.float32)
+    return batch
+
+
+def _tensor_wrap(tree):
+    """Parent-side: numpy leaves -> Tensor (device transfer boundary)."""
+    if isinstance(tree, list):
+        return [_tensor_wrap(t) for t in tree]
+    if isinstance(tree, dict):
+        return {k: _tensor_wrap(v) for k, v in tree.items()}
+    if isinstance(tree, np.ndarray):
+        return Tensor(tree)
+    return tree
+
+
+class _WorkerError:
+    def __init__(self, worker_id, tb):
+        self.worker_id = worker_id
+        self.traceback = tb
+
+
+def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
+                 worker_init_fn):
+    """Forked worker: fetch + collate in numpy, ship via queue (reference
+    dataloader_iter.py _worker_loop)."""
+    import traceback
+    if worker_init_fn is not None:
+        worker_init_fn(worker_id)
+    use_numpy = collate_fn is default_collate_fn
+    while True:
+        job = index_queue.get()
+        if job is None:
+            break
+        bid, indices = job
+        try:
+            samples = [dataset[i] for i in indices]
+            batch = (_numpy_collate(samples) if use_numpy
+                     else collate_fn(samples))
+            result_queue.put((bid, batch))
+        except Exception:
+            result_queue.put((bid, _WorkerError(worker_id,
+                                                traceback.format_exc())))
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (tuple, list)):
@@ -257,6 +318,8 @@ class DataLoader:
         self.num_workers = num_workers
         self.prefetch_factor = max(prefetch_factor, 1)
         self.use_buffer_reader = use_buffer_reader
+        self.timeout = timeout
+        self.worker_init_fn = worker_init_fn
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -287,8 +350,96 @@ class DataLoader:
             if batch and not getattr(self, "drop_last", False):
                 yield self.collate_fn(batch)
             return
+        if self.num_workers > 0:
+            yield from self._produce_multiprocess()
+            return
         for idx_batch in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in idx_batch])
+
+    def _produce_multiprocess(self):
+        """Multi-process map-style loading (reference:
+        fluid/reader.py dataloader_iter.py _DataLoaderIterMultiProcess:478 —
+        worker pool + result reordering).  Workers are forked and do
+        numpy-only work (fetch + collate); device transfer stays in the
+        main process, the fork-safety boundary for XLA."""
+        import multiprocessing as mp
+
+        ctx = mp.get_context("fork")
+        index_queues = [ctx.Queue() for _ in range(self.num_workers)]
+        result_queue = ctx.Queue()
+        workers = []
+        for wid, iq in enumerate(index_queues):
+            w = ctx.Process(
+                target=_worker_loop,
+                args=(self.dataset, self.collate_fn, iq, result_queue, wid,
+                      self.worker_init_fn),
+                daemon=True)
+            w.start()
+            workers.append(w)
+        try:
+            batches = list(self.batch_sampler)
+            # dispatch round-robin, keep prefetch_factor per worker in flight
+            next_send = 0
+            max_inflight = self.num_workers * self.prefetch_factor
+            reorder: dict[int, object] = {}
+            next_yield = 0
+            user_timeout = self.timeout if self.timeout > 0 else None
+            import time as _time
+
+            def send_one():
+                nonlocal next_send
+                if next_send < len(batches):
+                    index_queues[next_send % self.num_workers].put(
+                        (next_send, batches[next_send]))
+                    next_send += 1
+
+            def recv_one():
+                """Poll the result queue, detecting dead workers (a
+                segfaulted/OOM-killed worker would otherwise hang the
+                loader forever) and honoring the user timeout."""
+                deadline = (None if user_timeout is None
+                            else _time.monotonic() + user_timeout)
+                while True:
+                    try:
+                        return result_queue.get(timeout=1.0)
+                    except queue.Empty:
+                        pass
+                    for w in workers:
+                        if not w.is_alive() and w.exitcode != 0:
+                            raise RuntimeError(
+                                f"DataLoader worker pid={w.pid} died with "
+                                f"exit code {w.exitcode}")
+                    if deadline is not None and _time.monotonic() > deadline:
+                        raise RuntimeError(
+                            f"DataLoader worker timed out after "
+                            f"{self.timeout}s")
+
+            for _ in range(min(max_inflight, len(batches))):
+                send_one()
+            while next_yield < len(batches):
+                if next_yield in reorder:
+                    batch = reorder.pop(next_yield)
+                    next_yield += 1
+                    from .. import core as _core
+                    _core.stat_add("dataloader.batches")
+                    if self.collate_fn is default_collate_fn:
+                        batch = _tensor_wrap(batch)
+                    yield batch
+                    send_one()
+                    continue
+                bid, payload = recv_one()
+                if isinstance(payload, _WorkerError):
+                    raise RuntimeError(
+                        f"DataLoader worker {payload.worker_id} failed:\n"
+                        f"{payload.traceback}")
+                reorder[bid] = payload
+        finally:
+            for iq in index_queues:
+                iq.put(None)
+            for w in workers:
+                w.join(timeout=5)
+                if w.is_alive():
+                    w.terminate()
 
     def __iter__(self):
         gen = self._produce()
@@ -299,21 +450,44 @@ class DataLoader:
         # (operators/reader/buffered_reader.cc analog)
         q: queue.Queue = queue.Queue(maxsize=self.prefetch_factor)
         sentinel = object()
+        stop = threading.Event()
+
+        def put_or_stop(item) -> bool:
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def worker():
             try:
                 for item in gen:
-                    q.put(item)
+                    if not put_or_stop(item):
+                        return
+                put_or_stop(sentinel)
+            except BaseException as e:  # re-raised in the consumer
+                put_or_stop(e)
             finally:
-                q.put(sentinel)
+                # run the source generator's cleanup (worker-process
+                # shutdown) in ITS OWN thread — the consumer abandoning
+                # iteration early must not leak worker processes
+                gen.close()
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        while True:
-            item = q.get()
-            if item is sentinel:
-                break
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is sentinel:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=10)
 
 
 def get_worker_info():
