@@ -15,7 +15,6 @@ works between batches).
 from __future__ import annotations
 
 import os
-import time
 from typing import Any
 
 import jax
@@ -180,45 +179,77 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None):
+        from .callbacks import config_callbacks
+
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
                        drop_last=drop_last, num_workers=num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        self._save_dir = save_dir
+        self.stop_training = False
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=[m._name for m in self._metrics])
+        from .callbacks import LRScheduler as _LRCb
+        from .callbacks import ModelCheckpoint as _CkptCb
+        from .callbacks import ProgBarLogger as _PBCb
+
+        # metric.accumulate() is host-side work — only compute per-batch
+        # when a log step fires or a user callback might consume it
+        user_cbs = any(not isinstance(c, (_PBCb, _LRCb, _CkptCb))
+                       for c in cbks)
         history = {"loss": []}
         it_count = 0
-        for epoch in range(epochs):
-            self.network.train()
-            for m in self._metrics:
-                m.reset()
-            t0 = time.time()
-            losses = []
-            for step_i, batch in enumerate(loader):
-                batch = _to_list(batch)
-                inputs, labels = self._split_batch(batch)
-                loss = self.train_batch(inputs, labels)
-                losses.append(loss if np.isscalar(loss) else loss[0])
-                it_count += 1
-                if verbose and log_freq and step_i % log_freq == 0:
-                    msg = f"Epoch {epoch + 1}/{epochs} step {step_i} " \
-                          f"loss: {losses[-1]:.4f}"
-                    for m in self._metrics:
-                        msg += f" {m._name}: {np.mean(_to_list(m.accumulate())):.4f}"
-                    print(msg, flush=True)
+        cbks.on_train_begin({})
+        try:
+            for epoch in range(epochs):
+                self.network.train()
+                for m in self._metrics:
+                    m.reset()
+                cbks.on_epoch_begin(epoch, {})
+                losses = []
+                for step_i, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step_i, {})
+                    batch = _to_list(batch)
+                    inputs, labels = self._split_batch(batch)
+                    loss = self.train_batch(inputs, labels)
+                    losses.append(loss if np.isscalar(loss) else loss[0])
+                    it_count += 1
+                    logs = {"loss": losses[-1], "batch_size": batch_size}
+                    if user_cbs or (log_freq and step_i % log_freq == 0):
+                        for m in self._metrics:
+                            logs[m._name] = np.mean(
+                                _to_list(m.accumulate()))
+                    cbks.on_train_batch_end(step_i, logs)
+                    if num_iters is not None and it_count >= num_iters:
+                        break
+                history["loss"].append(float(np.mean(losses)))
+                epoch_logs = {"loss": history["loss"][-1]}
+                for m in self._metrics:
+                    epoch_logs[m._name] = np.mean(_to_list(m.accumulate()))
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    cbks.on_eval_begin({})
+                    eval_res = self.evaluate(eval_data,
+                                             batch_size=batch_size,
+                                             verbose=0)
+                    history.setdefault("eval_loss", []).append(
+                        eval_res.get("loss"))
+                    epoch_logs.update({f"eval_{k}": v
+                                       for k, v in eval_res.items()})
+                    cbks.on_eval_end(eval_res)
+                cbks.on_epoch_end(epoch, epoch_logs)
+                if self.stop_training:
+                    break
                 if num_iters is not None and it_count >= num_iters:
                     break
-            history["loss"].append(float(np.mean(losses)))
-            if verbose:
-                print(f"Epoch {epoch + 1}/{epochs} done in "
-                      f"{time.time() - t0:.1f}s avg loss "
-                      f"{history['loss'][-1]:.4f}", flush=True)
-            if eval_data is not None and (epoch + 1) % eval_freq == 0:
-                eval_res = self.evaluate(eval_data, batch_size=batch_size,
-                                         verbose=0)
-                history.setdefault("eval_loss", []).append(
-                    eval_res.get("loss"))
-            if save_dir and (epoch + 1) % save_freq == 0:
-                self.save(os.path.join(save_dir, f"epoch_{epoch}"))
-            if num_iters is not None and it_count >= num_iters:
-                break
+        finally:
+            # a crash mid-fit must still flush/close callback resources
+            cbks.on_train_end({})
         return history
 
     def _split_batch(self, batch):

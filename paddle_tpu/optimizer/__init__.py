@@ -46,7 +46,9 @@ class Optimizer:
             return 0.0
         if isinstance(weight_decay, (int, float)):
             return float(weight_decay)
-        # fluid regularizer object (L2Decay) — read its coeff
+        if callable(weight_decay):
+            # paddle.regularizer.L1Decay/L2Decay — a grad transform
+            return weight_decay
         return float(getattr(weight_decay, "_regularization_coeff",
                              getattr(weight_decay, "coeff", 0.0)))
 
@@ -108,9 +110,13 @@ class Optimizer:
             self._slots[id(p)] = new_slots
 
     def _apply_decay(self, p_val, g_val):
-        """Coupled L2 (fluid regularizer semantics); AdamW overrides."""
-        if self._weight_decay:
-            return g_val + self._weight_decay * p_val
+        """Coupled decay (fluid regularizer semantics); AdamW overrides.
+        A callable regularizer (L1Decay/L2Decay) transforms the grad."""
+        wd = self._weight_decay
+        if callable(wd):
+            return wd(p_val, g_val)
+        if wd:
+            return g_val + wd * p_val
         return g_val
 
     def clear_grad(self, set_to_zero=False):
@@ -262,6 +268,12 @@ class AdamW(Adam):
                  lazy_mode=False, multi_precision=False, name=None, **kw):
         super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
                          None, grad_clip)
+        from ..regularizer import L1Decay
+        if isinstance(weight_decay, L1Decay):
+            raise TypeError(
+                "AdamW applies DECOUPLED L2 weight decay; L1Decay has no "
+                "decoupled analog here — use paddle.optimizer.Adam with "
+                "weight_decay=L1Decay(...) for coupled L1")
         self._wd_coeff = float(weight_decay) if not hasattr(weight_decay, "_regularization_coeff") \
             else float(weight_decay._regularization_coeff)
         self._apply_decay_param_fun = apply_decay_param_fun

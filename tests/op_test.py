@@ -1,0 +1,104 @@
+"""OpTest — the numpy-reference + numeric-gradient op test harness.
+
+Reference parity: python/paddle/fluid/tests/unittests/op_test.py —
+`check_output_with_place` (op_test.py:1027) compares a one-op program
+against a numpy reference on every place; `check_grad` (op_test.py:1329)
+compares analytic gradients against `get_numeric_gradient` central finite
+differences (op_test.py:101).  This is the contract every TPU op lowering
+must satisfy (SURVEY.md §4).
+
+TPU-native: the "one-op program" is the paddle_tpu eager op itself (which
+is also what jit traces), the "places" matrix collapses to the active jax
+backend, and analytic grads come from the autograd tape (jax.vjp under the
+hood).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.tensor import Tensor
+
+
+def numeric_gradient(fn, inputs: list[np.ndarray], wrt: int,
+                     eps: float = 5e-3) -> np.ndarray:
+    """Central finite differences of sum(fn(*inputs)) w.r.t. inputs[wrt]
+    (op_test.py:101 get_numeric_gradient, delta-based)."""
+    inputs = [np.asarray(a, np.float32) for a in inputs]
+    x = inputs[wrt]
+    grad = np.zeros_like(x, np.float64)
+    flat = x.reshape(-1)
+    gflat = grad.reshape(-1)
+
+    def loss_at(v):
+        probe = list(inputs)
+        probe[wrt] = v
+        out = fn(*[paddle.to_tensor(p) for p in probe])
+        if isinstance(out, (tuple, list)):
+            out = out[0]
+        return float(np.asarray(out.numpy(), np.float64).sum())
+
+    for i in range(flat.size):
+        orig = flat[i]
+        flat[i] = orig + eps
+        up = loss_at(x)
+        flat[i] = orig - eps
+        down = loss_at(x)
+        flat[i] = orig
+        gflat[i] = (up - down) / (2 * eps)
+    return grad.reshape(x.shape)
+
+
+def analytic_gradient(fn, inputs: list[np.ndarray], wrt: int) -> np.ndarray:
+    """Tape gradient of sum(fn(*inputs)) (the BasicEngine walk)."""
+    ts = [paddle.to_tensor(np.asarray(a, np.float32)) for a in inputs]
+    for t in ts:
+        t.stop_gradient = False
+    out = fn(*ts)
+    if isinstance(out, (tuple, list)):
+        out = out[0]
+    loss = paddle.sum(out)
+    loss.backward()
+    g = ts[wrt].grad
+    assert g is not None, f"no grad flowed to input {wrt}"
+    return np.asarray(g.numpy(), np.float64)
+
+
+class OpTest:
+    """Subclass per op; set `atol/rtol` for low-precision kernels."""
+
+    atol = 1e-5
+    rtol = 1e-5
+    grad_eps = 5e-3
+    max_relative_error = 5e-3  # reference check_grad default tolerance
+
+    def check_output(self, fn, ref_fn, inputs, atol=None, rtol=None):
+        """fn: paddle op over Tensors; ref_fn: numpy reference."""
+        outs = fn(*[paddle.to_tensor(np.asarray(a)) for a in inputs])
+        refs = ref_fn(*[np.asarray(a) for a in inputs])
+        if not isinstance(outs, (tuple, list)):
+            outs, refs = [outs], [refs]
+        assert len(outs) == len(refs)
+        for o, r in zip(outs, refs):
+            np.testing.assert_allclose(
+                np.asarray(o.numpy(), np.float64), np.asarray(r, np.float64),
+                atol=atol if atol is not None else self.atol,
+                rtol=rtol if rtol is not None else self.rtol)
+
+    def check_grad(self, fn, inputs, wrt=None, eps=None,
+                   max_relative_error=None):
+        """Analytic-vs-numeric gradient check for each input in `wrt`
+        (default: all float inputs)."""
+        if wrt is None:
+            wrt = [i for i, a in enumerate(inputs)
+                   if np.issubdtype(np.asarray(a).dtype, np.floating)]
+        tol = max_relative_error or self.max_relative_error
+        for i in wrt:
+            num = numeric_gradient(fn, inputs, i,
+                                   eps=eps or self.grad_eps)
+            ana = analytic_gradient(fn, inputs, i)
+            denom = max(1.0, float(np.abs(num).max()))
+            err = float(np.abs(num - ana).max()) / denom
+            assert err < tol, (
+                f"gradient mismatch on input {i}: max rel err {err:.2e} "
+                f">= {tol:.0e}\n numeric:\n{num}\n analytic:\n{ana}")

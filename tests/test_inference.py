@@ -1,0 +1,114 @@
+"""Inference export/serve tests.
+
+Mirrors the reference's inference/api tests (analysis_predictor_tester.cc):
+export a trained model, reload in a fresh predictor, assert identical
+outputs — including the AOT (StableHLO) path that needs no python model
+code at serve time."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.inference import (
+    Config,
+    Predictor,
+    create_predictor,
+    load_inference_model,
+    save_inference_model,
+)
+
+
+def _trained_mlp():
+    paddle.seed(7)
+    net = paddle.nn.Sequential(
+        paddle.nn.Linear(8, 16), paddle.nn.ReLU(), paddle.nn.Linear(16, 3))
+    return net
+
+
+class TestSaveLoad:
+    def test_aot_roundtrip_matches_eager(self, tmp_path):
+        net = _trained_mlp()
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        net.eval()
+        ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+        prefix = str(tmp_path / "model" / "mlp")
+        save_inference_model(prefix, net, example_inputs=[x])
+        # AOT artifacts exist
+        assert os.path.exists(prefix + ".pdexport")
+        assert os.path.exists(prefix + ".pdiparams")
+        manifest = json.load(open(prefix + ".pdmodel.json"))
+        assert manifest["format"] == "jax.export/stablehlo"
+        assert manifest["input_specs"][0]["shape"] == [4, 8]
+
+        pred = load_inference_model(prefix)
+        assert pred._mode == "aot"
+        out, = pred.run([x])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_pickle_fallback_without_example_inputs(self, tmp_path):
+        net = _trained_mlp()
+        x = np.random.RandomState(1).randn(2, 8).astype(np.float32)
+        net.eval()
+        ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+        prefix = str(tmp_path / "m2")
+        save_inference_model(prefix, net)  # no example → no AOT artifact
+        assert not os.path.exists(prefix + ".pdexport")
+        pred = create_predictor(Config(prefix))
+        assert pred._mode == "jit"
+        out, = pred.run([x])
+        np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    def test_save_restores_training_mode(self, tmp_path):
+        net = _trained_mlp()
+        net.train()
+        save_inference_model(str(tmp_path / "m3"), net)
+        assert net.training
+
+
+class TestPredictorAPI:
+    def test_zero_copy_handles(self, tmp_path):
+        """The get_input_handle/copy_from_cpu/run/copy_to_cpu contract
+        (api/analysis_predictor.cc ZeroCopyRun)."""
+        net = _trained_mlp()
+        net.eval()
+        x = np.random.RandomState(2).randn(4, 8).astype(np.float32)
+        ref = np.asarray(net(paddle.to_tensor(x)).numpy())
+        prefix = str(tmp_path / "m4")
+        save_inference_model(prefix, net, example_inputs=[x])
+        pred = create_predictor(Config(prefix))
+        names = pred.get_input_names()
+        assert names == ["x0"]
+        h = pred.get_input_handle("x0")
+        h.copy_from_cpu(x)
+        pred.run()
+        out_h = pred.get_output_handle(pred.get_output_names()[0])
+        pred.run()
+        np.testing.assert_allclose(out_h.copy_to_cpu(), ref,
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_config_knobs_inert(self):
+        c = Config("/nonexistent/prefix")
+        c.enable_use_gpu(100, 0)
+        c.disable_gpu()
+        c.enable_mkldnn()
+        c.enable_tensorrt_engine()
+        c.enable_memory_optim()
+        c.switch_ir_optim(True)
+        assert "switches" in c.summary()
+
+    def test_missing_model_raises(self):
+        with pytest.raises((FileNotFoundError, ValueError)):
+            Predictor(Config("/nonexistent/prefix"))
+
+    def test_input_spec_export(self, tmp_path):
+        from paddle_tpu.jit import InputSpec
+        net = _trained_mlp()
+        prefix = str(tmp_path / "m5")
+        save_inference_model(prefix, net,
+                             input_spec=[InputSpec([2, 8], "float32")])
+        assert os.path.exists(prefix + ".pdexport")
+        pred = load_inference_model(prefix)
+        out, = pred.run([np.zeros((2, 8), np.float32)])
+        assert out.shape == (2, 3)

@@ -1,0 +1,314 @@
+"""Op-lowering tests through the OpTest harness (numpy reference +
+numeric-grad), covering the dense-op set the 5 baseline configs use
+(SURVEY.md §7 step 4): elementwise/broadcast binary ops, activations,
+matmul, reductions, shape ops, softmax/cross-entropy, norm layers, conv,
+pooling, embedding, clip."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn.functional as F
+
+from op_test import OpTest
+
+
+def rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+class TestElementwiseOps(OpTest):
+    def test_add_broadcast(self):
+        a, b = rs().randn(3, 4).astype("f"), rs(1).randn(4).astype("f")
+        self.check_output(paddle.add, np.add, [a, b])
+        self.check_grad(paddle.add, [a, b])
+
+    def test_subtract(self):
+        a, b = rs().randn(2, 5).astype("f"), rs(1).randn(2, 5).astype("f")
+        self.check_output(paddle.subtract, np.subtract, [a, b])
+        self.check_grad(paddle.subtract, [a, b])
+
+    def test_multiply(self):
+        a, b = rs().randn(3, 4).astype("f"), rs(1).randn(3, 4).astype("f")
+        self.check_output(paddle.multiply, np.multiply, [a, b])
+        self.check_grad(paddle.multiply, [a, b])
+
+    def test_divide(self):
+        a = rs().randn(3, 4).astype("f")
+        b = rs(1).rand(3, 4).astype("f") + 1.0
+        self.check_output(paddle.divide, np.divide, [a, b])
+        self.check_grad(paddle.divide, [a, b])
+
+    def test_pow_maximum_minimum(self):
+        a = rs().rand(3, 3).astype("f") + 0.5
+        self.check_output(lambda x: paddle.pow(x, 2.5),
+                          lambda x: np.power(x, 2.5), [a])
+        self.check_grad(lambda x: paddle.pow(x, 2.5), [a])
+        b = rs(1).randn(3, 3).astype("f")
+        c = rs(2).randn(3, 3).astype("f")
+        self.check_output(paddle.maximum, np.maximum, [b, c])
+        self.check_output(paddle.minimum, np.minimum, [b, c])
+
+
+class TestActivationOps(OpTest):
+    cases = {
+        "relu": (F.relu, lambda x: np.maximum(x, 0)),
+        "sigmoid": (F.sigmoid, lambda x: 1 / (1 + np.exp(-x))),
+        "tanh": (F.tanh, np.tanh),
+        "exp": (paddle.exp, np.exp),
+        "log": (paddle.log, np.log),
+        "sqrt": (paddle.sqrt, np.sqrt),
+        "silu": (F.silu, lambda x: x / (1 + np.exp(-x))),
+        "softplus": (F.softplus, lambda x: np.log1p(np.exp(-np.abs(x)))
+                     + np.maximum(x, 0)),
+    }
+
+    def test_forward_and_grad(self):
+        for name, (op, ref) in self.cases.items():
+            x = (rs().rand(4, 5).astype("f") + 0.5  # positive for log/sqrt
+                 if name in ("log", "sqrt") else rs().randn(4, 5).astype("f"))
+            self.check_output(op, ref, [x], atol=1e-5, rtol=1e-4)
+            # relu grad is non-smooth at 0 — nudge away
+            if name == "relu":
+                x = x + np.sign(x) * 0.05
+            self.check_grad(op, [x], max_relative_error=2e-2)
+
+    def test_gelu_matches_reference_formula(self):
+        x = rs().randn(3, 4).astype("f")
+        # exact erf gelu vs the tanh approximation agree to ~2e-3
+        out = F.gelu(paddle.to_tensor(x)).numpy()
+        approx = 0.5 * x * (1 + np.tanh(np.sqrt(2 / np.pi)
+                                        * (x + 0.044715 * x ** 3)))
+        np.testing.assert_allclose(np.asarray(out), approx, atol=2e-3)
+        self.check_grad(F.gelu, [x], max_relative_error=2e-2)
+
+
+class TestMatmulOps(OpTest):
+    def test_matmul(self):
+        a, b = rs().randn(4, 6).astype("f"), rs(1).randn(6, 3).astype("f")
+        self.check_output(paddle.matmul, np.matmul, [a, b], rtol=1e-4)
+        self.check_grad(paddle.matmul, [a, b], max_relative_error=1e-2)
+
+    def test_matmul_transpose_flags(self):
+        a, b = rs().randn(6, 4).astype("f"), rs(1).randn(3, 6).astype("f")
+        self.check_output(
+            lambda x, y: paddle.matmul(x, y, transpose_x=True,
+                                       transpose_y=True),
+            lambda x, y: x.T @ y.T, [a, b], rtol=1e-4)
+
+    def test_batched(self):
+        a = rs().randn(2, 4, 5).astype("f")
+        b = rs(1).randn(2, 5, 3).astype("f")
+        self.check_output(paddle.bmm, np.matmul, [a, b], rtol=1e-4)
+
+
+class TestReduceOps(OpTest):
+    def test_sum_mean_max_min(self):
+        x = rs().randn(3, 4, 5).astype("f")
+        self.check_output(lambda t: paddle.sum(t, axis=1),
+                          lambda a: a.sum(1), [x], rtol=1e-4)
+        self.check_output(lambda t: paddle.mean(t, axis=(0, 2)),
+                          lambda a: a.mean((0, 2)), [x], rtol=1e-4)
+        self.check_output(lambda t: paddle.max(t, axis=-1),
+                          lambda a: a.max(-1), [x])
+        self.check_output(lambda t: paddle.min(t),
+                          lambda a: a.min(), [x])
+        self.check_grad(lambda t: paddle.sum(t, axis=1), [x])
+        self.check_grad(lambda t: paddle.mean(t, axis=(0, 2)), [x])
+
+    def test_prod_logsumexp(self):
+        x = (rs().rand(3, 4).astype("f") + 0.5)
+        self.check_output(lambda t: paddle.prod(t, axis=1),
+                          lambda a: a.prod(1), [x], rtol=1e-4)
+        self.check_output(
+            lambda t: paddle.logsumexp(t, axis=1),
+            lambda a: np.log(np.exp(a).sum(1)), [x], rtol=1e-4)
+
+
+class TestShapeOps(OpTest):
+    def test_reshape_transpose_concat_split_stack(self):
+        x = rs().randn(2, 6).astype("f")
+        y = rs(1).randn(2, 6).astype("f")
+        self.check_output(lambda t: paddle.reshape(t, [3, 4]),
+                          lambda a: a.reshape(3, 4), [x])
+        self.check_output(lambda t: paddle.transpose(t, [1, 0]),
+                          lambda a: a.T, [x])
+        self.check_output(lambda a, b: paddle.concat([a, b], axis=0),
+                          lambda a, b: np.concatenate([a, b], 0), [x, y])
+        self.check_output(lambda a, b: paddle.stack([a, b], axis=1),
+                          lambda a, b: np.stack([a, b], 1), [x, y])
+        self.check_output(lambda t: paddle.split(t, 3, axis=1),
+                          lambda a: np.split(a, 3, 1), [x])
+        self.check_grad(lambda t: paddle.reshape(t, [3, 4]), [x])
+        self.check_grad(lambda a, b: paddle.concat([a, b], axis=0), [x, y])
+
+    def test_gather_slice_where(self):
+        x = rs().randn(5, 3).astype("f")
+        idx = np.array([0, 2, 4])
+        self.check_output(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                          lambda a: a[idx], [x])
+        self.check_output(lambda t: t[1:4, :2],
+                          lambda a: a[1:4, :2], [x])
+        cond = x > 0
+        self.check_output(
+            lambda t: paddle.where(paddle.to_tensor(cond), t, -t),
+            lambda a: np.where(cond, a, -a), [x])
+        self.check_grad(lambda t: paddle.gather(t, paddle.to_tensor(idx)),
+                        [x])
+
+    def test_squeeze_unsqueeze_tile_flip(self):
+        x = rs().randn(2, 1, 3).astype("f")
+        self.check_output(lambda t: paddle.squeeze(t, axis=1),
+                          lambda a: a.squeeze(1), [x])
+        self.check_output(lambda t: paddle.unsqueeze(t, axis=0),
+                          lambda a: a[None], [x])
+        self.check_output(lambda t: paddle.tile(t, [2, 1, 1]),
+                          lambda a: np.tile(a, (2, 1, 1)), [x])
+        self.check_output(lambda t: paddle.flip(t, axis=[0]),
+                          lambda a: a[::-1].copy(), [x])
+
+
+class TestSoftmaxXentOps(OpTest):
+    def test_softmax(self):
+        x = rs().randn(4, 7).astype("f")
+
+        def ref(a):
+            e = np.exp(a - a.max(-1, keepdims=True))
+            return e / e.sum(-1, keepdims=True)
+
+        self.check_output(F.softmax, ref, [x], rtol=1e-4)
+        self.check_grad(F.softmax, [x], max_relative_error=2e-2)
+
+    def test_log_softmax(self):
+        x = rs().randn(4, 7).astype("f")
+
+        def ref(a):
+            m = a - a.max(-1, keepdims=True)
+            return m - np.log(np.exp(m).sum(-1, keepdims=True))
+
+        self.check_output(F.log_softmax, ref, [x], rtol=1e-4)
+
+    def test_cross_entropy_fused(self):
+        """softmax_with_cross_entropy_op.cc:301 semantics: fused, stable."""
+        logits = rs().randn(6, 5).astype("f")
+        labels = rs(1).randint(0, 5, (6,))
+
+        def ref(a):
+            m = a - a.max(-1, keepdims=True)
+            lse = np.log(np.exp(m).sum(-1)) - m[np.arange(6), labels]
+            return lse.mean()
+
+        def op(t):
+            return F.cross_entropy(t, paddle.to_tensor(labels))
+
+        self.check_output(op, ref, [logits], rtol=1e-4)
+        self.check_grad(op, [logits], max_relative_error=2e-2)
+
+
+class TestNormOps(OpTest):
+    def test_layer_norm(self):
+        x = rs().randn(4, 8).astype("f")
+        g = np.ones(8, "f") + rs(1).randn(8).astype("f") * 0.1
+        b = rs(2).randn(8).astype("f") * 0.1
+
+        def ref(a, gg, bb):
+            mu = a.mean(-1, keepdims=True)
+            var = a.var(-1, keepdims=True)
+            return (a - mu) / np.sqrt(var + 1e-5) * gg + bb
+
+        def op(t, gg, bb):
+            return F.layer_norm(t, 8, weight=gg, bias=bb)
+
+        self.check_output(op, ref, [x, g, b], rtol=1e-4, atol=1e-5)
+        self.check_grad(op, [x, g, b], max_relative_error=2e-2)
+
+    def test_batch_norm_eval(self):
+        x = rs().randn(4, 3, 5).astype("f")
+        mean = rs(1).randn(3).astype("f") * 0.1
+        var = rs(2).rand(3).astype("f") + 0.5
+        w = np.ones(3, "f")
+        b = np.zeros(3, "f")
+
+        def ref(a, *_):
+            return (a - mean[None, :, None]) / \
+                np.sqrt(var[None, :, None] + 1e-5)
+
+        def op(t, *_):
+            return F.batch_norm(t, paddle.to_tensor(mean),
+                                paddle.to_tensor(var), paddle.to_tensor(w),
+                                paddle.to_tensor(b), training=False)
+
+        self.check_output(op, ref, [x], rtol=1e-4, atol=1e-5)
+
+
+class TestConvPoolOps(OpTest):
+    def test_conv2d(self):
+        x = rs().randn(1, 2, 6, 6).astype("f")
+        w = rs(1).randn(3, 2, 3, 3).astype("f") * 0.2
+
+        def ref(a, ww):
+            out = np.zeros((1, 3, 4, 4), np.float64)
+            for oc in range(3):
+                for i in range(4):
+                    for j in range(4):
+                        out[0, oc, i, j] = (a[0, :, i:i + 3, j:j + 3]
+                                            * ww[oc]).sum()
+            return out
+
+        self.check_output(lambda a, ww: F.conv2d(a, ww), ref, [x, w],
+                          rtol=1e-3, atol=1e-4)
+        self.check_grad(lambda a, ww: F.conv2d(a, ww), [x, w],
+                        max_relative_error=2e-2)
+
+    def test_pooling(self):
+        x = rs().randn(1, 1, 4, 4).astype("f")
+
+        def ref_max(a):
+            return a.reshape(1, 1, 2, 2, 2, 2).max((3, 5))
+
+        def ref_avg(a):
+            return a.reshape(1, 1, 2, 2, 2, 2).mean((3, 5))
+
+        self.check_output(lambda t: F.max_pool2d(t, 2, 2), ref_max, [x])
+        self.check_output(lambda t: F.avg_pool2d(t, 2, 2), ref_avg, [x],
+                          rtol=1e-4)
+        self.check_grad(lambda t: F.avg_pool2d(t, 2, 2), [x])
+
+
+class TestEmbeddingClipOps(OpTest):
+    def test_embedding(self):
+        table = rs().randn(10, 4).astype("f")
+        ids = np.array([[1, 3], [7, 0]])
+
+        def op(w):
+            return F.embedding(paddle.to_tensor(ids), w)
+
+        self.check_output(op, lambda w: w[ids], [table])
+        self.check_grad(op, [table])
+
+    def test_clip(self):
+        x = rs().randn(4, 4).astype("f") * 2
+        self.check_output(lambda t: paddle.clip(t, -1.0, 1.0),
+                          lambda a: np.clip(a, -1, 1), [x])
+        # clip grad non-smooth at boundaries; keep interior
+        xi = np.clip(x, -0.9, 0.9).astype("f")
+        self.check_grad(lambda t: paddle.clip(t, -1.0, 1.0), [xi])
+
+
+class TestCumulativeOps(OpTest):
+    def test_cumsum_cumprod(self):
+        x = rs().rand(3, 4).astype("f") + 0.5
+        self.check_output(lambda t: paddle.cumsum(t, axis=1),
+                          lambda a: a.cumsum(1), [x], rtol=1e-4)
+        self.check_output(lambda t: paddle.cumprod(t, dim=1),
+                          lambda a: a.cumprod(1), [x], rtol=1e-4)
+        self.check_grad(lambda t: paddle.cumsum(t, axis=1), [x])
+
+    def test_sort_topk_argmax_values(self):
+        x = rs().randn(3, 6).astype("f")
+        self.check_output(lambda t: paddle.sort(t, axis=1),
+                          lambda a: np.sort(a, 1), [x])
+        self.check_output(
+            lambda t: paddle.topk(t, 2, axis=1)[0],
+            lambda a: np.sort(a, 1)[:, ::-1][:, :2].copy(), [x])
+        self.check_output(lambda t: paddle.argmax(t, axis=1),
+                          lambda a: a.argmax(1), [x])
