@@ -89,22 +89,49 @@ def main():
                                                  lr=1e-4, step=1)
         return new_params, new_state, loss
 
-    step = jax.jit(train_step, donate_argnums=(0, 1))
-
     rs = np.random.RandomState(0)
     ids = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
     labels = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
 
-    # warmup/compile
-    params, opt_state, loss = step(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
+    # Timing methodology: per-call timing through the remote-TPU tunnel is
+    # unreliable (dispatch returns early; block_until_ready does not chain
+    # across calls), so run `iters` steps inside ONE jit via lax.scan and
+    # force a host readback, then subtract the measured call roundtrip.
+    iters = 10 if on_tpu else 3
 
-    iters = 20 if on_tpu else 3
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, opt_state, loss = step(params, opt_state, ids, labels)
-    jax.block_until_ready(loss)
-    dt = (time.perf_counter() - t0) / iters
+    def loop(params, opt_state, ids, labels):
+        def body(carry, _):
+            p, s = carry
+            p, s, loss = train_step(p, s, ids, labels)
+            return (p, s), loss
+        (p, s), losses = jax.lax.scan(body, (params, opt_state), None,
+                                      length=iters)
+        return p, s, losses[-1]
+
+    loop_j = jax.jit(loop, donate_argnums=(0, 1))
+
+    # roundtrip latency of a trivial call (tunnel overhead)
+    triv = jax.jit(lambda x: x + 1)
+    float(triv(jnp.zeros(())))
+    lats = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(triv(jnp.zeros(())))
+        lats.append(time.perf_counter() - t0)
+    roundtrip = sorted(lats)[len(lats) // 2]
+
+    # warmup/compile
+    params, opt_state, loss = loop_j(params, opt_state, ids, labels)
+    loss = float(loss)
+
+    best = float("inf")
+    for _ in range(3 if on_tpu else 1):
+        t0 = time.perf_counter()
+        params, opt_state, l_last = loop_j(params, opt_state, ids, labels)
+        l_host = float(l_last)
+        best = min(best, time.perf_counter() - t0)
+    loss = l_host
+    dt = max(best - roundtrip, 1e-9) / iters
 
     n_params = sum(int(np.prod(v.shape)) for v in
                    jax.tree_util.tree_leaves(params))
@@ -115,6 +142,30 @@ def main():
     mfu = flops / dt / peak_flops_per_chip() if on_tpu else 0.0
     samples_per_sec = B / dt
 
+    # calibrate the device's ACHIEVABLE matmul roofline (the shared/
+    # throttled tunnel device delivers far below nominal peak; report both)
+    matmul_tflops = 0.0
+    if on_tpu:
+        N = 4096
+        # random data — an all-ones operand lets XLA's algebraic
+        # simplifier fold the matmul into a reduction
+        a = jnp.asarray(rs.randn(N, N), jnp.bfloat16)
+
+        def mm(a, c):
+            # body must use the traced parameter, not a closure — a closed-
+            # over matrix would be baked into the HLO as a constant
+            return jax.lax.scan(lambda c, _: (a @ c, ()), c, None,
+                                length=30)[0]
+
+        mm = jax.jit(mm)
+        c = mm(a, a)
+        float(c[0, 0])
+        t0 = time.perf_counter()
+        c = mm(a, c)
+        float(c[0, 0])
+        mm_dt = max(time.perf_counter() - t0 - roundtrip, 1e-9) / 30
+        matmul_tflops = 2 * N ** 3 / mm_dt / 1e12
+
     result = {
         "metric": "bert_base_samples_per_sec_per_chip" if on_tpu
                   else "bert_smoke_samples_per_sec_cpu",
@@ -122,6 +173,9 @@ def main():
         "unit": "samples/s",
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
         "mfu": round(mfu, 4),
+        "mfu_vs_measured_matmul_peak": round(
+            flops / dt / (matmul_tflops * 1e12), 4) if matmul_tflops else 0.0,
+        "measured_matmul_tflops": round(matmul_tflops, 1),
         "step_time_ms": round(dt * 1e3, 2),
         "params": n_params,
         "loss": float(loss),

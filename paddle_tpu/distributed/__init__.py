@@ -33,6 +33,7 @@ from .mesh import (  # noqa: F401
 from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
 from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
 from .pipeline import (  # noqa: F401
     pipeline_step_fn,
     spmd_pipeline,

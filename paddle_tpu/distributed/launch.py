@@ -45,6 +45,10 @@ def _parse_args(argv=None):
                         help="write workerlog.N files here")
     parser.add_argument("--backend", default="auto",
                         help="communication backend hint (auto|xla|gloo)")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="restart the pod up to N times on trainer "
+                             "failure (pairs with checkpoint auto-resume; "
+                             "the reference launcher has no restart)")
     parser.add_argument("training_script",
                         help="the training script to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -71,11 +75,22 @@ def get_cluster_from_args(args):
 def launch_collective(args):
     cluster, pod = get_cluster_from_args(args)
     logger.info("launching %s", cluster.trainers_endpoints())
-    procs = start_local_trainers(
-        cluster, pod, args.training_script, args.training_script_args,
-        log_dir=args.log_dir, backend=args.backend)
-    watch_local_trainers(procs, cluster.trainers_nranks())
-    return 0
+    attempt = 0
+    while True:
+        procs = start_local_trainers(
+            cluster, pod, args.training_script, args.training_script_args,
+            log_dir=args.log_dir, backend=args.backend,
+            envs={"PADDLE_RESTART_COUNT": str(attempt)})
+        try:
+            watch_local_trainers(procs, cluster.trainers_nranks())
+            return 0
+        except RuntimeError:
+            if attempt >= args.max_restarts:
+                raise
+            attempt += 1
+            logger.warning("pod failed — restart %s/%s (trainers should "
+                           "auto-resume from their latest checkpoint)",
+                           attempt, args.max_restarts)
 
 
 def launch(argv=None):

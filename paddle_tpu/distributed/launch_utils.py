@@ -133,6 +133,9 @@ def start_local_trainers(cluster, pod, training_script,
     procs = []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
+    # restarts (PADDLE_RESTART_COUNT > 0) append so earlier attempts'
+    # logs — usually the interesting ones — survive
+    restarting = (envs or {}).get("PADDLE_RESTART_COUNT", "0") != "0"
     for idx, t in enumerate(pod.trainers):
         env = dict(os.environ)
         env.update(envs or {})
@@ -141,8 +144,8 @@ def start_local_trainers(cluster, pod, training_script,
             list(training_script_args)
         log_fn = None
         if log_dir:
-            log_fn = open(os.path.join(log_dir,
-                                       f"workerlog.{t.rank}"), "w")
+            log_fn = open(os.path.join(log_dir, f"workerlog.{t.rank}"),
+                          "a" if restarting else "w")
             proc = subprocess.Popen(cmd, env=env, stdout=log_fn,
                                     stderr=subprocess.STDOUT)
         else:

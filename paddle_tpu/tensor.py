@@ -24,6 +24,7 @@ import numpy as np
 from . import autograd
 from .framework import dtype as _dtype_mod
 from .framework.dtype import convert_dtype, get_default_dtype, is_floating
+from .framework.flags import flag as _flag
 
 
 def _is_tracer(x) -> bool:
@@ -269,6 +270,27 @@ def _needs_grad(x) -> bool:
     return isinstance(x, Tensor) and not x.stop_gradient and is_floating(x.dtype)
 
 
+def _maybe_check_nan_inf(fn, out):
+    """FLAGS_check_nan_inf: per-op output finiteness guard in eager mode
+    (reference: operator.cc:1192 CheckOpHasNanOrInf via
+    details/nan_inf_utils_detail.cc). Debug-only — forces a host sync."""
+    if not _flag("FLAGS_check_nan_inf"):
+        return
+    import numpy as _np
+
+    leaves = out if isinstance(out, (tuple, list)) else [out]
+    for o in leaves:
+        v = o._value if isinstance(o, Tensor) else o
+        if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
+            arr = _np.asarray(v)
+            if not _np.isfinite(arr).all():
+                name = getattr(fn, "__name__", str(fn))
+                kind = "nan" if _np.isnan(arr).any() else "inf"
+                raise FloatingPointError(
+                    f"Operator {name} output contains {kind} "
+                    f"(FLAGS_check_nan_inf is set); shape={arr.shape}")
+
+
 def apply(fn, *args, _multi_out: bool = False, **kwargs):
     """Run pure jax function `fn` over (possibly Tensor) args.
 
@@ -282,6 +304,8 @@ def apply(fn, *args, _multi_out: bool = False, **kwargs):
 
     if any_tracer or not autograd.tape_enabled() or not any(_needs_grad(a) for a in args):
         out = fn(*jvals, **kwargs)
+        if not any_tracer:
+            _maybe_check_nan_inf(fn, out)
         # under no_grad / inside traces outputs do not require grad
         rg = (any_tracer or autograd.tape_enabled()) and \
             any(_needs_grad(a) for a in args)
@@ -298,6 +322,7 @@ def apply(fn, *args, _multi_out: bool = False, **kwargs):
         return fn(*vals, **kwargs)
 
     primal, vjp_fn = jax.vjp(closed, *diff_vals)
+    _maybe_check_nan_inf(fn, primal)
     out = _wrap_out(primal, tensor_args, produced=True, multi=_multi_out, requires_grad=True)
 
     outs = out if isinstance(out, (list, tuple)) else (out,)
