@@ -346,7 +346,11 @@ def _wrap_out(out, tensor_args, produced: bool, multi: bool, requires_grad: bool
         if not isinstance(v, (jax.Array, jax.core.Tracer, np.ndarray)):
             return v
         t = Tensor(v, stop_gradient=not requires_grad)
-        t._produced_by_op = produced
+        # leaf-ness is about GRAD HISTORY, not mere production: an output
+        # of an unrecorded op (no grad required at the time) is a leaf, so
+        # marking it trainable later accumulates into .grad (torch/paddle
+        # semantics) instead of dropping the gradient in backward()
+        t._produced_by_op = produced and requires_grad
         return t
 
     if isinstance(out, (tuple, list)):

@@ -1,0 +1,365 @@
+"""paddle.vision.ops — detection operators.
+
+Reference parity: paddle/fluid/operators/detection/ (~40 CUDA/C++ ops,
+SURVEY.md §2.4) — the subset modern detectors actually use: nms,
+multiclass_nms, roi_align, roi_pool, yolo_box, box_coder, prior_box, plus
+box_iou/box_area helpers (operators/detection/{multiclass_nms_op.cc,
+roi_align_op.cc, yolo_box_op.cc, box_coder_op.cc, prior_box_op.cc,
+iou_similarity_op.cc}).
+
+TPU disposition: everything is expressed with static shapes so it jits —
+NMS is a fixed-trip-count `lax.fori_loop` producing a keep mask (no
+dynamic-size outputs; callers slice by `keep_num`), RoI align is a
+vectorized bilinear gather, decoders are pure elementwise. No dynamic
+boxes-count recompilation as long as inputs are padded to a fixed N.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..tensor import Tensor, apply, unwrap
+
+__all__ = ["box_area", "box_iou", "nms", "multiclass_nms", "roi_align",
+           "roi_pool", "yolo_box", "box_coder", "prior_box"]
+
+
+def _v(x):
+    return unwrap(x)
+
+
+def box_area(boxes):
+    """[N,4] xyxy -> [N] (detection/iou_similarity_op.h area)."""
+    def f(b):
+        return (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    return apply(f, boxes)
+
+
+def _pairwise_iou(a, b):
+    """jnp-level [N,4]x[M,4] -> [N,M] IoU (single implementation shared by
+    box_iou and the NMS mask)."""
+    area1 = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area2 = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    lt = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    rb = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.clip(rb - lt, 0)
+    inter = wh[..., 0] * wh[..., 1]
+    return inter / (area1[:, None] + area2[None, :] - inter + 1e-10)
+
+
+def box_iou(boxes1, boxes2):
+    """[N,4] x [M,4] xyxy -> [N,M] IoU (iou_similarity_op.cc)."""
+    return apply(_pairwise_iou, boxes1, boxes2)
+
+
+def _nms_mask(boxes, scores, iou_threshold):
+    """Greedy NMS as a keep mask over a FIXED N (the jit-safe variant for
+    compiled detector steps)."""
+    n = boxes.shape[0]
+    order = jnp.argsort(-scores)
+    b = boxes[order]
+    pair_iou = _pairwise_iou(b, b)
+
+    def body(i, keep):
+        # suppress j>i overlapping a kept i
+        row = (pair_iou[i] > iou_threshold) & (jnp.arange(n) > i) & keep[i]
+        return keep & ~row
+
+    keep_sorted = jax.lax.fori_loop(0, n, body, jnp.ones((n,), bool))
+    # scatter back to original indexing
+    keep = jnp.zeros((n,), bool).at[order].set(keep_sorted)
+    return keep
+
+
+def _greedy_nms_numpy(b, s, iou_threshold):
+    """Host-side greedy NMS — no XLA compile per distinct box count."""
+    order = np.argsort(-s)
+    x1, y1, x2, y2 = b[:, 0], b[:, 1], b[:, 2], b[:, 3]
+    area = (x2 - x1) * (y2 - y1)
+    keep = []
+    while order.size:
+        i = order[0]
+        keep.append(i)
+        rest = order[1:]
+        xx1 = np.maximum(x1[i], x1[rest])
+        yy1 = np.maximum(y1[i], y1[rest])
+        xx2 = np.minimum(x2[i], x2[rest])
+        yy2 = np.minimum(y2[i], y2[rest])
+        inter = np.clip(xx2 - xx1, 0, None) * np.clip(yy2 - yy1, 0, None)
+        iou = inter / (area[i] + area[rest] - inter + 1e-10)
+        order = rest[iou <= iou_threshold]
+    return np.asarray(keep, np.int64)
+
+
+def nms(boxes, scores=None, iou_threshold=0.3, top_k=None):
+    """Greedy hard NMS (eager/host path). Returns kept indices sorted by
+    descending score (reference nms op); jit callers use the static-shape
+    mask variant paddle.vision.ops._nms_mask."""
+    b = np.asarray(_v(boxes))
+    s = (np.asarray(_v(scores)) if scores is not None
+         else np.arange(len(b), 0, -1, dtype=np.float32))
+    idx = _greedy_nms_numpy(b, s, iou_threshold)
+    if top_k is not None:
+        idx = idx[:top_k]
+    return Tensor(idx)
+
+
+def multiclass_nms(bboxes, scores, score_threshold=0.05, nms_top_k=400,
+                   keep_top_k=100, nms_threshold=0.3, background_label=0):
+    """Per-class NMS + global top-k (multiclass_nms_op.cc semantics,
+    single image). bboxes [N,4], scores [C,N]. Returns [M,6]
+    (label, score, x1, y1, x2, y2).  background_label defaults to 0 like
+    the reference op (class row 0 = background is skipped); pass -1 to
+    keep every class."""
+    b = np.asarray(_v(bboxes))
+    s = np.asarray(_v(scores))
+    out = []
+    for c in range(s.shape[0]):
+        if c == background_label:
+            continue
+        mask = s[c] > score_threshold
+        if not mask.any():
+            continue
+        cb, cs = b[mask], s[c][mask]
+        ord_ = np.argsort(-cs)[:nms_top_k]
+        cb, cs = cb[ord_], cs[ord_]
+        kept = np.asarray(nms(cb, cs, nms_threshold).numpy())
+        for i in kept:
+            out.append([c, cs[i], *cb[i]])
+    if not out:
+        return Tensor(np.zeros((0, 6), np.float32))
+    out = np.asarray(out, np.float32)
+    out = out[np.argsort(-out[:, 1])][:keep_top_k]
+    return Tensor(out)
+
+
+def roi_align(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+              sampling_ratio=-1, aligned=True):
+    """RoIAlign (roi_align_op.cc): x [N,C,H,W], boxes [R,4] xyxy in input
+    coords, boxes assumed on image 0 unless boxes_num splits them.
+    Bilinear-gather implementation — pure XLA, grads for free."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xv, bv):
+        xv = jnp.asarray(xv)
+        bv = jnp.asarray(bv)
+        N, C, H, W = xv.shape
+        R = bv.shape[0]
+        # batch index per roi — traced-safe: jnp.repeat with a static
+        # total length, so boxes_num may be a tracer under jit
+        if boxes_num is not None:
+            bn = jnp.asarray(_v(boxes_num))
+            bidx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                              total_repeat_length=R).astype(jnp.int32)
+        else:
+            bidx = jnp.zeros((R,), jnp.int32)
+        offset = 0.5 if aligned else 0.0
+        x1 = bv[:, 0] * spatial_scale - offset
+        y1 = bv[:, 1] * spatial_scale - offset
+        x2 = bv[:, 2] * spatial_scale - offset
+        y2 = bv[:, 3] * spatial_scale - offset
+        rw = x2 - x1
+        rh = y2 - y1
+        if not aligned:
+            rw = jnp.maximum(rw, 1.0)
+            rh = jnp.maximum(rh, 1.0)
+        sr = sampling_ratio if sampling_ratio > 0 else 2
+        # sample grid: [R, oh*sr, ow*sr]
+        ys = (y1[:, None] + (jnp.arange(oh * sr) + 0.5)[None, :]
+              * rh[:, None] / (oh * sr))
+        xs = (x1[:, None] + (jnp.arange(ow * sr) + 0.5)[None, :]
+              * rw[:, None] / (ow * sr))
+
+        def bilinear(img, yy, xx):
+            # img [C,H,W]; yy [P], xx [Q] -> [C,P,Q]
+            y0 = jnp.clip(jnp.floor(yy), 0, H - 1)
+            x0 = jnp.clip(jnp.floor(xx), 0, W - 1)
+            y1i = jnp.clip(y0 + 1, 0, H - 1).astype(jnp.int32)
+            x1i = jnp.clip(x0 + 1, 0, W - 1).astype(jnp.int32)
+            y0i = y0.astype(jnp.int32)
+            x0i = x0.astype(jnp.int32)
+            wy = jnp.clip(yy, 0, H - 1) - y0
+            wx = jnp.clip(xx, 0, W - 1) - x0
+            v00 = img[:, y0i][:, :, x0i]
+            v01 = img[:, y0i][:, :, x1i]
+            v10 = img[:, y1i][:, :, x0i]
+            v11 = img[:, y1i][:, :, x1i]
+            return (v00 * (1 - wy)[None, :, None] * (1 - wx)[None, None, :]
+                    + v01 * (1 - wy)[None, :, None] * wx[None, None, :]
+                    + v10 * wy[None, :, None] * (1 - wx)[None, None, :]
+                    + v11 * wy[None, :, None] * wx[None, None, :])
+
+        def per_roi(r):
+            img = xv[bidx[r]]
+            samples = bilinear(img, ys[r], xs[r])  # [C, oh*sr, ow*sr]
+            return samples.reshape(C, oh, sr, ow, sr).mean((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    return apply(f, x, boxes)
+
+
+def roi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0):
+    """RoIPool (roi_pool_op.cc) via dense-sampled max."""
+    if isinstance(output_size, int):
+        output_size = (output_size, output_size)
+    oh, ow = output_size
+
+    def f(xv, bv):
+        xv = jnp.asarray(xv)
+        bv = jnp.asarray(bv)
+        N, C, H, W = xv.shape
+        R = bv.shape[0]
+        if boxes_num is not None:
+            bn = jnp.asarray(_v(boxes_num))
+            bidx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                              total_repeat_length=R).astype(jnp.int32)
+        else:
+            bidx = jnp.zeros((R,), jnp.int32)
+        sr = 4  # dense samples per output cell edge
+
+        def per_roi(r):
+            x1 = bv[r, 0] * spatial_scale
+            y1 = bv[r, 1] * spatial_scale
+            x2 = jnp.maximum(bv[r, 2] * spatial_scale, x1 + 1)
+            y2 = jnp.maximum(bv[r, 3] * spatial_scale, y1 + 1)
+            ys = jnp.clip(y1 + (jnp.arange(oh * sr) + 0.5) * (y2 - y1)
+                          / (oh * sr), 0, H - 1).astype(jnp.int32)
+            xs = jnp.clip(x1 + (jnp.arange(ow * sr) + 0.5) * (x2 - x1)
+                          / (ow * sr), 0, W - 1).astype(jnp.int32)
+            img = xv[bidx[r]]
+            samples = img[:, ys][:, :, xs]
+            return samples.reshape(C, oh, sr, ow, sr).max((2, 4))
+
+        return jax.vmap(per_roi)(jnp.arange(R))
+
+    return apply(f, x, boxes)
+
+
+def yolo_box(x, img_size, anchors, class_num, conf_thresh=0.01,
+             downsample_ratio=32, clip_bbox=True, scale_x_y=1.0):
+    """Decode YOLO head output (yolo_box_op.cc): x [N, A*(5+C), H, W],
+    img_size [N,2] (h,w). Returns (boxes [N, A*H*W, 4] xyxy,
+    scores [N, A*H*W, C])."""
+    anchors = np.asarray(anchors, np.float32).reshape(-1, 2)
+    A = anchors.shape[0]
+
+    def f(xv, imgv):
+        N, _, H, W = xv.shape
+        xv = xv.reshape(N, A, 5 + class_num, H, W)
+        gx = (jnp.arange(W))[None, None, None, :]
+        gy = (jnp.arange(H))[None, None, :, None]
+        alpha, beta = scale_x_y, -0.5 * (scale_x_y - 1.0)
+        cx = (jax.nn.sigmoid(xv[:, :, 0]) * alpha + beta + gx) / W
+        cy = (jax.nn.sigmoid(xv[:, :, 1]) * alpha + beta + gy) / H
+        anc = jnp.asarray(anchors)
+        pw = anc[None, :, 0, None, None] * jnp.exp(xv[:, :, 2]) \
+            / (downsample_ratio * W)
+        ph = anc[None, :, 1, None, None] * jnp.exp(xv[:, :, 3]) \
+            / (downsample_ratio * H)
+        conf = jax.nn.sigmoid(xv[:, :, 4])
+        cls = jax.nn.sigmoid(xv[:, :, 5:]) * conf[:, :, None]
+        cls = jnp.where(conf[:, :, None] >= conf_thresh, cls, 0.0)
+        imh = imgv[:, 0].astype(jnp.float32)[:, None, None, None]
+        imw = imgv[:, 1].astype(jnp.float32)[:, None, None, None]
+        x1 = (cx - pw / 2) * imw
+        y1 = (cy - ph / 2) * imh
+        x2 = (cx + pw / 2) * imw
+        y2 = (cy + ph / 2) * imh
+        if clip_bbox:
+            x1 = jnp.clip(x1, 0, imw - 1)
+            y1 = jnp.clip(y1, 0, imh - 1)
+            x2 = jnp.clip(x2, 0, imw - 1)
+            y2 = jnp.clip(y2, 0, imh - 1)
+        boxes = jnp.stack([x1, y1, x2, y2], -1).reshape(N, -1, 4)
+        scores = jnp.moveaxis(cls, 2, -1).reshape(N, -1, class_num)
+        return boxes, scores
+
+    return apply(f, x, img_size, _multi_out=True)
+
+
+def box_coder(prior_box, prior_box_var, target_box,
+              code_type="encode_center_size", box_normalized=True, axis=0):
+    """Encode/decode boxes against priors (box_coder_op.cc).
+
+    Encode: priors [N,4], targets [N,4] -> [N,4] deltas.
+    Decode: priors [N,4] broadcast into targets [N,M,4] along `axis`
+    (axis=0: priors vary along dim 0; axis=1: along dim 1 — the reference's
+    per-class decode shape); 2-D targets decode elementwise.
+    """
+    def f(pb, pbv, tb):
+        norm = 0.0 if box_normalized else 1.0
+        pw = pb[..., 2] - pb[..., 0] + norm
+        ph = pb[..., 3] - pb[..., 1] + norm
+        pcx = pb[..., 0] + pw / 2
+        pcy = pb[..., 1] + ph / 2
+        if code_type == "encode_center_size":
+            tw = tb[..., 2] - tb[..., 0] + norm
+            th = tb[..., 3] - tb[..., 1] + norm
+            tcx = tb[..., 0] + tw / 2
+            tcy = tb[..., 1] + th / 2
+            dx = (tcx - pcx) / pw / pbv[..., 0]
+            dy = (tcy - pcy) / ph / pbv[..., 1]
+            dw = jnp.log(tw / pw) / pbv[..., 2]
+            dh = jnp.log(th / ph) / pbv[..., 3]
+            return jnp.stack([dx, dy, dw, dh], -1)
+        # decode — broadcast [N,4] priors against [N,M,4] targets per axis
+        if tb.ndim == 3:
+            exp = 1 if axis == 0 else 0
+            pw, ph, pcx, pcy = (jnp.expand_dims(v, exp)
+                                for v in (pw, ph, pcx, pcy))
+            pbv_b = jnp.expand_dims(pbv, exp)
+        else:
+            pbv_b = pbv
+        dcx = pbv_b[..., 0] * tb[..., 0] * pw + pcx
+        dcy = pbv_b[..., 1] * tb[..., 1] * ph + pcy
+        dw = jnp.exp(pbv_b[..., 2] * tb[..., 2]) * pw
+        dh = jnp.exp(pbv_b[..., 3] * tb[..., 3]) * ph
+        return jnp.stack([dcx - dw / 2, dcy - dh / 2,
+                          dcx + dw / 2 - norm, dcy + dh / 2 - norm], -1)
+
+    return apply(f, prior_box, prior_box_var, target_box)
+
+
+def prior_box(input, image, min_sizes, max_sizes=None, aspect_ratios=(1.0,),
+              variance=(0.1, 0.1, 0.2, 0.2), flip=False, clip=False,
+              steps=(0.0, 0.0), offset=0.5):
+    """SSD prior (anchor) boxes (prior_box_op.cc). input [N,C,H,W] feature
+    map, image [N,C,IH,IW]. Returns (boxes [H,W,A,4], variances same)."""
+    fh, fw = int(input.shape[2]), int(input.shape[3])
+    ih, iw = int(image.shape[2]), int(image.shape[3])
+    step_w = steps[0] or iw / fw
+    step_h = steps[1] or ih / fh
+    ars = list(aspect_ratios)
+    if flip:
+        ars += [1.0 / a for a in aspect_ratios if a != 1.0]
+    # reference anchor ordering (prior_box_op.h): per min_size emit
+    # [min, aspect-ratio anchors, max] — heads trained against paddle
+    # depend on this exact order
+    boxes = []
+    for k, s in enumerate(min_sizes):
+        boxes.append((s, s))
+        for a in ars:
+            if a == 1.0:
+                continue
+            boxes.append((s * np.sqrt(a), s / np.sqrt(a)))
+        if max_sizes:
+            smax = max_sizes[k]
+            boxes.append((np.sqrt(s * smax),) * 2)
+    A = len(boxes)
+    wh = np.asarray(boxes, np.float32)  # [A,2]
+    cx = (np.arange(fw) + offset) * step_w
+    cy = (np.arange(fh) + offset) * step_h
+    out = np.zeros((fh, fw, A, 4), np.float32)
+    out[..., 0] = (cx[None, :, None] - wh[None, None, :, 0] / 2) / iw
+    out[..., 1] = (cy[:, None, None] - wh[None, None, :, 1] / 2) / ih
+    out[..., 2] = (cx[None, :, None] + wh[None, None, :, 0] / 2) / iw
+    out[..., 3] = (cy[:, None, None] + wh[None, None, :, 1] / 2) / ih
+    if clip:
+        out = np.clip(out, 0, 1)
+    var = np.broadcast_to(np.asarray(variance, np.float32),
+                          out.shape).copy()
+    return Tensor(out), Tensor(var)

@@ -135,3 +135,27 @@ def test_getitem_grad():
     x = paddle.to_tensor([1.0, 2.0, 3.0], stop_gradient=False)
     x[1].backward()
     np.testing.assert_allclose(x.grad.numpy(), [0.0, 1.0, 0.0])
+
+
+class TestLeafSemantics:
+    def test_computed_tensor_marked_trainable_gets_grad(self):
+        """A tensor produced by an UNRECORDED op (no grad history) is a
+        leaf — marking it trainable afterwards must accumulate into .grad
+        (torch/paddle leaf semantics), not silently drop the gradient."""
+        b = paddle.randn([3]) * 0.01
+        assert b.is_leaf  # no grad history
+        b.stop_gradient = False
+        loss = paddle.sum(b * 2.0)
+        loss.backward()
+        assert b.grad is not None
+        np.testing.assert_allclose(np.asarray(b.grad.numpy()),
+                                   np.full(3, 2.0), rtol=1e-6)
+
+    def test_recorded_intermediate_is_not_leaf(self):
+        a = paddle.randn([3])
+        a.stop_gradient = False
+        mid = a * 2.0
+        assert not mid.is_leaf
+        loss = paddle.sum(mid)
+        loss.backward()
+        assert a.grad is not None and mid.grad is None

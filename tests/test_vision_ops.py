@@ -1,0 +1,177 @@
+"""Detection op tests (paddle.vision.ops vs numpy references — the
+detection/ op-family slice of the OpTest contract)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def rs(seed=0):
+    return np.random.RandomState(seed)
+
+
+class TestBoxHelpers:
+    def test_box_area_iou(self):
+        a = np.array([[0, 0, 2, 2], [1, 1, 3, 3]], np.float32)
+        area = np.asarray(V.box_area(a).numpy())
+        np.testing.assert_allclose(area, [4, 4])
+        iou = np.asarray(V.box_iou(a, a).numpy())
+        np.testing.assert_allclose(np.diag(iou), [1, 1], rtol=1e-5)
+        # overlap of the two: inter=1, union=7
+        assert iou[0, 1] == pytest.approx(1 / 7, rel=1e-4)
+
+
+class TestNMS:
+    def test_suppresses_overlaps(self):
+        boxes = np.array([[0, 0, 10, 10],
+                          [1, 1, 11, 11],     # heavy overlap with 0
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([0.9, 0.8, 0.7], np.float32)
+        keep = np.asarray(V.nms(boxes, scores, iou_threshold=0.5).numpy())
+        np.testing.assert_array_equal(keep, [0, 2])
+
+    def test_keeps_all_disjoint(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 10, 10]],
+                         np.float32)
+        scores = np.array([0.1, 0.9, 0.5], np.float32)
+        keep = np.asarray(V.nms(boxes, scores, 0.5).numpy())
+        np.testing.assert_array_equal(keep, [1, 2, 0])  # score order
+
+    def test_top_k(self):
+        boxes = np.array([[0, 0, 1, 1], [5, 5, 6, 6], [9, 9, 10, 10]],
+                         np.float32)
+        scores = np.array([0.1, 0.9, 0.5], np.float32)
+        keep = np.asarray(V.nms(boxes, scores, 0.5, top_k=1).numpy())
+        np.testing.assert_array_equal(keep, [1])
+
+    def test_multiclass(self):
+        boxes = np.array([[0, 0, 10, 10], [1, 1, 11, 11],
+                          [20, 20, 30, 30]], np.float32)
+        scores = np.array([[0.9, 0.85, 0.01],    # class 0
+                           [0.02, 0.03, 0.8]], np.float32)  # class 1
+        out = np.asarray(V.multiclass_nms(boxes, scores,
+                                          score_threshold=0.05,
+                                          nms_threshold=0.5,
+                                          background_label=-1).numpy())
+        labels = out[:, 0].astype(int).tolist()
+        # class 0: boxes 0/1 overlap → one kept; box 2 below threshold
+        assert labels.count(0) == 1 and labels.count(1) == 1
+
+    def test_multiclass_background_default_skips_class0(self):
+        """multiclass_nms_op.cc defaults background_label=0."""
+        boxes = np.array([[0, 0, 10, 10]], np.float32)
+        scores = np.array([[0.99], [0.5]], np.float32)
+        out = np.asarray(V.multiclass_nms(boxes, scores,
+                                          score_threshold=0.05).numpy())
+        assert (out[:, 0] != 0).all() and len(out) == 1
+        assert out[0, 1] == pytest.approx(0.5)  # the class-1 detection
+
+
+class TestRoiOps:
+    def test_roi_align_constant_map(self):
+        """Constant feature map → every aligned cell equals the constant."""
+        x = np.full((1, 3, 16, 16), 2.5, np.float32)
+        rois = np.array([[0, 0, 8, 8], [4, 4, 12, 12]], np.float32)
+        out = np.asarray(V.roi_align(x, rois, output_size=4).numpy())
+        assert out.shape == (2, 3, 4, 4)
+        np.testing.assert_allclose(out, 2.5, rtol=1e-5)
+
+    def test_roi_align_gradient_flows(self):
+        x = paddle.to_tensor(rs().rand(1, 2, 8, 8).astype("f"))
+        x.stop_gradient = False
+        rois = np.array([[0, 0, 8, 8]], np.float32)
+        out = V.roi_align(x, rois, output_size=2)
+        paddle.sum(out).backward()
+        g = np.asarray(x.grad.numpy())
+        assert g.sum() > 0  # bilinear weights sum to out-cells
+
+    def test_roi_pool_takes_max(self):
+        x = np.zeros((1, 1, 8, 8), np.float32)
+        x[0, 0, 2, 2] = 5.0
+        rois = np.array([[0, 0, 4, 4]], np.float32)
+        out = np.asarray(V.roi_pool(x, rois, output_size=1).numpy())
+        assert out.reshape(()) == pytest.approx(5.0)
+
+
+class TestYoloBox:
+    def test_decode_shapes_and_ranges(self):
+        N, A, C, H, W = 2, 3, 4, 5, 5
+        x = rs().randn(N, A * (5 + C), H, W).astype("f")
+        img = np.array([[160, 160], [320, 320]], np.int32)
+        anchors = [10, 13, 16, 30, 33, 23]
+        boxes, scores = V.yolo_box(x, img, anchors, C, conf_thresh=0.0)
+        b = np.asarray(boxes.numpy())
+        s = np.asarray(scores.numpy())
+        assert b.shape == (N, A * H * W, 4)
+        assert s.shape == (N, A * H * W, C)
+        # clip_bbox → inside image
+        assert b[0].min() >= 0 and b[0, :, [0, 2]].max() <= 159
+        assert (s >= 0).all() and (s <= 1).all()
+
+    def test_conf_thresh_zeroes_scores(self):
+        N, A, C, H, W = 1, 1, 2, 2, 2
+        x = np.full((N, A * (5 + C), H, W), -10.0, np.float32)  # conf ~0
+        img = np.array([[64, 64]], np.int32)
+        _, scores = V.yolo_box(x, img, [10, 10], C, conf_thresh=0.5)
+        assert np.asarray(scores.numpy()).max() == 0.0
+
+
+class TestBoxCoder:
+    def test_encode_decode_roundtrip(self):
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        pvar = np.full((2, 4), 0.1, np.float32)
+        targets = np.array([[1, 1, 12, 11], [4, 6, 22, 24]], np.float32)
+        enc = V.box_coder(priors, pvar, targets, "encode_center_size")
+        dec = V.box_coder(priors, pvar, enc, "decode_center_size")
+        np.testing.assert_allclose(np.asarray(dec.numpy()), targets,
+                                   rtol=1e-4, atol=1e-3)
+
+    def test_decode_3d_per_class(self):
+        """[N,M,4] decode (per-class deltas) with axis=0: priors vary
+        along dim 0, classes along dim 1."""
+        priors = np.array([[0, 0, 10, 10], [5, 5, 20, 25]], np.float32)
+        pvar = np.ones((2, 4), np.float32)
+        deltas = np.zeros((2, 3, 4), np.float32)  # zero deltas → priors
+        dec = np.asarray(V.box_coder(priors, pvar, deltas,
+                                     "decode_center_size", axis=0).numpy())
+        assert dec.shape == (2, 3, 4)
+        for m in range(3):
+            np.testing.assert_allclose(dec[:, m], priors, rtol=1e-5)
+
+
+class TestRoiAlignJit:
+    def test_roi_align_jits_with_traced_boxes_num(self):
+        import jax
+        import jax.numpy as jnp
+
+        x = rs().rand(2, 2, 8, 8).astype("f")
+        rois = np.array([[0, 0, 8, 8], [0, 0, 4, 4], [2, 2, 6, 6]], "f")
+
+        @jax.jit
+        def run(xv, bv, bn):
+            return V.roi_align(paddle.Tensor(xv), paddle.Tensor(bv),
+                               boxes_num=paddle.Tensor(bn),
+                               output_size=2).value
+
+        out = run(jnp.asarray(x), jnp.asarray(rois),
+                  jnp.asarray(np.array([1, 2], np.int32)))
+        assert out.shape == (3, 2, 2, 2)
+
+
+class TestPriorBox:
+    def test_shapes_and_normalization(self):
+        feat = paddle.zeros([1, 8, 4, 4])
+        img = paddle.zeros([1, 3, 64, 64])
+        boxes, var = V.prior_box(feat, img, min_sizes=[16],
+                                 aspect_ratios=[1.0, 2.0], flip=True,
+                                 clip=True)
+        b = np.asarray(boxes.numpy())
+        assert b.shape == (4, 4, 3, 4)  # 1 + (2.0, 0.5) aspect anchors
+        assert b.min() >= 0 and b.max() <= 1
+        v = np.asarray(var.numpy())
+        assert v.shape == b.shape
+        np.testing.assert_allclose(v[..., 2], 0.2)
+        # square anchor centered in cell 0: size 16/64 = 0.25 normalized
+        w = b[0, 0, 0, 2] - b[0, 0, 0, 0]
+        assert w == pytest.approx(0.25, abs=1e-5)
