@@ -1065,19 +1065,117 @@ def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
     return apply(f, x)
 
 
+def _unfold_pads(paddings):
+    """1/2/4-int padding forms (reference unfold_op): 1 → all sides,
+    2 → (ph, pw), 4 → (top, left, bottom, right). Returns ((pt,pb),(pl,pr))."""
+    if isinstance(paddings, int):
+        return (paddings, paddings), (paddings, paddings)
+    p = list(paddings)
+    if len(p) == 1:
+        return (p[0], p[0]), (p[0], p[0])
+    if len(p) == 2:
+        return (p[0], p[0]), (p[1], p[1])
+    if len(p) == 4:
+        return (p[0], p[2]), (p[1], p[3])
+    raise ValueError(f"paddings must have 1, 2 or 4 elements, got {p}")
+
+
 def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
     k = _norm_tuple(kernel_sizes, 2)
     s = _norm_tuple(strides, 2)
-    p = _norm_tuple(paddings, 2)
+    (pt, pb), (pl, pr) = _unfold_pads(paddings)
     d = _norm_tuple(dilations, 2)
 
     def f(v):
         N, C, H, W = v.shape
         patches = jax.lax.conv_general_dilated_patches(
-            v, k, s, [(p[0], p[0]), (p[1], p[1])], rhs_dilation=d,
+            v, k, s, [(pt, pb), (pl, pr)], rhs_dilation=d,
             dimension_numbers=("NCHW", "OIHW", "NCHW"))
         L = patches.shape[2] * patches.shape[3]
         return patches.reshape(N, C * k[0] * k[1], L)
+    return apply(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — inverse of unfold (operators/fold_op): x [N, C*kh*kw, L]
+    -> [N, C, H, W] with overlapping patches summed (scatter-add via the
+    transpose of the patch-extraction convolution)."""
+    out = _norm_tuple(output_sizes, 2)
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    (pt, pb), (pl, pr) = _unfold_pads(paddings)
+    d = _norm_tuple(dilations, 2)
+
+    def f(v):
+        N, CKK, L = v.shape
+        C = CKK // (k[0] * k[1])
+        oh = (out[0] + pt + pb - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out[1] + pl + pr - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = v.reshape(N, C, k[0], k[1], oh, ow)
+        # scatter-add each kernel tap into the padded output
+        acc = jnp.zeros((N, C, out[0] + pt + pb, out[1] + pl + pr),
+                        v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                ys = i * d[0]
+                xs = j * d[1]
+                acc = acc.at[:, :, ys:ys + oh * s[0]:s[0],
+                             xs:xs + ow * s[1]:s[1]].add(cols[:, :, i, j])
+        return acc[:, :, pt:pt + out[0], pl:pl + out[1]]
+
+    return apply(f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid from affine matrices (operators/affine_grid_op):
+    theta [N,2,3], out_shape [N,C,H,W] -> grid [N,H,W,2] for grid_sample."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(x) for x in np.asarray(out_shape.numpy())]
+    N, C, H, W = (int(x) for x in out_shape)
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H,W,3]
+        return jnp.einsum("hwk,nik->nhwi", base,
+                          th.astype(jnp.float32)).astype(th.dtype)
+
+    return apply(f, theta)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift along time (operators/temporal_shift_op):
+    x [N*T, C, H, W] -> same shape with the first fold of channels shifted
+    back one step in time, the second fold forward."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, got {data_format}")
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        NT, C, H, W = v.shape
+        T = seg_num
+        B = NT // T
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        v = v.reshape(B, T, C, H, W)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])],
+                               axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                               v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
     return apply(f, x)
 
 

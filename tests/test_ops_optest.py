@@ -312,3 +312,70 @@ class TestCumulativeOps(OpTest):
             lambda a: np.sort(a, 1)[:, ::-1][:, :2].copy(), [x])
         self.check_output(lambda t: paddle.argmax(t, axis=1),
                           lambda a: a.argmax(1), [x])
+
+
+class TestImageOps(OpTest):
+    def test_unfold_fold_roundtrip(self):
+        """fold(unfold(x)) with stride=kernel (non-overlapping) == x."""
+        x = rs().randn(2, 3, 8, 8).astype("f")
+        cols = F.unfold(paddle.to_tensor(x), kernel_sizes=2, strides=2)
+        back = F.fold(cols, output_sizes=8, kernel_sizes=2, strides=2)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-5)
+
+    def test_fold_overlap_sums(self):
+        """Overlapping patches scatter-ADD (col2im semantics): folding
+        all-ones cols with k=2,s=1 counts patch coverage per pixel."""
+        oh = ow = 3  # output 4x4, kernel 2, stride 1 → 3x3 patches
+        cols = np.ones((1, 1 * 2 * 2, oh * ow), np.float32)
+        out = np.asarray(F.fold(paddle.to_tensor(cols), output_sizes=4,
+                                kernel_sizes=2, strides=1).numpy())
+        expect = np.array([[1, 2, 2, 1],
+                           [2, 4, 4, 2],
+                           [2, 4, 4, 2],
+                           [1, 2, 2, 1]], np.float32)
+        np.testing.assert_allclose(out[0, 0], expect)
+
+    def test_affine_grid_identity(self):
+        theta = np.tile(np.array([[[1, 0, 0], [0, 1, 0]]], np.float32),
+                        (2, 1, 1))
+        grid = F.affine_grid(paddle.to_tensor(theta), [2, 3, 4, 5])
+        g = np.asarray(grid.numpy())
+        assert g.shape == (2, 4, 5, 2)
+        np.testing.assert_allclose(g[0, 0, 0], [-1, -1], atol=1e-6)
+        np.testing.assert_allclose(g[0, -1, -1], [1, 1], atol=1e-6)
+        # identity grid + grid_sample == identity resize
+        x = rs().randn(2, 3, 4, 5).astype("f")
+        y = F.grid_sample(paddle.to_tensor(x), grid)
+        np.testing.assert_allclose(np.asarray(y.numpy()), x, atol=1e-5)
+
+    def test_temporal_shift(self):
+        B, T, C, H, W = 2, 4, 8, 2, 2
+        x = rs().randn(B * T, C, H, W).astype("f")
+        out = np.asarray(F.temporal_shift(paddle.to_tensor(x), T,
+                                          shift_ratio=0.25).numpy())
+        v = x.reshape(B, T, C, H, W)
+        o = out.reshape(B, T, C, H, W)
+        np.testing.assert_allclose(o[:, :-1, :2], v[:, 1:, :2])   # back
+        np.testing.assert_allclose(o[:, -1, :2], 0)
+        np.testing.assert_allclose(o[:, 1:, 2:4], v[:, :-1, 2:4])  # fwd
+        np.testing.assert_allclose(o[:, 0, 2:4], 0)
+        np.testing.assert_allclose(o[:, :, 4:], v[:, :, 4:])       # rest
+
+    def test_fold_asymmetric_4pad_roundtrip(self):
+        """4-int [top, left, bottom, right] padding form (reference
+        unfold_op) round-trips through unfold→fold on the interior."""
+        x = rs().randn(1, 2, 6, 6).astype("f")
+        pads = [1, 0, 2, 1]
+        cols = F.unfold(paddle.to_tensor(x), kernel_sizes=3, strides=3,
+                        paddings=pads)
+        back = F.fold(cols, output_sizes=6, kernel_sizes=3, strides=3,
+                      paddings=pads)
+        np.testing.assert_allclose(np.asarray(back.numpy()), x, rtol=1e-5)
+
+    def test_temporal_shift_nhwc(self):
+        x = rs().randn(4, 2, 2, 8).astype("f")  # [N*T, H, W, C]
+        out = np.asarray(F.temporal_shift(paddle.to_tensor(x), 2,
+                                          data_format="NHWC").numpy())
+        ref = np.asarray(F.temporal_shift(
+            paddle.to_tensor(np.moveaxis(x, -1, 1).copy()), 2).numpy())
+        np.testing.assert_allclose(out, np.moveaxis(ref, 1, -1), rtol=1e-6)
