@@ -172,6 +172,15 @@ class GPTForCausalLM(Layer):
         return logits, T.mean(loss)
 
     def loss(self, input_ids):
-        """Next-token LM loss on a batch of token ids."""
-        _, loss = self.forward(input_ids, labels=input_ids)
-        return loss
+        """Next-token LM loss on a batch of token ids, via the chunked
+        fused LM-head matmul + cross entropy (ops/fused.py
+        fused_linear_cross_entropy) — the fp32 [B*S, V] logits never
+        materialize in HBM at once."""
+        hidden = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            w = T.transpose(self.gpt.wte.weight, [1, 0])
+        else:
+            w = self.lm_head.weight
+        loss = fused.fused_linear_cross_entropy(
+            hidden[:, :-1], w, input_ids[:, 1:])
+        return T.mean(loss)

@@ -83,6 +83,112 @@ def softmax_cross_entropy(logits, label, ignore_index=-100):
 
 
 # ---------------------------------------------------------------------------
+# fused LM-head matmul + cross entropy, chunked over the vocab
+# ---------------------------------------------------------------------------
+def _flce_impl(h, w, labels, chunk):
+    """Online-logsumexp over vocab chunks: never materializes the full
+    [N, V] logits in fp32 (the [B*S, 30k+] fp32 buffer is the single
+    largest allocation in a BERT/GPT loss)."""
+    N, H = h.shape
+    V = w.shape[1]
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    wp = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    w_chunks = wp.reshape(H, n_chunks, chunk).transpose(1, 0, 2)
+    hf = h.astype(jnp.float32)
+    li = labels.astype(jnp.int32)
+
+    def body(carry, wc_i):
+        m, s, picked = carry
+        wc, i = wc_i
+        z = (hf @ wc.astype(jnp.float32))              # [N, chunk] fp32
+        base = i * chunk
+        # mask padded vocab tail
+        valid = (base + jnp.arange(chunk)) < V
+        z = jnp.where(valid[None, :], z, -jnp.inf)
+        m_new = jnp.maximum(m, z.max(-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            z - m_new[:, None]).sum(-1)
+        in_chunk = (li >= base) & (li < base + chunk)
+        local = jnp.clip(li - base, 0, chunk - 1)
+        picked = picked + jnp.where(
+            in_chunk, jnp.take_along_axis(z, local[:, None], 1)[:, 0], 0.0)
+        return (m_new, s, picked), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    (m, s, picked), _ = jax.lax.scan(
+        body, init, (w_chunks, jnp.arange(n_chunks)))
+    return jnp.log(s) + m - picked, (m, s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flce(h, w, labels, chunk):
+    loss, _ = _flce_impl(h, w, labels, chunk)
+    return loss
+
+
+def _flce_fwd(h, w, labels, chunk):
+    loss, (m, s) = _flce_impl(h, w, labels, chunk)
+    return loss, (h, w, labels, m, s)
+
+
+def _flce_bwd(chunk, res, g):
+    h, w, labels, m, s = res
+    N, H = h.shape
+    V = w.shape[1]
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    wp = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    w_chunks = wp.reshape(H, n_chunks, chunk).transpose(1, 0, 2)
+    hf = h.astype(jnp.float32)
+    li = labels.astype(jnp.int32)
+    lse = jnp.log(s) + m
+    gf = g.astype(jnp.float32)
+
+    def body(dh, wc_i):
+        wc, i = wc_i
+        wcf = wc.astype(jnp.float32)
+        z = hf @ wcf
+        base = i * chunk
+        valid = (base + jnp.arange(chunk)) < V
+        p = jnp.where(valid[None, :], jnp.exp(z - lse[:, None]), 0.0)
+        onehot = ((li[:, None] - base) ==
+                  jnp.arange(chunk)[None, :]).astype(jnp.float32)
+        dz = (p - onehot) * gf[:, None]               # [N, chunk]
+        dh = dh + dz @ wcf.T
+        dwc = hf.T @ dz                               # [H, chunk]
+        return dh, dwc
+
+    dh, dwcs = jax.lax.scan(body, jnp.zeros((N, H), jnp.float32),
+                            (w_chunks, jnp.arange(n_chunks)))
+    dw = dwcs.transpose(1, 0, 2).reshape(H, Vp)[:, :V]
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192):
+    """loss = cross_entropy(hidden @ weight, labels), streamed over vocab
+    chunks (TPU-native extension; the reference's closest analog is the
+    fused softmax_with_cross_entropy_op.cc — this additionally fuses the
+    LM-head matmul so the fp32 [N, V] logits never hit HBM at once).
+
+    hidden [..., H], weight [H, V], labels [...] int. Returns per-token
+    loss with hidden's leading shape.
+    """
+    def f(h, w, l):
+        lead = h.shape[:-1]
+        hf = h.reshape(-1, h.shape[-1])
+        lf = l.reshape(-1)
+        loss = _flce(hf, w, lf, chunk_size)
+        return loss.reshape(lead)
+
+    return apply(f, hidden, weight, labels)
+
+
+# ---------------------------------------------------------------------------
 # attention
 # ---------------------------------------------------------------------------
 def scaled_dot_product_attention(query, key, value, attn_mask=None,

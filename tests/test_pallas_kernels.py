@@ -8,6 +8,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+import paddle_tpu as paddle
+
 from paddle_tpu.ops.pallas.flash_attention import flash_attention
 from paddle_tpu.ops.pallas.layer_norm import layer_norm
 
@@ -93,3 +95,82 @@ def test_fused_op_dispatch_falls_back_cleanly(monkeypatch):
     ref = _attn_ref(x.value, x.value, x.value, False)
     np.testing.assert_allclose(np.asarray(out.value), np.asarray(ref),
                                rtol=1e-4, atol=1e-5)
+
+
+class TestFusedLinearCrossEntropy:
+    """Chunked LM-head matmul + xent vs the direct computation."""
+
+    def _direct(self, h, w, labels):
+        z = (h.astype(np.float64) @ w.astype(np.float64))
+        m = z.max(-1, keepdims=True)
+        lse = np.log(np.exp(z - m).sum(-1)) + m[:, 0]
+        picked = z[np.arange(len(labels)), labels]
+        return lse - picked
+
+    def test_forward_matches_direct(self):
+        from paddle_tpu.ops import fused
+        rs = np.random.RandomState(0)
+        N, H, V = 12, 16, 1000
+        h = rs.randn(N, H).astype("f")
+        w = (rs.randn(H, V) * 0.1).astype("f")
+        labels = rs.randint(0, V, N)
+        out = fused.fused_linear_cross_entropy(
+            paddle.to_tensor(h), paddle.to_tensor(w),
+            paddle.to_tensor(labels), chunk_size=128)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   self._direct(h, w, labels), rtol=1e-4)
+
+    def test_vocab_not_multiple_of_chunk(self):
+        from paddle_tpu.ops import fused
+        rs = np.random.RandomState(1)
+        N, H, V = 6, 8, 37  # 37 not divisible by 16
+        h = rs.randn(N, H).astype("f")
+        w = (rs.randn(H, V) * 0.1).astype("f")
+        labels = rs.randint(0, V, N)
+        out = fused.fused_linear_cross_entropy(
+            paddle.to_tensor(h), paddle.to_tensor(w),
+            paddle.to_tensor(labels), chunk_size=16)
+        np.testing.assert_allclose(np.asarray(out.numpy()),
+                                   self._direct(h, w, labels), rtol=1e-4)
+
+    def test_gradients_match_direct(self):
+        from paddle_tpu.ops import fused
+        import jax
+        import jax.numpy as jnp
+        rs = np.random.RandomState(2)
+        N, H, V = 8, 12, 300
+        h = rs.randn(N, H).astype("f")
+        w = (rs.randn(H, V) * 0.1).astype("f")
+        labels = jnp.asarray(rs.randint(0, V, N))
+
+        def fused_loss(hh, ww):
+            return fused._flce(hh, ww, labels, 64).mean()
+
+        def direct_loss(hh, ww):
+            z = (hh @ ww).astype(jnp.float32)
+            lp = jax.nn.log_softmax(z, -1)
+            return -jnp.take_along_axis(lp, labels[:, None], 1).mean()
+
+        gh1, gw1 = jax.grad(fused_loss, (0, 1))(jnp.asarray(h),
+                                                jnp.asarray(w))
+        gh2, gw2 = jax.grad(direct_loss, (0, 1))(jnp.asarray(h),
+                                                 jnp.asarray(w))
+        np.testing.assert_allclose(np.asarray(gh1), np.asarray(gh2),
+                                   rtol=1e-3, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(gw1), np.asarray(gw2),
+                                   rtol=1e-3, atol=1e-6)
+
+    def test_batched_leading_shape(self):
+        from paddle_tpu.ops import fused
+        rs = np.random.RandomState(3)
+        B, S, H, V = 2, 5, 8, 50
+        h = rs.randn(B, S, H).astype("f")
+        w = (rs.randn(H, V) * 0.1).astype("f")
+        labels = rs.randint(0, V, (B, S))
+        out = fused.fused_linear_cross_entropy(
+            paddle.to_tensor(h), paddle.to_tensor(w),
+            paddle.to_tensor(labels), chunk_size=16)
+        assert tuple(out.shape) == (B, S)
+        flat = self._direct(h.reshape(-1, H), w, labels.reshape(-1))
+        np.testing.assert_allclose(np.asarray(out.numpy()).reshape(-1),
+                                   flat, rtol=1e-4)
