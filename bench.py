@@ -1,55 +1,201 @@
-"""Benchmark: BERT-base pretraining step throughput + MFU on one chip.
+"""Benchmark driver: BASELINE.md configs on one TPU chip, resilient to
+backend failures.
 
-BASELINE.md config 3 (BERT-base, Fleet collective DP): measures
-samples/sec/chip and MFU for a full jitted train step (fwd+bwd+AdamW) in
-bf16.  vs_baseline = achieved MFU / 0.40 (the north-star target — the
-reference publishes no numbers, BASELINE.md).
+Design (VERDICT.md round-1 Weak #1): the top-level process imports NO jax.
+It probes the TPU backend in a subprocess with a hard timeout and
+retry-with-backoff, then runs every benchmark config in its own subprocess.
+A hung/unavailable TPU tunnel can therefore never crash or wedge the
+driver: configs fall back to an explicit-marker CPU run, and the driver
+always exits 0 having printed one JSON line per config.
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+The HEADLINE line (BERT-base samples/s + MFU, BASELINE.md config 3) is
+printed LAST so output tails capture it:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N, ...}
+
+Secondary configs (one JSON line each, VERDICT round-1 next-step #6):
+  resnet50   - ResNet-50 data-parallel samples/s/chip  (BASELINE config 2)
+  ernie      - ERNIE/BERT-base with AMP-O2 GradScaler  (BASELINE config 4)
+  gpt13b     - GPT-3 1.3B-layout tokens/s (scaled-down hidden on one chip,
+               exact 1.3B config compile+memory check)  (BASELINE config 5)
+  kernels    - Pallas flash-attention + fused layer_norm numerics vs the
+               plain-XLA path ON THE REAL CHIP (round-1 gap: kernels had
+               only been validated in CPU interpret mode)
 """
 from __future__ import annotations
 
 import json
+import os
+import subprocess
+import sys
 import time
 
-import numpy as np
+PROBE_TIMEOUT_S = 240        # first TPU compile can take ~40s; init can be slower
+PROBE_ATTEMPTS = 3
+PROBE_BACKOFF_S = (0, 15, 45)
+CONFIG_TIMEOUT_TPU_S = 900
+CONFIG_TIMEOUT_CPU_S = 600
+
+CONFIGS = ("kernels", "resnet50", "ernie", "gpt13b", "bert")  # bert last = headline
 
 
-def peak_flops_per_chip() -> float:
+def _cpu_env():
+    """Env for a guaranteed-CPU subprocess: skip axon TPU registration
+    entirely (the sitecustomize register() call blocks interpreter startup
+    when the tunnel is down)."""
+    env = dict(os.environ)
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _tpu_env():
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)  # let the axon plugin pick its backend
+    return env
+
+
+def _run(args, env, timeout):
+    try:
+        p = subprocess.run([sys.executable, os.path.abspath(__file__)] + args,
+                           env=env, timeout=timeout, capture_output=True,
+                           text=True)
+        return p.returncode, p.stdout, p.stderr
+    except subprocess.TimeoutExpired as e:
+        return -1, (e.stdout or ""), f"timeout after {timeout}s"
+    except Exception as e:  # noqa: BLE001 - driver must never crash
+        return -2, "", f"{type(e).__name__}: {e}"
+
+
+def probe_tpu():
+    """Return device-kind string if a TPU chip is reachable AND executes a
+    matmul, else None. Retries with backoff."""
+    for i in range(PROBE_ATTEMPTS):
+        if PROBE_BACKOFF_S[i]:
+            time.sleep(PROBE_BACKOFF_S[i])
+        rc, out, err = _run(["--probe"], _tpu_env(), PROBE_TIMEOUT_S)
+        for line in out.splitlines():
+            if line.startswith('{"probe"'):
+                d = json.loads(line)
+                if d.get("ok"):
+                    return d["device_kind"]
+        sys.stderr.write(f"[bench] TPU probe attempt {i + 1}/{PROBE_ATTEMPTS} "
+                         f"failed (rc={rc}): {err.strip()[-200:]}\n")
+    return None
+
+
+def drive():
+    kind = probe_tpu()
+    on_tpu = kind is not None
+    sys.stderr.write(f"[bench] backend: {'TPU ' + kind if on_tpu else 'CPU fallback'}\n")
+    for cfg in CONFIGS:
+        line = None
+        if on_tpu:
+            rc, out, err = _run(["--config", cfg], _tpu_env(),
+                                CONFIG_TIMEOUT_TPU_S)
+            line = _extract(out)
+            if line is None:  # one retry on TPU, then CPU fallback
+                sys.stderr.write(f"[bench] {cfg} on TPU failed (rc={rc}): "
+                                 f"{err.strip()[-300:]}\n[bench] retrying {cfg} on TPU\n")
+                rc, out, err = _run(["--config", cfg], _tpu_env(),
+                                    CONFIG_TIMEOUT_TPU_S)
+                line = _extract(out)
+        if line is None:
+            rc, out, err = _run(["--config", cfg], _cpu_env(),
+                                CONFIG_TIMEOUT_CPU_S)
+            line = _extract(out)
+            if line is not None and on_tpu:
+                line["fallback_from_tpu"] = True
+        if line is None:
+            line = {"metric": cfg, "value": 0.0, "unit": "error",
+                    "vs_baseline": 0.0, "error": (err or "no output").strip()[-300:]}
+        print(json.dumps(line), flush=True)
+    return 0
+
+
+def _extract(out):
+    for line in reversed(out.splitlines()):
+        line = line.strip()
+        if line.startswith("{") and '"metric"' in line:
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                pass
+    return None
+
+
+# --------------------------------------------------------------------------
+# subprocess bodies (these DO import jax)
+# --------------------------------------------------------------------------
+
+def body_probe():
+    import jax
+    import jax.numpy as jnp
+
+    d = jax.devices()[0]
+    x = jnp.ones((256, 256), jnp.bfloat16)
+    v = float((x @ x)[0, 0])
+    print(json.dumps({"probe": 1, "ok": v == 256.0,
+                      "device_kind": d.device_kind,
+                      "platform": d.platform}))
+
+
+def peak_flops_per_chip():
     import jax
 
     kind = jax.devices()[0].device_kind.lower()
-    table = {
-        "v4": 275e12,
-        "v5 lite": 197e12,
-        "v5e": 197e12,
-        "v5p": 459e12,
-        "v5": 459e12,
-        "v6 lite": 918e12,
-        "v6e": 918e12,
-    }
+    table = {"v4": 275e12, "v5 lite": 197e12, "v5e": 197e12, "v5p": 459e12,
+             "v5": 459e12, "v6 lite": 918e12, "v6e": 918e12}
     for k, v in sorted(table.items(), key=lambda kv: -len(kv[0])):
         if k in kind:
             return v
     return 275e12  # default to v4 per BASELINE.md
 
 
-def main():
+def _roundtrip():
+    """Median host<->device roundtrip latency of a trivial jitted call
+    (the remote-TPU tunnel adds tens of ms; subtract it from timings)."""
     import jax
     import jax.numpy as jnp
 
+    triv = jax.jit(lambda x: x + 1)
+    float(triv(jnp.zeros(())))
+    lats = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        float(triv(jnp.zeros(())))
+        lats.append(time.perf_counter() - t0)
+    return sorted(lats)[len(lats) // 2]
+
+
+def _time_scan_loop(step, carry, xs, iters, n_timed):
+    """Run `iters` train steps inside ONE jit via lax.scan (per-call timing
+    through the tunnel is unreliable); return best per-step seconds and the
+    last loss."""
+    import jax
+
+    def loop(carry, *xs):
+        def body(c, _):
+            c, loss = step(c, *xs)
+            return c, loss
+        carry, losses = jax.lax.scan(body, carry, None, length=iters)
+        return carry, losses[-1]
+
+    loop_j = jax.jit(loop, donate_argnums=(0,))
+    rt = _roundtrip()
+    carry, loss = loop_j(carry, *xs)   # compile + warmup
+    loss = float(loss)
+    best = float("inf")
+    for _ in range(n_timed):
+        t0 = time.perf_counter()
+        carry, l_last = loop_j(carry, *xs)
+        loss = float(l_last)
+        best = min(best, time.perf_counter() - t0)
+    return max(best - rt, 1e-9) / iters, loss
+
+
+def _encoder_model(L, H, A, I, S, V):
     import paddle_tpu as paddle
     import paddle_tpu.nn as nn
-    from paddle_tpu.nn.layer_base import functional_call, state_pytrees
-
-    on_tpu = jax.default_backend() != "cpu"
-    # BERT-base: L12 H768 A12 I3072, seq 128
-    if on_tpu:
-        L, H, A, I, S, B, V = 12, 768, 12, 3072, 128, 32, 30522
-    else:  # smoke config for CPU dev runs
-        L, H, A, I, S, B, V = 2, 128, 4, 256, 64, 8, 1000
-
-    paddle.seed(0)
 
     class Bert(nn.Layer):
         def __init__(self):
@@ -67,7 +213,27 @@ def main():
             x = self.encoder(x)
             return self.head(x)
 
-    model = Bert()
+    return Bert()
+
+
+def _encoder_bench(name, on_tpu, amp_o2_scaler=False):
+    """Shared body for the bert (config 3) and ernie (config 4) benches."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+
+    if on_tpu:  # BERT-base: L12 H768 A12 I3072, seq 128
+        L, H, A, I, S, B, V = 12, 768, 12, 3072, 128, 32, 30522
+        iters, n_timed = 10, 3
+    else:
+        L, H, A, I, S, B, V = 2, 128, 4, 256, 64, 8, 1000
+        iters, n_timed = 3, 1
+
+    paddle.seed(0)
+    model = _encoder_model(L, H, A, I, S, V)
     if on_tpu:
         model.astype("bfloat16")  # AMP-O2 pure bf16 params
     model.train()
@@ -75,113 +241,345 @@ def main():
     opt = paddle.optimizer.AdamW(learning_rate=1e-4, weight_decay=0.01)
     opt_state = opt.init_pytree(params)
 
-    def train_step(params, opt_state, ids, labels):
+    def loss_of(p, ids, labels):
+        out, _ = functional_call(model, p, (paddle.Tensor(ids),),
+                                 buffers=buffers)
+        logits = out.value.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, -1)
+        return -jnp.take_along_axis(logp, labels[..., None], -1).mean()
+
+    if amp_o2_scaler:
+        # dynamic loss scaling inside the jit step (functional analogs of
+        # amp/check_finite_and_unscale_op.cc + update_loss_scaling_op.cc)
+        from paddle_tpu.amp import check_finite_and_unscale, update_loss_scaling
+
+        def step(carry, ids, labels):
+            p, s, (scale, good, bad) = carry
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_of(p, ids, labels) * scale)(p)
+            grads, found_inf = check_finite_and_unscale(grads, scale)
+            scale, good, bad = update_loss_scaling(scale, good, bad, found_inf)
+            p2, s2 = opt.apply_pytree(p, grads, s, lr=1e-4, step=1)
+            keep = lambda new, old: jax.tree_util.tree_map(  # noqa: E731
+                lambda a, b: jnp.where(found_inf, b, a), new, old)
+            return (keep(p2, p), keep(s2, s), (scale, good, bad)), loss / scale
+    else:
+        def step(carry, ids, labels):
+            p, s = carry
+            loss, grads = jax.value_and_grad(
+                lambda p: loss_of(p, ids, labels))(p)
+            p, s = opt.apply_pytree(p, grads, s, lr=1e-4, step=1)
+            return (p, s), loss
+
+    rs = np.random.RandomState(0)
+    ids = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
+    labels = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
+    if amp_o2_scaler:
+        import jax.numpy as _jnp
+        carry = (params, opt_state,
+                 (_jnp.float32(2.0 ** 15), _jnp.int32(0), _jnp.int32(0)))
+    else:
+        carry = (params, opt_state)
+    dt, loss = _time_scan_loop(step, carry, (ids, labels), iters, n_timed)
+
+    n_params = sum(int(np.prod(v.shape))
+                   for v in jax.tree_util.tree_leaves(params))
+    tokens = B * S
+    attn_flops = L * 12 * S * S * H * B  # qk^T + softmax*v, fwd+bwd
+    flops = 6.0 * n_params * tokens + attn_flops
+    mfu = flops / dt / peak_flops_per_chip() if on_tpu else 0.0
+    return {
+        "metric": f"{name}_samples_per_sec_per_chip" if on_tpu
+                  else f"{name}_smoke_samples_per_sec_cpu",
+        "value": round(B / dt, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(dt * 1e3, 2),
+        "params": n_params,
+        "loss": float(loss),
+    }
+
+
+def body_bert(on_tpu):
+    r = _encoder_bench("bert_base", on_tpu, amp_o2_scaler=False)
+    if on_tpu:
+        r["measured_matmul_tflops"] = round(_matmul_roofline(), 1)
+    return r
+
+
+def body_ernie(on_tpu):
+    # ERNIE-1.0 base == BERT-base geometry; the config measures the AMP-O2
+    # path: bf16 params + dynamic loss scaling GradScaler inside the jit
+    # step (reference: contrib/mixed_precision/decorator.py:36).
+    return _encoder_bench("ernie_amp_o2", on_tpu, amp_o2_scaler=True)
+
+
+def _matmul_roofline():
+    """Achievable bf16 matmul TFLOPs on this (shared/throttled) chip."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    N = 4096
+    a = jnp.asarray(np.random.RandomState(0).randn(N, N), jnp.bfloat16)
+
+    def mm(a, c):
+        return jax.lax.scan(lambda c, _: (a @ c, ()), c, None, length=30)[0]
+
+    mm = jax.jit(mm)
+    rt = _roundtrip()
+    c = mm(a, a)
+    float(c[0, 0])
+    t0 = time.perf_counter()
+    c = mm(a, c)
+    float(c[0, 0])
+    dt = max(time.perf_counter() - t0 - rt, 1e-9) / 30
+    return 2 * N ** 3 / dt / 1e12
+
+
+def body_resnet50(on_tpu):
+    """BASELINE config 2: ResNet-50 data-parallel samples/s/chip (single
+    chip here; DP scaling shape is exercised by the 8-device CPU-mesh tests
+    and dryrun_multichip)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+    from paddle_tpu.vision.models import resnet50
+
+    if on_tpu:
+        B, HW, iters, n_timed = 64, 224, 5, 3
+    else:
+        B, HW, iters, n_timed = 4, 32, 2, 1
+
+    paddle.seed(0)
+    model = resnet50(num_classes=1000)
+    if on_tpu:
+        model.astype("bfloat16")
+    model.train()
+    params, buffers = state_pytrees(model)
+    opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+    opt_state = opt.init_pytree(params)
+
+    def step(carry, images, labels):
+        p, s = carry
+
+        def loss_fn(p):
+            out, _ = functional_call(model, p, (paddle.Tensor(images),),
+                                     buffers=buffers)
+            logits = out.value.astype(jnp.float32)
+            logp = jax.nn.log_softmax(logits, -1)
+            return -jnp.take_along_axis(logp, labels[:, None], -1).mean()
+
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p, s = opt.apply_pytree(p, grads, s, lr=0.1, step=1)
+        return (p, s), loss
+
+    rs = np.random.RandomState(0)
+    dt_ = jnp.bfloat16 if on_tpu else jnp.float32
+    images = jnp.asarray(rs.randn(B, 3, HW, HW), dt_)
+    labels = jnp.asarray(rs.randint(0, 1000, (B,)), jnp.int32)
+    dt, loss = _time_scan_loop(step, (params, opt_state), (images, labels),
+                               iters, n_timed)
+    # ResNet-50 fwd ~4.1 GFLOPs/image at 224^2; train ~3x fwd
+    flops = 3 * 4.1e9 * (HW / 224.0) ** 2 * B
+    mfu = flops / dt / peak_flops_per_chip() if on_tpu else 0.0
+    return {
+        "metric": "resnet50_samples_per_sec_per_chip" if on_tpu
+                  else "resnet50_smoke_samples_per_sec_cpu",
+        "value": round(B / dt, 2),
+        "unit": "samples/s",
+        "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
+        "mfu": round(mfu, 4),
+        "step_time_ms": round(dt * 1e3, 2),
+        "loss": float(loss),
+    }
+
+
+def body_gpt13b(on_tpu):
+    """BASELINE config 5: GPT-3 1.3B layout. One chip cannot hold 1.3B
+    params + Adam fp32 state, so (per VERDICT round-1 next-step #6):
+      (a) measure tokens/s on a depth-scaled variant (same hidden 2048,
+          heads 16, seq 1024 - per-layer compute identical to 1.3B), and
+      (b) compile the EXACT 1.3B train-step HLO and report its analyzed
+          memory, proving shapes/memory plumb through.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+    from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+
+    if on_tpu:
+        H, A, S, B, V = 2048, 16, 1024, 4, 50304
+        L_meas = 4          # measured depth (per-layer perf == 1.3B's)
+        iters, n_timed = 5, 3
+    else:
+        H, A, S, B, V = 128, 4, 64, 2, 1000
+        L_meas, iters, n_timed = 2, 2, 1
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=V, hidden_size=H, num_layers=L_meas,
+                    num_heads=A, max_position_embeddings=S, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    if on_tpu:
+        model.astype("bfloat16")
+    model.train()
+    params, buffers = state_pytrees(model)
+    opt = paddle.optimizer.AdamW(learning_rate=2e-4, weight_decay=0.01)
+    opt_state = opt.init_pytree(params)
+
+    def step(carry, ids):
+        p, s = carry
+
         def loss_fn(p):
             out, _ = functional_call(model, p, (paddle.Tensor(ids),),
                                      buffers=buffers)
             logits = out.value.astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, -1)
-            picked = jnp.take_along_axis(logp, labels[..., None], -1)
-            return -picked.mean()
+            tgt = jnp.roll(ids, -1, axis=1)
+            return -jnp.take_along_axis(logp, tgt[..., None], -1)[:, :-1].mean()
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        new_params, new_state = opt.apply_pytree(params, grads, opt_state,
-                                                 lr=1e-4, step=1)
-        return new_params, new_state, loss
+        loss, grads = jax.value_and_grad(loss_fn)(p)
+        p, s = opt.apply_pytree(p, grads, s, lr=2e-4, step=1)
+        return (p, s), loss
 
     rs = np.random.RandomState(0)
     ids = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
-    labels = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
-
-    # Timing methodology: per-call timing through the remote-TPU tunnel is
-    # unreliable (dispatch returns early; block_until_ready does not chain
-    # across calls), so run `iters` steps inside ONE jit via lax.scan and
-    # force a host readback, then subtract the measured call roundtrip.
-    iters = 10 if on_tpu else 3
-
-    def loop(params, opt_state, ids, labels):
-        def body(carry, _):
-            p, s = carry
-            p, s, loss = train_step(p, s, ids, labels)
-            return (p, s), loss
-        (p, s), losses = jax.lax.scan(body, (params, opt_state), None,
-                                      length=iters)
-        return p, s, losses[-1]
-
-    loop_j = jax.jit(loop, donate_argnums=(0, 1))
-
-    # roundtrip latency of a trivial call (tunnel overhead)
-    triv = jax.jit(lambda x: x + 1)
-    float(triv(jnp.zeros(())))
-    lats = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        float(triv(jnp.zeros(())))
-        lats.append(time.perf_counter() - t0)
-    roundtrip = sorted(lats)[len(lats) // 2]
-
-    # warmup/compile
-    params, opt_state, loss = loop_j(params, opt_state, ids, labels)
-    loss = float(loss)
-
-    best = float("inf")
-    for _ in range(3 if on_tpu else 1):
-        t0 = time.perf_counter()
-        params, opt_state, l_last = loop_j(params, opt_state, ids, labels)
-        l_host = float(l_last)
-        best = min(best, time.perf_counter() - t0)
-    loss = l_host
-    dt = max(best - roundtrip, 1e-9) / iters
-
-    n_params = sum(int(np.prod(v.shape)) for v in
-                   jax.tree_util.tree_leaves(params))
+    dt, loss = _time_scan_loop(step, (params, opt_state), (ids,),
+                               iters, n_timed)
+    n_params = sum(int(np.prod(v.shape))
+                   for v in jax.tree_util.tree_leaves(params))
     tokens = B * S
-    # training FLOPs ≈ 6 * N * tokens (fwd 2N + bwd 4N) + attention term
-    attn_flops = L * 12 * S * S * H * B  # qk^T, softmax*v fwd+bwd
-    flops = 6.0 * n_params * tokens + attn_flops
+    flops = 6.0 * n_params * tokens + L_meas * 12 * S * S * H * B
     mfu = flops / dt / peak_flops_per_chip() if on_tpu else 0.0
-    samples_per_sec = B / dt
 
-    # calibrate the device's ACHIEVABLE matmul roofline (the shared/
-    # throttled tunnel device delivers far below nominal peak; report both)
-    matmul_tflops = 0.0
+    full_compile_ok = False
+    full_mem_gb = 0.0
     if on_tpu:
-        N = 4096
-        # random data — an all-ones operand lets XLA's algebraic
-        # simplifier fold the matmul into a reduction
-        a = jnp.asarray(rs.randn(N, N), jnp.bfloat16)
+        try:  # exact 1.3B layout: L24 H2048 - compile only (AOT, no alloc)
+            cfg_full = GPTConfig(vocab_size=V, hidden_size=H, num_layers=24,
+                                 num_heads=A, max_position_embeddings=S,
+                                 dropout=0.0, attn_dropout=0.0)
+            full = GPTForCausalLM(cfg_full)
+            full.astype("bfloat16")
+            full.train()
+            fp, fb = state_pytrees(full)
+            fshapes = jax.tree_util.tree_map(
+                lambda v: jax.ShapeDtypeStruct(v.shape, v.dtype), fp)
 
-        def mm(a, c):
-            # body must use the traced parameter, not a closure — a closed-
-            # over matrix would be baked into the HLO as a constant
-            return jax.lax.scan(lambda c, _: (a @ c, ()), c, None,
-                                length=30)[0]
+            def full_loss(p, ids):
+                out, _ = functional_call(full, p, (paddle.Tensor(ids),),
+                                         buffers=fb)
+                return out.value.astype(jnp.float32).mean()
 
-        mm = jax.jit(mm)
-        c = mm(a, a)
-        float(c[0, 0])
-        t0 = time.perf_counter()
-        c = mm(a, c)
-        float(c[0, 0])
-        mm_dt = max(time.perf_counter() - t0 - roundtrip, 1e-9) / 30
-        matmul_tflops = 2 * N ** 3 / mm_dt / 1e12
+            lowered = jax.jit(jax.grad(full_loss)).lower(
+                fshapes, jax.ShapeDtypeStruct((B, S), jnp.int32))
+            compiled = lowered.compile()
+            ma = compiled.memory_analysis()
+            if ma is not None:
+                full_mem_gb = round(
+                    (ma.temp_size_in_bytes + ma.argument_size_in_bytes) / 2**30, 2)
+            full_compile_ok = True
+        except Exception as e:  # noqa: BLE001
+            sys.stderr.write(f"[bench] gpt13b full compile failed: {e}\n")
 
-    result = {
-        "metric": "bert_base_samples_per_sec_per_chip" if on_tpu
-                  else "bert_smoke_samples_per_sec_cpu",
-        "value": round(samples_per_sec, 2),
-        "unit": "samples/s",
+    return {
+        "metric": "gpt13b_layout_tokens_per_sec_per_chip" if on_tpu
+                  else "gpt13b_smoke_tokens_per_sec_cpu",
+        "value": round(tokens / dt, 1),
+        "unit": "tokens/s",
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
         "mfu": round(mfu, 4),
-        "mfu_vs_measured_matmul_peak": round(
-            flops / dt / (matmul_tflops * 1e12), 4) if matmul_tflops else 0.0,
-        "measured_matmul_tflops": round(matmul_tflops, 1),
         "step_time_ms": round(dt * 1e3, 2),
-        "params": n_params,
+        "measured_layers": L_meas,
+        "full_1p3b_compile_ok": full_compile_ok,
+        "full_1p3b_grad_mem_gb": full_mem_gb,
         "loss": float(loss),
     }
-    print(json.dumps(result))
+
+
+def body_kernels(on_tpu):
+    """Validate Pallas flash-attention (fwd + bwd) and fused layer_norm
+    numerics against the plain-XLA path on the REAL device (VERDICT round-1
+    Weak #1: round 1 only ever ran these in CPU interpret mode)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+    from paddle_tpu.ops.pallas.layer_norm import layer_norm as fused_layer_norm
+
+    rs = np.random.RandomState(0)
+    B, S, H, D = (2, 512, 8, 64) if on_tpu else (1, 128, 2, 32)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
+
+    def ref_attn(q, k, v):
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
+        mask = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(mask, logits, -1e30)
+        p = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+    def loss_fa(q, k, v):
+        return (flash_attention(q, k, v, causal=True) ** 2).mean()
+
+    def loss_ref(q, k, v):
+        return (ref_attn(q, k, v) ** 2).mean()
+
+    out_fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    out_ref = jax.jit(ref_attn)(q, k, v)
+    fwd_err = float(jnp.abs(out_fa - out_ref).max())
+
+    g_fa = jax.jit(jax.grad(loss_fa, argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
+    bwd_err = max(float(jnp.abs(a - b).max()) for a, b in zip(g_fa, g_ref))
+
+    x = jnp.asarray(rs.randn(64, 1024 if on_tpu else 128), jnp.float32)
+    w = jnp.asarray(rs.randn(x.shape[-1]), jnp.float32)
+    b = jnp.asarray(rs.randn(x.shape[-1]), jnp.float32)
+    ln_fused = jax.jit(lambda x: fused_layer_norm(x, w, b, 1e-5))(x)
+    mu = x.mean(-1, keepdims=True)
+    var = x.var(-1, keepdims=True)
+    ln_ref = (x - mu) / jnp.sqrt(var + 1e-5) * w + b
+    ln_err = float(jnp.abs(ln_fused - ln_ref).max())
+
+    ok = fwd_err < 2e-2 and bwd_err < 2e-2 and ln_err < 1e-3
+    return {
+        "metric": "pallas_kernels_validated_on_tpu" if on_tpu
+                  else "pallas_kernels_validated_cpu_interpret",
+        "value": 1.0 if ok else 0.0,
+        "unit": "bool",
+        "vs_baseline": 1.0 if ok else 0.0,
+        "flash_attn_fwd_max_err": fwd_err,
+        "flash_attn_bwd_max_err": bwd_err,
+        "fused_ln_max_err": ln_err,
+    }
+
+
+def body_config(name):
+    import jax
+
+    on_tpu = jax.default_backend() not in ("cpu",)
+    body = {"bert": body_bert, "ernie": body_ernie, "resnet50": body_resnet50,
+            "gpt13b": body_gpt13b, "kernels": body_kernels}[name]
+    r = body(on_tpu)
+    r["platform"] = jax.devices()[0].device_kind if on_tpu else "cpu"
+    print(json.dumps(r), flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--probe" in sys.argv:
+        body_probe()
+    elif "--config" in sys.argv:
+        body_config(sys.argv[sys.argv.index("--config") + 1])
+    else:
+        sys.exit(drive())

@@ -97,6 +97,9 @@ _CONFIG_DEFAULTS = {
         "accumulate_steps": 1,
         "schedule_mode": "F-then-B",  # reference GPipe schedule (A.2)
         "p2p_cache_shape": True,
+        "pp_degree": 1,               # TPU extension: pp mesh-axis size;
+                                      # >1 routes a PipelineProgram through
+                                      # spmd_pipeline (strategy_compiler)
     },
     "gradient_merge_configs": {"k_steps": 1, "avg": True},
     "localsgd_configs": {"k_steps": 1, "begin_step": 1},

@@ -76,9 +76,16 @@ class DistributedOptimizer:
         return ctx
 
     def build_train_step(self, loss_fn, params, mesh=None, batch_spec=None,
-                         batch_axis="dp", model_axis="mp", donate=True):
+                         param_specs=None, batch_axis="dp", model_axis="mp",
+                         donate=True):
+        """loss_fn: (params, batch) -> loss, or a
+        distributed.pipeline.PipelineProgram (strategy.pipeline path).
+        param_specs: tensor-parallel PartitionSpecs matching params — pass
+        meta_parallel.dist_specs(layer) so Column/RowParallelLinear
+        annotations physically shard the weights in the built step."""
         ctx = self.compile_context(loss_fn, mesh, batch_axis, model_axis)
         return self._compiler.build_train_step(ctx, params,
+                                               param_specs=param_specs,
                                                batch_spec=batch_spec,
                                                donate=donate)
 

@@ -55,6 +55,9 @@ class TrainStepContext:
         self.dynamic_loss_scaling = False
         self.loss_scale_cfg = {}
         self.grad_comm_dtype = None       # fp16_allreduce
+        self.pipeline_degree = 1          # pp stages (strategy.pipeline)
+        self.pipeline_axis = "pp"
+        self.pipeline_program = None      # PipelineProgram when pipelined
         self.applied = []                 # names, for tests/repr
 
 
@@ -124,12 +127,18 @@ class RecomputeOptimizer(MetaOptimizerBase):
 
 
 class PipelineOptimizer(MetaOptimizerBase):
-    """strategy.pipeline → GPipe fill-drain ≙ micro-batch accumulation in
-    SPMD (section_worker.cc:44 runs all-forward then all-backward; under one
-    jitted scan the schedules are equivalent — see SURVEY.md A.2).  The
-    per-stage ppermute pipeline for stage-structured models lives in
-    distributed.pipeline.spmd_pipeline; this meta-opt wires the microbatch
-    loop so generic models honor pipeline_configs[accumulate_steps]."""
+    """strategy.pipeline → a real GPipe pipeline over the `pp` mesh axis.
+
+    Reference: fluid.PipelineOptimizer (optimizer.py:3702) splits the
+    program into per-device sections joined by send_v2/recv_v2, run by
+    SectionWorker with a fill-drain schedule (section_worker.cc:44).
+
+    TPU-native: when the model is stage-structured (a
+    `distributed.pipeline.PipelineProgram`, or a plain loss_fn the user
+    built over `spmd_pipeline`), `pipeline_configs["pp_degree"]` routes the
+    built train step through `spmd_pipeline` — per-stage weights sharded
+    P('pp', ...), activations hopping via lax.ppermute (the send_v2/recv_v2
+    analog), `accumulate_steps` microbatches per step."""
     name = "pipeline"
     order = 30
 
@@ -137,9 +146,23 @@ class PipelineOptimizer(MetaOptimizerBase):
         return strategy.pipeline
 
     def apply(self, ctx):
-        ctx.k_steps = max(ctx.k_steps,
-                          int(ctx.strategy.pipeline_configs.get(
-                              "accumulate_steps", 1)))
+        cfg = ctx.strategy.pipeline_configs
+        if ctx.pipeline_program is not None:
+            # the strategy compiler already routed a PipelineProgram
+            # through spmd_pipeline; microbatching happens inside the pipe
+            ctx.applied.append(self.name)
+            return
+        degree = int(cfg.get("pp_degree", 1))
+        if degree > 1:
+            raise ValueError(
+                "pipeline_configs['pp_degree'] > 1 requires a "
+                "stage-structured model: pass a distributed.pipeline."
+                "PipelineProgram as the loss argument of build_train_step "
+                "(e.g. models.gpt_hybrid.pipeline_program)")
+        # plain loss_fn: fall back to microbatch accumulation, which under
+        # one jitted scan is schedule-equivalent to GPipe fill-drain for an
+        # unstaged model (SURVEY.md A.2)
+        ctx.k_steps = max(ctx.k_steps, int(cfg.get("accumulate_steps", 1)))
         ctx.applied.append(self.name)
 
 

@@ -57,6 +57,18 @@ def annotate(param, *spec):
     return param
 
 
+def dist_specs(layer_or_params) -> dict:
+    """{name: PartitionSpec | None} from Parameter.dist_spec annotations.
+
+    Feed to fleet's build_train_step(param_specs=...) so tensor-parallel
+    placements reach the compiled step (keys match state_pytrees)."""
+    if isinstance(layer_or_params, Layer):
+        items = list(layer_or_params.named_parameters())
+    else:
+        items = list(layer_or_params.items())
+    return {k: getattr(v, "dist_spec", None) for k, v in items}
+
+
 def param_sharding(layer_or_params, mesh=None) -> dict:
     """NamedSharding pytree from Parameter.dist_spec annotations.
 

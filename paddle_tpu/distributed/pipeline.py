@@ -23,7 +23,7 @@ from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
 __all__ = ["spmd_pipeline", "pipeline_step_fn", "stack_stage_params",
-           "unstack_stage_params"]
+           "unstack_stage_params", "PipelineProgram", "pipeline_loss_fn"]
 
 
 def spmd_pipeline(stage_fn, stage_params, microbatches, *, axis_name="pp",
@@ -105,6 +105,77 @@ def pipeline_step_fn(stage_fn, mesh, *, axis_name="pp", remat=True):
             check_vma=False)(stacked_params, microbatches)
 
     return run
+
+
+class PipelineProgram:
+    """Stage-structured model contract consumed by the Fleet pipeline path.
+
+    Reference parity: fluid.PipelineOptimizer (optimizer.py:3702) carves a
+    program into sections by per-op `device` attrs.  TPU-native there is no
+    program to carve — the user (or a model-zoo helper like
+    models.gpt_hybrid.pipeline_program) DECLARES the stage structure and
+    `pipeline_loss_fn` + StrategyCompiler.build_train_step turn it into one
+    SPMD program: embed → spmd_pipeline(stage) → head, inside shard_map.
+
+    Methods run INSIDE shard_map over the full mesh (use lax collectives
+    over 'mp'/'dp' axes freely):
+      embed(params, micro)        [M, mb, ...] batch -> [M, mb, ...] acts
+      stage(stage_params, act)    one pipeline stage; shape-preserving
+      head(params, out, micro)    last-stage acts -> local scalar loss
+    Declarations:
+      stage_key     key in the params dict whose subtree is stacked
+                    [pp, ...] per-stage weights
+      param_specs() PartitionSpec pytree matching the params structure
+      data_spec()   PartitionSpec of the [M, mb, ...] microbatched batch
+      to_microbatches(batch, M)   global batch -> [M, mb, ...]
+    """
+
+    stage_key = "blocks"
+
+    def embed(self, params, micro):
+        raise NotImplementedError
+
+    def stage(self, stage_params, act):
+        raise NotImplementedError
+
+    def head(self, params, out, micro):
+        raise NotImplementedError
+
+    def param_specs(self):
+        raise NotImplementedError
+
+    def data_spec(self):
+        return P(None, "dp", None)
+
+    def to_microbatches(self, batch, n_microbatches):
+        mb = batch.shape[0] // n_microbatches
+        return batch.reshape((n_microbatches, mb) + batch.shape[1:])
+
+
+def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
+                     *, axis_name="pp", remat=True):
+    """(params, batch) -> scalar loss running `program` as a GPipe pipeline
+    over mesh axis `axis_name`.  The loss is pmean'd over every mesh axis so
+    both the value and all gradients are exact (see models/gpt_hybrid)."""
+    all_axes = tuple(mesh.axis_names)
+
+    def inner(params, micro):
+        act = program.embed(params, micro)
+        out = spmd_pipeline(program.stage, params[program.stage_key], act,
+                            axis_name=axis_name, remat=remat)
+        loss = program.head(params, out, micro)
+        return jax.lax.pmean(loss, all_axes)
+
+    specs = program.param_specs()
+
+    def loss_fn(params, batch):
+        micro = program.to_microbatches(batch, n_microbatches)
+        f = shard_map(inner, mesh=mesh,
+                      in_specs=(specs, program.data_spec()),
+                      out_specs=P(), check_vma=False)
+        return f(params, micro)
+
+    return loss_fn
 
 
 def stack_stage_params(per_stage_params):

@@ -24,13 +24,13 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from .gpt import GPTConfig
-from ..distributed.pipeline import spmd_pipeline
+from ..distributed.pipeline import PipelineProgram, pipeline_loss_fn
 
-__all__ = ["init_params", "param_specs", "make_loss_fn", "make_train_step"]
+__all__ = ["init_params", "param_specs", "make_loss_fn", "make_train_step",
+           "pipeline_program", "GPTPipelineProgram"]
 
 
 def _check(cfg: GPTConfig, pp: int, mp: int):
@@ -164,47 +164,55 @@ def _vocab_parallel_xent(h, wte_local, labels, v_local):
     return log_z - picked
 
 
-def make_loss_fn(cfg: GPTConfig, mesh, n_microbatches: int, remat=True):
-    """Jittable (params, ids[M*mb_global, S]) -> scalar LM loss over the
-    (dp, pp, mp) mesh."""
-    pp, mp = mesh.shape["pp"], mesh.shape["mp"]
-    _check(cfg, pp, mp)
-    block = _make_block(cfg, mp)
-    v_local = cfg.vocab_size // mp
-    M = n_microbatches
-    eps = cfg.layer_norm_epsilon
+class GPTPipelineProgram(PipelineProgram):
+    """gpt_hybrid's stage structure as a fleet-consumable PipelineProgram
+    (strategy.pipeline pp_degree routes it through spmd_pipeline — the
+    Fleet-entrypoint equivalent of fluid.PipelineOptimizer optimizer.py:3702)."""
 
-    def stage_fn(p_stage, a):
-        out, _ = jax.lax.scan(lambda act, pl: (block(pl, act), None),
+    stage_key = "blocks"
+
+    def __init__(self, cfg: GPTConfig, mp: int):
+        self.cfg = cfg
+        self.mp = mp
+        self._block = _make_block(cfg, mp)
+        self._v_local = cfg.vocab_size // mp
+
+    def embed(self, params, ids):
+        S = ids.shape[-1]
+        return (_vocab_parallel_embed(ids, params["wte"], self._v_local)
+                + params["wpe"][:S])
+
+    def stage(self, p_stage, a):
+        out, _ = jax.lax.scan(lambda act, pl: (self._block(pl, act), None),
                               a, p_stage)
         return out
 
-    def inner(params, ids):
-        # ids: [M, mb_local, S] (dp-sharded microbatch dim)
+    def head(self, params, out, ids):
+        cfg = self.cfg
         S = ids.shape[-1]
-        wte = params["wte"]
-        emb = _vocab_parallel_embed(ids, wte, v_local) + params["wpe"][:S]
-        out = spmd_pipeline(stage_fn, params["blocks"], emb,
-                            axis_name="pp", remat=remat)
-        h = _ln(out, params["ln_f_w"], params["ln_f_b"], eps)
+        h = _ln(out, params["ln_f_w"], params["ln_f_b"],
+                cfg.layer_norm_epsilon)
         losses = _vocab_parallel_xent(
-            h.reshape((-1,) + h.shape[2:])[:, :-1], wte,
-            ids.reshape(-1, S)[:, 1:], v_local)
-        # pmean over every axis: value = global batch mean; also makes every
-        # weight gradient exact (see module docstring)
-        return jax.lax.pmean(losses.mean(), ("dp", "pp", "mp"))
+            h.reshape((-1,) + h.shape[2:])[:, :-1], params["wte"],
+            ids.reshape(-1, S)[:, 1:], self._v_local)
+        return losses.mean()
 
-    specs = param_specs(cfg)
-    data_spec = P(None, "dp", None)
+    def param_specs(self):
+        return param_specs(self.cfg)
 
-    def loss_fn(params, ids):
-        mb = ids.shape[0] // M
-        micro = ids.reshape(M, mb, ids.shape[-1])
-        f = shard_map(inner, mesh=mesh, in_specs=(specs, data_spec),
-                      out_specs=P(), check_vma=False)
-        return f(params, micro)
 
-    return loss_fn
+def pipeline_program(cfg: GPTConfig, mesh) -> GPTPipelineProgram:
+    pp, mp = mesh.shape["pp"], mesh.shape["mp"]
+    _check(cfg, pp, mp)
+    return GPTPipelineProgram(cfg, mp)
+
+
+def make_loss_fn(cfg: GPTConfig, mesh, n_microbatches: int, remat=True):
+    """Jittable (params, ids[M*mb_global, S]) -> scalar LM loss over the
+    (dp, pp, mp) mesh.  Implemented via the shared PipelineProgram path so
+    the Fleet strategy.pipeline entrypoint is numerically identical."""
+    return pipeline_loss_fn(pipeline_program(cfg, mesh), mesh,
+                            n_microbatches, remat=remat)
 
 
 def _flatten(tree):

@@ -94,7 +94,23 @@ class TestZeroShardings:
     def test_shard_spec_picks_divisible_dim(self):
         assert shard_spec((3, 16), "dp", 8) == P(None, "dp")
         assert shard_spec((5, 3), "dp", 8) == P()
-        assert shard_spec((8, 16), "dp", 8) == P("dp", None)
+        # largest divisible dim wins (a [vocab, hidden] embedding shards
+        # vocab; leaves TP'd dims free for merge_zero_spec)
+        assert shard_spec((8, 16), "dp", 8) == P(None, "dp")
+        assert shard_spec((32, 16), "dp", 8) == P("dp", None)
+
+    def test_merge_zero_spec_composes_with_tp(self):
+        from paddle_tpu.distributed.sharding import merge_zero_spec
+        # TP holds dim 0 ('mp'); ZeRO goes to the largest free dim
+        assert merge_zero_spec(P("mp", None), (1024, 64), "dp", 8) == \
+            P("mp", "dp")
+        # already dp-sharded spec untouched
+        assert merge_zero_spec(P("dp", None), (64, 64), "dp", 8) == \
+            P("dp", None)
+        # nothing free & divisible -> TP placement kept, no dp added
+        assert merge_zero_spec(P("mp"), (128,), "dp", 8) == P("mp")
+        # no TP spec -> plain zero sharding of the largest dim
+        assert merge_zero_spec(None, (16, 256), "dp", 8) == P(None, "dp")
 
     def test_stages(self):
         mesh = build_mesh({"dp": 8})
