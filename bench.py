@@ -76,7 +76,9 @@ def probe_tpu():
         for line in out.splitlines():
             if line.startswith('{"probe"'):
                 d = json.loads(line)
-                if d.get("ok"):
+                # require a real accelerator: a silent CPU fallback would
+                # otherwise report smoke numbers as a TPU-backed run
+                if d.get("ok") and d.get("platform") not in (None, "cpu"):
                     return d["device_kind"]
         sys.stderr.write(f"[bench] TPU probe attempt {i + 1}/{PROBE_ATTEMPTS} "
                          f"failed (rc={rc}): {err.strip()[-200:]}\n")

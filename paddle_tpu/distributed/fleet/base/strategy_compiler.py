@@ -169,20 +169,34 @@ class StrategyCompiler:
                 "meshes with ZeRO stage < 2 (the explicit bf16 psum path); "
                 "flag ignored for this configuration")
 
+        k = ctx.k_steps
+
         if fp16_sm:
+            # NOTE: this path computes grads per dp-shard and combines with
+            # psum(bf16)/dp + pmean(loss) — exact only for the standard
+            # batch-MEAN loss over equal shards (a sum- or weighted-
+            # reduction loss should not enable fp16_allreduce).
             dp_size = mesh.shape[batch_axis]
             p_repl = jax.tree.map(lambda _: P(), params)
 
-            def vg(params, batch, scale):
+            def loss_grads(params, batch, scale):
                 b_spec = jax.tree.map(lambda _: P(batch_axis), batch)
                 g_spec = jax.tree.map(lambda _: P(), params)
 
                 def local(p, b):
-                    def scaled_loss(p):
+                    def scaled_loss(p, b):
                         loss = loss_fn(p, b)
                         return ((loss * scale).astype(loss.dtype)
                                 if dls else loss)
-                    loss, grads = jax.value_and_grad(scaled_loss)(p)
+
+                    base = lambda p, b: \
+                        jax.value_and_grad(scaled_loss)(p, b)  # noqa: E731
+                    # grad-merge runs INSIDE the shard (local microbatch
+                    # accumulation) so the bf16 psum below happens ONCE on
+                    # the merged gradient, not k times per step
+                    f = gradient_merge(base, k, avg=ctx.grad_merge_avg) \
+                        if k > 1 else base
+                    loss, grads = f(p, b)
                     # the wire format: bf16 across the ICI, halving
                     # collective bytes (fp16_allreduce_optimizer.py parity)
                     grads = jax.tree.map(
@@ -203,7 +217,11 @@ class StrategyCompiler:
                 loss, grads = jax.value_and_grad(scaled_loss)(params, batch)
                 return (loss / scale if dls else loss), grads
 
-        k = ctx.k_steps
+            def loss_grads(params, batch, scale):
+                base = lambda p, b: vg(p, b, scale)  # noqa: E731
+                merged = gradient_merge(base, k, avg=ctx.grad_merge_avg) \
+                    if k > 1 else base
+                return merged(params, batch)
 
         # -- shardings (computed before `step` so the stage-2 grad
         #    constraint can close over them) ------------------------------
@@ -217,10 +235,7 @@ class StrategyCompiler:
 
         def step(params, state, batch):
             scale = state.get("loss_scale", jnp.float32(1.0)) if dls else 1.0
-            base = lambda p, b: vg(p, b, scale)  # noqa: E731
-            merged = gradient_merge(base, k, avg=ctx.grad_merge_avg) \
-                if k > 1 else base
-            loss, grads = merged(params, batch)
+            loss, grads = loss_grads(params, batch, scale)
             g = flat(grads)
             if stage >= 2 and mesh is not None:
                 # ZeRO-2: pin gradients to their owner shard — GSPMD then

@@ -237,9 +237,12 @@ class LarsOptimizer(MetaOptimizerBase):
 class FP16AllReduceOptimizer(MetaOptimizerBase):
     """strategy.fp16_allreduce → gradients cross the ICI in half precision.
     Reference: meta_optimizers/fp16_allreduce_optimizer.py (cast before
-    c_allreduce, cast back after).  Implemented by casting grads to bf16
-    right after autodiff — the psum XLA inserts then runs on the cast
-    values, halving collective bytes."""
+    c_allreduce, cast back after).  Implemented as an explicit shard_map
+    psum over bf16-cast per-shard gradients (a plain cast round-trip would
+    be folded away by XLA's simplifier).  Only applies on pure-dp meshes
+    with ZeRO stage < 2, and assumes the loss is a batch-MEAN over equal
+    shards (the grads are combined as psum/dp) — the strategy compiler
+    warns and ignores the flag otherwise."""
     name = "fp16_allreduce"
     order = 70
 

@@ -52,7 +52,8 @@ def merge_zero_spec(dist_spec, shape, axis_name, axis_size):
     base += [None] * (len(shape) - len(base))
     used = {a for entry in base if entry is not None
             for a in (entry if isinstance(entry, tuple) else (entry,))}
-    if axis_name in used:
+    zero_axes = (axis_name if isinstance(axis_name, tuple) else (axis_name,))
+    if any(a in used for a in zero_axes):
         return P(*base)
     best = None
     for d, n in enumerate(shape):

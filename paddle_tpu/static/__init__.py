@@ -14,6 +14,7 @@ import threading
 
 from ..jit import InputSpec  # re-export (paddle.static.InputSpec)
 from ..tensor import Tensor
+from . import nn  # noqa: F401  (paddle.static.nn.while_loop/cond/...)
 
 
 class _Mode(threading.local):

@@ -1,0 +1,306 @@
+"""Control-flow ops: while_loop / cond / case / switch_case + TensorArray.
+
+Reference parity: python/paddle/fluid/layers/control_flow.py
+(while_loop:1111, cond:2291, case:2470, switch_case:3587, array ops
+:1455-2023) over paddle/fluid/operators/controlflow/{while_op.cc,
+conditional_block_op.cc}.  Re-exported as paddle.static.nn.* like the
+reference's python/paddle/static/nn/__init__.py:39-68.
+
+TPU-native lowering: the reference executes sub-blocks op-by-op on the
+host; here every construct lowers to XLA's structured control flow —
+`lax.while_loop` / `lax.cond` / `lax.switch` — so it compiles into the
+jitted step with no host round-trips and no unrolling.  Tensors are
+pytree-registered, so loop_vars / branch outputs may be arbitrary nests of
+paddle Tensors, jax arrays, and python scalars.
+
+Gradients: `cond`/`case`/`switch_case` are reverse-differentiable
+(lax.cond transposes).  `while_loop` is forward-only under autodiff (an
+XLA limit: reverse-mode needs a known trip count); use `lax.scan`-based
+ops or `fori_collect` below when you need gradients through a loop.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["while_loop", "cond", "case", "switch_case", "increment",
+           "create_array", "array_write", "array_read", "array_length",
+           "TensorArray", "StaticTensorArray", "tensor_array_to_tensor",
+           "fori_collect"]
+
+
+def _scalar_bool(x):
+    v = x.value if isinstance(x, Tensor) else x
+    if isinstance(v, bool):
+        return jnp.bool_(v)
+    v = jnp.asarray(v)
+    if v.size != 1:
+        raise TypeError(f"predicate must have exactly one element, "
+                        f"got shape {v.shape}")
+    return v.reshape(()).astype(jnp.bool_)
+
+
+def while_loop(cond, body, loop_vars, is_test=False, name=None):
+    """Repeat `body` until `cond` is False (control_flow.py:1111).
+
+    cond/body take as many arguments as loop_vars; body returns the same
+    arity and structure.  Lowers to lax.while_loop (traced once, runs
+    on-device)."""
+    if not callable(cond) or not callable(body):
+        raise TypeError("cond and body must be callable")
+    if not isinstance(loop_vars, (list, tuple)):
+        raise TypeError("loop_vars must be a list or tuple")
+    if not loop_vars:
+        raise ValueError("loop_vars is empty")
+    vars_t = tuple(loop_vars)
+
+    def cond_fn(vs):
+        return _scalar_bool(cond(*vs))
+
+    def body_fn(vs):
+        out = body(*vs)
+        if not isinstance(out, (list, tuple)):
+            out = (out,)
+        if len(out) != len(vars_t):
+            raise ValueError(
+                f"body must return {len(vars_t)} values like loop_vars, "
+                f"got {len(out)}")
+        return tuple(out)
+
+    out = jax.lax.while_loop(cond_fn, body_fn, vars_t)
+    return list(out) if isinstance(loop_vars, list) else out
+
+
+def cond(pred, true_fn=None, false_fn=None, name=None):
+    """true_fn() if pred else false_fn() (control_flow.py:2291).
+
+    Both branches must return the same nest structure; either may be None
+    (treated as returning None).  Lowers to lax.cond — differentiable, and
+    only the taken branch executes at runtime."""
+    if true_fn is not None and not callable(true_fn):
+        raise TypeError("true_fn must be callable")
+    if false_fn is not None and not callable(false_fn):
+        raise TypeError("false_fn must be callable")
+    if true_fn is None and false_fn is None:
+        return None
+    t_fn = true_fn or (lambda: None)
+    f_fn = false_fn or (lambda: None)
+    if isinstance(pred, bool):  # python-static predicate: pick eagerly
+        return t_fn() if pred else f_fn()
+    return jax.lax.cond(_scalar_bool(pred),
+                        lambda _: t_fn(), lambda _: f_fn(), 0)
+
+
+def case(pred_fn_pairs, default=None, name=None):
+    """First (pred, fn) pair with a true pred wins (control_flow.py:2470).
+    If none is true, `default` runs; if default is None the reference runs
+    the LAST pair's fn — same here.  Lowers to a chain of lax.cond."""
+    if not isinstance(pred_fn_pairs, (list, tuple)) or not pred_fn_pairs:
+        raise TypeError("pred_fn_pairs must be a non-empty list/tuple")
+    pairs = list(pred_fn_pairs)
+    for i, pair in enumerate(pairs):
+        if not (isinstance(pair, (list, tuple)) and len(pair) == 2
+                and callable(pair[1])):
+            raise TypeError(f"pred_fn_pairs[{i}] must be (pred, callable)")
+    if default is None:
+        default = pairs[-1][1]
+        pairs = pairs[:-1]
+    if not callable(default):
+        raise TypeError("default must be callable")
+
+    out = default()
+    for pred, fn in reversed(pairs):
+        if isinstance(pred, bool):
+            out = fn() if pred else out
+            continue
+        out = jax.lax.cond(_scalar_bool(pred),
+                           lambda _, fn=fn: fn(),
+                           lambda _, o=out: o, 0)
+    return out
+
+
+def switch_case(branch_index, branch_fns, default=None, name=None):
+    """Run the fn whose index matches branch_index (control_flow.py:3587).
+
+    branch_fns: list of callables (indices 0..n-1), or list of (int, fn)
+    pairs, or a dict {int: fn}.  Out-of-range / unmatched indices run
+    `default` (or the fn with the MAX index when default is None — the
+    reference's rule).  Lowers to lax.switch."""
+    if isinstance(branch_fns, dict):
+        pairs = sorted(branch_fns.items())
+    elif isinstance(branch_fns, (list, tuple)) and branch_fns and \
+            callable(branch_fns[0]):
+        pairs = list(enumerate(branch_fns))
+    else:
+        pairs = sorted(branch_fns, key=lambda p: p[0])
+    for idx, fn in pairs:
+        if not isinstance(idx, int):
+            raise TypeError(f"branch index {idx!r} must be int")
+        if not callable(fn):
+            raise TypeError(f"branch_fns[{idx}] must be callable")
+    keys = [idx for idx, _ in pairs]
+    if len(set(keys)) != len(keys):
+        raise ValueError(f"duplicate branch indices: {keys}")
+    if default is None:
+        default = dict(pairs)[max(keys)]
+    if not callable(default):
+        raise TypeError("default must be callable")
+
+    bi = branch_index.value if isinstance(branch_index, Tensor) \
+        else branch_index
+    bi = jnp.asarray(bi).reshape(()).astype(jnp.int32)
+    # position in the dense fn table: count of keys < bi when matched,
+    # else the trailing default slot
+    keys_arr = jnp.asarray(keys, jnp.int32)
+    matched = (keys_arr == bi)
+    pos = jnp.where(matched.any(), jnp.argmax(matched), len(keys))
+    fns = [lambda _, fn=fn: fn() for _, fn in pairs]
+    fns.append(lambda _: default())
+    return jax.lax.switch(pos, fns, 0)
+
+
+def increment(x, value=1.0, in_place=True):
+    """x + value (control_flow.py:1419; in_place is meaningless under a
+    functional runtime — returns the new Tensor)."""
+    v = x.value if isinstance(x, Tensor) else jnp.asarray(x)
+    out = v + jnp.asarray(value, v.dtype)
+    return Tensor(out) if isinstance(x, Tensor) else out
+
+
+# ---------------------------------------------------------------------------
+# TensorArray (reference LoDTensorArray + array ops :1455-2023)
+# ---------------------------------------------------------------------------
+
+class TensorArray:
+    """Eager, list-backed tensor array — the dygraph analog of the
+    reference's LoDTensorArray.  For use INSIDE jitted control flow see
+    StaticTensorArray (fixed capacity, XLA-safe)."""
+
+    def __init__(self, dtype="float32"):
+        self.dtype = dtype
+        self._items = []
+
+    def write(self, i, x):
+        i = int(i.value if isinstance(i, Tensor) else i)
+        if i < len(self._items):
+            self._items[i] = x
+        elif i == len(self._items):
+            self._items.append(x)
+        else:
+            raise IndexError(
+                f"array_write index {i} beyond length {len(self._items)} "
+                f"(writes must be dense, like the reference op)")
+        return self
+
+    def read(self, i):
+        i = int(i.value if isinstance(i, Tensor) else i)
+        return self._items[i]
+
+    def __len__(self):
+        return len(self._items)
+
+    def stack(self, axis=0):
+        vals = [v.value if isinstance(v, Tensor) else jnp.asarray(v)
+                for v in self._items]
+        return Tensor(jnp.stack(vals, axis=axis))
+
+    def concat(self, axis=0):
+        vals = [v.value if isinstance(v, Tensor) else jnp.asarray(v)
+                for v in self._items]
+        return Tensor(jnp.concatenate(vals, axis=axis))
+
+
+def create_array(dtype="float32"):
+    return TensorArray(dtype)
+
+
+def array_write(x, i, array=None):
+    if array is None:
+        array = TensorArray(getattr(x, "dtype", "float32"))
+    array.write(i, x)
+    return array
+
+
+def array_read(array, i):
+    return array.read(i)
+
+
+def array_length(array):
+    return Tensor(jnp.asarray(len(array), jnp.int64))
+
+
+def tensor_array_to_tensor(input, axis=0, use_stack=False):
+    """(tensor, per-item sizes) like the reference fused op."""
+    if use_stack:
+        out = input.stack(axis=axis)
+        n = out.shape[axis]
+        sizes = jnp.ones((n,), jnp.int32)
+    else:
+        out = input.concat(axis=axis)
+        sizes = jnp.asarray(
+            [(v.shape[axis] if getattr(v, "ndim", 0) else 1)
+             for v in input._items], jnp.int32)
+    return out, Tensor(sizes)
+
+
+@jax.tree_util.register_pytree_node_class
+class StaticTensorArray:
+    """Fixed-capacity tensor array usable inside jit / lax control flow.
+
+    A functional buffer [capacity, *shape] + write mask; every method
+    returns a NEW array (XLA needs static shapes, so capacity is fixed up
+    front — the TPU-idiomatic replacement for the dynamic LoDTensorArray)."""
+
+    def __init__(self, capacity, shape, dtype=jnp.float32, _data=None,
+                 _written=None):
+        self.capacity = int(capacity)
+        self.shape = tuple(shape)
+        self.dtype = dtype
+        self.data = _data if _data is not None else \
+            jnp.zeros((self.capacity,) + self.shape, dtype)
+        self.written = _written if _written is not None else \
+            jnp.zeros((self.capacity,), jnp.bool_)
+
+    def write(self, i, x):
+        x = x.value if isinstance(x, Tensor) else jnp.asarray(x, self.dtype)
+        i = jnp.asarray(i.value if isinstance(i, Tensor) else i, jnp.int32)
+        data = jax.lax.dynamic_update_index_in_dim(
+            self.data, x.astype(self.dtype), i, 0)
+        written = self.written.at[i].set(True)
+        return StaticTensorArray(self.capacity, self.shape, self.dtype,
+                                 _data=data, _written=written)
+
+    def read(self, i):
+        i = jnp.asarray(i.value if isinstance(i, Tensor) else i, jnp.int32)
+        return jax.lax.dynamic_index_in_dim(self.data, i, 0, keepdims=False)
+
+    def length(self):
+        return self.written.sum().astype(jnp.int32)
+
+    def stack(self):
+        return self.data
+
+    def tree_flatten(self):
+        return (self.data, self.written), (self.capacity, self.shape,
+                                           self.dtype)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        cap, shape, dtype = aux
+        data, written = children
+        return cls(cap, shape, dtype, _data=data, _written=written)
+
+
+def fori_collect(lower, upper, body, init):
+    """Differentiable bounded loop that collects per-iteration outputs.
+
+    body(i, carry) -> (carry, y).  Returns (carry, ys[upper-lower, ...]).
+    Backed by lax.scan, so jax.grad works through it — use this where the
+    reference used While + array_write for a KNOWN trip count."""
+    def scan_body(carry, i):
+        carry, y = body(i, carry)
+        return carry, y
+
+    return jax.lax.scan(scan_body, init, jnp.arange(lower, upper))

@@ -1,0 +1,210 @@
+"""Control-flow ops (paddle.static.nn) — OpTest-style coverage.
+
+Reference contract: python/paddle/fluid/layers/control_flow.py
+(while_loop:1111, cond:2291, case:2470, switch_case:3587) and
+operators/controlflow/*.cc, including gradients through cond (the
+conditional_block grad op)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.static import nn as snn
+
+
+class TestWhileLoop:
+    def test_counts_to_ten(self):
+        def cond(i, ten):
+            return i < ten
+
+        def body(i, ten):
+            return [i + 1, ten]
+
+        i = paddle.zeros([1], dtype="int64")
+        ten = paddle.full([1], 10, dtype="int64")
+        out_i, out_ten = snn.while_loop(cond, body, [i, ten])
+        assert int(out_i.value[0]) == 10
+
+    def test_pytree_loop_vars_and_jit(self):
+        def run(x):
+            def cond(state):
+                return state["n"] < 5
+
+            def body(state):
+                return ({"n": state["n"] + 1, "acc": state["acc"] * 2.0},)
+
+            (out,) = snn.while_loop(cond, body,
+                                    [{"n": jnp.int32(0), "acc": x}])
+            return out["acc"]
+
+        out = jax.jit(run)(jnp.float32(3.0))
+        assert float(out) == 3.0 * 32
+
+    def test_type_errors(self):
+        with pytest.raises(TypeError):
+            snn.while_loop("notfn", lambda: None, [1])
+        with pytest.raises(TypeError):
+            snn.while_loop(lambda x: x, "notfn", [1])
+        with pytest.raises(TypeError):
+            snn.while_loop(lambda x: x, lambda x: x, "notalist")
+        with pytest.raises(ValueError):
+            snn.while_loop(lambda: True, lambda: (), [])
+
+    def test_bad_pred_shape(self):
+        with pytest.raises(TypeError, match="one element"):
+            snn.while_loop(lambda x: x, lambda x: (x,),
+                           [jnp.zeros((2,), jnp.bool_)])
+
+
+class TestCond:
+    def test_scalar_pred_branches(self):
+        x = paddle.full([1], 3.0)
+        y = paddle.full([1], 5.0)
+        lt = snn.cond(x < y, lambda: x + y, lambda: x - y)
+        gt = snn.cond(x > y, lambda: x + y, lambda: x - y)
+        assert float(lt.value[0]) == 8.0
+        assert float(gt.value[0]) == -2.0
+
+    def test_python_bool_pred(self):
+        assert snn.cond(True, lambda: 1, lambda: 2) == 1
+        assert snn.cond(False, lambda: 1, lambda: 2) == 2
+
+    def test_none_fns(self):
+        assert snn.cond(True, None, None) is None
+        assert snn.cond(jnp.bool_(True), lambda: None, None) is None
+
+    def test_gradient_through_cond(self):
+        """d/dx of cond(x>0, x^2, 3x) — the conditional_block grad-op
+        semantics: only the taken branch contributes."""
+        def f(x):
+            return snn.cond(x > 0, lambda: x * x, lambda: 3.0 * x)
+
+        g_pos = jax.grad(f)(jnp.float32(2.0))
+        g_neg = jax.grad(f)(jnp.float32(-2.0))
+        assert float(g_pos) == 4.0
+        assert float(g_neg) == 3.0
+
+    def test_inside_jit_runs_taken_branch_only(self):
+        def f(x):
+            return snn.cond(x.sum() > 0,
+                            lambda: jnp.log(jnp.abs(x).sum()),
+                            lambda: x.sum())
+
+        out = jax.jit(f)(jnp.asarray([-1.0, -2.0]))
+        assert float(out) == -3.0
+
+
+class TestCaseSwitch:
+    def test_case_first_true_wins(self):
+        out = snn.case([(jnp.bool_(False), lambda: jnp.float32(1.0)),
+                        (jnp.bool_(True), lambda: jnp.float32(2.0)),
+                        (jnp.bool_(True), lambda: jnp.float32(3.0))],
+                       default=lambda: jnp.float32(9.0))
+        assert float(out) == 2.0
+
+    def test_case_default_is_last_fn_when_none(self):
+        out = snn.case([(jnp.bool_(False), lambda: jnp.float32(1.0)),
+                        (jnp.bool_(False), lambda: jnp.float32(2.0)),
+                        (jnp.bool_(True), lambda: jnp.float32(7.0))])
+        # reference rule: default=None -> last pair's fn is the default;
+        # preds before it are all false -> 7.0 runs as default
+        assert float(out) == 7.0
+
+    def test_case_type_errors(self):
+        with pytest.raises(TypeError):
+            snn.case([])
+        with pytest.raises(TypeError):
+            snn.case([(True, "notfn")])
+
+    def test_switch_list_of_fns(self):
+        fns = [lambda: jnp.float32(10.0), lambda: jnp.float32(20.0),
+               lambda: jnp.float32(30.0)]
+        assert float(snn.switch_case(jnp.int32(1), fns)) == 20.0
+        # out-of-range -> max-index fn when default is None
+        assert float(snn.switch_case(jnp.int32(7), fns)) == 30.0
+
+    def test_switch_pairs_and_default(self):
+        out = snn.switch_case(
+            jnp.int32(5),
+            [(1, lambda: jnp.float32(1.0)), (3, lambda: jnp.float32(3.0))],
+            default=lambda: jnp.float32(-1.0))
+        assert float(out) == -1.0
+        out = snn.switch_case(
+            jnp.int32(3),
+            {1: lambda: jnp.float32(1.0), 3: lambda: jnp.float32(3.0)})
+        assert float(out) == 3.0
+
+    def test_switch_duplicate_indices(self):
+        with pytest.raises(ValueError, match="duplicate"):
+            snn.switch_case(jnp.int32(0), [(1, lambda: 1), (1, lambda: 2)])
+
+    def test_switch_under_jit_and_grad(self):
+        def f(x, idx):
+            return snn.switch_case(
+                idx, [lambda: x * 2.0, lambda: x * x, lambda: x + 1.0])
+
+        g = jax.jit(jax.grad(f))(jnp.float32(3.0), jnp.int32(1))
+        assert float(g) == 6.0
+
+
+class TestTensorArray:
+    def test_eager_write_read_stack(self):
+        arr = snn.create_array("float32")
+        for i in range(4):
+            snn.array_write(paddle.full([2], float(i)), i, arr)
+        assert int(snn.array_length(arr).value) == 4
+        got = snn.array_read(arr, 2)
+        assert float(got.value[0]) == 2.0
+        stacked = arr.stack()
+        assert stacked.shape == [4, 2]
+        cat, sizes = snn.tensor_array_to_tensor(arr, axis=0)
+        assert cat.shape == [8]
+        assert list(np.asarray(sizes.value)) == [2, 2, 2, 2]
+
+    def test_sparse_write_raises(self):
+        arr = snn.create_array()
+        with pytest.raises(IndexError, match="dense"):
+            arr.write(3, paddle.ones([1]))
+
+    def test_static_array_in_while_loop(self):
+        """The reference seq2seq pattern: While + array_write, jit-safe."""
+        def collect(n):
+            arr = snn.StaticTensorArray(8, (2,), jnp.float32)
+
+            def cond(state):
+                return state[0] < n
+
+            def body(state):
+                i, arr = state
+                arr = arr.write(i, jnp.full((2,), i, jnp.float32))
+                return ((i + 1, arr),)
+
+            (out,) = snn.while_loop(cond, body,
+                                    [(jnp.int32(0), arr)])
+            i, arr = out
+            return arr.stack(), arr.length()
+
+        data, n = jax.jit(collect)(jnp.int32(5))
+        assert int(n) == 5
+        np.testing.assert_array_equal(np.asarray(data[:5, 0]),
+                                      np.arange(5, dtype=np.float32))
+
+    def test_fori_collect_differentiable(self):
+        def f(x):
+            def body(i, carry):
+                carry = carry * x
+                return carry, carry
+
+            last, ys = snn.fori_collect(0, 3, body, jnp.float32(1.0))
+            return ys.sum()  # x + x^2 + x^3
+
+        g = jax.grad(f)(jnp.float32(2.0))
+        assert float(g) == 1 + 2 * 2 + 3 * 4  # d/dx(x+x^2+x^3) at 2
+
+
+class TestIncrement:
+    def test_increment(self):
+        x = paddle.full([1], 1.0)
+        y = snn.increment(x, 2.0)
+        assert float(y.value[0]) == 3.0

@@ -325,6 +325,28 @@ class TestFP16AllReduce:
                                    np.asarray(p_off["w"]),
                                    rtol=2e-2, atol=2e-4)
 
+    def test_single_psum_with_gradient_merge(self):
+        """fp16_allreduce + gradient_merge must psum ONCE on the merged
+        grad, not once per microbatch (one bf16 all_reduce pair in the
+        StableHLO, not k)."""
+        loss_fn, params, batch = _toy()
+        mesh = build_mesh({"dp": 8})
+        strategy = DistributedStrategy()
+        strategy.fp16_allreduce = True
+        strategy.gradient_merge = True
+        strategy.gradient_merge_configs = {"k_steps": 4}
+        dopt, step, init_state, _ = _build(loss_fn, params, strategy, mesh)
+        shlo = step.lower(params, init_state(params), batch).as_text()
+        blocks = re.findall(
+            r'"stablehlo\.all_reduce".*?\n(?:.*?\n)*?.*?->\s*tensor<[^>]*>',
+            shlo)
+        bf16_ars = [b for b in blocks if b.splitlines()[-1].count("bf16")]
+        # 2 grad tensors (w, b) -> exactly 2 bf16 all_reduces, and none
+        # inside the scan body (which would multiply them by k)
+        assert len(bf16_ars) == 2, f"got {len(bf16_ars)} bf16 all_reduces"
+        _, _, loss = step(params, init_state(params), batch)
+        assert np.isfinite(float(loss))
+
     def test_warns_when_not_applicable(self):
         loss_fn, params, batch = _toy()
         mesh = build_mesh({"dp": 4, "mp": 2})
