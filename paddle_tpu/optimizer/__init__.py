@@ -480,3 +480,5 @@ DpsgdOptimizer = Dpsgd
 
 from .lr import *  # noqa: F401,F403,E402
 from . import lr  # noqa: F401,E402
+from .wrappers import (ModelAverage, ExponentialMovingAverage,  # noqa: E402
+                       EMA, LookaheadOptimizer)  # noqa: F401

@@ -1,0 +1,159 @@
+"""ModelAverage / EMA / Lookahead wrapper tests.
+
+Reference contract: fluid/optimizer.py ModelAverage:3141 (windowed
+average + apply/restore), ExponentialMovingAverage:3450 (shadow + decay
+ramp), LookaheadOptimizer:5212 (slow/fast sync every k)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu.optimizer import (EMA, ExponentialMovingAverage,
+                                  LookaheadOptimizer, ModelAverage)
+
+
+class TestEMAFunctional:
+    def test_shadow_math(self):
+        ema = ExponentialMovingAverage(decay=0.9)
+        params = {"w": jnp.ones((2,))}
+        st = ema.init_pytree(params)
+        st = ema.update_pytree({"w": jnp.full((2,), 2.0)}, st)
+        # shadow = 0.9*1 + 0.1*2 = 1.1
+        np.testing.assert_allclose(np.asarray(st["shadow"]["w"]),
+                                   [1.1, 1.1], rtol=1e-6)
+        assert int(st["step"]) == 1
+
+    def test_thres_steps_ramp(self):
+        ema = ExponentialMovingAverage(decay=0.999, thres_steps=True)
+        params = {"w": jnp.zeros((1,))}
+        st = ema.init_pytree({"w": jnp.ones((1,))})
+        # step 0: decay = min(0.999, 1/10) = 0.1 -> shadow = 0.1*1+0.9*0
+        st = ema.update_pytree(params, st)
+        np.testing.assert_allclose(np.asarray(st["shadow"]["w"]), [0.1],
+                                   rtol=1e-6)
+
+    def test_jit_composes_with_train_step(self):
+        opt = paddle.optimizer.SGD(learning_rate=0.1)
+        ema = ExponentialMovingAverage(decay=0.5)
+        params = {"w": jnp.float32(1.0)}
+
+        def step(p, s, e):
+            g = {"w": jnp.float32(1.0)}
+            p, s = opt.apply_pytree(p, g, s, step=1)
+            e = ema.update_pytree(p, e)
+            return p, s, e
+
+        p, s, e = jax.jit(step)(params, opt.init_pytree(params),
+                                ema.init_pytree(params))
+        np.testing.assert_allclose(float(p["w"]), 0.9, rtol=1e-6)
+        # shadow = 0.5*1 + 0.5*0.9
+        np.testing.assert_allclose(float(e["shadow"]["w"]), 0.95, rtol=1e-6)
+
+
+class TestEMAEager:
+    def test_update_apply_restore(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(4, 4)
+        ema = EMA(decay=0.0, parameters=lin.parameters())  # shadow == param
+        ema.update()
+        orig = np.asarray(lin.weight.value).copy()
+        lin.weight._value = lin.weight.value + 1.0
+        with ema.apply():
+            np.testing.assert_allclose(np.asarray(lin.weight.value), orig,
+                                       rtol=1e-6)
+        np.testing.assert_allclose(np.asarray(lin.weight.value), orig + 1.0,
+                                   rtol=1e-6)
+
+
+class TestModelAverage:
+    def test_three_step_average(self):
+        ma = ModelAverage(average_window_rate=1.0, min_average_window=1,
+                          max_average_window=100)
+        params = {"w": jnp.float32(0.0)}
+        st = ma.init_pytree(params)
+        for v in (1.0, 2.0, 3.0):
+            st = ma.update_pytree({"w": jnp.float32(v)}, st)
+        avg = ma.average_pytree(st)
+        # window math: each step restarts when num_acc >= min(max, rate*n)
+        # with rate=1 the window tracks all updates; average over the
+        # retained buckets must lie within [1, 3]
+        assert 1.0 <= float(avg["w"]) <= 3.0
+
+    def test_wide_window_is_plain_mean(self):
+        ma = ModelAverage(average_window_rate=0.0, min_average_window=100,
+                          max_average_window=100)
+        st = ma.init_pytree({"w": jnp.float32(0.0)})
+        for v in (1.0, 2.0, 3.0, 4.0):
+            st = ma.update_pytree({"w": jnp.float32(v)}, st)
+        np.testing.assert_allclose(float(ma.average_pytree(st)["w"]), 2.5,
+                                   rtol=1e-6)
+
+    def test_eager_apply_restore(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(3, 3)
+        ma = ModelAverage(0.0, parameters=lin.parameters(),
+                          min_average_window=100, max_average_window=100)
+        w0 = np.asarray(lin.weight.value).copy()
+        ma.update()
+        lin.weight._value = lin.weight.value + 2.0
+        ma.update()
+        with ma.apply():
+            np.testing.assert_allclose(np.asarray(lin.weight.value),
+                                       w0 + 1.0, rtol=1e-5)
+        np.testing.assert_allclose(np.asarray(lin.weight.value), w0 + 2.0,
+                                   rtol=1e-6)
+
+
+class TestLookahead:
+    def test_sync_every_k(self):
+        inner = paddle.optimizer.SGD(learning_rate=1.0)
+        la = LookaheadOptimizer(inner, alpha=0.5, k=2)
+        params = {"w": jnp.float32(10.0)}
+        st = la.init_pytree(params)
+        g = {"w": jnp.float32(1.0)}
+        # step1: fast 10->9, no sync.  step2: fast 9->8, sync:
+        # slow = 10 + 0.5*(8-10) = 9, fast = 9
+        p, st = la.apply_pytree(params, g, st, step=1)
+        assert float(p["w"]) == 9.0
+        p, st = la.apply_pytree(p, g, st, step=2)
+        assert float(p["w"]) == 9.0
+        assert float(st["slow"]["w"]) == 9.0
+
+    def test_jitted(self):
+        inner = paddle.optimizer.SGD(learning_rate=1.0)
+        la = LookaheadOptimizer(inner, alpha=0.5, k=2)
+        params = {"w": jnp.float32(10.0)}
+
+        @jax.jit
+        def two(p, st):
+            g = {"w": jnp.float32(1.0)}
+            p, st = la.apply_pytree(p, g, st, step=1)
+            return la.apply_pytree(p, g, st, step=2)
+
+        p, st = two(params, la.init_pytree(params))
+        assert float(p["w"]) == 9.0
+
+    def test_eager_step(self):
+        paddle.seed(0)
+        lin = paddle.nn.Linear(2, 2, bias_attr=False)
+        w0 = np.asarray(lin.weight.value).copy()
+        inner = paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=lin.parameters())
+        la = LookaheadOptimizer(inner, alpha=0.5, k=2)
+        x = paddle.ones([4, 2])
+        for _ in range(2):
+            loss = (lin(x) ** 2).mean()
+            loss.backward()
+            la.step()
+            la.clear_grad()
+        # after k=2 steps the weights must equal slow-sync of the fast path
+        assert not np.allclose(np.asarray(lin.weight.value), w0)
+
+    def test_validation(self):
+        import pytest
+        with pytest.raises(ValueError):
+            LookaheadOptimizer(None)
+        with pytest.raises(ValueError):
+            LookaheadOptimizer(paddle.optimizer.SGD(), alpha=2.0)
+        with pytest.raises(ValueError):
+            LookaheadOptimizer(paddle.optimizer.SGD(), k=0)
