@@ -19,6 +19,8 @@ from __future__ import annotations
 
 from typing import Sequence
 
+import numpy as np
+
 import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
@@ -62,13 +64,18 @@ def _axis_names(group=None):
     return tuple(mesh.axis_names)
 
 
-def _eager_collective(fn, x_val, axes):
-    """Run a collective eagerly via shard_map over the current mesh."""
+def _eager_collective(fn, x_val, axes, out_spec=None):
+    """Run a collective eagerly via a one-shot shard_map over the current
+    mesh (the dygraph `core.ops.c_*` analog).  Input is the replicated
+    eager value; out_spec defaults to replicated-same-shape (all_reduce /
+    broadcast); gather/scatter-shaped collectives pass their own."""
     mesh = ensure_mesh()
     if mesh.size == 1 or not axes:
         return None  # caller handles identity
     spec = P(*[None] * x_val.ndim)
-    f = shard_map(fn, mesh=mesh, in_specs=(spec,), out_specs=spec)
+    f = shard_map(fn, mesh=mesh, in_specs=(spec,),
+                  out_specs=out_spec if out_spec is not None else spec,
+                  check_vma=False)
     return f(x_val)
 
 
@@ -108,7 +115,8 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
             tensor_list.append(tensor)
         return tensor
     out = _eager_collective(
-        lambda x: jax.lax.all_gather(x, axes, axis=0, tiled=False), v, axes)
+        lambda x: jax.lax.all_gather(x, axes, axis=0, tiled=False), v, axes,
+        out_spec=P(*[None] * (v.ndim + 1)))
     g = Tensor(out) if out is not None else tensor
     if tensor_list is not None and out is not None:
         for i in range(g.shape[0]):
@@ -155,21 +163,47 @@ def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
     mesh = get_mesh()
     if mesh is None or mesh.size == 1:
         return tensor
+    scatter_spec = P(axes if isinstance(axes, str) else tuple(axes),
+                     *[None] * (v.ndim - 1))
     out = _eager_collective(
         lambda x: jax.lax.psum_scatter(x, axes, scatter_dimension=0, tiled=True),
-        v, axes)
-    return Tensor(out) if out is not None else tensor
+        v, axes, out_spec=scatter_spec)
+    if out is None:
+        return tensor
+    # return THIS rank's shard (the reference contract and the traced
+    # path's per-shard view), not the global concatenation
+    mesh = get_mesh()
+    n = int(np.prod([mesh.shape[a] for a in
+                     ((axes,) if isinstance(axes, str) else axes)]))
+    local = out.reshape((n, out.shape[0] // n) + out.shape[1:])[
+        _local_rank() % n]
+    return Tensor(local)
+
+
+def _local_rank():
+    from .env import ParallelEnv
+
+    try:
+        return int(ParallelEnv().rank)
+    except Exception:  # noqa: BLE001 - no env configured -> rank 0
+        return 0
 
 
 def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
-    mesh = get_mesh()
-    if mesh is None or mesh.size == 1:
-        if tensor_list:
-            tensor._value = unwrap(tensor_list[0])
-        return tensor
-    raise NotImplementedError(
-        "eager scatter across a pod: address shards with jax.device_put + "
-        "NamedSharding instead (data is placed, not messaged, on TPU)")
+    """Dygraph scatter parity (collective.py:386): this process's `tensor`
+    becomes tensor_list[rank].  Under the single-controller SPMD runtime
+    every logical rank runs here, so tensor_list is required (the
+    reference only needs it on the src rank); cross-chip placement of the
+    shards is jax.device_put + NamedSharding, which the caller controls
+    (data is placed, not messaged, on TPU)."""
+    if not tensor_list:
+        raise ValueError(
+            "scatter() under the single-controller runtime requires "
+            "tensor_list on every rank (there is no cross-process eager "
+            "messaging on TPU; place shards with jax.device_put instead)")
+    rank = _local_rank() % len(tensor_list)
+    tensor._value = unwrap(tensor_list[rank])
+    return tensor
 
 
 def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
@@ -191,7 +225,26 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
         if out_tensor_list is not None:
             out_tensor_list.extend(list(in_tensor_list))
         return x
-    raise NotImplementedError("eager alltoall: use inside a pjit step")
+    # eager one-shot: every replica holds the same in_tensor_list (the
+    # single-controller degenerate of the dygraph contract), so rank r's
+    # output is in_list[r] received from every peer — run the REAL
+    # lax.all_to_all over the mesh so the bytes cross the ICI exactly as
+    # the reference's alltoall op would
+    spec_in = P(*[None] * v.ndim)
+    ax_spec = axes if isinstance(axes, str) else tuple(axes)
+    n = int(np.prod([mesh.shape[a] for a in
+                     ((axes,) if isinstance(axes, str) else axes)]))
+    out = shard_map(
+        lambda a: jax.lax.all_to_all(a, axes, split_axis=0, concat_axis=0,
+                                     tiled=True),
+        mesh=mesh, in_specs=(spec_in,),
+        out_specs=P(ax_spec, *[None] * (v.ndim - 1)), check_vma=False)(v)
+    # global [n * len(in_list), ...]; this rank's block is its exchange
+    mine = out.reshape((n, -1) + out.shape[1:])[_local_rank() % n]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(
+            [Tensor(mine[i]) for i in range(mine.shape[0])])
+    return Tensor(mine)
 
 
 def barrier(group=None):
@@ -200,18 +253,41 @@ def barrier(group=None):
     (jnp.zeros(()) + 0).block_until_ready()
 
 
+# Eager P2P: the single-controller runtime executes every logical rank's
+# code in one process, so send/recv pair up through an in-process FIFO
+# keyed by the SENDER's rank (the only address both sides can agree on:
+# send declares dst, recv declares src; under emulation the sender's rank
+# is this controller's rank).  Inside jitted pipeline steps use
+# lax.ppermute (the send_v2/recv_v2 analog, distributed.pipeline) — that
+# is the path that rides ICI.
+_P2P_MAILBOX: dict = {}
+
+
 def send(tensor, dst=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv are expressed as lax.ppermute inside "
-        "pipeline-parallel steps (paddle_tpu.distributed.pipeline); "
-        "eager P2P does not exist on TPU")
+    """Dygraph send parity (operators/collective/send_v2_op.cc UX).  Under
+    single-controller SPMD this enqueues for the matching recv(src=<this
+    rank>); dst is accepted for script parity.  There is no cross-process
+    eager messaging on TPU (use pipeline ppermute)."""
+    _P2P_MAILBOX.setdefault(_local_rank(), []).append(unwrap(tensor))
+    return tensor
 
 
 def recv(tensor, src=0, group=None, sync_op=True):
-    raise NotImplementedError(
-        "point-to-point send/recv are expressed as lax.ppermute inside "
-        "pipeline-parallel steps (paddle_tpu.distributed.pipeline); "
-        "eager P2P does not exist on TPU")
+    """Matching receive: pops the oldest value sent by rank `src` in this
+    controller and copies it into `tensor` (shape/dtype preserved)."""
+    box = _P2P_MAILBOX.get(int(src))
+    if not box:
+        raise RuntimeError(
+            f"recv(src={src}): no matching send in this controller — "
+            f"cross-process eager P2P does not exist on TPU; use "
+            f"lax.ppermute inside a jitted pipeline step")
+    v = box[0]
+    if tuple(v.shape) != tuple(unwrap(tensor).shape):
+        raise ValueError(f"recv shape mismatch: got {tuple(v.shape)}, "
+                         f"tensor is {tuple(unwrap(tensor).shape)}")
+    box.pop(0)  # consume only after validation so a retry can succeed
+    tensor._value = v.astype(unwrap(tensor).dtype)
+    return tensor
 
 
 def new_group(ranks=None, backend=None):
