@@ -84,17 +84,42 @@ struct Ring {
   std::mutex mu;
   std::condition_variable cv_free, cv_ready;
   bool closed = false;
+  int refs = 0;  // in-flight API calls; guarded by g_rings_mu
 };
 
 std::mutex g_rings_mu;
+std::condition_variable g_rings_cv;  // signaled when a ring's refs drop
 std::map<int64_t, Ring*> g_rings;
 int64_t g_next_ring = 1;
 
-Ring* get_ring(int64_t h) {
-  std::lock_guard<std::mutex> lk(g_rings_mu);
-  auto it = g_rings.find(h);
-  return it == g_rings.end() ? nullptr : it->second;
-}
+// Refcounted access: pt_ring_destroy must not free a Ring while a reader
+// blocked in acquire_read still holds the pointer (it re-locks r->mu after
+// waking — a plain delete-after-notify is a use-after-free). Every API call
+// pins the ring for its duration; destroy drains refs before deleting.
+class RingRef {
+ public:
+  explicit RingRef(int64_t h) {
+    std::lock_guard<std::mutex> lk(g_rings_mu);
+    auto it = g_rings.find(h);
+    if (it != g_rings.end()) {
+      r_ = it->second;
+      ++r_->refs;
+    }
+  }
+  ~RingRef() {
+    if (!r_) return;
+    std::lock_guard<std::mutex> lk(g_rings_mu);
+    if (--r_->refs == 0) g_rings_cv.notify_all();
+  }
+  RingRef(const RingRef&) = delete;
+  RingRef& operator=(const RingRef&) = delete;
+  Ring* operator->() const { return r_; }
+  Ring* get() const { return r_; }
+  explicit operator bool() const { return r_ != nullptr; }
+
+ private:
+  Ring* r_ = nullptr;
+};
 
 }  // namespace
 
@@ -240,7 +265,7 @@ PT_API int64_t pt_ring_create(int capacity, int64_t slot_bytes) {
 
 // -1 timeout, -2 closed, else slot index
 PT_API int pt_ring_acquire_write(int64_t h, int timeout_ms) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   if (!r) return -3;
   std::unique_lock<std::mutex> lk(r->mu);
   auto pred = [&] { return r->closed || !r->free_q.empty(); };
@@ -257,19 +282,19 @@ PT_API int pt_ring_acquire_write(int64_t h, int timeout_ms) {
 }
 
 PT_API void* pt_ring_slot_ptr(int64_t h, int idx) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   if (!r || idx < 0 || idx >= static_cast<int>(r->slots.size()))
     return nullptr;
   return r->slots[idx].data();
 }
 
 PT_API int64_t pt_ring_slot_bytes(int64_t h) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   return r ? static_cast<int64_t>(r->slots[0].size()) : -1;
 }
 
 PT_API void pt_ring_commit_write(int64_t h, int idx, int64_t nbytes) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   if (!r) return;
   {
     std::lock_guard<std::mutex> lk(r->mu);
@@ -281,7 +306,7 @@ PT_API void pt_ring_commit_write(int64_t h, int idx, int64_t nbytes) {
 
 // -1 timeout, -2 closed-and-drained, else slot index (payload in *nbytes)
 PT_API int pt_ring_acquire_read(int64_t h, int timeout_ms, int64_t* nbytes) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   if (!r) return -3;
   std::unique_lock<std::mutex> lk(r->mu);
   auto pred = [&] { return r->closed || !r->ready_q.empty(); };
@@ -299,7 +324,7 @@ PT_API int pt_ring_acquire_read(int64_t h, int timeout_ms, int64_t* nbytes) {
 }
 
 PT_API void pt_ring_release_read(int64_t h, int idx) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   if (!r) return;
   {
     std::lock_guard<std::mutex> lk(r->mu);
@@ -309,7 +334,7 @@ PT_API void pt_ring_release_read(int64_t h, int idx) {
 }
 
 PT_API void pt_ring_close(int64_t h) {
-  Ring* r = get_ring(h);
+  RingRef r(h);
   if (!r) return;
   {
     std::lock_guard<std::mutex> lk(r->mu);
@@ -319,6 +344,67 @@ PT_API void pt_ring_close(int64_t h) {
   r->cv_ready.notify_all();
 }
 
+// One-shot write: acquire+copy+commit under a single RingRef pin. The
+// split acquire/slot_ptr/commit API leaves an unpinned window where a
+// concurrent destroy can free the slot vectors mid-copy; these entry
+// points close it (the Python RingBuffer uses only these).
+// 0 ok, -1 timeout, -2 closed, -3 no such ring, -4 payload too big
+PT_API int pt_ring_write(int64_t h, const void* src, int64_t n,
+                         int timeout_ms) {
+  RingRef r(h);
+  if (!r) return -3;
+  int idx;
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    if (n > static_cast<int64_t>(r->slots[0].size())) return -4;
+    auto pred = [&] { return r->closed || !r->free_q.empty(); };
+    if (timeout_ms < 0) {
+      r->cv_free.wait(lk, pred);
+    } else if (!r->cv_free.wait_for(lk,
+                                    std::chrono::milliseconds(timeout_ms),
+                                    pred)) {
+      return -1;
+    }
+    if (r->closed) return -2;
+    idx = r->free_q.front();
+    r->free_q.pop_front();
+    std::memcpy(r->slots[idx].data(), src, static_cast<size_t>(n));
+    r->sizes[idx] = n;
+    r->ready_q.push_back(idx);
+  }
+  r->cv_ready.notify_one();
+  return 0;
+}
+
+// One-shot read into dst (cap bytes): returns payload size, -1 timeout,
+// -2 closed-and-drained, -3 no such ring, -4 dst too small
+PT_API int64_t pt_ring_read(int64_t h, void* dst, int64_t cap,
+                            int timeout_ms) {
+  RingRef r(h);
+  if (!r) return -3;
+  int64_t n;
+  {
+    std::unique_lock<std::mutex> lk(r->mu);
+    auto pred = [&] { return r->closed || !r->ready_q.empty(); };
+    if (timeout_ms < 0) {
+      r->cv_ready.wait(lk, pred);
+    } else if (!r->cv_ready.wait_for(lk,
+                                     std::chrono::milliseconds(timeout_ms),
+                                     pred)) {
+      return -1;
+    }
+    if (r->ready_q.empty()) return r->closed ? -2 : -1;
+    int idx = r->ready_q.front();
+    n = r->sizes[idx];
+    if (n > cap) return -4;  // slot stays queued; caller re-reads bigger
+    r->ready_q.pop_front();
+    std::memcpy(dst, r->slots[idx].data(), static_cast<size_t>(n));
+    r->free_q.push_back(idx);
+  }
+  r->cv_free.notify_one();
+  return n;
+}
+
 PT_API void pt_ring_destroy(int64_t h) {
   Ring* r = nullptr;
   {
@@ -326,7 +412,7 @@ PT_API void pt_ring_destroy(int64_t h) {
     auto it = g_rings.find(h);
     if (it == g_rings.end()) return;
     r = it->second;
-    g_rings.erase(it);
+    g_rings.erase(it);  // no new RingRef can pin it from here on
   }
   {
     std::lock_guard<std::mutex> lk(r->mu);
@@ -334,6 +420,13 @@ PT_API void pt_ring_destroy(int64_t h) {
   }
   r->cv_free.notify_all();
   r->cv_ready.notify_all();
+  // Drain in-flight callers: a reader blocked in acquire_read wakes from
+  // the notify above, re-locks r->mu, returns, and drops its RingRef.
+  // Deleting before refs hit zero is the round-1/2 advisor UAF.
+  {
+    std::unique_lock<std::mutex> lk(g_rings_mu);
+    g_rings_cv.wait(lk, [&] { return r->refs == 0; });
+  }
   delete r;
 }
 

@@ -1,9 +1,53 @@
+import os as _os
+
 import jax as _jax
 
-# paddle's dtype surface includes float64/int64 as first-class citizens
-# (framework.proto VarType); jax disables 64-bit by default — enable it.
-# float32/bfloat16 remain the working dtypes on the TPU hot path.
-_jax.config.update("jax_enable_x64", True)
+
+def _x64_default() -> bool:
+    """x64 policy (ref framework.proto VarType lists FP64/INT64 as
+    first-class dtypes, so CPU keeps them for API parity).
+
+    TPU compiles reject f64 outright, so on accelerator backends x64 stays
+    OFF: JAX then canonicalizes any f64 leak (np.float64 scalars such as
+    ``x / np.sqrt(d)``, numpy-initialized weights) to f32 at trace time
+    instead of producing a fatal ``(f64) -> f32`` convert in Mosaic/XLA.
+    This is a policy, not a per-callsite patch: no user script can poison a
+    TPU compile with f64 constants. Override with PADDLE_TPU_ENABLE_X64=0/1.
+    """
+    env = _os.environ.get("PADDLE_TPU_ENABLE_X64")
+    if env is not None:
+        return env.strip().lower() not in ("0", "false", "off", "")
+    # An explicit JAX_PLATFORMS=cpu wins even when a site plugin rewrites
+    # jax_platforms to an accelerator list after env parsing.
+    if _os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True
+    # Decide from configuration WITHOUT initializing the XLA backend: a
+    # default_backend() probe here would lock in local devices and break a
+    # later jax.distributed.initialize() (multi-host fleets init lazily —
+    # see distributed/parallel.py / role_maker.py).
+    cfg = getattr(_jax.config, "jax_platforms", None) or ""
+    plats = {p.strip().lower() for p in cfg.split(",") if p.strip()}
+    if plats:
+        return plats <= {"cpu"}
+    # Unknown target: stay 32-bit — f64 canonicalization is harmless on
+    # CPU but f64 leakage is fatal on TPU.
+    return False
+
+
+_jax.config.update("jax_enable_x64", _x64_default())
+
+if not _jax.config.jax_enable_x64:
+    # 64-bit dtype requests canonicalize to 32-bit on accelerators; the
+    # per-callsite truncation warning would otherwise fire on every astype.
+    import warnings as _warnings
+
+    _warnings.filterwarnings(
+        "ignore", message="Explicitly requested dtype.*is not available")
+
+
+def enable_x64(flag: bool = True) -> None:
+    """Runtime override of the 64-bit policy (affects subsequent traces)."""
+    _jax.config.update("jax_enable_x64", bool(flag))
 
 from . import dtype as dtypes
 from .dtype import (

@@ -38,6 +38,14 @@ class PrecisionType:
     Int8 = 3
 
 
+def _natural_key(name):
+    """Sort key splitting digit runs so x2 < x10 (AnalysisPredictor binds
+    feeds by declaration order; numeric-suffix names must follow it)."""
+    import re
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", str(name))]
+
+
 class Config:
     """AnalysisConfig parity (api/analysis_config.cc)."""
 
@@ -194,8 +202,10 @@ class Predictor:
         """Run with positional numpy inputs (returns list of numpy), or
         with bound handles when inputs is None (ZeroCopyRun path)."""
         if inputs is None:
+            # Natural-sort fallback: lexicographic sorted() would bind x10
+            # before x2 for models with 11+ inputs (advisor r1/r2 finding).
             names = self._input_names or sorted(
-                getattr(self, "_in_handles", {}))
+                getattr(self, "_in_handles", {}), key=_natural_key)
             inputs = [self._in_handles[n]._value for n in names]
         arrays = [jnp.asarray(np.asarray(
             x.numpy() if isinstance(x, Tensor) else x)) for x in inputs]
@@ -241,6 +251,39 @@ def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
     try:
         params, buffers = state_pytrees(layer)
 
+        # Dynamic dims (-1/None) in an InputSpec export symbolically via
+        # jax.export so the served artifact accepts ANY size there. Baking
+        # -1 to a concrete 1 (the old behavior) silently served batch-1
+        # only (advisor r1/r2 finding).
+        sym_in_specs = None
+        manifest_shapes = None
+        if input_spec is not None and example_inputs is not None:
+            if len(input_spec) != len(example_inputs):
+                raise ValueError(
+                    f"input_spec has {len(input_spec)} entries but "
+                    f"example_inputs has {len(example_inputs)}")
+            for i, (s, a) in enumerate(zip(input_spec, example_inputs)):
+                ashape = tuple(np.shape(np.asarray(
+                    a.numpy() if isinstance(a, Tensor) else a)))
+                if len(s.shape) != len(ashape) or any(
+                        d is not None and d >= 0 and d != ad
+                        for d, ad in zip(s.shape, ashape)):
+                    raise ValueError(
+                        f"input_spec[{i}] shape {list(s.shape)} does not "
+                        f"match example_inputs[{i}] shape {list(ashape)}")
+        if input_spec is not None:
+            manifest_shapes = [[-1 if (d is None or d < 0) else int(d)
+                                for d in s.shape] for s in input_spec]
+            if any(d < 0 for shp in manifest_shapes for d in shp):
+                scope = jax.export.SymbolicScope()
+                sym_in_specs = []
+                for i, s in enumerate(input_spec):
+                    dims = ",".join(
+                        f"d{i}_{j}" if (d is None or d < 0) else str(d)
+                        for j, d in enumerate(s.shape))
+                    shape = jax.export.symbolic_shape(dims, scope=scope)
+                    sym_in_specs.append(jax.ShapeDtypeStruct(
+                        shape, np.dtype(convert_dtype(s.dtype))))
         if example_inputs is None and input_spec is not None:
             example_inputs = [
                 np.zeros([d if d and d > 0 else 1 for d in s.shape],
@@ -269,18 +312,32 @@ def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
         arrays = [jnp.asarray(np.asarray(
             x.numpy() if isinstance(x, Tensor) else x))
             for x in example_inputs]
+        in_specs = sym_in_specs if sym_in_specs is not None else [
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
         specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
-                 for a in jax.tree.leaves(params)] + \
-                [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
-        exported = jax.export.export(jax.jit(fwd))(*specs)
+                 for a in jax.tree.leaves(params)] + list(in_specs)
+        try:
+            exported = jax.export.export(jax.jit(fwd))(*specs)
+        except Exception as e:
+            if sym_in_specs is not None:
+                raise ValueError(
+                    "AOT export with dynamic dims "
+                    f"{[list(s.shape) for s in sym_in_specs]} failed "
+                    "(model not traceable with symbolic shapes: "
+                    f"{type(e).__name__}: {e}). Pass concrete "
+                    "example_inputs to export a fixed-shape artifact."
+                ) from e
+            raise
         with open(path_prefix + ".pdexport", "wb") as f:
             f.write(exported.serialize())
         manifest = {
             "input_names": [f"x{i}" for i in range(len(arrays))],
             "output_names": [f"out{i}"
                              for i in range(len(exported.out_avals))],
-            "input_specs": [{"shape": list(a.shape), "dtype": str(a.dtype)}
-                            for a in arrays],
+            "input_specs": [{"shape": (manifest_shapes[i] if manifest_shapes
+                                       else list(a.shape)),
+                             "dtype": str(a.dtype)}
+                            for i, a in enumerate(arrays)],
             "format": "jax.export/stablehlo",
         }
         with open(path_prefix + ".pdmodel.json", "w") as f:

@@ -20,6 +20,17 @@ from paddle_tpu.inference import (
 )
 
 
+class _Sum12(paddle.nn.Layer):
+    """12 inputs, each weighted differently so binding order matters."""
+
+    def __init__(self):
+        super().__init__()
+        self.w = self.create_parameter([1], default_initializer=None)
+
+    def forward(self, *xs):
+        return sum((i + 1) * x for i, x in enumerate(xs)) + 0 * self.w
+
+
 def _trained_mlp():
     paddle.seed(7)
     net = paddle.nn.Sequential(
@@ -112,3 +123,46 @@ class TestPredictorAPI:
         pred = load_inference_model(prefix)
         out, = pred.run([np.zeros((2, 8), np.float32)])
         assert out.shape == (2, 3)
+
+    def test_dynamic_batch_export_serves_any_batch(self, tmp_path):
+        """Regression (advisor r1/r2): InputSpec([-1, 8]) used to bake the
+        dynamic dim to 1, silently serving batch-1 only. Now exports via
+        jax.export symbolic shapes."""
+        from paddle_tpu.jit import InputSpec
+        net = _trained_mlp()
+        prefix = str(tmp_path / "mdyn")
+        save_inference_model(prefix, net,
+                             input_spec=[InputSpec([-1, 8], "float32")])
+        pred = load_inference_model(prefix)
+        assert pred._mode == "aot"
+        for b in (1, 3, 17):
+            out, = pred.run([np.random.RandomState(b)
+                             .randn(b, 8).astype(np.float32)])
+            assert out.shape == (b, 3)
+        manifest = json.load(open(prefix + ".pdmodel.json"))
+        assert manifest["input_specs"][0]["shape"] == [-1, 8]
+
+    def test_many_input_handle_ordering(self, tmp_path):
+        """Regression (advisor r1/r2): lexicographic sorted() bound x10
+        before x2 for models with 11+ inputs."""
+        net = _Sum12()
+        net.eval()
+        prefix = str(tmp_path / "m12")
+        examples = [np.full((1,), 1.0, np.float32) for _ in range(12)]
+        save_inference_model(prefix, net, example_inputs=examples)
+        pred = load_inference_model(prefix)
+        names = pred.get_input_names()
+        assert names == [f"x{i}" for i in range(12)]
+        for i, n in enumerate(names):
+            h = pred.get_input_handle(n)
+            h.copy_from_cpu(np.full((1,), float(i), np.float32))
+        out, = pred.run()
+        expect = sum((i + 1) * float(i) for i in range(12))
+        assert np.allclose(out, expect)
+
+    def test_zero_copy_natural_order_fallback(self):
+        """When input names must be inferred from handles alone, numeric
+        suffixes bind in natural order (x2 before x10)."""
+        from paddle_tpu.inference import _natural_key
+        names = [f"x{i}" for i in range(12)]
+        assert sorted(names, key=_natural_key) == names

@@ -110,6 +110,33 @@ class TestRingBuffer:
             rb.put(b"x" * 9)
         rb.close()
 
+    @needs_native
+    def test_destroy_while_reader_blocked(self):
+        """Regression (advisor r1/r2): pt_ring_destroy used to delete the
+        Ring right after notify_all while a blocked reader re-locks r->mu
+        on wakeup — a use-after-free. destroy now drains in-flight callers
+        (refcount) before freeing."""
+        for _ in range(20):
+            rb = core.RingBuffer(2, 16)
+            results = []
+
+            def reader(rb=rb, results=results):
+                try:
+                    results.append(rb.get(timeout_ms=2000))
+                except EOFError:
+                    results.append("eof")
+
+            ts = [threading.Thread(target=reader) for _ in range(4)]
+            for t in ts:
+                t.start()
+            time.sleep(0.005)  # let readers block inside acquire_read
+            rb._lib.pt_ring_destroy(rb._h)  # close+drain+free
+            rb._h = -1  # prevent double-destroy in __del__
+            for t in ts:
+                t.join(timeout=5)
+                assert not t.is_alive()
+            assert all(r == "eof" or r is None for r in results)
+
 
 class TestBatchAssemble:
     def test_matches_np_stack(self):
