@@ -35,7 +35,8 @@ PROBE_BACKOFF_S = (0, 15, 45)
 CONFIG_TIMEOUT_TPU_S = 900
 CONFIG_TIMEOUT_CPU_S = 600
 
-CONFIGS = ("kernels", "resnet50", "ernie", "gpt13b", "bert")  # bert last = headline
+CONFIGS = ("mnist", "kernels", "resnet50", "ernie", "gpt13b",
+           "bert")  # bert last = headline
 
 
 def _cpu_env():
@@ -318,26 +319,81 @@ def body_ernie(on_tpu):
 
 
 def _matmul_roofline():
-    """Achievable bf16 matmul TFLOPs on this (shared/throttled) chip."""
+    """Achievable bf16 matmul TFLOPs on this (shared/throttled) chip.
+
+    Calibration (round-2 advisor finding: subtracting a noisy tunnel
+    roundtrip from ONE short timing reported 214 TFLOPs on a 197-peak
+    part): time two chain lengths and use the difference — fixed
+    per-call overhead (tunnel, dispatch) cancels exactly, and the long
+    chain keeps compute ≫ noise. Clamped to the part's peak."""
+    import functools
+
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     N = 4096
-    a = jnp.asarray(np.random.RandomState(0).randn(N, N), jnp.bfloat16)
+    a = jnp.asarray(np.random.RandomState(0).randn(N, N) * 0.01,
+                    jnp.bfloat16)
 
-    def mm(a, c):
-        return jax.lax.scan(lambda c, _: (a @ c, ()), c, None, length=30)[0]
+    @functools.partial(jax.jit, static_argnames="n")
+    def mm(a, c, n):
+        return jax.lax.scan(lambda c, _: (a @ c, ()), c, None, length=n)[0]
 
-    mm = jax.jit(mm)
-    rt = _roundtrip()
-    c = mm(a, a)
-    float(c[0, 0])
-    t0 = time.perf_counter()
-    c = mm(a, c)
-    float(c[0, 0])
-    dt = max(time.perf_counter() - t0 - rt, 1e-9) / 30
-    return 2 * N ** 3 / dt / 1e12
+    def timed(n):
+        c = mm(a, a, n)
+        float(c[0, 0])  # warmup/compile
+        best = float("inf")
+        for _ in range(3):
+            t0 = time.perf_counter()
+            c = mm(a, a, n)
+            float(c[0, 0])
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    n_long, n_short = 240, 40
+    dt = max(timed(n_long) - timed(n_short), 1e-9) / (n_long - n_short)
+    tflops = 2 * N ** 3 / dt / 1e12
+    return min(tflops, peak_flops_per_chip() / 1e12)
+
+
+def body_mnist(on_tpu):
+    """BASELINE config 1: MNIST LeNet convergence parity — train the
+    hapi Model.fit path (the reference's fluid Executor entry) and report
+    final accuracy/loss; vs_baseline is acc against the 0.97 bar the
+    reference's LeNet reaches on MNIST-scale data."""
+    import time as _time
+
+    import numpy as np
+
+    import paddle_tpu as paddle
+    from paddle_tpu.vision.models import LeNet
+
+    paddle.seed(0)
+    net = LeNet()
+    model = paddle.Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    train = paddle.vision.datasets.MNIST(mode="train")
+    test = paddle.vision.datasets.MNIST(mode="test")
+    t0 = _time.perf_counter()
+    model.fit(train, batch_size=128, epochs=1, verbose=0)
+    fit_s = _time.perf_counter() - t0
+    res = model.evaluate(test, batch_size=256, verbose=0)
+    acc = float(res["acc"])
+    loss = float(np.asarray(res["loss"]).reshape(-1)[0])
+    return {
+        "metric": "mnist_lenet_convergence",
+        "value": round(acc, 4),
+        "unit": "accuracy",
+        "vs_baseline": round(acc / 0.97, 4),
+        "final_loss": round(loss, 4),
+        "fit_seconds": round(fit_s, 1),
+        "epochs": 1,
+    }
 
 
 def body_resnet50(on_tpu):
@@ -572,7 +628,8 @@ def body_config(name):
 
     on_tpu = jax.default_backend() not in ("cpu",)
     body = {"bert": body_bert, "ernie": body_ernie, "resnet50": body_resnet50,
-            "gpt13b": body_gpt13b, "kernels": body_kernels}[name]
+            "gpt13b": body_gpt13b, "kernels": body_kernels,
+            "mnist": body_mnist}[name]
     r = body(on_tpu)
     r["platform"] = jax.devices()[0].device_kind if on_tpu else "cpu"
     print(json.dumps(r), flush=True)

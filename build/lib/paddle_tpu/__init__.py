@@ -1,0 +1,107 @@
+"""paddle_tpu — a TPU-native deep learning framework.
+
+A ground-up rebuild of the PaddlePaddle (Fluid ~2.0) capability surface on
+JAX/XLA/Pallas/pjit.  Import as `import paddle_tpu as paddle` — the public API
+mirrors python/paddle/__init__.py of the reference.
+
+Architecture (see SURVEY.md §7):
+  eager "dygraph"  = Tensor wrapper + jax.vjp autograd tape
+  "static"/jit     = jax.jit over the same layer code via functional_call
+  ParallelExecutor = pjit + sharding specs (paddle_tpu.distributed)
+  fused ops        = Pallas kernels behind FLAGS_use_pallas_kernels
+"""
+from __future__ import annotations
+
+from . import framework
+from .framework import (
+    CPUPlace,
+    CUDAPinnedPlace,
+    CUDAPlace,
+    TPUPlace,
+    XPUPlace,
+    bfloat16,
+    bool_,
+    complex64,
+    complex128,
+    device_count,
+    float16,
+    float32,
+    float64,
+    get_default_dtype,
+    get_device,
+    get_flags,
+    int8,
+    int16,
+    int32,
+    int64,
+    is_compiled_with_cuda,
+    is_compiled_with_tpu,
+    seed,
+    set_default_dtype,
+    set_device,
+    set_flags,
+    uint8,
+)
+from .tensor import Tensor
+from .creation import (
+    arange,
+    assign,
+    bernoulli,
+    clone,
+    diag,
+    diagflat,
+    empty,
+    empty_like,
+    eye,
+    full,
+    full_like,
+    linspace,
+    logspace,
+    meshgrid,
+    multinomial,
+    normal,
+    ones,
+    ones_like,
+    rand,
+    randint,
+    randn,
+    randperm,
+    to_tensor,
+    tril,
+    triu,
+    uniform,
+    zeros,
+    zeros_like,
+)
+from .tensor_ops import *  # noqa: F401,F403 — the paddle.tensor surface
+from .tensor_ops import linalg  # noqa: F401
+from .autograd import grad, is_grad_enabled, no_grad
+from . import autograd  # noqa: F401
+
+# subpackages (imported lazily-ish but exposed eagerly for API parity)
+from . import nn  # noqa: E402
+from . import optimizer  # noqa: E402
+from . import io  # noqa: E402
+from . import metric  # noqa: E402
+from . import amp  # noqa: E402
+from . import jit  # noqa: E402
+from . import static  # noqa: E402
+from . import distributed  # noqa: E402
+from . import vision  # noqa: E402
+from . import text  # noqa: E402
+from . import hapi  # noqa: E402
+from . import utils  # noqa: E402
+from . import inference  # noqa: E402
+from . import core  # noqa: E402
+from . import distribution  # noqa: E402
+from . import regularizer  # noqa: E402
+from .hapi import Model  # noqa: E402
+from .framework.io_state import load, save  # noqa: E402
+from .nn.layer_base import ParamAttr  # noqa: E402
+from .distributed.parallel import DataParallel  # noqa: E402
+
+disable_static = lambda: None  # imperative is the default mode  # noqa: E731
+enable_static = static.enable_static
+in_dynamic_mode = lambda: not static.in_static_mode()  # noqa: E731
+
+__version__ = "0.1.0"

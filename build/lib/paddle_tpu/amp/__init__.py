@@ -1,0 +1,214 @@
+"""paddle.amp — auto mixed precision.
+
+Reference parity: python/paddle/amp/auto_cast.py:20 + grad_scaler.py:20,
+imperative/amp_auto_cast.{h,cc} (per-op white/black lists), and the static AMP
+ops amp/check_finite_and_unscale_op.cc + update_loss_scaling_op.cc.
+
+TPU-native: autocast is a thread-local policy consulted by the matmul/conv
+class ops (the white list — compute-bound ops that ride the MXU in
+bf16/fp16); norms, softmax, losses and reductions stay in fp32 (black list).
+GradScaler implements dynamic loss scaling; on TPU the natural mode is
+bf16 (no scaling needed), fp16 scaling is kept for parity.  Inside a jitted
+step the found_inf/scale logic is pure lax arithmetic — no recompilation
+(the check_finite_and_unscale/update_loss_scaling semantics).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework.dtype import convert_dtype
+from ..tensor import Tensor, apply
+
+
+class _AmpState(threading.local):
+    def __init__(self):
+        self.enabled = False
+        self.dtype = jnp.bfloat16
+        self.level = "O1"
+        self.custom_white_list = set()
+        self.custom_black_list = set()
+
+
+_state = _AmpState()
+
+
+def amp_state():
+    return _state
+
+
+def amp_active() -> bool:
+    return _state.enabled
+
+
+def amp_dtype():
+    return _state.dtype
+
+
+def white_cast(*vals):
+    """Cast float inputs of a white-list op to the amp dtype."""
+    if not _state.enabled:
+        return vals
+    dt = _state.dtype
+    return tuple(v.astype(dt) if hasattr(v, "dtype")
+                 and jnp.issubdtype(v.dtype, jnp.floating) and v.dtype != dt
+                 else v for v in vals)
+
+
+@contextlib.contextmanager
+def auto_cast(enable=True, custom_white_list=None, custom_black_list=None,
+              level="O1", dtype="bfloat16"):
+    prev = (_state.enabled, _state.dtype, _state.level,
+            _state.custom_white_list, _state.custom_black_list)
+    _state.enabled = enable
+    _state.dtype = convert_dtype(dtype)
+    _state.level = level
+    _state.custom_white_list = set(custom_white_list or [])
+    _state.custom_black_list = set(custom_black_list or [])
+    try:
+        yield
+    finally:
+        (_state.enabled, _state.dtype, _state.level,
+         _state.custom_white_list, _state.custom_black_list) = prev
+
+
+amp_guard = auto_cast  # fluid.dygraph.amp_guard alias
+
+
+def decorate(models, optimizers=None, level="O1", dtype="bfloat16",
+             master_weight=None, save_dtype=None):
+    """O2: cast model params to the amp dtype (pure-bf16/fp16 mode,
+    fluid cast_model_to_fp16 analog)."""
+    if level == "O2":
+        single = not isinstance(models, (list, tuple))
+        for m in ([models] if single else models):
+            m.astype(dtype)
+    if optimizers is None:
+        return models
+    return models, optimizers
+
+
+amp_decorate = decorate
+
+
+class GradScaler:
+    def __init__(self, enable=True, init_loss_scaling=2.0 ** 15,
+                 incr_ratio=2.0, decr_ratio=0.5, incr_every_n_steps=1000,
+                 decr_every_n_nan_or_inf=2, use_dynamic_loss_scaling=True):
+        self._enable = enable
+        self._scale = float(init_loss_scaling)
+        self._incr_ratio = incr_ratio
+        self._decr_ratio = decr_ratio
+        self._incr_every_n = incr_every_n_steps
+        self._decr_every_n = decr_every_n_nan_or_inf
+        self._dynamic = use_dynamic_loss_scaling
+        self._good_steps = 0
+        self._bad_steps = 0
+        self._found_inf = False
+
+    def is_enable(self):
+        return self._enable
+
+    def is_use_dynamic_loss_scaling(self):
+        return self._dynamic
+
+    def get_loss_scaling(self):
+        return self._scale
+
+    def scale(self, var):
+        if not self._enable:
+            return var
+        return var * self._scale
+
+    def unscale_(self, optimizer):
+        if not self._enable:
+            return
+        params = optimizer._parameter_list or []
+        inv = 1.0 / self._scale
+        found = False
+        for p in params:
+            if p.grad is None:
+                continue
+            g = p.grad.value * inv
+            found = found or bool(jnp.any(~jnp.isfinite(g)))
+            p.grad = Tensor(g)
+        self._found_inf = found
+
+    def step(self, optimizer):
+        if not self._enable:
+            optimizer.step()
+            return
+        self.unscale_(optimizer)
+        if not self._found_inf:
+            optimizer.step()
+        self.update()
+
+    def minimize(self, optimizer, scaled_loss):
+        scaled_loss.backward()
+        self.step(optimizer)
+
+    def update(self):
+        """Dynamic loss-scale bookkeeping (update_loss_scaling_op semantics)."""
+        if not self._dynamic:
+            self._found_inf = False
+            return
+        if self._found_inf:
+            self._bad_steps += 1
+            self._good_steps = 0
+            if self._bad_steps >= self._decr_every_n:
+                self._scale = max(self._scale * self._decr_ratio, 1.0)
+                self._bad_steps = 0
+        else:
+            self._good_steps += 1
+            self._bad_steps = 0
+            if self._good_steps >= self._incr_every_n:
+                self._scale *= self._incr_ratio
+                self._good_steps = 0
+        self._found_inf = False
+
+    def state_dict(self):
+        return {"scale": self._scale, "incr_ratio": self._incr_ratio,
+                "decr_ratio": self._decr_ratio,
+                "incr_every_n_steps": self._incr_every_n,
+                "decr_every_n_nan_or_inf": self._decr_every_n,
+                "good_steps": self._good_steps, "bad_steps": self._bad_steps}
+
+    def set_state_dict(self, sd):
+        self._scale = sd.get("scale", self._scale)
+        self._good_steps = sd.get("good_steps", 0)
+        self._bad_steps = sd.get("bad_steps", 0)
+
+
+# -- functional loss-scaling for jitted steps --------------------------------
+def check_finite_and_unscale(grads, scale):
+    """Pure analog of amp/check_finite_and_unscale_op.cc for pjit steps.
+    grads pytree, scale scalar -> (unscaled grads, found_inf bool scalar)."""
+    inv = 1.0 / scale
+    leaves = jax.tree_util.tree_leaves(grads)
+    found = jnp.zeros((), jnp.bool_)
+    for g in leaves:
+        found = found | jnp.any(~jnp.isfinite(g))
+    unscaled = jax.tree_util.tree_map(lambda g: g * inv, grads)
+    return unscaled, found
+
+
+def update_loss_scaling(scale, good_steps, bad_steps, found_inf,
+                        incr_ratio=2.0, decr_ratio=0.5, incr_every_n=1000,
+                        decr_every_n=2):
+    """Pure analog of amp/update_loss_scaling_op.cc. All args/returns are
+    traced scalars — safe inside jit with no recompilation."""
+    good = jnp.where(found_inf, 0, good_steps + 1)
+    bad = jnp.where(found_inf, bad_steps + 1, 0)
+    do_incr = good >= incr_every_n
+    do_decr = bad >= decr_every_n
+    new_scale = jnp.where(do_incr, scale * incr_ratio,
+                          jnp.where(do_decr,
+                                    jnp.maximum(scale * decr_ratio, 1.0),
+                                    scale))
+    good = jnp.where(do_incr, 0, good)
+    bad = jnp.where(do_decr, 0, bad)
+    return new_scale, good, bad

@@ -1,0 +1,286 @@
+"""Eager autograd tape.
+
+Reference parity: paddle/fluid/imperative/ — Tracer::TraceOp (tracer.cc:131)
+records a grad-op node per op; BasicEngine (basic_engine.cc:191) walks the
+graph in reverse on `loss.backward()`.
+
+TPU-native design: there is no per-op grad kernel zoo.  Each eager op is a pure
+jax function; when grad is required we call `jax.vjp` on it, which gives the
+primal outputs AND a backward closure in one forward pass.  The tape is a flat
+chronological list of nodes; reverse-chronological traversal is a valid
+topological order, so `backward()` is a single reversed loop with grad
+accumulation keyed by tensor identity (the GradientAccumulator analog,
+basic_engine.cc PrepareDeps/Execute).
+
+Inside `jax.jit`-traced code (the "static graph" path) the tape is bypassed
+entirely: gradients come from `jax.grad` over the whole step function, which is
+both simpler and faster (XLA sees the full graph).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+from typing import Any, Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class GradNode:
+    __slots__ = ("vjp_fn", "input_ids", "input_refs", "output_ids",
+                 "out_specs", "multi_out", "fwd_fn")
+
+    def __init__(self, vjp_fn, input_refs, output_ids, out_specs, multi_out,
+                 fwd_fn=None):
+        self.vjp_fn = vjp_fn
+        self.input_refs = input_refs  # Tensors we differentiate w.r.t.
+        self.input_ids = [id(t) for t in input_refs]
+        self.output_ids = output_ids
+        self.out_specs = out_specs  # [(shape, dtype)] aligned with output_ids
+        self.multi_out = multi_out
+        # the closed-over forward (diff inputs -> outputs); kept so
+        # create_graph=True can re-derive the vjp AS A TAPED OP (double
+        # grad: the reference's double_grad op chain, e.g.
+        # imperative/partial_grad_engine.cc + *_grad_grad kernels)
+        self.fwd_fn = fwd_fn
+
+
+class _TapeState(threading.local):
+    def __init__(self):
+        self.nodes: list[GradNode] = []
+        self.enabled = True
+        # count of nested jax traces / functional calls where taping must not run
+        self.suspend = 0
+
+
+_tape = _TapeState()
+
+
+def tape_enabled() -> bool:
+    return _tape.enabled and _tape.suspend == 0
+
+
+@contextlib.contextmanager
+def no_grad():
+    prev = _tape.enabled
+    _tape.enabled = False
+    try:
+        yield
+    finally:
+        _tape.enabled = prev
+
+
+@contextlib.contextmanager
+def suspend_tape():
+    """Disable taping inside traced/functional regions (jit path)."""
+    _tape.suspend += 1
+    try:
+        yield
+    finally:
+        _tape.suspend -= 1
+
+
+def enable_grad():
+    _tape.enabled = True
+
+
+def is_grad_enabled() -> bool:
+    return _tape.enabled
+
+
+def clear_tape():
+    _tape.nodes.clear()
+
+
+def record(node: GradNode):
+    _tape.nodes.append(node)
+
+
+def _ones_like_spec(spec):
+    shape, dtype = spec
+    return jnp.ones(shape, dtype)
+
+
+def _zeros_like_spec(spec):
+    shape, dtype = spec
+    return jnp.zeros(shape, dtype)
+
+
+def backward(tensors: Sequence[Any], grad_tensors=None, retain_graph: bool = False):
+    """Run reverse-mode accumulation from `tensors` back to all leaf tensors
+    on the tape, writing into each leaf's `.grad`."""
+    from ..tensor import Tensor  # local import to avoid cycle
+
+    if not isinstance(tensors, (list, tuple)):
+        tensors = [tensors]
+    if grad_tensors is None:
+        grad_tensors = [None] * len(tensors)
+    elif not isinstance(grad_tensors, (list, tuple)):
+        grad_tensors = [grad_tensors]
+
+    pending: dict[int, Any] = {}
+    for t, g in zip(tensors, grad_tensors):
+        if g is None:
+            if t.size != 1:
+                raise RuntimeError(
+                    "backward() on a non-scalar tensor requires an explicit "
+                    "grad_tensor (reference: basic_engine.cc:39 Init)"
+                )
+            g_val = jnp.ones(t.shape, t.dtype)
+        else:
+            g_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        pending[id(t)] = pending.get(id(t), 0) + g_val
+
+    for node in reversed(_tape.nodes):
+        if not any(oid in pending for oid in node.output_ids):
+            continue
+        if node.multi_out:
+            cotangents = tuple(
+                pending.pop(oid, None) if oid in pending else _zeros_like_spec(spec)
+                for oid, spec in zip(node.output_ids, node.out_specs)
+            )
+            cotangents = tuple(
+                c if c is not None else _zeros_like_spec(spec)
+                for c, spec in zip(cotangents, node.out_specs)
+            )
+        else:
+            cotangents = pending.pop(node.output_ids[0])
+        in_grads = node.vjp_fn(cotangents)
+        for t, g in zip(node.input_refs, in_grads):
+            if g is None:
+                continue
+            g = _apply_hooks(t, g)
+            if t.is_leaf:
+                t._accumulate_grad(g)
+            else:
+                prev = pending.get(id(t))
+                pending[id(t)] = g if prev is None else prev + g
+
+    # leaves may also be targets of backward() directly (grad of x wrt x)
+    for t, _ in zip(tensors, grad_tensors):
+        if t.is_leaf and id(t) in pending:
+            t._accumulate_grad(pending.pop(id(t)))
+
+    if not retain_graph:
+        clear_tape()
+
+
+def grad(
+    outputs,
+    inputs,
+    grad_outputs=None,
+    retain_graph=False,
+    create_graph=False,
+    allow_unused=False,
+):
+    """paddle.grad parity (imperative/partial_grad_engine.cc).  Returns grads
+    of `outputs` w.r.t. `inputs` without touching `.grad` fields."""
+    from ..tensor import Tensor
+
+    outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+    inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+    if grad_outputs is None:
+        grad_outputs = [None] * len(outputs)
+
+    pending: dict[int, Any] = {}
+    for t, g in zip(outputs, grad_outputs):
+        if g is None:
+            g_val = jnp.ones(t.shape, t.dtype)
+        else:
+            g_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
+        pending[id(t)] = pending.get(id(t), 0) + g_val
+
+    want = {id(t): i for i, t in enumerate(inputs)}
+    results: list[Any] = [None] * len(inputs)
+
+    # snapshot: the create_graph walk APPENDS new nodes to the tape (the
+    # re-derived vjp ops) — iterate over the pre-walk graph only
+    walk_nodes = list(_tape.nodes)
+    for node in reversed(walk_nodes):
+        if not any(oid in pending for oid in node.output_ids):
+            continue
+        if node.multi_out:
+            cotangents = tuple(
+                pending.pop(oid) if oid in pending else _zeros_like_spec(spec)
+                for oid, spec in zip(node.output_ids, node.out_specs)
+            )
+        else:
+            cotangents = pending.pop(node.output_ids[0])
+        if create_graph and node.fwd_fn is not None:
+            in_grads = _taped_vjp(node, cotangents)
+        else:
+            in_grads = node.vjp_fn(_unwrap_ct(cotangents))
+        for t, g in zip(node.input_refs, in_grads):
+            if g is None:
+                continue
+            g = _apply_hooks(t, g)
+            prev = pending.get(id(t))
+            pending[id(t)] = g if prev is None else prev + g
+
+    for t in inputs:
+        if id(t) in pending:
+            g = pending[id(t)]
+            if create_graph:
+                results[want[id(t)]] = (g if isinstance(g, Tensor)
+                                        else Tensor(g, stop_gradient=False))
+            else:
+                results[want[id(t)]] = Tensor(
+                    g.value if isinstance(g, Tensor) else g,
+                    stop_gradient=True)
+        elif not allow_unused:
+            raise RuntimeError(
+                "One of the differentiated tensors appears unused in the graph "
+                "(pass allow_unused=True to return None for it)"
+            )
+
+    if not retain_graph and not create_graph:
+        clear_tape()
+    return results if len(results) > 1 else results[0]
+
+
+def _unwrap_ct(ct):
+    from ..tensor import Tensor
+
+    if isinstance(ct, tuple):
+        return tuple(c.value if isinstance(c, Tensor) else c for c in ct)
+    return ct.value if isinstance(ct, Tensor) else ct
+
+
+def _taped_vjp(node, cotangents):
+    """Re-derive this node's vjp as a TAPED eager op so the produced
+    gradients carry grad history themselves (create_graph=True — the
+    reference's double-grad path, partial_grad_engine.cc create_graph).
+    Recomputes the node's forward inside jax.vjp: double grad trades one
+    extra forward for differentiability, as the *_grad_grad kernels do."""
+    from ..tensor import Tensor, apply
+
+    cts = list(cotangents) if node.multi_out else [cotangents]
+    ct_tensors = [c if isinstance(c, Tensor) else Tensor(c) for c in cts]
+    n_in = len(node.input_refs)
+
+    def revf(*vals):
+        dv, ct = vals[:n_in], vals[n_in:]
+        _, vf = jax.vjp(node.fwd_fn, *dv)
+        grads = vf(tuple(ct) if node.multi_out else ct[0])
+        return tuple(grads) if n_in > 1 else grads[0]
+
+    out = apply(revf, *node.input_refs, *ct_tensors,
+                _multi_out=n_in > 1)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _apply_hooks(t, g):
+    """Run a tensor's registered grad hooks (tensor.register_hook) on its
+    freshly produced gradient; a hook returning None leaves g unchanged."""
+    from ..tensor import Tensor
+
+    hooks = getattr(t, "_grad_hooks", None)
+    if not hooks:
+        return g
+    was_tensor = isinstance(g, Tensor)
+    gt = g if was_tensor else Tensor(g)
+    for h in list(hooks.values()):
+        res = h(gt)
+        if res is not None:
+            gt = res if isinstance(res, Tensor) else Tensor(res)
+    return gt if was_tensor else gt.value

@@ -1,0 +1,198 @@
+"""Tensor creation ops.
+
+Reference parity: python/paddle/tensor/creation.py + fill/assign/random ops
+(paddle/fluid/operators/fill_constant_op.cc, uniform_random_op.cc,
+gaussian_random_op.cc).  Randomness draws from the splittable PRNG chain in
+framework.random (generator.cc analog) so results are reproducible under
+paddle.seed and explicit under jit via rng_guard.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .framework import random as _random
+from .framework.dtype import convert_dtype, get_default_dtype
+from .tensor import Tensor, apply, unwrap
+
+
+def _dt(dtype, default_float=True):
+    d = convert_dtype(dtype)
+    if d is None and default_float:
+        d = get_default_dtype()
+    return d
+
+
+def _shape(shape):
+    if isinstance(shape, Tensor):
+        shape = shape.tolist()
+    if isinstance(shape, (int, np.integer)):
+        shape = [int(shape)]
+    return tuple(int(s) for s in shape)
+
+
+def to_tensor(data, dtype=None, place=None, stop_gradient=True) -> Tensor:
+    if isinstance(data, Tensor):
+        t = Tensor(data.value, dtype=dtype, stop_gradient=stop_gradient)
+        return t
+    return Tensor(data, dtype=dtype, stop_gradient=stop_gradient)
+
+
+def zeros(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.zeros(_shape(shape), _dt(dtype)))
+
+
+def ones(shape, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.ones(_shape(shape), _dt(dtype)))
+
+
+def full(shape, fill_value, dtype=None, name=None) -> Tensor:
+    fill_value = unwrap(fill_value)
+    return Tensor(jnp.full(_shape(shape), fill_value, _dt(dtype)))
+
+
+def empty(shape, dtype=None, name=None) -> Tensor:
+    return zeros(shape, dtype)
+
+
+def zeros_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda v: jnp.zeros_like(v, dtype=_dt(dtype, False)), x)
+
+
+def ones_like(x, dtype=None, name=None) -> Tensor:
+    return apply(lambda v: jnp.ones_like(v, dtype=_dt(dtype, False)), x)
+
+
+def full_like(x, fill_value, dtype=None, name=None) -> Tensor:
+    return apply(lambda v: jnp.full_like(v, fill_value, dtype=_dt(dtype, False)), x)
+
+
+def empty_like(x, dtype=None, name=None) -> Tensor:
+    return zeros_like(x, dtype)
+
+
+def arange(start=0, end=None, step=1, dtype=None, name=None) -> Tensor:
+    start, end, step = unwrap(start), unwrap(end), unwrap(step)
+    if end is None:
+        start, end = 0, start
+    if dtype is None:
+        vals = (start, end, step)
+        dtype = "float32" if any(isinstance(v, float) for v in vals) else "int64"
+    return Tensor(jnp.arange(start, end, step, dtype=convert_dtype(dtype)))
+
+
+def linspace(start, stop, num, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.linspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               dtype=_dt(dtype)))
+
+
+def logspace(start, stop, num, base=10.0, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.logspace(unwrap(start), unwrap(stop), int(unwrap(num)),
+                               base=base, dtype=_dt(dtype)))
+
+
+def eye(num_rows, num_columns=None, dtype=None, name=None) -> Tensor:
+    return Tensor(jnp.eye(num_rows, num_columns, dtype=_dt(dtype)))
+
+
+def diag(x, offset=0, padding_value=0, name=None) -> Tensor:
+    def f(v):
+        if v.ndim == 1 and padding_value != 0:
+            d = jnp.diag(v, k=offset)
+            mask = jnp.diag(jnp.ones_like(v, dtype=bool), k=offset)
+            return jnp.where(mask, d, jnp.asarray(padding_value, d.dtype))
+        return jnp.diag(v, k=offset)
+    return apply(f, x)
+
+
+def diagflat(x, offset=0, name=None) -> Tensor:
+    return apply(lambda v: jnp.diagflat(v, k=offset), x)
+
+
+def tril(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda v: jnp.tril(v, k=diagonal), x)
+
+
+def triu(x, diagonal=0, name=None) -> Tensor:
+    return apply(lambda v: jnp.triu(v, k=diagonal), x)
+
+
+def meshgrid(*args, **kwargs):
+    arrs = [unwrap(a) for a in (args[0] if len(args) == 1 and isinstance(args[0], (list, tuple)) else args)]
+    outs = jnp.meshgrid(*arrs, indexing="ij")
+    return [Tensor(o) for o in outs]
+
+
+def assign(x, output=None) -> Tensor:
+    out = apply(lambda v: v + 0 if jnp.issubdtype(jnp.asarray(v).dtype, jnp.number) else jnp.asarray(v),
+                x if isinstance(x, Tensor) else Tensor(np.asarray(x)))
+    if output is not None:
+        output._value = out.value
+        return output
+    return out
+
+
+def clone(x) -> Tensor:
+    return x.clone()
+
+
+# -- random -----------------------------------------------------------------
+def rand(shape, dtype=None, name=None) -> Tensor:
+    return uniform(shape, dtype, min=0.0, max=1.0)
+
+
+def randn(shape, dtype=None, name=None) -> Tensor:
+    key = _random.split_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)))
+
+
+def uniform(shape, dtype=None, min=-1.0, max=1.0, seed=0, name=None) -> Tensor:
+    key = jax.random.PRNGKey(seed) if seed else _random.split_key()
+    return Tensor(jax.random.uniform(key, _shape(shape), _dt(dtype),
+                                     minval=unwrap(min), maxval=unwrap(max)))
+
+
+def normal(mean=0.0, std=1.0, shape=None, name=None) -> Tensor:
+    key = _random.split_key()
+    mean_v, std_v = unwrap(mean), unwrap(std)
+    if shape is None:
+        shape = np.broadcast_shapes(np.shape(mean_v), np.shape(std_v))
+    n = jax.random.normal(key, _shape(shape), get_default_dtype())
+    return Tensor(n * std_v + mean_v)
+
+
+def gaussian(shape, mean=0.0, std=1.0, dtype=None, name=None) -> Tensor:
+    key = _random.split_key()
+    return Tensor(jax.random.normal(key, _shape(shape), _dt(dtype)) * std + mean)
+
+
+def randint(low=0, high=None, shape=(1,), dtype=None, name=None) -> Tensor:
+    if high is None:
+        low, high = 0, low
+    key = _random.split_key()
+    dt = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.randint(key, _shape(shape), low, high, dtype=dt))
+
+
+def randperm(n, dtype=None, name=None) -> Tensor:
+    key = _random.split_key()
+    dt = convert_dtype(dtype) or jnp.int64
+    return Tensor(jax.random.permutation(key, n).astype(dt))
+
+
+def bernoulli(x, name=None) -> Tensor:
+    key = _random.split_key()
+    return Tensor(jax.random.bernoulli(key, unwrap(x)).astype(unwrap(x).dtype))
+
+
+def multinomial(x, num_samples=1, replacement=False, name=None) -> Tensor:
+    key = _random.split_key()
+    v = unwrap(x)
+    logits = jnp.log(v / v.sum(-1, keepdims=True))
+    if v.ndim == 1:
+        out = jax.random.categorical(key, logits, shape=(num_samples,))
+    else:
+        out = jax.random.categorical(key, logits[:, None, :], axis=-1,
+                                     shape=(v.shape[0], num_samples))
+    return Tensor(out.astype(jnp.int64))

@@ -1,0 +1,57 @@
+"""paddle.distributed — collectives, mesh, parallel training.
+
+Reference parity: python/paddle/distributed/* (SURVEY.md §2.10).
+"""
+from .collective import (  # noqa: F401
+    ReduceOp,
+    all_gather,
+    all_reduce,
+    alltoall,
+    barrier,
+    broadcast,
+    destroy_process_group,
+    get_group,
+    new_group,
+    ppermute,
+    recv,
+    reduce,
+    reduce_scatter,
+    scatter,
+    send,
+    wait,
+)
+from .env import ParallelEnv, get_rank, get_world_size  # noqa: F401
+from .mesh import (  # noqa: F401
+    P,
+    build_mesh,
+    ensure_mesh,
+    get_mesh,
+    mesh_guard,
+    named_sharding,
+    set_mesh,
+)
+from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F401
+from .spawn import spawn  # noqa: F401
+from . import fleet  # noqa: F401
+from . import checkpoint  # noqa: F401
+from .pipeline import (  # noqa: F401
+    pipeline_step_fn,
+    spmd_pipeline,
+    stack_stage_params,
+    unstack_stage_params,
+)
+from .sharding import zero_shardings, shard_spec  # noqa: F401
+# NOTE: the recompute FUNCTION lives at distributed.recompute.recompute
+# (and fleet.utils re-exports it for paddle parity); re-exporting it here
+# would shadow the .recompute submodule.
+from . import recompute as _recompute_mod  # noqa: F401
+from .grad_merge import gradient_merge, split_microbatches  # noqa: F401
+from .meta_parallel import (  # noqa: F401
+    ColumnParallelLinear,
+    ParallelCrossEntropy,
+    RowParallelLinear,
+    VocabParallelEmbedding,
+    param_sharding,
+    shard_constraint,
+    split,
+)

@@ -1,0 +1,318 @@
+"""Collective communication API.
+
+Reference parity: python/paddle/distributed/collective.py
+(broadcast:101 / all_reduce:157 / reduce:231 / all_gather:313 / scatter:386 /
+barrier:457) over the c_* collective ops (operators/collective/
+c_allreduce_op.h:38, c_allgather_op.cu.cc, c_broadcast_op.cc ...).
+
+TPU-native: a collective is `jax.lax.p*` over a named mesh axis.  Two modes:
+  * traced (inside pjit/shard_map/jit train steps): lowers directly to an XLA
+    collective riding ICI — this is the performance path, equivalent to the
+    reference's in-graph c_allreduce ops.
+  * eager: executed via a one-off shard_map over the current mesh so the
+    semantics match (the dygraph `core.ops.c_allreduce_sum_` analog).  With a
+    single device this degenerates to identity, like nranks==1 in the
+    reference (collective.py:157 early-returns).
+Ring ids map to axis names; `ring_id=0` ≙ every mesh axis (full reduction).
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ..tensor import Tensor, apply, unwrap
+from .mesh import ensure_mesh, get_mesh
+
+
+class ReduceOp:
+    SUM = 0
+    MAX = 1
+    MIN = 2
+    PROD = 3
+    AVG = 4
+
+
+_LAX_REDUCE = {
+    ReduceOp.SUM: jax.lax.psum,
+    ReduceOp.MAX: jax.lax.pmax,
+    ReduceOp.MIN: jax.lax.pmin,
+    ReduceOp.PROD: lambda x, axis_name: jnp.exp(
+        jax.lax.psum(jnp.log(x), axis_name)),
+    ReduceOp.AVG: jax.lax.pmean,
+}
+
+
+def _in_trace(x) -> bool:
+    return isinstance(x, jax.core.Tracer)
+
+
+def _axis_names(group=None):
+    """group=None / ring 0 → all mesh axes."""
+    if isinstance(group, str):
+        return group
+    if isinstance(group, (list, tuple)):
+        return tuple(group)
+    mesh = get_mesh()
+    if mesh is None:
+        return None
+    return tuple(mesh.axis_names)
+
+
+def _eager_collective(fn, x_val, axes, out_spec=None):
+    """Run a collective eagerly via a one-shot shard_map over the current
+    mesh (the dygraph `core.ops.c_*` analog).  Input is the replicated
+    eager value; out_spec defaults to replicated-same-shape (all_reduce /
+    broadcast); gather/scatter-shaped collectives pass their own."""
+    mesh = ensure_mesh()
+    if mesh.size == 1 or not axes:
+        return None  # caller handles identity
+    spec = P(*[None] * x_val.ndim)
+    f = shard_map(fn, mesh=mesh, in_specs=(spec,),
+                  out_specs=out_spec if out_spec is not None else spec,
+                  check_vma=False)
+    return f(x_val)
+
+
+def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
+               use_calc_stream=True):
+    axes = _axis_names(group)
+    red = _LAX_REDUCE[op]
+    v = unwrap(tensor)
+    if _in_trace(v):
+        out = apply(lambda x: red(x, axes), tensor)
+        if isinstance(tensor, Tensor):
+            tensor._value = out.value
+        return out
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        return tensor
+    out_val = _eager_collective(lambda x: red(x, axes), v, axes)
+    if out_val is None:
+        return tensor
+    tensor._value = out_val
+    return tensor
+
+
+def all_gather(tensor_list, tensor, group=None, sync_op=True):
+    axes = _axis_names(group)
+    v = unwrap(tensor)
+    if _in_trace(v):
+        gathered = apply(
+            lambda x: jax.lax.all_gather(x, axes, axis=0, tiled=False), tensor)
+        n = gathered.shape[0]
+        if tensor_list is not None:
+            tensor_list.extend([gathered[i] for i in range(n)])
+        return gathered
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        if tensor_list is not None:
+            tensor_list.append(tensor)
+        return tensor
+    out = _eager_collective(
+        lambda x: jax.lax.all_gather(x, axes, axis=0, tiled=False), v, axes,
+        out_spec=P(*[None] * (v.ndim + 1)))
+    g = Tensor(out) if out is not None else tensor
+    if tensor_list is not None and out is not None:
+        for i in range(g.shape[0]):
+            tensor_list.append(g[i])
+    return g
+
+
+def broadcast(tensor, src=0, group=None, sync_op=True):
+    axes = _axis_names(group)
+    v = unwrap(tensor)
+    if _in_trace(v):
+        # inside SPMD trace every shard computes identically; broadcast from
+        # src = select src's value across the axis
+        def f(x):
+            idx = jax.lax.axis_index(axes if isinstance(axes, str) else axes[0])
+            root = jax.lax.all_gather(x, axes, axis=0)[src]
+            return root
+
+        out = apply(f, tensor)
+        tensor._value = out.value
+        return out
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        return tensor
+    out = _eager_collective(
+        lambda x: jax.lax.all_gather(x, axes, axis=0)[src], v, axes)
+    if out is not None:
+        tensor._value = out
+    return tensor
+
+
+def reduce(tensor, dst=0, op=ReduceOp.SUM, group=None, sync_op=True):
+    # SPMD: reduce == all_reduce (every replica holds the result; dst owns it)
+    return all_reduce(tensor, op, group, sync_op)
+
+
+def reduce_scatter(tensor, tensor_list=None, op=ReduceOp.SUM, group=None,
+                   sync_op=True):
+    axes = _axis_names(group)
+    v = unwrap(tensor)
+    if _in_trace(v):
+        return apply(lambda x: jax.lax.psum_scatter(x, axes, scatter_dimension=0,
+                                                    tiled=True), tensor)
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        return tensor
+    scatter_spec = P(axes if isinstance(axes, str) else tuple(axes),
+                     *[None] * (v.ndim - 1))
+    out = _eager_collective(
+        lambda x: jax.lax.psum_scatter(x, axes, scatter_dimension=0, tiled=True),
+        v, axes, out_spec=scatter_spec)
+    if out is None:
+        return tensor
+    # return THIS rank's shard (the reference contract and the traced
+    # path's per-shard view), not the global concatenation
+    mesh = get_mesh()
+    n = int(np.prod([mesh.shape[a] for a in
+                     ((axes,) if isinstance(axes, str) else axes)]))
+    local = out.reshape((n, out.shape[0] // n) + out.shape[1:])[
+        _local_rank() % n]
+    return Tensor(local)
+
+
+def _local_rank():
+    from .env import ParallelEnv
+
+    try:
+        return int(ParallelEnv().rank)
+    except Exception:  # noqa: BLE001 - no env configured -> rank 0
+        return 0
+
+
+def scatter(tensor, tensor_list=None, src=0, group=None, sync_op=True):
+    """Dygraph scatter parity (collective.py:386): this process's `tensor`
+    becomes tensor_list[rank].  Under the single-controller SPMD runtime
+    every logical rank runs here, so tensor_list is required (the
+    reference only needs it on the src rank); cross-chip placement of the
+    shards is jax.device_put + NamedSharding, which the caller controls
+    (data is placed, not messaged, on TPU)."""
+    if not tensor_list:
+        raise ValueError(
+            "scatter() under the single-controller runtime requires "
+            "tensor_list on every rank (there is no cross-process eager "
+            "messaging on TPU; place shards with jax.device_put instead)")
+    rank = _local_rank() % len(tensor_list)
+    tensor._value = unwrap(tensor_list[rank])
+    return tensor
+
+
+def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
+    axes = _axis_names(group)
+    x = in_tensor_list
+    if isinstance(x, (list, tuple)):
+        from .. import tensor_ops as T
+
+        x = T.stack(list(x), axis=0)
+    v = unwrap(x)
+    if _in_trace(v):
+        out = apply(lambda a: jax.lax.all_to_all(a, axes, split_axis=0,
+                                                 concat_axis=0, tiled=False), x)
+        if out_tensor_list is not None:
+            out_tensor_list.extend([out[i] for i in range(out.shape[0])])
+        return out
+    mesh = get_mesh()
+    if mesh is None or mesh.size == 1:
+        if out_tensor_list is not None:
+            out_tensor_list.extend(list(in_tensor_list))
+        return x
+    # eager one-shot: every replica holds the same in_tensor_list (the
+    # single-controller degenerate of the dygraph contract), so rank r's
+    # output is in_list[r] received from every peer — run the REAL
+    # lax.all_to_all over the mesh so the bytes cross the ICI exactly as
+    # the reference's alltoall op would
+    spec_in = P(*[None] * v.ndim)
+    ax_spec = axes if isinstance(axes, str) else tuple(axes)
+    n = int(np.prod([mesh.shape[a] for a in
+                     ((axes,) if isinstance(axes, str) else axes)]))
+    out = shard_map(
+        lambda a: jax.lax.all_to_all(a, axes, split_axis=0, concat_axis=0,
+                                     tiled=True),
+        mesh=mesh, in_specs=(spec_in,),
+        out_specs=P(ax_spec, *[None] * (v.ndim - 1)), check_vma=False)(v)
+    # global [n * len(in_list), ...]; this rank's block is its exchange
+    mine = out.reshape((n, -1) + out.shape[1:])[_local_rank() % n]
+    if out_tensor_list is not None:
+        out_tensor_list.extend(
+            [Tensor(mine[i]) for i in range(mine.shape[0])])
+    return Tensor(mine)
+
+
+def barrier(group=None):
+    # eager: block until all local async work completes (XLA has no global
+    # host barrier; jax.distributed rendezvous happens at collective launch)
+    (jnp.zeros(()) + 0).block_until_ready()
+
+
+# Eager P2P: the single-controller runtime executes every logical rank's
+# code in one process, so send/recv pair up through an in-process FIFO
+# keyed by the SENDER's rank (the only address both sides can agree on:
+# send declares dst, recv declares src; under emulation the sender's rank
+# is this controller's rank).  Inside jitted pipeline steps use
+# lax.ppermute (the send_v2/recv_v2 analog, distributed.pipeline) — that
+# is the path that rides ICI.
+_P2P_MAILBOX: dict = {}
+
+
+def send(tensor, dst=0, group=None, sync_op=True):
+    """Dygraph send parity (operators/collective/send_v2_op.cc UX).  Under
+    single-controller SPMD this enqueues for the matching recv(src=<this
+    rank>); dst is accepted for script parity.  There is no cross-process
+    eager messaging on TPU (use pipeline ppermute)."""
+    _P2P_MAILBOX.setdefault(_local_rank(), []).append(unwrap(tensor))
+    return tensor
+
+
+def recv(tensor, src=0, group=None, sync_op=True):
+    """Matching receive: pops the oldest value sent by rank `src` in this
+    controller and copies it into `tensor` (shape/dtype preserved)."""
+    box = _P2P_MAILBOX.get(int(src))
+    if not box:
+        raise RuntimeError(
+            f"recv(src={src}): no matching send in this controller — "
+            f"cross-process eager P2P does not exist on TPU; use "
+            f"lax.ppermute inside a jitted pipeline step")
+    v = box[0]
+    if tuple(v.shape) != tuple(unwrap(tensor).shape):
+        raise ValueError(f"recv shape mismatch: got {tuple(v.shape)}, "
+                         f"tensor is {tuple(unwrap(tensor).shape)}")
+    box.pop(0)  # consume only after validation so a retry can succeed
+    tensor._value = v.astype(unwrap(tensor).dtype)
+    return tensor
+
+
+def new_group(ranks=None, backend=None):
+    """Groups map to mesh axes on TPU; returns a token usable as `group`."""
+    mesh = get_mesh()
+    return tuple(mesh.axis_names) if mesh is not None else None
+
+
+def get_group(gid=0):
+    return new_group()
+
+
+def wait(tensor, group=None, use_calc_stream=True):
+    v = unwrap(tensor)
+    if hasattr(v, "block_until_ready"):
+        v.block_until_ready()
+    return tensor
+
+
+def destroy_process_group(group=None):
+    pass
+
+
+# -- p2p-ish helpers used by pipeline parallelism ---------------------------
+def ppermute(tensor, perm: Sequence[tuple[int, int]], axis_name="pp"):
+    """send_v2/recv_v2 analog: neighbor exchange on a mesh axis
+    (operators/collective/send_v2_op.cc ≙ lax.ppermute over ICI)."""
+    return apply(lambda x: jax.lax.ppermute(x, axis_name, perm), tensor)

@@ -1,0 +1,76 @@
+"""Cluster environment discovery.
+
+Reference parity: python/paddle/fluid/dygraph/parallel.py ParallelEnv:65 and
+fleet/base/role_maker.py PaddleCloudRoleMaker — rank/world discovered from
+PADDLE_TRAINER_* env vars (kept compatible) or from the JAX distributed
+runtime (process_index/process_count) when running under a TPU pod launcher.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+class ParallelEnv:
+    def __init__(self):
+        # jax.process_index() initializes the XLA backend, which must not
+        # happen before jax.distributed.initialize — consult it only when
+        # NEITHER env var is set (all-or-nothing: a partially-set
+        # PADDLE_TRAINER_* env must not touch the backend either)
+        rank = os.environ.get("PADDLE_TRAINER_ID")
+        world = os.environ.get("PADDLE_TRAINERS_NUM")
+        if rank is None and world is None:
+            self._rank = jax.process_index()
+            self._world_size = jax.process_count()
+        else:
+            self._rank = int(rank or 0)
+            self._world_size = int(world or 1)
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._trainer_endpoints = eps.split(",") if eps else []
+        self._current_endpoint = os.environ.get("PADDLE_CURRENT_ENDPOINT", "")
+        devs = os.environ.get("FLAGS_selected_tpus",
+                              os.environ.get("FLAGS_selected_gpus", "0"))
+        first = devs.split(",")[0].strip()
+        self._device_id = int(first) if first else 0
+
+    @property
+    def rank(self):
+        return self._rank
+
+    @property
+    def world_size(self):
+        return self._world_size
+
+    # fluid-era names
+    @property
+    def local_rank(self):
+        return self._rank
+
+    @property
+    def nranks(self):
+        return self._world_size
+
+    @property
+    def dev_id(self):
+        return self._device_id
+
+    @property
+    def device_id(self):
+        return self._device_id
+
+    @property
+    def current_endpoint(self):
+        return self._current_endpoint
+
+    @property
+    def trainer_endpoints(self):
+        return self._trainer_endpoints
+
+
+def get_rank():
+    return ParallelEnv().rank
+
+
+def get_world_size():
+    return ParallelEnv().world_size

@@ -1,0 +1,58 @@
+"""paddle.distributed.fleet — the distributed training facade.
+
+Reference parity: python/paddle/distributed/fleet/__init__.py — module-level
+functions delegate to the Fleet singleton (fleet_base.py:63).  Usage keeps
+the reference shape:
+
+    import paddle_tpu.distributed.fleet as fleet
+    strategy = fleet.DistributedStrategy()
+    strategy.amp = True
+    strategy.sharding = True
+    fleet.init(is_collective=True, strategy=strategy)
+    opt = fleet.distributed_optimizer(paddle.optimizer.AdamW(...))
+    # SPMD path (TPU-native):
+    step, init_state, shardings = opt.build_train_step(loss_fn, params)
+"""
+from __future__ import annotations
+
+from . import metrics, utils  # noqa: F401
+from .base import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedStrategy,
+    Fleet,
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    StrategyCompiler,
+    UserDefinedRoleMaker,
+    fleet,
+)
+
+__all__ = ["DistributedStrategy", "Fleet", "fleet", "init",
+           "distributed_optimizer", "distributed_model",
+           "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
+           "is_first_worker", "worker_index", "worker_num", "is_worker",
+           "worker_endpoints", "server_num", "server_index",
+           "server_endpoints", "is_server", "barrier_worker",
+           "init_worker", "init_server", "run_server", "stop_worker"]
+
+# module-level delegates (reference __init__.py binds these the same way)
+init = fleet.init
+is_first_worker = fleet.is_first_worker
+worker_index = fleet.worker_index
+worker_num = fleet.worker_num
+is_worker = fleet.is_worker
+worker_endpoints = fleet.worker_endpoints
+server_num = fleet.server_num
+server_index = fleet.server_index
+server_endpoints = fleet.server_endpoints
+is_server = fleet.is_server
+barrier_worker = fleet.barrier_worker
+init_worker = fleet.init_worker
+init_server = fleet.init_server
+run_server = fleet.run_server
+stop_worker = fleet.stop_worker
+distributed_optimizer = fleet.distributed_optimizer
+distributed_model = fleet.distributed_model
+save_inference_model = fleet.save_inference_model
+save_persistables = fleet.save_persistables

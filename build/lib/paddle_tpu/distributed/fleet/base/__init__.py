@@ -1,0 +1,9 @@
+from .distributed_strategy import DistributedStrategy  # noqa: F401
+from .fleet_base import DistributedOptimizer, Fleet, fleet  # noqa: F401
+from .role_maker import (  # noqa: F401
+    PaddleCloudRoleMaker,
+    Role,
+    RoleMakerBase,
+    UserDefinedRoleMaker,
+)
+from .strategy_compiler import StrategyCompiler  # noqa: F401

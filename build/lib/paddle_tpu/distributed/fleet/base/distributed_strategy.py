@@ -1,0 +1,216 @@
+"""DistributedStrategy — the one config object for distributed training.
+
+Reference parity: python/paddle/distributed/fleet/base/distributed_strategy.py:101
+(python property facade) over paddle/fluid/framework/distributed_strategy.proto
+(top-level flags :120-163, nested *_configs :150-160, embedded Build/Execution
+strategy :161-162).  Every knob name from the proto is kept; knobs whose
+mechanism cannot exist on TPU (dgc, mkldnn-ish build flags) are accepted and
+recorded so reference scripts run unchanged, and the strategy compiler maps
+each flag to a functional transform (SURVEY.md §2.10 right column).
+
+Serialization uses JSON instead of protobuf text (save_to_prototxt /
+load_from_prototxt keep their names).
+"""
+from __future__ import annotations
+
+import copy
+import json
+
+__all__ = ["DistributedStrategy"]
+
+# (flag, default) — mirrors distributed_strategy.proto:120-163
+_BOOL_FLAGS = {
+    "amp": False,
+    "recompute": False,
+    "localsgd": False,
+    "adaptive_localsgd": False,
+    "dgc": False,
+    "gradient_merge": False,
+    "lars": False,
+    "lamb": False,
+    "pipeline": False,
+    "elastic": False,          # proto flag only — no impl in reference (A.3)
+    "auto": False,
+    "a_sync": False,
+    "sync_nccl_allreduce": True,
+    "use_hierarchical_allreduce": False,
+    "sync_batch_norm": False,
+    "fuse_all_reduce_ops": True,
+    "fp16_allreduce": False,
+    "sharding": False,
+    "cudnn_exhaustive_search": False,
+    "cudnn_batchnorm_spatial_persistent": False,
+    "enable_cudnn_frontend": False,
+    "find_unused_parameters": False,
+    "tensor_parallel": False,
+    "heter_ccl_mode": False,
+    "without_graph_optimization": False,
+}
+
+_INT_FLAGS = {
+    "nccl_comm_num": 1,
+    "hierarchical_allreduce_inter_nranks": 1,
+    "fuse_grad_size_in_MB": 32,
+    "last_comm_group_size_MB": 1,
+    "conv_workspace_size_limit": 512,
+}
+
+_FLOAT_FLAGS = {
+    "fuse_grad_size_in_TFLOPS": 50.0,
+}
+
+_CONFIG_DEFAULTS = {
+    # distributed_strategy.proto nested messages (:36-118)
+    "amp_configs": {
+        "init_loss_scaling": 32768.0,
+        "incr_every_n_steps": 1000,
+        "decr_every_n_nan_or_inf": 2,
+        "incr_ratio": 2.0,
+        "decr_ratio": 0.8,
+        "use_dynamic_loss_scaling": True,
+        "custom_white_list": [],
+        "custom_black_list": [],
+        "custom_black_varnames": [],
+        "use_pure_fp16": False,       # O2
+        "use_fp16_guard": True,
+        "use_bf16": True,             # TPU-native default dtype
+    },
+    "recompute_configs": {
+        "checkpoints": [],
+        "enable_offload": False,
+        "checkpoint_shape": [],
+        "policy": None,               # TPU extension: jax.checkpoint policy
+    },
+    "sharding_configs": {
+        "segment_broadcast_MB": 32.0,
+        "segment_anchors": [],
+        "sharding_degree": 8,
+        "mp_degree": 1,
+        "dp_degree": 1,
+        "hybrid_dp": False,
+        "gradient_merge_acc_step": 1,
+        "optimize_offload": False,
+        "stage": 1,                   # TPU extension: ZeRO stage 1/2/3
+    },
+    "pipeline_configs": {
+        "micro_batch_size": 1,
+        "accumulate_steps": 1,
+        "schedule_mode": "F-then-B",  # reference GPipe schedule (A.2)
+        "p2p_cache_shape": True,
+        "pp_degree": 1,               # TPU extension: pp mesh-axis size;
+                                      # >1 routes a PipelineProgram through
+                                      # spmd_pipeline (strategy_compiler)
+    },
+    "gradient_merge_configs": {"k_steps": 1, "avg": True},
+    "localsgd_configs": {"k_steps": 1, "begin_step": 1},
+    "adaptive_localsgd_configs": {"init_k_steps": 1, "begin_step": 1},
+    "dgc_configs": {"rampup_begin_step": 0, "rampup_step": 1,
+                    "sparsity": [0.999]},
+    "lars_configs": {"lars_coeff": 0.001, "lars_weight_decay": 0.0005,
+                     "epsilon": 0.0, "exclude_from_weight_decay": []},
+    "lamb_configs": {"lamb_weight_decay": 0.01,
+                     "exclude_from_weight_decay": []},
+    "a_sync_configs": {"k_steps": -1, "max_merge_var_num": 1,
+                       "send_queue_size": 16, "independent_recv_thread": False,
+                       "min_send_grad_num_before_recv": 1, "thread_pool_size": 1,
+                       "send_wait_times": 1, "runtime_split_send_recv": False,
+                       "launch_barrier": True, "heter_worker_device_guard": "cpu",
+                       "lr_decay_steps": 10, "use_ps_gpu": 0},
+    "tensor_parallel_configs": {"tensor_parallel_degree": 1,
+                                "tensor_init_seed": -1},
+    "hybrid_configs": {"dp_degree": -1, "mp_degree": 1, "pp_degree": 1,
+                       "sharding_degree": 1, "sep_degree": 1},
+    # embedded BuildStrategy / ExecutionStrategy mirrors (proto :161-162).
+    # On TPU these map to XLA/jit behavior; recorded for script parity.
+    "build_strategy": {
+        "enable_sequential_execution": False,
+        "fuse_elewise_add_act_ops": False,
+        "fuse_bn_act_ops": False,
+        "fuse_bn_add_act_ops": True,
+        "fuse_relu_depthwise_conv": False,
+        "fuse_broadcast_ops": False,
+        "fuse_all_optimizer_ops": False,
+        "enable_inplace": False,
+        "enable_backward_optimizer_op_deps": True,
+        "cache_runtime_context": False,
+        "fuse_all_reduce_ops": True,
+        "nccl_comm_num": 1,
+        "sync_batch_norm": False,
+        "reduce_strategy": "AllReduce",
+    },
+    "execution_strategy": {
+        "num_threads": 1,
+        "num_iteration_per_drop_scope": 10,
+        "num_iteration_per_run": 1,
+        "use_thread_barrier": False,
+    },
+}
+
+
+class DistributedStrategy:
+    """fleet.DistributedStrategy with the reference's exact knob surface."""
+
+    def __init__(self):
+        self._flags = dict(_BOOL_FLAGS)
+        self._flags.update(_INT_FLAGS)
+        self._flags.update(_FLOAT_FLAGS)
+        self._configs = copy.deepcopy(_CONFIG_DEFAULTS)
+
+    # -- generic accessors (every proto knob becomes a property) ----------
+    def __getattr__(self, name):
+        # only called when normal lookup fails
+        flags = object.__getattribute__(self, "_flags")
+        configs = object.__getattribute__(self, "_configs")
+        if name in flags:
+            return flags[name]
+        if name in configs:
+            return copy.deepcopy(configs[name])
+        raise AttributeError(f"DistributedStrategy has no attribute {name!r}")
+
+    def __setattr__(self, name, value):
+        if name in ("_flags", "_configs"):
+            object.__setattr__(self, name, value)
+            return
+        if name in self._flags:
+            default = self._flags[name]
+            if isinstance(default, bool) and not isinstance(value, bool):
+                raise TypeError(f"{name} expects bool, got {type(value).__name__}")
+            self._flags[name] = type(_BOOL_FLAGS.get(name, _INT_FLAGS.get(
+                name, _FLOAT_FLAGS.get(name, value))))(value) \
+                if not isinstance(default, bool) else value
+            return
+        if name in self._configs:
+            if not isinstance(value, dict):
+                raise TypeError(f"{name} expects dict")
+            cfg = self._configs[name]
+            unknown = set(value) - set(cfg)
+            if unknown:
+                raise ValueError(f"unknown keys for {name}: {sorted(unknown)}")
+            cfg.update(value)
+            return
+        object.__setattr__(self, name, value)
+
+    # -- serialization ----------------------------------------------------
+    def to_dict(self):
+        return {"flags": dict(self._flags),
+                "configs": copy.deepcopy(self._configs)}
+
+    def save_to_prototxt(self, output):
+        with open(output, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+
+    def load_from_prototxt(self, pb_file):
+        with open(pb_file) as f:
+            d = json.load(f)
+        self._flags.update(d.get("flags", {}))
+        for k, v in d.get("configs", {}).items():
+            if k in self._configs:
+                self._configs[k].update(v)
+
+    def __repr__(self):
+        on = [k for k, v in self._flags.items()
+              if isinstance(v, bool) and v and not _BOOL_FLAGS.get(k, False)]
+        off = [k for k, v in self._flags.items()
+               if isinstance(v, bool) and not v and _BOOL_FLAGS.get(k, False)]
+        parts = [f"+{k}" for k in sorted(on)] + [f"-{k}" for k in sorted(off)]
+        return f"DistributedStrategy({', '.join(parts) or 'defaults'})"

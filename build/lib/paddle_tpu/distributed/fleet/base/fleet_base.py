@@ -1,0 +1,217 @@
+"""Fleet — the unified distributed-training facade.
+
+Reference parity: python/paddle/distributed/fleet/base/fleet_base.py
+(Fleet:63 singleton; init:130 creates RoleMaker + strategy;
+distributed_optimizer:593 wraps the user optimizer; minimize:988 runs the
+strategy compiler and delegates).  TPU-native: `distributed_optimizer`
+returns a DistributedOptimizer that (a) keeps the dygraph
+minimize/step/clear_grad UX and (b) exposes `build_train_step` — the
+compiled SPMD path produced by the StrategyCompiler.
+"""
+from __future__ import annotations
+
+import jax
+
+from ...env import ParallelEnv
+from ...mesh import build_mesh, ensure_mesh, get_mesh
+from ...parallel import DataParallel, init_parallel_env
+from .distributed_strategy import DistributedStrategy
+from .role_maker import PaddleCloudRoleMaker, RoleMakerBase
+from .strategy_compiler import StrategyCompiler
+
+__all__ = ["Fleet", "DistributedOptimizer", "fleet"]
+
+
+class DistributedOptimizer:
+    """Wrapper produced by fleet.distributed_optimizer().
+
+    Eager UX: step/minimize/clear_grad delegate to the (possibly swapped)
+    inner optimizer.  SPMD UX: build_train_step(loss_fn, params) returns the
+    jitted composed step (see StrategyCompiler.build_train_step).
+    """
+
+    def __init__(self, optimizer, strategy, fleet_obj):
+        self.user_defined_optimizer = optimizer
+        self.user_defined_strategy = strategy
+        self._fleet = fleet_obj
+        self._compiler = StrategyCompiler()
+        self._last_ctx = None
+
+    # -- eager path -------------------------------------------------------
+    def step(self):
+        return self.user_defined_optimizer.step()
+
+    def clear_grad(self):
+        return self.user_defined_optimizer.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def get_lr(self):
+        return self.user_defined_optimizer.get_lr()
+
+    def set_lr(self, value):
+        return self.user_defined_optimizer.set_lr(value)
+
+    def state_dict(self):
+        return self.user_defined_optimizer.state_dict()
+
+    def set_state_dict(self, sd):
+        return self.user_defined_optimizer.set_state_dict(sd)
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        """Dygraph delegate (reference fleet_base.py:988)."""
+        return self.user_defined_optimizer.minimize(
+            loss, startup_program, parameter_list, no_grad_set)
+
+    # -- SPMD path --------------------------------------------------------
+    def compile_context(self, loss_fn, mesh=None, batch_axis="dp",
+                        model_axis="mp"):
+        mesh = mesh or get_mesh() or ensure_mesh()
+        ctx = self._compiler.compile(
+            loss_fn, self.user_defined_optimizer,
+            self.user_defined_strategy, mesh,
+            batch_axis=batch_axis, model_axis=model_axis)
+        self._last_ctx = ctx
+        return ctx
+
+    def build_train_step(self, loss_fn, params, mesh=None, batch_spec=None,
+                         param_specs=None, batch_axis="dp", model_axis="mp",
+                         donate=True):
+        """loss_fn: (params, batch) -> loss, or a
+        distributed.pipeline.PipelineProgram (strategy.pipeline path).
+        param_specs: tensor-parallel PartitionSpecs matching params — pass
+        meta_parallel.dist_specs(layer) so Column/RowParallelLinear
+        annotations physically shard the weights in the built step."""
+        ctx = self.compile_context(loss_fn, mesh, batch_axis, model_axis)
+        return self._compiler.build_train_step(ctx, params,
+                                               param_specs=param_specs,
+                                               batch_spec=batch_spec,
+                                               donate=donate)
+
+    @property
+    def applied_meta_list(self):
+        """Names of meta-optimizers the last compile applied (reference:
+        fleet_base._context / strategy compiler output; used by tests)."""
+        return list(self._last_ctx.applied) if self._last_ctx else []
+
+
+class Fleet:
+    """Singleton facade (reference fleet_base.py:63)."""
+
+    def __init__(self):
+        self._role_maker: RoleMakerBase | None = None
+        self._user_defined_strategy: DistributedStrategy | None = None
+        self._is_collective = True
+        self._initialized = False
+
+    # -- lifecycle --------------------------------------------------------
+    def init(self, role_maker=None, is_collective=False, strategy=None):
+        self._is_collective = is_collective or role_maker is None or \
+            getattr(role_maker, "_is_collective", True)
+        self._role_maker = role_maker or PaddleCloudRoleMaker(
+            is_collective=self._is_collective)
+        self._role_maker._generate_role()
+        self._user_defined_strategy = strategy or DistributedStrategy()
+        self._initialized = True
+        if self._role_maker._worker_num() > 1:
+            init_parallel_env()
+        return self
+
+    @property
+    def is_initialized(self):
+        return self._initialized
+
+    def _ensure_init(self):
+        if not self._initialized:
+            self.init()
+
+    # -- role queries (reference names) -----------------------------------
+    def is_first_worker(self):
+        self._ensure_init()
+        return self._role_maker._is_first_worker()
+
+    def worker_index(self):
+        self._ensure_init()
+        return self._role_maker._worker_index()
+
+    def worker_num(self):
+        self._ensure_init()
+        return self._role_maker._worker_num()
+
+    def is_worker(self):
+        self._ensure_init()
+        return self._role_maker._is_worker()
+
+    def worker_endpoints(self, to_string=False):
+        self._ensure_init()
+        eps = self._role_maker._get_trainer_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def server_num(self):
+        self._ensure_init()
+        return self._role_maker._server_num()
+
+    def server_index(self):
+        self._ensure_init()
+        return self._role_maker._server_index()
+
+    def server_endpoints(self, to_string=False):
+        self._ensure_init()
+        eps = self._role_maker._get_pserver_endpoints()
+        return ",".join(eps) if to_string else eps
+
+    def is_server(self):
+        self._ensure_init()
+        return self._role_maker._is_server()
+
+    def barrier_worker(self):
+        self._ensure_init()
+        self._role_maker._barrier("worker")
+
+    # -- model/optimizer wrapping ----------------------------------------
+    def distributed_model(self, model):
+        """Wrap for data parallelism (reference fleet_base.py:713)."""
+        self._ensure_init()
+        return DataParallel(model)
+
+    def distributed_optimizer(self, optimizer, strategy=None):
+        self._ensure_init()
+        if strategy is not None:
+            self._user_defined_strategy = strategy
+        return DistributedOptimizer(optimizer, self._user_defined_strategy,
+                                    self)
+
+    # PS-era no-ops kept for script compatibility (collective-only build,
+    # SURVEY.md §2.5):
+    def init_worker(self):
+        pass
+
+    def init_server(self, *args, **kwargs):
+        pass
+
+    def run_server(self):
+        raise NotImplementedError(
+            "parameter-server mode is out of scope for the TPU build "
+            "(SURVEY.md §2.5); use collective training")
+
+    def stop_worker(self):
+        pass
+
+    def save_inference_model(self, executor, dirname, feeded_var_names,
+                             target_vars, main_program=None,
+                             export_for_deployment=True):
+        from ....inference import save_inference_model
+        return save_inference_model(dirname, feeded_var_names, target_vars)
+
+    def save_persistables(self, executor, dirname, main_program=None):
+        import os
+        import pickle
+        os.makedirs(dirname, exist_ok=True)
+        if hasattr(main_program, "state_dict"):
+            with open(os.path.join(dirname, "persistables.pkl"), "wb") as f:
+                pickle.dump({k: v.numpy() for k, v in
+                             main_program.state_dict().items()}, f)
+
+
+fleet = Fleet()

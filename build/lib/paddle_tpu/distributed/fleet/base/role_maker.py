@@ -1,0 +1,163 @@
+"""RoleMaker — rank/world discovery.
+
+Reference parity: python/paddle/distributed/fleet/base/role_maker.py
+(PaddleCloudRoleMaker reads PADDLE_TRAINER_ID / PADDLE_TRAINERS_NUM /
+PADDLE_TRAINER_ENDPOINTS / TRAINING_ROLE; UserDefinedRoleMaker takes them as
+args; gloo barrier init).  TPU-native: the same env schema, with the JAX
+process runtime (jax.process_index/count) as the fallback source of truth;
+the gloo KV-store rendezvous is replaced by the JAX coordination service.
+PS roles (server/heter) are kept API-wise for script compatibility but the
+TPU build is collective-only (SURVEY.md §2.5 — PS is out-of-scope).
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["Role", "RoleMakerBase", "PaddleCloudRoleMaker",
+           "UserDefinedRoleMaker"]
+
+
+class Role:
+    WORKER = 1
+    SERVER = 2
+    HETER_WORKER = 3
+    ALL = 4
+
+
+class RoleMakerBase:
+    def __init__(self):
+        self._role = Role.WORKER
+        self._current_id = 0
+        self._worker_endpoints = []
+        self._server_endpoints = []
+        self._role_is_generated = False
+
+    def _generate_role(self):
+        self._role_is_generated = True
+
+    def _ensure(self):
+        if not self._role_is_generated:
+            self._generate_role()
+
+    # -- queries (reference method names) ---------------------------------
+    def _is_worker(self):
+        self._ensure()
+        return self._role == Role.WORKER
+
+    def _is_server(self):
+        self._ensure()
+        return self._role == Role.SERVER
+
+    def _is_first_worker(self):
+        self._ensure()
+        return self._role == Role.WORKER and self._current_id == 0
+
+    def _worker_index(self):
+        self._ensure()
+        return self._current_id
+
+    def _server_index(self):
+        self._ensure()
+        return self._current_id if self._role == Role.SERVER else -1
+
+    def _worker_num(self):
+        self._ensure()
+        return max(1, len(self._worker_endpoints)) \
+            if self._worker_endpoints else self._infer_world()
+
+    def _server_num(self):
+        self._ensure()
+        return len(self._server_endpoints)
+
+    def _get_trainer_endpoints(self):
+        self._ensure()
+        return list(self._worker_endpoints)
+
+    def _get_pserver_endpoints(self):
+        self._ensure()
+        return list(self._server_endpoints)
+
+    def _infer_world(self):
+        return 1
+
+    def _barrier(self, comm_world="worker"):
+        # single-host barrier is a no-op; multi-process sync happens through
+        # the JAX coordination service at collective time
+        import jax
+        if jax.process_count() > 1:
+            from ... import collective
+            collective.barrier()
+
+    def _all_gather(self, obj, comm_world="worker"):
+        return [obj]
+
+    def _all_reduce(self, obj, mode="sum", comm_world="worker"):
+        return obj
+
+
+class PaddleCloudRoleMaker(RoleMakerBase):
+    """Env-driven role maker (the fleetrun / cloud launcher contract)."""
+
+    def __init__(self, is_collective=True, **kwargs):
+        super().__init__()
+        self._is_collective = is_collective
+        self._kwargs = kwargs
+
+    def _generate_role(self):
+        training_role = os.environ.get("TRAINING_ROLE", "TRAINER").upper()
+        if training_role not in ("TRAINER", "PSERVER", "HETER_TRAINER"):
+            raise ValueError(f"TRAINING_ROLE must be TRAINER or PSERVER, "
+                             f"got {training_role}")
+        if training_role == "PSERVER":
+            self._role = Role.SERVER
+            self._current_id = int(os.environ.get("PADDLE_PSERVER_ID", "0"))
+            eps = os.environ.get("PADDLE_PSERVERS_IP_PORT_LIST", "")
+            self._server_endpoints = eps.split(",") if eps else []
+        else:
+            self._role = Role.WORKER
+            # lazy jax fallback: jax.process_index() would initialize the
+            # XLA backend, breaking a later jax.distributed.initialize()
+            rank = os.environ.get("PADDLE_TRAINER_ID")
+            if rank is None:
+                import jax
+                rank = jax.process_index()
+            self._current_id = int(rank)
+        eps = os.environ.get("PADDLE_TRAINER_ENDPOINTS", "")
+        self._worker_endpoints = eps.split(",") if eps else []
+        world = os.environ.get("PADDLE_TRAINERS_NUM")
+        if world is None:
+            import jax
+            world = jax.process_count()
+        self._trainers_num = int(world)
+        self._role_is_generated = True
+
+    def _infer_world(self):
+        return getattr(self, "_trainers_num", 1)
+
+    def _worker_num(self):
+        self._ensure()
+        return self._trainers_num
+
+
+class UserDefinedRoleMaker(PaddleCloudRoleMaker):
+    """Explicit-args role maker (reference: UserDefinedRoleMaker)."""
+
+    def __init__(self, is_collective=True, init_gloo=False, current_id=0,
+                 role=Role.WORKER, worker_endpoints=None,
+                 worker_num=None, server_endpoints=None, **kwargs):
+        super().__init__(is_collective=is_collective, **kwargs)
+        self._ud_current_id = current_id
+        self._ud_role = role
+        self._ud_worker_endpoints = worker_endpoints or []
+        self._ud_worker_num = worker_num
+        self._ud_server_endpoints = server_endpoints or []
+
+    def _generate_role(self):
+        self._role = self._ud_role
+        self._current_id = self._ud_current_id
+        self._worker_endpoints = list(self._ud_worker_endpoints)
+        self._server_endpoints = list(self._ud_server_endpoints)
+        self._trainers_num = (self._ud_worker_num
+                              if self._ud_worker_num is not None
+                              else max(1, len(self._worker_endpoints)))
+        self._role_is_generated = True

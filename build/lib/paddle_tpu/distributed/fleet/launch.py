@@ -1,0 +1,12 @@
+"""`python -m paddle_tpu.distributed.fleet.launch` — fleetrun alias.
+
+Reference parity: python/paddle/distributed/fleet/launch.py:321 (the
+`fleetrun` console script, setup.py.in:515); delegates to the shared
+launcher implementation.
+"""
+import sys
+
+from ..launch import launch  # noqa: F401
+
+if __name__ == "__main__":
+    sys.exit(launch())

@@ -1,0 +1,315 @@
+"""Fleet meta-optimizers — strategy flags as composable step transforms.
+
+Reference parity: python/paddle/distributed/fleet/meta_optimizers/* — each
+meta-optimizer declares `_can_apply(strategy)` and rewrites the training
+program (AMP inserts casts+loss scaling, Recompute re-emits forward
+segments, GradientMerge adds accumulators, Sharding splits params across
+ranks, Pipeline splits the program into stages...).
+
+TPU-native: there is no program to rewrite.  Each meta-optimizer transforms
+a *train-step build context* (`TrainStepContext`): the loss function, the
+value_and_grad wrapper, the optimizer, and the GSPMD sharding specs.  The
+strategy compiler (base/strategy_compiler.py) applies them in the
+reference's canonical order and `build_train_step` jits the composed result
+over the mesh — XLA then inserts the collectives the reference inserted as
+graph passes (grad all-reduce ≙ psum from batch sharding, ZeRO ≙
+reduce-scatter/all-gather from opt-state shardings).
+"""
+from __future__ import annotations
+
+import logging
+import warnings
+
+import jax
+import jax.numpy as jnp
+
+from .... import amp as amp_mod
+from ....optimizer import Lamb, LarsMomentum
+from ...grad_merge import gradient_merge
+from ...recompute import checkpoint as _remat
+from ...sharding import zero_shardings
+
+__all__ = ["TrainStepContext", "MetaOptimizerBase", "AMPOptimizer",
+           "RecomputeOptimizer", "GradientMergeOptimizer",
+           "PipelineOptimizer", "ShardingOptimizer", "LambOptimizer",
+           "LarsOptimizer", "FP16AllReduceOptimizer", "LocalSGDOptimizer",
+           "DGCOptimizer", "TensorParallelOptimizer", "META_OPTIMIZERS"]
+
+log = logging.getLogger("paddle_tpu.fleet")
+
+
+class TrainStepContext:
+    """Everything needed to build one jitted train step."""
+
+    def __init__(self, loss_fn, optimizer, strategy, mesh,
+                 batch_axis="dp", model_axis="mp"):
+        self.loss_fn = loss_fn            # (params, batch) -> loss
+        self.optimizer = optimizer
+        self.strategy = strategy
+        self.mesh = mesh
+        self.batch_axis = batch_axis
+        self.model_axis = model_axis
+        self.k_steps = 1                  # microbatch accumulation factor
+        self.grad_merge_avg = True
+        self.zero_stage = 0               # 0 = plain DP (replicated state)
+        self.dynamic_loss_scaling = False
+        self.loss_scale_cfg = {}
+        self.grad_comm_dtype = None       # fp16_allreduce
+        self.pipeline_degree = 1          # pp stages (strategy.pipeline)
+        self.pipeline_axis = "pp"
+        self.pipeline_program = None      # PipelineProgram when pipelined
+        self.applied = []                 # names, for tests/repr
+
+
+class MetaOptimizerBase:
+    name = "base"
+    # reference order (strategy_compiler picks & sorts): inner-most first
+    order = 100
+
+    def _can_apply(self, strategy) -> bool:
+        raise NotImplementedError
+
+    def apply(self, ctx: TrainStepContext) -> None:
+        raise NotImplementedError
+
+
+class AMPOptimizer(MetaOptimizerBase):
+    """strategy.amp → bf16 autocast (O1) or pure low-precision compute (O2),
+    with fp16 dynamic loss scaling folded into the step as pure lax math
+    (check_finite_and_unscale + update_loss_scaling semantics).
+    Reference: meta_optimizers/amp_optimizer.py + contrib/mixed_precision."""
+    name = "amp"
+    order = 10
+
+    def _can_apply(self, strategy):
+        return strategy.amp
+
+    def apply(self, ctx):
+        cfg = ctx.strategy.amp_configs
+        dtype = "bfloat16" if cfg.get("use_bf16", True) else "float16"
+        level = "O2" if cfg.get("use_pure_fp16") else "O1"
+        inner = ctx.loss_fn
+
+        def amp_loss(params, batch):
+            with amp_mod.auto_cast(
+                    enable=True, level=level, dtype=dtype,
+                    custom_white_list=cfg.get("custom_white_list") or None,
+                    custom_black_list=cfg.get("custom_black_list") or None):
+                return inner(params, batch)
+
+        ctx.loss_fn = amp_loss
+        if dtype == "float16" and cfg.get("use_dynamic_loss_scaling", True):
+            ctx.dynamic_loss_scaling = True
+            ctx.loss_scale_cfg = dict(
+                init_loss_scaling=cfg.get("init_loss_scaling", 32768.0),
+                incr_ratio=cfg.get("incr_ratio", 2.0),
+                decr_ratio=cfg.get("decr_ratio", 0.8),
+                incr_every_n=cfg.get("incr_every_n_steps", 1000),
+                decr_every_n=cfg.get("decr_every_n_nan_or_inf", 2))
+        ctx.applied.append(self.name)
+
+
+class RecomputeOptimizer(MetaOptimizerBase):
+    """strategy.recompute → jax.checkpoint over the whole loss fn.
+    Fine-grained segment checkpoints are the model's job (pass
+    recompute_configs["policy"] or use distributed.recompute in the net).
+    Reference: meta_optimizers/recompute_optimizer.py / optimizer.py:4533."""
+    name = "recompute"
+    order = 20
+
+    def _can_apply(self, strategy):
+        return strategy.recompute
+
+    def apply(self, ctx):
+        policy = ctx.strategy.recompute_configs.get("policy")
+        ctx.loss_fn = _remat(ctx.loss_fn, policy=policy)
+        ctx.applied.append(self.name)
+
+
+class PipelineOptimizer(MetaOptimizerBase):
+    """strategy.pipeline → a real GPipe pipeline over the `pp` mesh axis.
+
+    Reference: fluid.PipelineOptimizer (optimizer.py:3702) splits the
+    program into per-device sections joined by send_v2/recv_v2, run by
+    SectionWorker with a fill-drain schedule (section_worker.cc:44).
+
+    TPU-native: when the model is stage-structured (a
+    `distributed.pipeline.PipelineProgram`, or a plain loss_fn the user
+    built over `spmd_pipeline`), `pipeline_configs["pp_degree"]` routes the
+    built train step through `spmd_pipeline` — per-stage weights sharded
+    P('pp', ...), activations hopping via lax.ppermute (the send_v2/recv_v2
+    analog), `accumulate_steps` microbatches per step."""
+    name = "pipeline"
+    order = 30
+
+    def _can_apply(self, strategy):
+        return strategy.pipeline
+
+    def apply(self, ctx):
+        cfg = ctx.strategy.pipeline_configs
+        if ctx.pipeline_program is not None:
+            # the strategy compiler already routed a PipelineProgram
+            # through spmd_pipeline; microbatching happens inside the pipe
+            ctx.applied.append(self.name)
+            return
+        degree = int(cfg.get("pp_degree", 1))
+        if degree > 1:
+            raise ValueError(
+                "pipeline_configs['pp_degree'] > 1 requires a "
+                "stage-structured model: pass a distributed.pipeline."
+                "PipelineProgram as the loss argument of build_train_step "
+                "(e.g. models.gpt_hybrid.pipeline_program)")
+        # plain loss_fn: fall back to microbatch accumulation, which under
+        # one jitted scan is schedule-equivalent to GPipe fill-drain for an
+        # unstaged model (SURVEY.md A.2)
+        ctx.k_steps = max(ctx.k_steps, int(cfg.get("accumulate_steps", 1)))
+        ctx.applied.append(self.name)
+
+
+class GradientMergeOptimizer(MetaOptimizerBase):
+    """strategy.gradient_merge → lax.scan accumulation over k microbatches.
+    Reference: meta_optimizers/gradient_merge_optimizer.py / optimizer.py:5384."""
+    name = "gradient_merge"
+    order = 40
+
+    def _can_apply(self, strategy):
+        return strategy.gradient_merge
+
+    def apply(self, ctx):
+        cfg = ctx.strategy.gradient_merge_configs
+        ctx.k_steps = max(ctx.k_steps, int(cfg.get("k_steps", 1)))
+        ctx.grad_merge_avg = bool(cfg.get("avg", True))
+        ctx.applied.append(self.name)
+
+
+class ShardingOptimizer(MetaOptimizerBase):
+    """strategy.sharding → ZeRO stage-1/2/3 GSPMD shardings over the dp axis.
+    Reference: meta_optimizers/sharding_optimizer.py:33."""
+    name = "sharding"
+    order = 50
+
+    def _can_apply(self, strategy):
+        return strategy.sharding
+
+    def apply(self, ctx):
+        ctx.zero_stage = int(ctx.strategy.sharding_configs.get("stage", 1))
+        ctx.applied.append(self.name)
+
+
+class LambOptimizer(MetaOptimizerBase):
+    """strategy.lamb → swap the inner optimizer for LAMB (large batch).
+    Reference: meta_optimizers/lamb_optimizer.py (only applies over SGD-family
+    in the reference; here any inner optimizer's lr is reused)."""
+    name = "lamb"
+    order = 60
+
+    def _can_apply(self, strategy):
+        return strategy.lamb
+
+    def apply(self, ctx):
+        cfg = ctx.strategy.lamb_configs
+        ctx.optimizer = Lamb(
+            learning_rate=ctx.optimizer._learning_rate,
+            lamb_weight_decay=cfg.get("lamb_weight_decay", 0.01),
+            exclude_from_weight_decay_fn=None)
+        ctx.applied.append(self.name)
+
+
+class LarsOptimizer(MetaOptimizerBase):
+    """strategy.lars → swap for LARS momentum.
+    Reference: meta_optimizers/lars_optimizer.py."""
+    name = "lars"
+    order = 61
+
+    def _can_apply(self, strategy):
+        return strategy.lars
+
+    def apply(self, ctx):
+        cfg = ctx.strategy.lars_configs
+        ctx.optimizer = LarsMomentum(
+            learning_rate=ctx.optimizer._learning_rate,
+            lars_coeff=cfg.get("lars_coeff", 0.001),
+            lars_weight_decay=cfg.get("lars_weight_decay", 0.0005),
+            epsilon=cfg.get("epsilon", 0.0))
+        ctx.applied.append(self.name)
+
+
+class FP16AllReduceOptimizer(MetaOptimizerBase):
+    """strategy.fp16_allreduce → gradients cross the ICI in half precision.
+    Reference: meta_optimizers/fp16_allreduce_optimizer.py (cast before
+    c_allreduce, cast back after).  Implemented as an explicit shard_map
+    psum over bf16-cast per-shard gradients (a plain cast round-trip would
+    be folded away by XLA's simplifier).  Only applies on pure-dp meshes
+    with ZeRO stage < 2, and assumes the loss is a batch-MEAN over equal
+    shards (the grads are combined as psum/dp) — the strategy compiler
+    warns and ignores the flag otherwise."""
+    name = "fp16_allreduce"
+    order = 70
+
+    def _can_apply(self, strategy):
+        return strategy.fp16_allreduce
+
+    def apply(self, ctx):
+        ctx.grad_comm_dtype = jnp.bfloat16
+        ctx.applied.append(self.name)
+
+
+class LocalSGDOptimizer(MetaOptimizerBase):
+    """strategy.localsgd — periodic model averaging. Not applicable under
+    SPMD (all replicas execute one program; there is no 'local' divergence
+    to average). Accepted and ignored with a warning, like the reference
+    does when _can_apply fails."""
+    name = "localsgd"
+    order = 80
+
+    def _can_apply(self, strategy):
+        return strategy.localsgd or strategy.adaptive_localsgd
+
+    def apply(self, ctx):
+        warnings.warn("localsgd is a no-op on TPU SPMD: replicas run one "
+                      "program and gradients are globally reduced each step")
+
+
+class DGCOptimizer(MetaOptimizerBase):
+    """strategy.dgc — deep gradient compression. Non-goal on TPU (ICI
+    bandwidth-rich, SURVEY.md §2.10); accepted and ignored."""
+    name = "dgc"
+    order = 81
+
+    def _can_apply(self, strategy):
+        return strategy.dgc
+
+    def apply(self, ctx):
+        warnings.warn("dgc is not applied on TPU (ICI is bandwidth-rich); "
+                      "flag accepted for script compatibility")
+
+
+class TensorParallelOptimizer(MetaOptimizerBase):
+    """strategy.tensor_parallel → require an 'mp' mesh axis; the
+    Column/RowParallelLinear + VocabParallelEmbedding layers
+    (distributed.meta_parallel) carry the shardings.  Reference:
+    meta_optimizers/tensor_parallel_optimizer.py / collective.py:492."""
+    name = "tensor_parallel"
+    order = 15
+
+    def _can_apply(self, strategy):
+        return strategy.tensor_parallel
+
+    def apply(self, ctx):
+        degree = int(ctx.strategy.tensor_parallel_configs.get(
+            "tensor_parallel_degree", 1))
+        if ctx.mesh is not None and ctx.model_axis in ctx.mesh.shape:
+            have = ctx.mesh.shape[ctx.model_axis]
+            if degree > 1 and have != degree:
+                raise ValueError(
+                    f"tensor_parallel_degree={degree} but mesh axis "
+                    f"'{ctx.model_axis}' has size {have}")
+        ctx.applied.append(self.name)
+
+
+META_OPTIMIZERS = [AMPOptimizer(), TensorParallelOptimizer(),
+                   RecomputeOptimizer(), PipelineOptimizer(),
+                   GradientMergeOptimizer(), ShardingOptimizer(),
+                   LambOptimizer(), LarsOptimizer(),
+                   FP16AllReduceOptimizer(), LocalSGDOptimizer(),
+                   DGCOptimizer()]

@@ -1,0 +1,83 @@
+"""fleet.metrics — globally-aggregated training metrics.
+
+Reference parity: python/paddle/distributed/fleet/metrics/metric.py — each
+helper all-reduces a local stat over the trainer world (gloo/NCCL) and
+returns the global value (sum/max/min/acc/auc).  TPU-native: aggregation
+runs over all JAX processes via a CPU-host psum (jax collectives), or is a
+passthrough single-process.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+
+__all__ = ["sum", "max", "min", "acc", "auc", "rmse", "mae", "mse"]
+
+_pysum, _pymax, _pymin = sum, max, min
+
+
+def _global_reduce(arr, op):
+    arr = np.asarray(arr, dtype=np.float64)
+    if jax.process_count() <= 1:
+        return arr
+    # multi-host: all processes participate via a host all-gather
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(arr)
+    if op == "sum":
+        return np.sum(gathered, axis=0)
+    if op == "max":
+        return np.max(gathered, axis=0)
+    return np.min(gathered, axis=0)
+
+
+def sum(input, scope=None, util=None):  # noqa: A001 — reference name
+    return _global_reduce(input, "sum")
+
+
+def max(input, scope=None, util=None):  # noqa: A001
+    return _global_reduce(input, "max")
+
+
+def min(input, scope=None, util=None):  # noqa: A001
+    return _global_reduce(input, "min")
+
+
+def acc(correct, total, scope=None, util=None):
+    c = _global_reduce(correct, "sum")
+    t = _global_reduce(total, "sum")
+    return float(np.sum(c)) / _pymax(float(np.sum(t)), 1e-12)
+
+
+def mse(sqrerr, total, scope=None, util=None):
+    s = _global_reduce(sqrerr, "sum")
+    t = _global_reduce(total, "sum")
+    return float(np.sum(s)) / _pymax(float(np.sum(t)), 1e-12)
+
+
+def rmse(sqrerr, total, scope=None, util=None):
+    return float(np.sqrt(mse(sqrerr, total)))
+
+
+def mae(abserr, total, scope=None, util=None):
+    a = _global_reduce(abserr, "sum")
+    t = _global_reduce(total, "sum")
+    return float(np.sum(a)) / _pymax(float(np.sum(t)), 1e-12)
+
+
+def auc(stat_pos, stat_neg, scope=None, util=None):
+    """Global AUC from per-rank positive/negative histogram buckets
+    (reference metric.py auc — the distributed AUC used by CTR models)."""
+    pos = _global_reduce(stat_pos, "sum").ravel()
+    neg = _global_reduce(stat_neg, "sum").ravel()
+    # walk buckets from highest score to lowest accumulating TP/FP area
+    area = 0.0
+    tp = fp = 0.0
+    for i in range(len(pos) - 1, -1, -1):
+        new_tp = tp + pos[i]
+        new_fp = fp + neg[i]
+        area += (new_fp - fp) * (tp + new_tp) / 2.0
+        tp, fp = new_tp, new_fp
+    if tp == 0 or fp == 0:
+        return 0.5
+    return float(area / (tp * fp))

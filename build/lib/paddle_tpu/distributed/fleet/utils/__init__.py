@@ -1,0 +1,75 @@
+"""fleet.utils — recompute + filesystem helpers.
+
+Reference parity: python/paddle/distributed/fleet/utils/ (recompute.py,
+fs.py LocalFS/HDFSClient, http_server.py gloo KV store).  The KV-store role
+is played by the JAX coordination service; LocalFS is kept (checkpoint
+tooling), HDFS is a documented non-goal (use GCS/posix mounts on TPU VMs).
+"""
+from __future__ import annotations
+
+import os
+import shutil
+
+from ...recompute import recompute, recompute_sequential  # noqa: F401
+
+__all__ = ["recompute", "recompute_sequential", "LocalFS", "HDFSClient"]
+
+
+class LocalFS:
+    """Reference: fleet/utils/fs.py LocalFS."""
+
+    def ls_dir(self, fs_path):
+        if not self.is_exist(fs_path):
+            return [], []
+        dirs, files = [], []
+        for name in sorted(os.listdir(fs_path)):
+            (dirs if os.path.isdir(os.path.join(fs_path, name))
+             else files).append(name)
+        return dirs, files
+
+    def mkdirs(self, fs_path):
+        os.makedirs(fs_path, exist_ok=True)
+
+    def is_exist(self, fs_path):
+        return os.path.exists(fs_path)
+
+    def is_dir(self, fs_path):
+        return os.path.isdir(fs_path)
+
+    def is_file(self, fs_path):
+        return os.path.isfile(fs_path)
+
+    def delete(self, fs_path):
+        if os.path.isdir(fs_path):
+            shutil.rmtree(fs_path, ignore_errors=True)
+        elif os.path.exists(fs_path):
+            os.remove(fs_path)
+
+    def rename(self, fs_src_path, fs_dst_path):
+        os.rename(fs_src_path, fs_dst_path)
+
+    def touch(self, fs_path, exist_ok=True):
+        if os.path.exists(fs_path) and not exist_ok:
+            raise FileExistsError(fs_path)
+        open(fs_path, "a").close()
+
+    def upload(self, local_path, fs_path):
+        shutil.copy(local_path, fs_path)
+
+    def download(self, fs_path, local_path):
+        shutil.copy(fs_path, local_path)
+
+    def mv(self, src, dst, overwrite=False):
+        if overwrite and os.path.exists(dst):
+            self.delete(dst)
+        os.rename(src, dst)
+
+    def list_dirs(self, fs_path):
+        return self.ls_dir(fs_path)[0]
+
+
+class HDFSClient:
+    def __init__(self, *args, **kwargs):
+        raise NotImplementedError(
+            "HDFS is a non-goal on TPU (SURVEY.md §2.10 fleet utils row); "
+            "TPU VMs mount GCS/posix storage — use LocalFS")

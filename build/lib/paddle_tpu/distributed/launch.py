@@ -1,0 +1,104 @@
+"""Distributed launcher CLI.
+
+Reference parity: python/paddle/distributed/fleet/launch.py (launch:321 →
+launch_collective:198; registered as the `fleetrun` console script,
+setup.py.in:515) and python/paddle/distributed/launch.py (legacy).
+
+Usage (same shape as fleetrun):
+    python -m paddle_tpu.distributed.launch \
+        --ips=10.0.0.1,10.0.0.2 --nproc_per_node=1 train.py --arg
+On a TPU pod each host runs ONE JAX process that drives all local chips
+(SPMD), so --nproc_per_node defaults to 1 (not device count); multi-process
+CPU simulation can raise it for tests.
+"""
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import sys
+
+from .launch_utils import (
+    find_free_ports,
+    get_cluster,
+    start_local_trainers,
+    watch_local_trainers,
+)
+
+logger = logging.getLogger("paddle_tpu.launch")
+
+
+def _parse_args(argv=None):
+    parser = argparse.ArgumentParser(
+        prog="paddle_tpu.distributed.launch",
+        description="launch distributed training (fleetrun equivalent)")
+    parser.add_argument("--ips", default="127.0.0.1",
+                        help="comma-separated host ips of the job")
+    parser.add_argument("--host", default=None,
+                        help="this node's ip (default: first of --ips)")
+    parser.add_argument("--nproc_per_node", type=int, default=1,
+                        help="trainer processes per node (TPU: 1 JAX "
+                             "process drives all local chips)")
+    parser.add_argument("--started_port", type=int, default=None,
+                        help="base port for trainer endpoints")
+    parser.add_argument("--log_dir", default=None,
+                        help="write workerlog.N files here")
+    parser.add_argument("--backend", default="auto",
+                        help="communication backend hint (auto|xla|gloo)")
+    parser.add_argument("--max_restarts", type=int, default=0,
+                        help="restart the pod up to N times on trainer "
+                             "failure (pairs with checkpoint auto-resume; "
+                             "the reference launcher has no restart)")
+    parser.add_argument("training_script",
+                        help="the training script to launch")
+    parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
+    return parser.parse_args(argv)
+
+
+def get_cluster_from_args(args):
+    node_ips = [ip.strip() for ip in args.ips.split(",") if ip.strip()]
+    node_ip = args.host or node_ips[0]
+    if node_ip not in node_ips:
+        raise ValueError(f"--host {node_ip} not in --ips {node_ips}")
+    n = args.nproc_per_node
+    if args.started_port is not None:
+        ports = list(range(args.started_port, args.started_port + n))
+    else:
+        ports = find_free_ports(n)
+        if len(node_ips) > 1:
+            # multi-node needs a deterministic port plan on every node
+            ports = list(range(6070, 6070 + n))
+    endpoints = [f"{ip}:{p}" for ip in node_ips for p in ports]
+    return get_cluster(node_ips, node_ip, endpoints, n)
+
+
+def launch_collective(args):
+    cluster, pod = get_cluster_from_args(args)
+    logger.info("launching %s", cluster.trainers_endpoints())
+    attempt = 0
+    while True:
+        procs = start_local_trainers(
+            cluster, pod, args.training_script, args.training_script_args,
+            log_dir=args.log_dir, backend=args.backend,
+            envs={"PADDLE_RESTART_COUNT": str(attempt)})
+        try:
+            watch_local_trainers(procs, cluster.trainers_nranks())
+            return 0
+        except RuntimeError:
+            if attempt >= args.max_restarts:
+                raise
+            attempt += 1
+            logger.warning("pod failed — restart %s/%s (trainers should "
+                           "auto-resume from their latest checkpoint)",
+                           attempt, args.max_restarts)
+
+
+def launch(argv=None):
+    args = _parse_args(argv)
+    logging.basicConfig(
+        level=os.environ.get("PADDLE_LAUNCH_LOGLEVEL", "INFO"))
+    return launch_collective(args)
+
+
+if __name__ == "__main__":
+    sys.exit(launch())

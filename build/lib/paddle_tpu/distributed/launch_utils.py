@@ -1,0 +1,206 @@
+"""Launcher plumbing — cluster spec, trainer process management.
+
+Reference parity: python/paddle/distributed/fleet/launch_utils.py
+(Cluster/Pod/Trainer:56,163; get_cluster; start_local_trainers:429 spawns one
+process per device with the PADDLE_TRAINER_* env contract;
+watch_local_trainers:517 polls and tears the pod down on any failure — the
+reference has NO elastic restart, SURVEY.md §5).
+
+TPU-native: one trainer process per *host* (a TPU VM worker) rather than per
+device — in-host chips are driven SPMD by one JAX process.  The env schema
+is kept verbatim so PaddleCloud-style schedulers keep working, plus
+PADDLE_MASTER for the JAX coordination service.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import time
+
+logger = logging.getLogger("paddle_tpu.launch")
+
+__all__ = ["Trainer", "Pod", "Cluster", "get_cluster",
+           "start_local_trainers", "watch_local_trainers",
+           "terminate_local_procs", "TrainerProc", "find_free_ports"]
+
+
+class Trainer:
+    def __init__(self, endpoint="", rank=0, accelerators=None):
+        self.endpoint = endpoint
+        self.rank = rank
+        self.accelerators = accelerators or []
+
+    def __str__(self):
+        return f"trainer rank={self.rank} endpoint={self.endpoint}"
+
+
+class Pod:
+    """All trainers on one node (reference launch_utils.py:163)."""
+
+    def __init__(self, rank=0, addr="127.0.0.1"):
+        self.rank = rank
+        self.addr = addr
+        self.trainers: list[Trainer] = []
+
+    def __str__(self):
+        return (f"pod rank={self.rank} addr={self.addr} "
+                f"trainers={[str(t) for t in self.trainers]}")
+
+
+class Cluster:
+    """The whole job (reference launch_utils.py:56)."""
+
+    def __init__(self):
+        self.pods: list[Pod] = []
+
+    def trainers_nranks(self):
+        return sum(len(p.trainers) for p in self.pods)
+
+    def trainers_endpoints(self):
+        return [t.endpoint for p in self.pods for t in p.trainers]
+
+    def pods_endpoints(self):
+        return [f"{p.addr}" for p in self.pods]
+
+    def pod(self, rank):
+        for p in self.pods:
+            if p.rank == rank:
+                return p
+        return None
+
+
+def find_free_ports(num):
+    import socket
+    ports, socks = [], []
+    try:
+        for _ in range(num):
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            s.bind(("", 0))
+            socks.append(s)
+            ports.append(s.getsockname()[1])
+    finally:
+        for s in socks:
+            s.close()
+    return ports
+
+
+def get_cluster(node_ips, node_ip, trainer_endpoints, nproc_per_node):
+    """Build the Cluster/Pod tree from the ip list + per-node proc count."""
+    cluster = Cluster()
+    rank = 0
+    for pod_rank, ip in enumerate(node_ips):
+        pod = Pod(rank=pod_rank, addr=ip)
+        for local in range(nproc_per_node):
+            t = Trainer(endpoint=trainer_endpoints[rank], rank=rank,
+                        accelerators=[local])
+            pod.trainers.append(t)
+            rank += 1
+        cluster.pods.append(pod)
+    return cluster, cluster.pod(node_ips.index(node_ip))
+
+
+class TrainerProc:
+    def __init__(self, proc, rank, log_fn=None, cmd=None):
+        self.proc = proc
+        self.rank = rank
+        self.log_fn = log_fn
+        self.cmd = cmd
+
+
+def _trainer_env(cluster: Cluster, trainer: Trainer, backend="auto"):
+    eps = cluster.trainers_endpoints()
+    env = {
+        "PADDLE_TRAINER_ID": str(trainer.rank),
+        "PADDLE_CURRENT_ENDPOINT": trainer.endpoint,
+        "PADDLE_TRAINERS_NUM": str(cluster.trainers_nranks()),
+        "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+        # JAX coordination service address = rank-0 endpoint
+        "PADDLE_MASTER": eps[0] if eps else "",
+        "FLAGS_selected_tpus": ",".join(str(a) for a in trainer.accelerators),
+        "FLAGS_selected_gpus": ",".join(str(a) for a in trainer.accelerators),
+        "PADDLE_DISTRI_BACKEND": backend,
+    }
+    return env
+
+
+def start_local_trainers(cluster, pod, training_script,
+                         training_script_args, log_dir=None, envs=None,
+                         backend="auto"):
+    """Spawn one subprocess per local trainer (reference :429)."""
+    procs = []
+    if log_dir:
+        os.makedirs(log_dir, exist_ok=True)
+    # restarts (PADDLE_RESTART_COUNT > 0) append so earlier attempts'
+    # logs — usually the interesting ones — survive
+    restarting = (envs or {}).get("PADDLE_RESTART_COUNT", "0") != "0"
+    for idx, t in enumerate(pod.trainers):
+        env = dict(os.environ)
+        env.update(envs or {})
+        env.update(_trainer_env(cluster, t, backend))
+        cmd = [sys.executable, "-u", training_script] + \
+            list(training_script_args)
+        log_fn = None
+        if log_dir:
+            log_fn = open(os.path.join(log_dir, f"workerlog.{t.rank}"),
+                          "a" if restarting else "w")
+            proc = subprocess.Popen(cmd, env=env, stdout=log_fn,
+                                    stderr=subprocess.STDOUT)
+        else:
+            proc = subprocess.Popen(cmd, env=env)
+        logger.info("started trainer rank=%s pid=%s", t.rank, proc.pid)
+        procs.append(TrainerProc(proc, t.rank, log_fn, cmd))
+    return procs
+
+
+def terminate_local_procs(procs):
+    for tp in procs:
+        if tp.proc.poll() is None:
+            try:
+                tp.proc.terminate()
+            except OSError:
+                pass
+    deadline = time.time() + 10
+    for tp in procs:
+        try:
+            tp.proc.wait(timeout=max(0.1, deadline - time.time()))
+        except subprocess.TimeoutExpired:
+            try:
+                tp.proc.send_signal(signal.SIGKILL)
+            except OSError:
+                pass
+        if tp.log_fn:
+            tp.log_fn.close()
+
+
+def watch_local_trainers(procs, nranks=None, poll_interval=1.0):
+    """Poll until all trainers exit; on ANY failure kill the pod and raise
+    (the reference's non-elastic policy, launch_utils.py:517).
+    Returns the list of exit codes on clean completion."""
+    try:
+        while True:
+            alive = False
+            for tp in procs:
+                ret = tp.proc.poll()
+                if ret is None:
+                    alive = True
+                elif ret != 0:
+                    logger.error("trainer rank=%s exited with code %s — "
+                                 "terminating pod", tp.rank, ret)
+                    terminate_local_procs(procs)
+                    raise RuntimeError(
+                        f"trainer {tp.rank} failed (exit {ret}); pod "
+                        f"terminated (cmd: {' '.join(tp.cmd or [])})")
+            if not alive:
+                break
+            time.sleep(poll_interval)
+    except KeyboardInterrupt:
+        terminate_local_procs(procs)
+        raise
+    codes = [tp.proc.returncode for tp in procs]
+    for tp in procs:
+        if tp.log_fn:
+            tp.log_fn.close()
+    return codes

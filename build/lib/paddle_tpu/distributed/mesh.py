@@ -1,0 +1,83 @@
+"""Device mesh management — the ring_id/communicator replacement.
+
+Reference parity: platform/collective_helper.h NCCLCommContext (comm rings
+keyed by ring_id) + nccl_helper.h NCCLContextMap.  TPU-native: ONE global
+`jax.sharding.Mesh` with named axes replaces all rings; a "ring" is a named
+mesh axis, and collectives address axes by name (`dp`, `mp`, `pp`, `sp`).
+Hierarchical allreduce (nccl_helper.h:207) is subsumed: XLA routes
+reductions over ICI within a slice and DCN across slices automatically from
+the mesh topology.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+P = PartitionSpec
+
+
+class _MeshState(threading.local):
+    def __init__(self):
+        self.mesh: Mesh | None = None
+
+
+_state = _MeshState()
+
+
+def build_mesh(mesh_shape: dict[str, int] | None = None,
+               devices=None) -> Mesh:
+    """Build a named mesh, e.g. build_mesh({"dp": 2, "mp": 4}).
+    Defaults to a pure data-parallel mesh over all devices."""
+    devices = list(devices if devices is not None else jax.devices())
+    if mesh_shape is None:
+        mesh_shape = {"dp": len(devices)}
+    names = list(mesh_shape.keys())
+    dims = [int(v) for v in mesh_shape.values()]
+    n_needed = int(np.prod(dims))
+    if n_needed != len(devices):
+        # allow -1 wildcard on one axis
+        if -1 in dims:
+            i = dims.index(-1)
+            rest = int(np.prod([d for d in dims if d != -1]))
+            dims[i] = len(devices) // rest
+        else:
+            raise ValueError(
+                f"mesh shape {mesh_shape} needs {n_needed} devices, "
+                f"have {len(devices)}")
+    arr = np.asarray(devices).reshape(dims)
+    return Mesh(arr, axis_names=tuple(names))
+
+
+def set_mesh(mesh: Mesh):
+    _state.mesh = mesh
+    return mesh
+
+
+def get_mesh() -> Mesh | None:
+    return _state.mesh
+
+
+def ensure_mesh(mesh_shape=None) -> Mesh:
+    if _state.mesh is None:
+        _state.mesh = build_mesh(mesh_shape)
+    return _state.mesh
+
+
+@contextlib.contextmanager
+def mesh_guard(mesh: Mesh):
+    prev = _state.mesh
+    _state.mesh = mesh
+    try:
+        with mesh:
+            yield mesh
+    finally:
+        _state.mesh = prev
+
+
+def named_sharding(*spec) -> NamedSharding:
+    return NamedSharding(ensure_mesh(), P(*spec))

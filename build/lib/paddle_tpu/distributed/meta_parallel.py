@@ -1,0 +1,232 @@
+"""Tensor (model) parallel layers + paddle.distributed.split.
+
+Reference parity: python/paddle/distributed/collective.py:492-640
+(`_parallel_linear` — row-parallel allreduce on output / column-parallel
+allgather; `_parallel_embedding` — shard_index + allreduce; public entry
+`split` at collective.py:566).
+
+TPU-native design — GSPMD, not explicit shards: every parallel layer holds
+the FULL logical weight and annotates it with a `PartitionSpec` over the
+model-parallel mesh axis (`Parameter.dist_spec`).  Under `jax.jit` on a mesh
+the annotation physically shards the weight; XLA's SPMD partitioner inserts
+the exact collectives the reference hand-codes (row-parallel matmul →
+all-reduce of partial sums ≙ collective.py:516's c_allreduce; column-parallel
+gather_out → all-gather ≙ :523).  `shard_constraint` is the explicit
+activation-side annotation (`jax.lax.with_sharding_constraint`).
+
+This means the same layer code runs single-chip (specs ignored), and on any
+dp×mp mesh without code changes — compile-only tests assert the HLO contains
+the expected collectives (mirrors the reference's fleet meta-optimizer
+program-inspection tests, SURVEY.md §4).
+"""
+from __future__ import annotations
+
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import numpy as np
+
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, apply
+from .mesh import get_mesh
+
+MP_AXIS = "mp"  # model-parallel mesh axis name (≙ ring_id of the mp group)
+
+
+def _mesh_has(axis) -> bool:
+    mesh = get_mesh()
+    return mesh is not None and axis in mesh.axis_names
+
+
+def shard_constraint(x, *spec):
+    """with_sharding_constraint that no-ops without a mesh (single chip)."""
+    mesh = get_mesh()
+    if mesh is None:
+        return x
+    clean = tuple(a if (a is None or a in mesh.axis_names) else None
+                  for a in spec)
+    sh = NamedSharding(mesh, P(*clean))
+    return apply(lambda v: jax.lax.with_sharding_constraint(v, sh), x)
+
+
+def annotate(param, *spec):
+    """Attach a PartitionSpec to a Parameter (consumed by fleet/pjit glue)."""
+    param.dist_spec = P(*spec)
+    return param
+
+
+def dist_specs(layer_or_params) -> dict:
+    """{name: PartitionSpec | None} from Parameter.dist_spec annotations.
+
+    Feed to fleet's build_train_step(param_specs=...) so tensor-parallel
+    placements reach the compiled step (keys match state_pytrees)."""
+    if isinstance(layer_or_params, Layer):
+        items = list(layer_or_params.named_parameters())
+    else:
+        items = list(layer_or_params.items())
+    return {k: getattr(v, "dist_spec", None) for k, v in items}
+
+
+def param_sharding(layer_or_params, mesh=None) -> dict:
+    """NamedSharding pytree from Parameter.dist_spec annotations.
+
+    Accepts a Layer (reads named_parameters, keys match state_pytrees) or a
+    {name: Parameter} dict; unannotated params replicate.  Without a mesh
+    (single chip) every entry is None — jax.device_put(x, None) is a no-op
+    placement, so call sites work unchanged."""
+    mesh = mesh or get_mesh()
+    if isinstance(layer_or_params, Layer):
+        items = list(layer_or_params.named_parameters())
+    else:
+        items = list(layer_or_params.items())
+    out = {}
+    for k, v in items:
+        if mesh is None:
+            out[k] = None
+            continue
+        spec = getattr(v, "dist_spec", None) or P()
+        out[k] = NamedSharding(mesh, spec)
+    return out
+
+
+class ColumnParallelLinear(Layer):
+    """Linear with the output dim sharded over `mp`.
+
+    y = x @ W[:, shard] per device; gather_output=True adds an all-gather
+    (reference: collective.py:523 concat of c_allgather)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, gather_output=True, name=None,
+                 mp_axis=MP_AXIS, bias_attr=None):
+        super().__init__()
+        self._gather_output = gather_output
+        self._mp_axis = mp_axis
+        self.weight = annotate(
+            self.create_parameter([in_features, out_features],
+                                  attr=weight_attr,
+                                  default_initializer=I.XavierUniform()),
+            None, mp_axis)
+        self.bias = None
+        if has_bias:
+            self.bias = annotate(
+                self.create_parameter(
+                    [out_features],
+                    attr=None if bias_attr in (None, True) else bias_attr,
+                    is_bias=True),
+                mp_axis)
+
+    def forward(self, x):
+        y = F.linear(x, self.weight, self.bias)
+        if self._gather_output:
+            return shard_constraint(y, *([None] * y.ndim))
+        return shard_constraint(y, *([None] * (y.ndim - 1) + [self._mp_axis]))
+
+
+class RowParallelLinear(Layer):
+    """Linear with the input (reduction) dim sharded over `mp`.
+
+    Partial products are combined by an all-reduce that XLA inserts when the
+    output is constrained to replicated (reference: collective.py:516
+    c_allreduce_sum on the output)."""
+
+    def __init__(self, in_features, out_features, weight_attr=None,
+                 has_bias=True, input_is_parallel=False, name=None,
+                 mp_axis=MP_AXIS, bias_attr=None):
+        super().__init__()
+        self._input_is_parallel = input_is_parallel
+        self._mp_axis = mp_axis
+        self.weight = annotate(
+            self.create_parameter([in_features, out_features],
+                                  attr=weight_attr,
+                                  default_initializer=I.XavierUniform()),
+            mp_axis, None)
+        self.bias = None
+        if has_bias:
+            # bias added after the reduce → replicated (reference adds bias
+            # only on the allreduced output, collective.py:512)
+            self.bias = self.create_parameter(
+                [out_features],
+                attr=None if bias_attr in (None, True) else bias_attr,
+                is_bias=True)
+
+    def forward(self, x):
+        if self._input_is_parallel:
+            x = shard_constraint(x, *([None] * (x.ndim - 1) + [self._mp_axis]))
+        y = F.linear(x, self.weight, None)
+        y = shard_constraint(y, *([None] * y.ndim))
+        if self.bias is not None:
+            y = y + self.bias
+        return y
+
+
+class VocabParallelEmbedding(Layer):
+    """Embedding with the vocab dim sharded over `mp`.
+
+    The reference masks out-of-shard ids and allreduces
+    (collective.py:526 _parallel_embedding + shard_index); under GSPMD the
+    gather over a vocab-sharded table compiles to the same pattern."""
+
+    def __init__(self, num_embeddings, embedding_dim, weight_attr=None,
+                 name=None, mp_axis=MP_AXIS):
+        super().__init__()
+        self._mp_axis = mp_axis
+        self.weight = annotate(
+            self.create_parameter([num_embeddings, embedding_dim],
+                                  attr=weight_attr,
+                                  default_initializer=I.Normal(0.0, 1.0)),
+            mp_axis, None)
+
+    def forward(self, x):
+        y = F.embedding(x, self.weight)
+        return shard_constraint(y, *([None] * y.ndim))
+
+
+def split(x, size, operation="linear", axis=0, num_partitions=None,
+          gather_out=True, weight_attr=None, bias_attr=None, name=None):
+    """paddle.distributed.split parity (collective.py:566).
+
+    operation='linear': size=(in, out); axis=0 → row-parallel, axis=1 →
+    column-parallel.  operation='embedding': size=(vocab, hidden), vocab
+    sharded.  Builds the parallel layer and applies it (graph-builder UX of
+    the reference; for reusable modules use the *Parallel* classes)."""
+    if weight_attr is False:
+        raise ValueError("split() requires a weight (weight_attr=False)")
+    if operation == "linear":
+        in_f, out_f = size
+        if axis == 0:
+            layer = RowParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                      has_bias=bias_attr is not False,
+                                      bias_attr=bias_attr)
+        elif axis == 1:
+            layer = ColumnParallelLinear(in_f, out_f, weight_attr=weight_attr,
+                                         has_bias=bias_attr is not False,
+                                         bias_attr=bias_attr,
+                                         gather_output=gather_out)
+        else:
+            raise ValueError("axis must be 0 (row) or 1 (column)")
+    elif operation == "embedding":
+        if axis != 0:
+            raise ValueError("embedding split supports axis=0 (vocab)")
+        layer = VocabParallelEmbedding(size[0], size[1],
+                                       weight_attr=weight_attr)
+    else:
+        raise ValueError(f"unsupported operation {operation!r}")
+    return layer(x if isinstance(x, Tensor) else Tensor(x))
+
+
+class ParallelCrossEntropy(Layer):
+    """Cross entropy over mp-sharded logits (fleet.meta_parallel analog);
+    under GSPMD plain softmax-xent on constrained logits compiles to the
+    vocab-parallel pattern."""
+
+    def __init__(self, mp_axis=MP_AXIS, name=None):
+        super().__init__()
+        self._mp_axis = mp_axis
+
+    def forward(self, logits, label):
+        from ..ops import fused
+        logits = shard_constraint(
+            logits, *([None] * (logits.ndim - 1) + [self._mp_axis]))
+        return fused.softmax_cross_entropy(logits, label)

@@ -1,0 +1,108 @@
+"""Data parallelism + process bootstrap.
+
+Reference parity: python/paddle/distributed/parallel.py (init_parallel_env:57)
++ python/paddle/fluid/dygraph/parallel.py (DataParallel:314, scale_loss:303)
++ imperative/reducer.cc (bucketed grad allreduce overlapped with backward)
++ imperative/nccl_context.cc (TCP ncclUniqueId bootstrap).
+
+TPU-native: there are no buckets, no comm streams, no TCP bootstrap.
+  * init_parallel_env → jax.distributed.initialize (the JAX coordination
+    service replaces gen_nccl_id TCP hand-rolling) + a default dp mesh.
+  * DataParallel(model) keeps the dygraph UX; grad sync happens by psum when
+    the train step is jitted over the dp mesh axis (XLA overlaps the
+    all-reduces with backward computation itself — the Reducer's job).  For
+    eager parity, `apply_collective_grads` all-reduces `.grad`s explicitly.
+"""
+from __future__ import annotations
+
+import os
+
+import jax
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor
+from . import collective
+from .env import ParallelEnv
+from .mesh import build_mesh, ensure_mesh, get_mesh, set_mesh
+
+_initialized = False
+
+
+def init_parallel_env(mesh_shape=None):
+    """Bootstrap multi-process JAX + build the default mesh."""
+    global _initialized
+    if _initialized:
+        return ParallelEnv()
+    env = ParallelEnv()
+    # probe the coordination client WITHOUT jax.process_count(): that call
+    # initializes the XLA backend, after which initialize() is illegal
+    already = jax.distributed.is_initialized()
+    if env.world_size > 1 and not already:
+        # PADDLE_TRAINER_* style launch: initialize jax.distributed from env
+        coord = os.environ.get("PADDLE_MASTER",
+                               (env.trainer_endpoints or [""])[0])
+        jax.distributed.initialize(
+            coordinator_address=coord or None,
+            num_processes=env.world_size,
+            process_id=env.rank)
+    ensure_mesh(mesh_shape)
+    _initialized = True
+    return env
+
+
+def is_initialized():
+    return _initialized
+
+
+class DataParallel(Layer):
+    """Reference: dygraph/parallel.py DataParallel:314."""
+
+    def __init__(self, layers, strategy=None, comm_buffer_size=25,
+                 last_comm_buffer_size=1, find_unused_parameters=False,
+                 group=None):
+        super().__init__()
+        self._layers = layers
+        self.find_unused_parameters = find_unused_parameters
+        self._grads_synced = True
+
+    def forward(self, *inputs, **kwargs):
+        self._grads_synced = False
+        return self._layers(*inputs, **kwargs)
+
+    def scale_loss(self, loss):
+        # reference scales by 1/nranks before backward (parallel.py:303);
+        # with psum-of-mean semantics we keep it for API parity
+        n = ParallelEnv().world_size
+        if n <= 1:
+            return loss
+        return loss / n
+
+    def apply_collective_grads(self):
+        """Eager grad sync (the Reducer path, reducer.cc:398-525)."""
+        mesh = get_mesh()
+        if mesh is None or mesh.size <= 1:
+            return
+        for p in self._layers.parameters():
+            if p.grad is not None:
+                collective.all_reduce(p.grad)
+
+    # delegate everything stateful to the wrapped layer
+    def parameters(self, include_sublayers=True):
+        return self._layers.parameters(include_sublayers)
+
+    def named_parameters(self, prefix="", include_sublayers=True):
+        return self._layers.named_parameters(prefix, include_sublayers)
+
+    def state_dict(self, *args, **kwargs):
+        return self._layers.state_dict(*args, **kwargs)
+
+    def set_state_dict(self, state_dict, *args, **kwargs):
+        return self._layers.set_state_dict(state_dict, *args, **kwargs)
+
+
+def get_rank():
+    return ParallelEnv().rank
+
+
+def get_world_size():
+    return ParallelEnv().world_size

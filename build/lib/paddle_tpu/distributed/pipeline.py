@@ -1,0 +1,189 @@
+"""SPMD pipeline parallelism with a GPipe fill-drain schedule.
+
+Reference parity: fluid.PipelineOptimizer (python/paddle/fluid/optimizer.py:3702)
+splits the program into per-device sections connected by send_v2/recv_v2 ops
+(optimizer.py:4178), executed by SectionWorker (framework/device_worker.h:637)
+with a GPipe schedule — all microbatch forwards, then all backwards, then one
+optimizer step (framework/section_worker.cc:44).
+
+TPU-native: ONE SPMD program over a `pp` mesh axis instead of per-stage
+processes.  Stage s's weights live at pp-coordinate s (parameters stacked on
+a leading stage axis and sharded P('pp', ...)); activations hop stages via
+`lax.ppermute` over ICI (the send_v2/recv_v2 analog); the fill-drain schedule
+is a `lax.scan` over M + S - 1 ticks.  The backward sweep needs no code:
+`jax.grad` transposes the scan (and ppermute transposes to the reverse
+shift), which reproduces GPipe's all-forwards-then-all-backwards exactly.
+The pipeline bubble is the masked compute during fill/drain ticks.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+__all__ = ["spmd_pipeline", "pipeline_step_fn", "stack_stage_params",
+           "unstack_stage_params", "PipelineProgram", "pipeline_loss_fn"]
+
+
+def spmd_pipeline(stage_fn, stage_params, microbatches, *, axis_name="pp",
+                  remat=True):
+    """Run the GPipe pipeline. MUST be called inside shard_map over `axis_name`.
+
+    Args:
+      stage_fn: (params_one_stage, act [mb,...]) -> act [mb,...].  Every stage
+        must preserve the activation shape/dtype (stages are homogeneous — the
+        usual transformer-block pipeline).  Embedding/head belong outside.
+      stage_params: pytree whose leaves carry a leading stage axis, sharded
+        over `axis_name` (inside shard_map each device sees leading dim 1).
+      microbatches: [M, mb, ...] array, replicated over `axis_name`.
+    Returns:
+      [M, mb, ...] outputs, replicated over `axis_name`.
+    """
+    S = jax.lax.axis_size(axis_name)
+    stage = jax.lax.axis_index(axis_name)
+    p_local = jax.tree.map(lambda l: l[0], stage_params)
+    M = microbatches.shape[0]
+    fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    out_sd = jax.eval_shape(stage_fn, p_local, microbatches[0])
+    if (out_sd.shape, out_sd.dtype) != (microbatches[0].shape,
+                                        microbatches[0].dtype):
+        raise ValueError(
+            f"pipeline stages must preserve activation shape/dtype; got "
+            f"{microbatches[0].shape}/{microbatches[0].dtype} -> "
+            f"{out_sd.shape}/{out_sd.dtype}")
+
+    T = M + S - 1
+    fwd_perm = [(i, i + 1) for i in range(S - 1)]
+
+    def tick(carry, t):
+        recv, outs = carry
+        inject = jax.lax.dynamic_index_in_dim(
+            microbatches, jnp.clip(t, 0, M - 1), 0, keepdims=False)
+        a = jnp.where(stage == 0, inject, recv)
+        y = fn(p_local, a)
+        mb = t - stage
+        valid = (mb >= 0) & (mb < M)
+        # zero the bubble lanes so no gradient flows through them
+        y = jnp.where(valid, y, jnp.zeros_like(y))
+        idx = jnp.clip(mb, 0, M - 1)
+        cur = jax.lax.dynamic_index_in_dim(outs, idx, 0, keepdims=False)
+        new = jnp.where(valid & (stage == S - 1), y, cur)
+        outs = jax.lax.dynamic_update_index_in_dim(outs, new, idx, 0)
+        nxt = jax.lax.ppermute(y, axis_name, fwd_perm)
+        return (nxt, outs), None
+
+    zero_act = jnp.zeros_like(microbatches[0])
+    zero_out = jnp.zeros_like(microbatches)
+    (_, outs), _ = jax.lax.scan(tick, (zero_act, zero_out), jnp.arange(T))
+    # only the last stage holds real outputs; psum-mask to replicate them
+    outs = jax.lax.psum(jnp.where(stage == S - 1, outs, jnp.zeros_like(outs)),
+                        axis_name)
+    return outs
+
+
+def pipeline_step_fn(stage_fn, mesh, *, axis_name="pp", remat=True):
+    """Build a jittable (stacked_params, microbatches) -> outputs function.
+
+    Wraps `spmd_pipeline` in shard_map over `mesh`: parameters sharded on the
+    stage axis, data replicated.  Compose with jax.grad / jax.jit outside.
+    check_vma=False so stage_fn may itself use collectives over other mesh
+    axes (tensor-parallel stages).
+    """
+    pspec = P(axis_name)
+    dspec = P()
+
+    def run(stacked_params, microbatches):
+        def inner(params, x):
+            return spmd_pipeline(stage_fn, params, x, axis_name=axis_name,
+                                 remat=remat)
+
+        return shard_map(
+            inner, mesh=mesh,
+            in_specs=(pspec, dspec), out_specs=dspec,
+            check_vma=False)(stacked_params, microbatches)
+
+    return run
+
+
+class PipelineProgram:
+    """Stage-structured model contract consumed by the Fleet pipeline path.
+
+    Reference parity: fluid.PipelineOptimizer (optimizer.py:3702) carves a
+    program into sections by per-op `device` attrs.  TPU-native there is no
+    program to carve — the user (or a model-zoo helper like
+    models.gpt_hybrid.pipeline_program) DECLARES the stage structure and
+    `pipeline_loss_fn` + StrategyCompiler.build_train_step turn it into one
+    SPMD program: embed → spmd_pipeline(stage) → head, inside shard_map.
+
+    Methods run INSIDE shard_map over the full mesh (use lax collectives
+    over 'mp'/'dp' axes freely):
+      embed(params, micro)        [M, mb, ...] batch -> [M, mb, ...] acts
+      stage(stage_params, act)    one pipeline stage; shape-preserving
+      head(params, out, micro)    last-stage acts -> local scalar loss
+    Declarations:
+      stage_key     key in the params dict whose subtree is stacked
+                    [pp, ...] per-stage weights
+      param_specs() PartitionSpec pytree matching the params structure
+      data_spec()   PartitionSpec of the [M, mb, ...] microbatched batch
+      to_microbatches(batch, M)   global batch -> [M, mb, ...]
+    """
+
+    stage_key = "blocks"
+
+    def embed(self, params, micro):
+        raise NotImplementedError
+
+    def stage(self, stage_params, act):
+        raise NotImplementedError
+
+    def head(self, params, out, micro):
+        raise NotImplementedError
+
+    def param_specs(self):
+        raise NotImplementedError
+
+    def data_spec(self):
+        return P(None, "dp", None)
+
+    def to_microbatches(self, batch, n_microbatches):
+        mb = batch.shape[0] // n_microbatches
+        return batch.reshape((n_microbatches, mb) + batch.shape[1:])
+
+
+def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
+                     *, axis_name="pp", remat=True):
+    """(params, batch) -> scalar loss running `program` as a GPipe pipeline
+    over mesh axis `axis_name`.  The loss is pmean'd over every mesh axis so
+    both the value and all gradients are exact (see models/gpt_hybrid)."""
+    all_axes = tuple(mesh.axis_names)
+
+    def inner(params, micro):
+        act = program.embed(params, micro)
+        out = spmd_pipeline(program.stage, params[program.stage_key], act,
+                            axis_name=axis_name, remat=remat)
+        loss = program.head(params, out, micro)
+        return jax.lax.pmean(loss, all_axes)
+
+    specs = program.param_specs()
+
+    def loss_fn(params, batch):
+        micro = program.to_microbatches(batch, n_microbatches)
+        f = shard_map(inner, mesh=mesh,
+                      in_specs=(specs, program.data_spec()),
+                      out_specs=P(), check_vma=False)
+        return f(params, micro)
+
+    return loss_fn
+
+
+def stack_stage_params(per_stage_params):
+    """[{leaf}, ...] per stage -> one pytree with leading stage axis."""
+    return jax.tree.map(lambda *ls: jnp.stack(ls, axis=0), *per_stage_params)
+
+
+def unstack_stage_params(stacked, n_stages):
+    """Inverse of stack_stage_params."""
+    return [jax.tree.map(lambda l, i=i: l[i], stacked)
+            for i in range(n_stages)]

@@ -1,0 +1,77 @@
+"""Activation recomputation (gradient checkpointing).
+
+Reference parity: RecomputeOptimizer (python/paddle/fluid/optimizer.py:4533)
+re-emits the forward subgraph of each checkpoint segment inside the backward
+program (backward.py ProgramStats:38 finds the segments).
+
+TPU-native: `jax.checkpoint` (remat) — XLA re-runs the forward of the wrapped
+region during the backward pass; policies choose what to keep (the reference
+always keeps only segment boundaries, ≙ policy None).
+"""
+from __future__ import annotations
+
+import jax
+
+__all__ = ["recompute", "checkpoint", "recompute_sequential", "POLICIES"]
+
+POLICIES = {
+    None: None,
+    "full": None,                                  # save nothing, recompute all
+    "dots": jax.checkpoint_policies.checkpoint_dots,
+    "dots_saveable": jax.checkpoint_policies.dots_saveable,
+    "dots_with_no_batch_dims":
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
+    "everything_saveable": jax.checkpoint_policies.everything_saveable,
+}
+
+
+def checkpoint(function, policy=None, prevent_cse=True, static_argnums=()):
+    """Wrap `function` so its activations are rematerialized in backward."""
+    if isinstance(policy, str):
+        if policy not in POLICIES:
+            raise ValueError(f"unknown recompute policy {policy!r}; one of "
+                             f"{sorted(k for k in POLICIES if k)}")
+        pol = POLICIES[policy]
+    else:
+        pol = policy
+    return jax.checkpoint(function, policy=pol, prevent_cse=prevent_cse,
+                          static_argnums=static_argnums)
+
+
+def recompute(function, *args, policy=None, **kwargs):
+    """paddle.distributed.fleet.utils.recompute-style immediate call.
+
+    RNG note: randomness inside `function` must come from explicit JAX keys
+    (there is no preserve_rng_state toggle — key-splitting makes the
+    recomputed forward bitwise-identical by construction).
+    """
+    return checkpoint(function, policy=policy)(*args, **kwargs)
+
+
+def recompute_sequential(ctx, functions, *args):
+    """Apply a list of functions sequentially, each as a remat segment.
+
+    `ctx` accepts {"segments": n} to group functions into n segments
+    (paddle.incubate.distributed.fleet.recompute_sequential parity).
+    """
+    segments = int((ctx or {}).get("segments", len(functions)))
+    funcs = list(functions)
+    per = max(1, -(-len(funcs) // max(1, segments)))
+    out = args
+
+    def seg_fn(fs):
+        def run(*xs):
+            for f in fs:
+                r = f(*xs)
+                xs = r if isinstance(r, tuple) else (r,)
+            return xs[0] if len(xs) == 1 else xs
+        return run
+
+    i = 0
+    while i < len(funcs):
+        fs = funcs[i:i + per]
+        r = checkpoint(seg_fn(fs))(*out)
+        out = r if isinstance(r, tuple) else (r,)
+        i += per
+    return out[0] if len(out) == 1 else out

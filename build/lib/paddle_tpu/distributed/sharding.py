@@ -1,0 +1,131 @@
+"""ZeRO-style sharded data parallelism expressed as GSPMD sharding specs.
+
+Reference parity: fleet/meta_optimizers/sharding_optimizer.py:33 — each rank
+owns a parameter shard plus its optimizer state; parameters are broadcast
+before use and gradients reduced to their owners (the program-rewrite ZeRO).
+
+TPU-native: no program rewrite.  Ownership is a `NamedSharding` over the dp
+axis and GSPMD inserts the all-gathers / reduce-scatters:
+
+  stage 1  optimizer state sharded over dp; params + grads replicated
+           (≈ free with pjit — the reference's sharding_optimizer default)
+  stage 2  + gradients reduce-scattered (pass grad specs as out_shardings)
+  stage 3  + parameters sharded (all-gather at use: fully-sharded DP / FSDP)
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+__all__ = ["shard_spec", "merge_zero_spec", "zero_shardings",
+           "param_shardings", "grad_shardings", "opt_state_shardings",
+           "merged_zero_shardings"]
+
+
+def shard_spec(shape, axis_name, axis_size):
+    """P sharding the largest dim divisible by axis_size, else replicated.
+
+    Largest-first (not first-divisible) so a [vocab, hidden] embedding
+    shards its big vocab dim — and, more importantly, `merge_zero_spec`
+    below composes with tensor-parallel dist_specs without collisions."""
+    best = None
+    for d, n in enumerate(shape):
+        if n % axis_size == 0 and n >= axis_size:
+            if best is None or n > shape[best]:
+                best = d
+    if best is None:
+        return P()
+    spec = [None] * len(shape)
+    spec[best] = axis_name
+    return P(*spec)
+
+
+def merge_zero_spec(dist_spec, shape, axis_name, axis_size):
+    """Compose a tensor-parallel PartitionSpec with ZeRO sharding over
+    `axis_name`: shard the largest still-unsharded dim divisible by
+    axis_size, keeping the TP placement intact (round-1 Weak #6 — ZeRO and
+    dist_spec previously had no merge logic and could collide on one dim).
+
+    dist_spec may be None / P(); returns a PartitionSpec."""
+    base = list(dist_spec) if dist_spec is not None else []
+    base += [None] * (len(shape) - len(base))
+    used = {a for entry in base if entry is not None
+            for a in (entry if isinstance(entry, tuple) else (entry,))}
+    zero_axes = (axis_name if isinstance(axis_name, tuple) else (axis_name,))
+    if any(a in used for a in zero_axes):
+        return P(*base)
+    best = None
+    for d, n in enumerate(shape):
+        if base[d] is None and n % axis_size == 0 and n >= axis_size:
+            if best is None or n > shape[best]:
+                best = d
+    if best is not None:
+        base[best] = axis_name
+    return P(*base)
+
+
+def _tree_shardings(tree, mesh, axis_name, sharded: bool):
+    size = int(np.prod([mesh.shape[a] for a in
+                        (axis_name if isinstance(axis_name, tuple)
+                         else (axis_name,))]))
+
+    def leaf(v):
+        if not sharded:
+            return NamedSharding(mesh, P())
+        return NamedSharding(mesh, shard_spec(np.shape(v), axis_name, size))
+
+    return jax.tree.map(leaf, tree)
+
+
+def param_shardings(params, mesh, axis_name="dp", stage=1):
+    return _tree_shardings(params, mesh, axis_name, sharded=stage >= 3)
+
+
+def grad_shardings(params, mesh, axis_name="dp", stage=1):
+    return _tree_shardings(params, mesh, axis_name, sharded=stage >= 2)
+
+
+def opt_state_shardings(opt_state, mesh, axis_name="dp", stage=1):
+    return _tree_shardings(opt_state, mesh, axis_name, sharded=stage >= 1)
+
+
+def zero_shardings(params, opt_state, mesh, axis_name="dp", stage=1):
+    """(param, opt_state, grad) NamedSharding pytrees for a ZeRO stage."""
+    return (param_shardings(params, mesh, axis_name, stage),
+            opt_state_shardings(opt_state, mesh, axis_name, stage),
+            grad_shardings(params, mesh, axis_name, stage))
+
+
+def merged_zero_shardings(params, dist_specs, opt_state, mesh,
+                          axis_name="dp", stage=1):
+    """ZeRO shardings composed with tensor/pipeline-parallel dist_specs.
+
+    dist_specs: {param_name: PartitionSpec} (missing/None entries =
+    replicated), same keys as `params`.  Returns (param, opt_state, grad)
+    NamedSharding pytrees where every leaf keeps its TP placement and the
+    ZeRO stage adds dp-sharding on a free dim:
+      params     dp-sharded when stage >= 3 (FSDP), else dist_spec only
+      grads      dp-sharded when stage >= 2 (reduce-scatter point)
+      opt slots  dp-sharded when stage >= 1 (always inherit TP placement)
+    """
+    size = int(np.prod([mesh.shape[a] for a in
+                        (axis_name if isinstance(axis_name, tuple)
+                         else (axis_name,))]))
+
+    def spec_for(name, v, zero: bool):
+        ds = dist_specs.get(name) if dist_specs else None
+        if not zero:
+            return ds if ds is not None else P()
+        return merge_zero_spec(ds, np.shape(v), axis_name, size)
+
+    def shardings(zero: bool):
+        return {name: NamedSharding(mesh, spec_for(name, v, zero))
+                for name, v in params.items()}
+
+    p_sh = shardings(zero=stage >= 3)
+    g_sh = shardings(zero=stage >= 2)
+    slot_spec = shardings(zero=stage >= 1)
+    s_sh = {name: jax.tree.map(lambda _: slot_spec[name], slots)
+            for name, slots in opt_state.items()}
+    return p_sh, s_sh, g_sh

@@ -1,0 +1,88 @@
+"""paddle.distributed.spawn — in-python multi-process launch.
+
+Reference parity: python/paddle/distributed/spawn.py:276 — start nprocs
+python processes running `func(*args)` each with the PADDLE_TRAINER_* env
+set, join and re-raise the first failure.
+
+TPU note: real TPU chips admit one process per host; spawn is the CPU-mesh
+test path (JAX_PLATFORMS=cpu) and the API-parity surface.  Workers run with
+the spawn start method so JAX state is never forked.
+"""
+from __future__ import annotations
+
+import multiprocessing as mp
+import os
+import traceback
+
+from .launch_utils import find_free_ports
+
+__all__ = ["spawn", "ParallelEnvArgs"]
+
+
+class ParallelEnvArgs:
+    """kwargs holder (reference spawn.py ParallelEnvArgs)."""
+
+    def __init__(self):
+        self.cluster_node_ips = "127.0.0.1"
+        self.node_ip = "127.0.0.1"
+        self.started_port = None
+        self.selected_devices = None
+        self.print_config = True
+        self.use_paddlecloud = False
+
+
+def _worker(func, i, args, env, error_queue):
+    os.environ.update(env)
+    try:
+        func(*args)
+    except KeyboardInterrupt:
+        pass
+    except Exception:
+        error_queue.put((i, traceback.format_exc()))
+        raise
+
+
+def spawn(func, args=(), nprocs=1, join=True, daemon=False, **options):
+    """Run func in nprocs processes with the trainer env contract set."""
+    ports = options.get("started_port")
+    if ports is None:
+        ports = find_free_ports(nprocs)
+    else:
+        ports = list(range(ports, ports + nprocs))
+    ip = options.get("node_ip", "127.0.0.1")
+    endpoints = [f"{ip}:{p}" for p in ports]
+
+    ctx = mp.get_context("spawn")
+    error_queue = ctx.SimpleQueue()
+    procs = []
+    for rank in range(nprocs):
+        env = {
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_CURRENT_ENDPOINT": endpoints[rank],
+            "PADDLE_TRAINERS_NUM": str(nprocs),
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(endpoints),
+            "PADDLE_MASTER": endpoints[0],
+        }
+        env.update(options.get("env", {}))
+        p = ctx.Process(target=_worker,
+                        args=(func, rank, args, env, error_queue),
+                        daemon=daemon)
+        p.start()
+        procs.append(p)
+
+    if not join:
+        return procs
+
+    for p in procs:
+        p.join()
+    failures = [p for p in procs if p.exitcode != 0]
+    if failures:
+        msgs = []
+        while not error_queue.empty():
+            rank, tb = error_queue.get()
+            msgs.append(f"-- process {rank} --\n{tb}")
+        for p in procs:
+            if p.is_alive():
+                p.terminate()
+        raise RuntimeError("spawned trainer failed:\n" + "\n".join(msgs))
+    return procs

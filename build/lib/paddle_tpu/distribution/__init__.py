@@ -1,0 +1,164 @@
+"""paddle.distribution — probability distributions.
+
+Reference parity: python/paddle/fluid/layers/distributions.py (fluid-era
+Distribution/Normal/Uniform/Categorical/MultivariateNormalDiag) + the
+paddle.distribution 2.x module.  TPU-native: pure jnp math over Tensor
+values; sampling draws explicit PRNG subkeys from the framework RNG chain
+so it is reproducible under seed() and correct under jit tracing
+(rng_guard).
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..tensor import Tensor, unwrap
+
+__all__ = ["Distribution", "Normal", "Uniform", "Categorical",
+           "kl_divergence"]
+
+
+def _val(x):
+    if isinstance(x, Tensor):
+        return x.value
+    return jnp.asarray(x, jnp.float32)
+
+
+class Distribution:
+    def sample(self, shape=()):
+        raise NotImplementedError
+
+    def entropy(self):
+        raise NotImplementedError
+
+    def log_prob(self, value):
+        raise NotImplementedError
+
+    def probs(self, value):
+        return Tensor(jnp.exp(unwrap(self.log_prob(value))))
+
+    def kl_divergence(self, other):
+        return kl_divergence(self, other)
+
+
+class Normal(Distribution):
+    """Reference: distributions.py Normal — loc/scale gaussian."""
+
+    def __init__(self, loc, scale, name=None):
+        self.loc = _val(loc)
+        self.scale = _val(scale)
+
+    def sample(self, shape=(), seed=0):
+        key = _random.split_key()
+        shape = tuple(shape) + tuple(np.broadcast_shapes(
+            np.shape(self.loc), np.shape(self.scale)))
+        eps = jax.random.normal(key, shape, jnp.float32)
+        return Tensor(self.loc + self.scale * eps)
+
+    def rsample(self, shape=()):
+        return self.sample(shape)
+
+    def entropy(self):
+        # 0.5 + 0.5 log(2 pi) + log sigma, broadcast over loc
+        ent = 0.5 + 0.5 * math.log(2 * math.pi) + jnp.log(self.scale)
+        return Tensor(jnp.broadcast_to(
+            ent, np.broadcast_shapes(np.shape(self.loc),
+                                     np.shape(self.scale))))
+
+    def log_prob(self, value):
+        v = _val(value)
+        var = self.scale ** 2
+        return Tensor(-((v - self.loc) ** 2) / (2 * var)
+                      - jnp.log(self.scale) - 0.5 * math.log(2 * math.pi))
+
+    @property
+    def mean(self):
+        return Tensor(jnp.broadcast_to(
+            self.loc, np.broadcast_shapes(np.shape(self.loc),
+                                          np.shape(self.scale))))
+
+    @property
+    def variance(self):
+        return Tensor(jnp.broadcast_to(
+            self.scale ** 2, np.broadcast_shapes(np.shape(self.loc),
+                                                 np.shape(self.scale))))
+
+
+class Uniform(Distribution):
+    """Reference: distributions.py Uniform — [low, high)."""
+
+    def __init__(self, low, high, name=None):
+        self.low = _val(low)
+        self.high = _val(high)
+
+    def sample(self, shape=(), seed=0):
+        key = _random.split_key()
+        shape = tuple(shape) + tuple(np.broadcast_shapes(
+            np.shape(self.low), np.shape(self.high)))
+        u = jax.random.uniform(key, shape, jnp.float32)
+        return Tensor(self.low + (self.high - self.low) * u)
+
+    def entropy(self):
+        return Tensor(jnp.log(self.high - self.low))
+
+    def log_prob(self, value):
+        v = _val(value)
+        inside = (v >= self.low) & (v < self.high)
+        lp = -jnp.log(self.high - self.low)
+        return Tensor(jnp.where(inside, lp, -jnp.inf))
+
+
+class Categorical(Distribution):
+    """Reference: distributions.py Categorical over unnormalized logits."""
+
+    def __init__(self, logits, name=None):
+        self.logits = _val(logits)
+
+    @property
+    def _log_pmf(self):
+        return self.logits - jax.scipy.special.logsumexp(
+            self.logits, axis=-1, keepdims=True)
+
+    def sample(self, shape=()):
+        key = _random.split_key()
+        return Tensor(jax.random.categorical(key, self.logits,
+                                             shape=tuple(shape) +
+                                             self.logits.shape[:-1]))
+
+    def entropy(self):
+        lp = self._log_pmf
+        return Tensor(-(jnp.exp(lp) * lp).sum(-1))
+
+    def log_prob(self, value):
+        idx = unwrap(value).astype(jnp.int32)
+        lp = self._log_pmf
+        if lp.ndim == 1:  # single distribution, batch of values
+            return Tensor(lp[idx])
+        return Tensor(jnp.take_along_axis(
+            lp, idx[..., None], axis=-1).squeeze(-1))
+
+    def probs(self, value):
+        return Tensor(jnp.exp(unwrap(self.log_prob(value))))
+
+
+def kl_divergence(p: Distribution, q: Distribution):
+    """KL(p || q) for matching families (reference: distributions kl_divergence)."""
+    if isinstance(p, Normal) and isinstance(q, Normal):
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1 - jnp.log(var_ratio)))
+    if isinstance(p, Uniform) and isinstance(q, Uniform):
+        # KL finite only if support(p) ⊆ support(q)
+        lp = -jnp.log(p.high - p.low)
+        lq = -jnp.log(q.high - q.low)
+        inside = (p.low >= q.low) & (p.high <= q.high)
+        return Tensor(jnp.where(inside, lp - lq, jnp.inf))
+    if isinstance(p, Categorical) and isinstance(q, Categorical):
+        lp, lq = p._log_pmf, q._log_pmf
+        return Tensor((jnp.exp(lp) * (lp - lq)).sum(-1))
+    raise NotImplementedError(
+        f"kl_divergence({type(p).__name__}, {type(q).__name__})")

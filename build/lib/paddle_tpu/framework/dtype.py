@@ -1,0 +1,82 @@
+"""Dtype system.
+
+Reference parity: paddle/fluid/framework/framework.proto VarType (reference
+framework.proto:106-166) defines the dtype enum; python/paddle/fluid/data_feeder.py
+maps strings.  Here dtypes ARE jax/numpy dtypes — no enum indirection: XLA is the
+only backend, so the canonical dtype object is `jnp.dtype`.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+# Canonical name -> jnp dtype
+_DTYPE_MAP = {
+    "float16": jnp.float16,
+    "bfloat16": jnp.bfloat16,
+    "float32": jnp.float32,
+    "float64": jnp.float64,
+    "int8": jnp.int8,
+    "int16": jnp.int16,
+    "int32": jnp.int32,
+    "int64": jnp.int64,
+    "uint8": jnp.uint8,
+    "uint16": jnp.uint16,
+    "uint32": jnp.uint32,
+    "uint64": jnp.uint64,
+    "bool": jnp.bool_,
+    "complex64": jnp.complex64,
+    "complex128": jnp.complex128,
+}
+
+float16 = jnp.dtype(jnp.float16)
+bfloat16 = jnp.dtype(jnp.bfloat16)
+float32 = jnp.dtype(jnp.float32)
+float64 = jnp.dtype(jnp.float64)
+int8 = jnp.dtype(jnp.int8)
+int16 = jnp.dtype(jnp.int16)
+int32 = jnp.dtype(jnp.int32)
+int64 = jnp.dtype(jnp.int64)
+uint8 = jnp.dtype(jnp.uint8)
+bool_ = jnp.dtype(jnp.bool_)
+complex64 = jnp.dtype(jnp.complex64)
+complex128 = jnp.dtype(jnp.complex128)
+
+_FLOAT_DTYPES = {float16, bfloat16, float32, float64}
+
+_default_dtype = float32
+
+
+def convert_dtype(dtype):
+    """Normalize a string / np.dtype / jnp dtype to a np.dtype object."""
+    if dtype is None:
+        return None
+    if isinstance(dtype, str):
+        if dtype not in _DTYPE_MAP:
+            raise ValueError(f"Unknown dtype {dtype!r}")
+        return jnp.dtype(_DTYPE_MAP[dtype])
+    return jnp.dtype(dtype)
+
+
+def dtype_name(dtype) -> str:
+    return np.dtype(dtype).name if np.dtype(dtype).name != "bool" else "bool"
+
+
+def is_floating(dtype) -> bool:
+    return jnp.dtype(dtype) in _FLOAT_DTYPES
+
+
+def is_integer(dtype) -> bool:
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.integer)
+
+
+def set_default_dtype(d):
+    global _default_dtype
+    d = convert_dtype(d)
+    if d not in (float16, bfloat16, float32, float64):
+        raise TypeError(f"set_default_dtype only accepts float dtypes, got {d}")
+    _default_dtype = d
+
+
+def get_default_dtype():
+    return _default_dtype

@@ -1,0 +1,64 @@
+"""Global runtime flag registry.
+
+Reference parity: paddle/fluid/platform/flags.cc (gflags FLAGS_* registry,
+env-overridable) + pybind/global_value_getter_setter.cc (paddle.set_flags /
+get_flags).  TPU-native: a plain python registry; flags that controlled CUDA
+allocator/cudnn behavior are accepted but inert, flags that map to XLA behavior
+are applied (e.g. check_nan_inf wraps jitted steps with debug checks).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+_REGISTRY: dict[str, Any] = {}
+
+
+def define_flag(name: str, default: Any, help_: str = ""):
+    env = os.environ.get(name.upper(), os.environ.get(name))
+    if env is not None:
+        if isinstance(default, bool):
+            default = env.lower() in ("1", "true", "yes")
+        elif isinstance(default, int):
+            default = int(env)
+        elif isinstance(default, float):
+            default = float(env)
+        else:
+            default = env
+    _REGISTRY[name] = default
+
+
+# Mirrors of the reference's commonly used flags (platform/flags.cc:33-565).
+define_flag("FLAGS_check_nan_inf", False, "per-op nan/inf checks in debug mode")
+define_flag("FLAGS_benchmark", False, "sync after each op for timing")
+define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "inert: XLA owns memory")
+define_flag("FLAGS_fraction_of_gpu_memory_to_use", 0.92, "inert on TPU")
+define_flag("FLAGS_use_pallas_kernels", True, "swap in Pallas fused kernels (TPU)")
+define_flag("FLAGS_cudnn_deterministic", False, "inert; XLA is deterministic")
+define_flag("FLAGS_sort_sum_gradient", False, "grad accumulation order")
+define_flag("FLAGS_max_inplace_grad_add", 0, "inert")
+define_flag("FLAGS_selected_gpus", "", "inert; device selection via set_device")
+
+
+def set_flags(flags: dict[str, Any]):
+    for k, v in flags.items():
+        _REGISTRY[k] = v
+    # mirror into the native runtime core so C++ components see the same
+    # registry (platform/flags.cc role; no-op without the native lib)
+    try:
+        from .. import core as _native
+        if _native.available():
+            for k, v in flags.items():
+                _native.flag_set(k, v)
+    except Exception:
+        pass
+
+
+def get_flags(keys):
+    if isinstance(keys, str):
+        keys = [keys]
+    return {k: _REGISTRY.get(k) for k in keys}
+
+
+def flag(name: str, default=None):
+    return _REGISTRY.get(name, default)

@@ -1,0 +1,37 @@
+"""paddle.save / paddle.load — pickled state_dict checkpointing.
+
+Reference parity: python/paddle/framework/io.py (save:200 / load:269).
+Tensors are stored as numpy arrays; nested dict/list structures round-trip.
+Sharded multi-host checkpoints live in paddle_tpu.utils.checkpoint (orbax).
+"""
+from __future__ import annotations
+
+import os
+import pickle
+
+import numpy as np
+
+
+def _to_storable(obj):
+    from ..tensor import Tensor
+
+    if isinstance(obj, Tensor):
+        return np.asarray(obj.numpy())
+    if isinstance(obj, dict):
+        return {k: _to_storable(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return type(obj)(_to_storable(v) for v in obj)
+    return obj
+
+
+def save(obj, path, protocol=4, **configs):
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "wb") as f:
+        pickle.dump(_to_storable(obj), f, protocol=protocol)
+
+
+def load(path, **configs):
+    with open(path, "rb") as f:
+        return pickle.load(f)

@@ -1,0 +1,82 @@
+"""Seeded RNG state.
+
+Reference parity: paddle/fluid/framework/generator.cc (per-device seeded
+generator) + paddle.seed.  TPU-native: a splittable JAX PRNG key chain.  Eager
+ops draw fresh subkeys by splitting a global state; traced/functional code must
+run under `rng_guard(key)` so randomness is explicit and reproducible under jit
+(no hidden state inside a compiled function).
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+import jax
+
+
+class _GeneratorState(threading.local):
+    """Key creation is LAZY: touching jax.random at import time would
+    initialize the XLA backend and break a later
+    jax.distributed.initialize() (it must run before any backend use —
+    the multi-process fleet/launch path)."""
+
+    def __init__(self):
+        self._key = None
+        self.seed_value = 0
+        # stack of explicitly-provided keys for traced code
+        self.guard_stack: list = []
+
+    @property
+    def key(self):
+        if self._key is None:
+            self._key = jax.random.PRNGKey(self.seed_value)
+        return self._key
+
+    @key.setter
+    def key(self, k):
+        self._key = k
+
+
+_state = _GeneratorState()
+
+
+def seed(s: int):
+    # lazy: materializing the key here would initialize the XLA backend,
+    # breaking a later jax.distributed.initialize() (seed-before-init is a
+    # normal reproducibility pattern)
+    _state.seed_value = int(s)
+    _state._key = None
+    return _state
+
+
+def get_seed() -> int:
+    return _state.seed_value
+
+
+def split_key(n: int = 1):
+    """Draw fresh subkey(s). Inside an rng_guard, split the guarded key
+    (pure w.r.t. the trace); otherwise advance the global eager chain."""
+    if _state.guard_stack:
+        key = _state.guard_stack[-1]
+        keys = jax.random.split(key, n + 1)
+        _state.guard_stack[-1] = keys[0]
+        return keys[1] if n == 1 else keys[1:]
+    _state.key, *sub = jax.random.split(_state.key, n + 1)
+    return sub[0] if n == 1 else sub
+
+
+@contextlib.contextmanager
+def rng_guard(key):
+    """Make `key` the source of randomness for the enclosed (usually traced)
+    region. `key` may be a PRNGKey or an int seed."""
+    if isinstance(key, int):
+        key = jax.random.PRNGKey(key)
+    _state.guard_stack.append(key)
+    try:
+        yield
+    finally:
+        _state.guard_stack.pop()
+
+
+def in_rng_guard() -> bool:
+    return bool(_state.guard_stack)

@@ -1,0 +1,11 @@
+from .model import Model  # noqa: F401
+from .model import summary  # noqa: F401
+from . import callbacks  # noqa: F401
+from .callbacks import (  # noqa: F401
+    Callback,
+    EarlyStopping,
+    LRScheduler,
+    ModelCheckpoint,
+    ProgBarLogger,
+    VisualDL,
+)

@@ -1,0 +1,349 @@
+"""High-level Model API (fit/evaluate/predict).
+
+Reference parity: python/paddle/hapi/model.py (Model:810 — fit:1299,
+evaluate:1515, predict, train_batch:896; StaticGraphAdapter:224 vs
+DynamicGraphAdapter:609).
+
+TPU-native: there is only ONE adapter — every train/eval batch runs through a
+jit-compiled pure step function (params/buffers/opt-state pytrees in, new
+state out).  This is what the reference's StaticGraphAdapter approximated
+with Program caching, but with autodiff + XLA fusion over the whole step, and
+it subsumes the DynamicGraphAdapter too (the layer's eager state is rebound
+to the new device arrays after each step, so dygraph-style inspection still
+works between batches).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .. import amp as amp_mod
+from ..framework import random as _random
+from ..io import DataLoader, Dataset
+from ..metric import Metric
+from ..nn.layer_base import Layer, functional_call, state_pytrees
+from ..tensor import Tensor, unwrap
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+def _as_tensor(x):
+    return x if isinstance(x, Tensor) else Tensor(np.asarray(x))
+
+
+class Model:
+    def __init__(self, network: Layer, inputs=None, labels=None):
+        self.network = network
+        self._inputs = inputs
+        self._labels = labels
+        self._optimizer = None
+        self._loss = None
+        self._metrics = []
+        self._train_step_fn = None
+        self._eval_fn = None
+        self.stop_training = False
+
+    # -- setup -------------------------------------------------------------
+    def prepare(self, optimizer=None, loss=None, metrics=None,
+                amp_configs=None):
+        self._optimizer = optimizer
+        self._loss = loss
+        self._metrics = [m for m in _to_list(metrics)
+                         if isinstance(m, Metric)]
+        self._train_step_fn = None
+        self._eval_fn = None
+        return self
+
+    # -- compiled steps ----------------------------------------------------
+    def _split_params(self):
+        params, buffers = state_pytrees(self.network)
+        named = dict(self.network.named_parameters())
+        trainable = {k: v for k, v in params.items()
+                     if not named[k].stop_gradient}
+        frozen = {k: v for k, v in params.items() if named[k].stop_gradient}
+        return trainable, frozen, buffers
+
+    def _build_train_step(self):
+        network, loss_layer, opt = self.network, self._loss, self._optimizer
+
+        @jax.jit
+        def step(trainable, frozen, buffers, opt_state, lr, t, rng, inputs,
+                 labels):
+            def loss_fn(tr):
+                all_params = {**tr, **frozen}
+                outs, new_buffers = functional_call(
+                    network, all_params, tuple(inputs), {}, buffers=buffers,
+                    rng=rng)
+                outs_l = _to_list(outs)
+                if callable(loss_layer):
+                    lv = loss_layer(*(outs_l + list(labels)))
+                else:
+                    raise RuntimeError("prepare() a loss before fit()")
+                lv = lv if isinstance(lv, Tensor) else _as_tensor(lv)
+                return jnp.mean(lv.value), (outs, new_buffers)
+
+            (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(trainable)
+            new_params, new_opt_state = opt.apply_pytree(
+                trainable, grads, opt_state, lr=lr, step=t)
+            return new_params, new_buffers, new_opt_state, loss_val, outs
+
+        return step
+
+    def _build_eval_step(self):
+        network, loss_layer = self.network, self._loss
+
+        @jax.jit
+        def step(params, buffers, rng, inputs, labels):
+            outs, _ = functional_call(network, params, tuple(inputs), {},
+                                      buffers=buffers, rng=rng)
+            outs_l = _to_list(outs)
+            if loss_layer is not None and labels:
+                lv = loss_layer(*(outs_l + list(labels)))
+                return outs, jnp.mean(unwrap(lv))
+            return outs, jnp.zeros(())
+
+        return step
+
+    def _write_back(self, trainable, buffers):
+        named = dict(self.network.named_parameters())
+        for k, v in trainable.items():
+            named[k]._value = v
+        bmap = dict(self.network.named_buffers())
+        for k, v in buffers.items():
+            bmap[k]._value = v
+
+    # -- batch-level API ---------------------------------------------------
+    def train_batch(self, inputs, labels=None, update=True):
+        self.network.train()
+        if self._train_step_fn is None:
+            self._train_step_fn = self._build_train_step()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        trainable, frozen, buffers = self._split_params()
+        opt = self._optimizer
+        opt_state = getattr(self, "_opt_state", None)
+        if opt_state is None:
+            opt_state = opt.init_pytree(trainable)
+        opt._step_count += 1
+        rng = _random.split_key()
+        new_params, new_buffers, new_opt_state, loss_val, outs = \
+            self._train_step_fn(
+                trainable, frozen, buffers, opt_state,
+                jnp.asarray(opt.get_lr(), jnp.float32),
+                jnp.asarray(opt._step_count, jnp.int32), rng,
+                inputs, labels)
+        self._write_back(new_params, new_buffers)
+        self._opt_state = new_opt_state
+        metrics_out = [float(loss_val)]
+        for m in self._metrics:
+            m.update(unwrap(m.compute(*( _to_list(outs) + labels))))
+        return metrics_out if len(metrics_out) > 1 else metrics_out[0]
+
+    def eval_batch(self, inputs, labels=None):
+        self.network.eval()
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_step()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        labels = [_as_tensor(x) for x in _to_list(labels)]
+        params, buffers = state_pytrees(self.network)
+        rng = _random.split_key()
+        outs, loss_val = self._eval_fn(params, buffers, rng, inputs, labels)
+        return outs, float(loss_val)
+
+    def predict_batch(self, inputs):
+        self.network.eval()
+        inputs = [_as_tensor(x) for x in _to_list(inputs)]
+        with jax.disable_jit() if False else _noop():
+            outs, _ = self.eval_batch_no_loss(inputs)
+        return outs
+
+    def eval_batch_no_loss(self, inputs):
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_step()
+        params, buffers = state_pytrees(self.network)
+        rng = _random.split_key()
+        outs, lv = self._eval_fn(params, buffers, rng, inputs, [])
+        return outs, lv
+
+    # -- loop-level API ----------------------------------------------------
+    def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
+            eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
+            drop_last=False, shuffle=True, num_workers=0, callbacks=None,
+            accumulate_grad_batches=1, num_iters=None):
+        from .callbacks import config_callbacks
+
+        loader = train_data if isinstance(train_data, DataLoader) else \
+            DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
+                       drop_last=drop_last, num_workers=num_workers)
+        try:
+            steps = len(loader)
+        except TypeError:
+            steps = None
+        self._save_dir = save_dir
+        self.stop_training = False
+        cbks = config_callbacks(
+            callbacks, model=self, batch_size=batch_size, epochs=epochs,
+            steps=steps, log_freq=log_freq, verbose=verbose,
+            save_freq=save_freq, save_dir=save_dir,
+            metrics=[m._name for m in self._metrics])
+        from .callbacks import LRScheduler as _LRCb
+        from .callbacks import ModelCheckpoint as _CkptCb
+        from .callbacks import ProgBarLogger as _PBCb
+
+        # metric.accumulate() is host-side work — only compute per-batch
+        # when a log step fires or a user callback might consume it
+        user_cbs = any(not isinstance(c, (_PBCb, _LRCb, _CkptCb))
+                       for c in cbks)
+        history = {"loss": []}
+        it_count = 0
+        cbks.on_train_begin({})
+        try:
+            for epoch in range(epochs):
+                self.network.train()
+                for m in self._metrics:
+                    m.reset()
+                cbks.on_epoch_begin(epoch, {})
+                losses = []
+                for step_i, batch in enumerate(loader):
+                    cbks.on_train_batch_begin(step_i, {})
+                    batch = _to_list(batch)
+                    inputs, labels = self._split_batch(batch)
+                    loss = self.train_batch(inputs, labels)
+                    losses.append(loss if np.isscalar(loss) else loss[0])
+                    it_count += 1
+                    logs = {"loss": losses[-1], "batch_size": batch_size}
+                    if user_cbs or (log_freq and step_i % log_freq == 0):
+                        for m in self._metrics:
+                            logs[m._name] = np.mean(
+                                _to_list(m.accumulate()))
+                    cbks.on_train_batch_end(step_i, logs)
+                    if num_iters is not None and it_count >= num_iters:
+                        break
+                history["loss"].append(float(np.mean(losses)))
+                epoch_logs = {"loss": history["loss"][-1]}
+                for m in self._metrics:
+                    epoch_logs[m._name] = np.mean(_to_list(m.accumulate()))
+                if eval_data is not None and (epoch + 1) % eval_freq == 0:
+                    cbks.on_eval_begin({})
+                    eval_res = self.evaluate(eval_data,
+                                             batch_size=batch_size,
+                                             verbose=0)
+                    history.setdefault("eval_loss", []).append(
+                        eval_res.get("loss"))
+                    epoch_logs.update({f"eval_{k}": v
+                                       for k, v in eval_res.items()})
+                    cbks.on_eval_end(eval_res)
+                cbks.on_epoch_end(epoch, epoch_logs)
+                if self.stop_training:
+                    break
+                if num_iters is not None and it_count >= num_iters:
+                    break
+        finally:
+            # a crash mid-fit must still flush/close callback resources
+            cbks.on_train_end({})
+        return history
+
+    def _split_batch(self, batch):
+        n_label = len(_to_list(self._labels)) or 1
+        if len(batch) == 1:
+            return batch, []
+        return batch[:-n_label], batch[-n_label:]
+
+    def evaluate(self, eval_data, batch_size=1, log_freq=10, verbose=2,
+                 num_workers=0, callbacks=None, num_samples=None):
+        loader = eval_data if isinstance(eval_data, DataLoader) else \
+            DataLoader(eval_data, batch_size=batch_size, shuffle=False,
+                       num_workers=num_workers)
+        for m in self._metrics:
+            m.reset()
+        losses = []
+        for batch in loader:
+            batch = _to_list(batch)
+            inputs, labels = self._split_batch(batch)
+            outs, loss = self.eval_batch(inputs, labels)
+            losses.append(loss)
+            for m in self._metrics:
+                m.update(unwrap(m.compute(*( _to_list(outs) +
+                                             [_as_tensor(l) for l in labels]))))
+        res = {"loss": float(np.mean(losses)) if losses else 0.0}
+        for m in self._metrics:
+            res[m._name] = m.accumulate()
+        if verbose:
+            print("Eval:", res, flush=True)
+        return res
+
+    def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
+                verbose=1, callbacks=None):
+        loader = test_data if isinstance(test_data, DataLoader) else \
+            DataLoader(test_data, batch_size=batch_size, shuffle=False,
+                       num_workers=num_workers)
+        outputs = []
+        for batch in loader:
+            batch = _to_list(batch)
+            inputs, _ = self._split_batch(batch)
+            outs, _ = self.eval_batch_no_loss([_as_tensor(x) for x in inputs])
+            outputs.append(outs)
+        if stack_outputs and outputs:
+            from .. import tensor_ops as T
+
+            if isinstance(outputs[0], Tensor):
+                return [T.concat(outputs, axis=0)]
+        return outputs
+
+    # -- persistence -------------------------------------------------------
+    def save(self, path, training=True):
+        from ..framework.io_state import save as fsave
+
+        fsave(self.network.state_dict(), path + ".pdparams")
+        if training and self._optimizer is not None:
+            opt_state = getattr(self, "_opt_state", None)
+            payload = {"step_count": self._optimizer._step_count}
+            if opt_state is not None:
+                payload["opt_state"] = jax.tree_util.tree_map(np.asarray,
+                                                              opt_state)
+            fsave(payload, path + ".pdopt")
+
+    def load(self, path, skip_mismatch=False, reset_optimizer=False):
+        from ..framework.io_state import load as fload
+
+        self.network.set_state_dict(fload(path + ".pdparams"))
+        opt_path = path + ".pdopt"
+        if not reset_optimizer and os.path.exists(opt_path):
+            payload = fload(opt_path)
+            if self._optimizer is not None:
+                self._optimizer._step_count = payload.get("step_count", 0)
+            if "opt_state" in payload:
+                self._opt_state = jax.tree_util.tree_map(
+                    jnp.asarray, payload["opt_state"])
+        return self
+
+    def parameters(self, *args, **kwargs):
+        return self.network.parameters()
+
+
+import contextlib as _ctx
+
+
+def _noop():
+    return _ctx.nullcontext()
+
+
+def summary(net, input_size=None, dtypes=None):
+    lines = []
+    total = 0
+    for name, p in net.named_parameters():
+        n = int(np.prod(p.shape))
+        total += n
+        lines.append(f"{name:60s} {str(p.shape):20s} {n}")
+    out = "\n".join(lines) + f"\nTotal params: {total}"
+    print(out)
+    return {"total_params": total}

@@ -1,0 +1,355 @@
+"""paddle.inference — the serving path.
+
+Reference parity: paddle/fluid/inference/ (SURVEY.md §2.6):
+  * AnalysisConfig        → Config (api/analysis_config.cc knob surface;
+                            CUDA/MKLDNN/TensorRT knobs accepted and inert)
+  * AnalysisPredictor     → Predictor (api/analysis_predictor.cc:306 Run /
+                            ZeroCopyRun) — named input/output handles
+  * save/load_inference_model (fluid io.py:1198/1411) — export artifact
+TPU-native: the "optimized program" is an AOT-compiled function.  Export
+serializes the jitted forward as StableHLO via jax.export (.pdexport) plus
+weights (.pdiparams) and an input-spec manifest (.pdmodel.json); the
+predictor deserializes and calls it — no Python model code needed at serve
+time (the AnalysisPredictor contract).  A pickle fallback (.pdmodel) keeps
+models with python-side control flow loadable.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.dtype import convert_dtype
+from ..tensor import Tensor
+
+__all__ = ["Config", "Predictor", "create_predictor",
+           "save_inference_model", "load_inference_model", "PrecisionType"]
+
+
+class PrecisionType:
+    Float32 = 0
+    Half = 1
+    Bfloat16 = 2
+    Int8 = 3
+
+
+def _natural_key(name):
+    """Sort key splitting digit runs so x2 < x10 (AnalysisPredictor binds
+    feeds by declaration order; numeric-suffix names must follow it)."""
+    import re
+    return [int(p) if p.isdigit() else p
+            for p in re.split(r"(\d+)", str(name))]
+
+
+class Config:
+    """AnalysisConfig parity (api/analysis_config.cc)."""
+
+    def __init__(self, model_dir=None, prog_file=None, params_file=None):
+        # paddle 2.x: Config(path_prefix) or Config(model_file, params_file)
+        if model_dir is not None and prog_file is None:
+            self._path_prefix = str(model_dir)
+        elif prog_file is not None:
+            self._path_prefix = os.path.splitext(str(model_dir))[0]
+        else:
+            self._path_prefix = None
+        self._use_tpu = True
+        self._precision = PrecisionType.Float32
+        self._switches = {}
+
+    def set_model(self, model_dir, params_file=None):
+        self._path_prefix = os.path.splitext(str(model_dir))[0]
+
+    def model_dir(self):
+        return self._path_prefix
+
+    # device knobs — TPU is the target; CUDA knobs accepted, inert
+    def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        self._switches["use_gpu"] = True
+
+    def disable_gpu(self):
+        self._switches["use_gpu"] = False
+
+    def enable_xpu(self, *a, **k):
+        self._switches["use_xpu"] = True
+
+    def enable_tpu(self):
+        self._use_tpu = True
+
+    def set_cpu_math_library_num_threads(self, n):
+        self._switches["cpu_threads"] = n
+
+    def enable_mkldnn(self):
+        self._switches["mkldnn"] = True
+
+    def enable_tensorrt_engine(self, *a, **k):
+        self._switches["tensorrt"] = True  # inert: XLA is the engine
+
+    def enable_memory_optim(self):
+        self._switches["memory_optim"] = True
+
+    def switch_ir_optim(self, x=True):
+        self._switches["ir_optim"] = x
+
+    def switch_use_feed_fetch_ops(self, x=False):
+        self._switches["feed_fetch_ops"] = x
+
+    def switch_specify_input_names(self, x=True):
+        self._switches["specify_input_names"] = x
+
+    def set_precision(self, p):
+        self._precision = p
+
+    def summary(self):
+        return json.dumps({"path": self._path_prefix,
+                           "switches": self._switches}, indent=2)
+
+
+class _Handle:
+    """ZeroCopy input/output handle (api/details/zero_copy_tensor.cc)."""
+
+    def __init__(self, name):
+        self.name = name
+        self._value = None
+
+    def reshape(self, shape):
+        pass  # shapes come from the bound array
+
+    def copy_from_cpu(self, arr):
+        self._value = np.ascontiguousarray(arr)
+
+    def copy_to_cpu(self):
+        return np.asarray(self._value)
+
+    def share_external_data(self, arr):
+        self._value = arr
+
+
+class Predictor:
+    """AnalysisPredictor parity: named handles + Run loop."""
+
+    def __init__(self, config: Config):
+        if isinstance(config, str):
+            config = Config(config)
+        self.config = config
+        prefix = config.model_dir()
+        if prefix is None:
+            raise ValueError("Config has no model path")
+        self._load(prefix)
+
+    # -- loading ----------------------------------------------------------
+    def _load(self, prefix):
+        manifest_path = prefix + ".pdmodel.json"
+        export_path = prefix + ".pdexport"
+        if os.path.exists(manifest_path) and os.path.exists(export_path):
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+            with open(export_path, "rb") as f:
+                self._exported = jax.export.deserialize(f.read())
+            self._input_names = manifest["input_names"]
+            self._output_names = manifest["output_names"]
+            params = {}
+            with open(prefix + ".pdiparams", "rb") as f:
+                raw = pickle.load(f)
+            for k, v in raw.items():
+                params[k] = jnp.asarray(v)
+            self._params = params
+            self._mode = "aot"
+            return
+        # fallback: pickled Layer artifact (paddle_tpu.jit.save format)
+        from .. import jit as _jit
+        layer = _jit.load(prefix)
+        layer.eval()
+        from ..nn.layer_base import functional_call, state_pytrees
+        params, buffers = state_pytrees(layer)
+
+        def fwd(params, *args):
+            out, _ = functional_call(layer, params,
+                                     tuple(Tensor(a) for a in args),
+                                     buffers=buffers)
+            if isinstance(out, (tuple, list)):
+                return tuple(o.value for o in out)
+            return (out.value,)
+
+        self._params = params
+        self._jitted = jax.jit(fwd)
+        self._input_names = None  # discovered at first run
+        self._output_names = None
+        self._mode = "jit"
+
+    # -- handle API (reference get_input_handle/get_output_handle) --------
+    def get_input_names(self):
+        return list(self._input_names or [])
+
+    def get_output_names(self):
+        return list(self._output_names or [])
+
+    def get_input_handle(self, name):
+        if not hasattr(self, "_in_handles"):
+            self._in_handles = {}
+        return self._in_handles.setdefault(name, _Handle(name))
+
+    def get_output_handle(self, name):
+        if not hasattr(self, "_out_handles"):
+            self._out_handles = {}
+        return self._out_handles.setdefault(name, _Handle(name))
+
+    def run(self, inputs=None):
+        """Run with positional numpy inputs (returns list of numpy), or
+        with bound handles when inputs is None (ZeroCopyRun path)."""
+        if inputs is None:
+            # Natural-sort fallback: lexicographic sorted() would bind x10
+            # before x2 for models with 11+ inputs (advisor r1/r2 finding).
+            names = self._input_names or sorted(
+                getattr(self, "_in_handles", {}), key=_natural_key)
+            inputs = [self._in_handles[n]._value for n in names]
+        arrays = [jnp.asarray(np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x)) for x in inputs]
+        if self._mode == "aot":
+            outs = self._exported.call(*jax.tree.leaves(self._params),
+                                       *arrays)
+        else:
+            outs = self._jitted(self._params, *arrays)
+            if self._input_names is None:
+                self._input_names = [f"x{i}" for i in range(len(arrays))]
+                self._output_names = [f"out{i}" for i in range(len(outs))]
+        outs = [np.asarray(o) for o in (outs if isinstance(outs, (tuple, list))
+                                        else [outs])]
+        for i, n in enumerate(self._output_names or []):
+            if hasattr(self, "_out_handles") and n in self._out_handles:
+                self._out_handles[n]._value = outs[i]
+        return outs
+
+
+def create_predictor(config):
+    return Predictor(config)
+
+
+def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
+                         input_spec=None, example_inputs=None):
+    """Export a Layer for serving.
+
+    TPU form: save_inference_model(prefix, layer, example_inputs=[...])
+    — AOT-serializes the jitted forward (StableHLO) + weights + manifest.
+    The fluid (executor, feed_names, fetch_targets) signature is accepted
+    via paddle_tpu.distributed.fleet.save_inference_model.
+    Reference: fluid io.py save_inference_model:1198.
+    """
+    from ..nn.layer_base import Layer, functional_call, state_pytrees
+
+    layer = layer_or_feed
+    if not isinstance(layer, Layer):
+        raise TypeError("save_inference_model expects a Layer; for the "
+                        "fluid executor signature use fleet.save_inference_model")
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    was_training = layer.training
+    layer.eval()
+    try:
+        params, buffers = state_pytrees(layer)
+
+        # Dynamic dims (-1/None) in an InputSpec export symbolically via
+        # jax.export so the served artifact accepts ANY size there. Baking
+        # -1 to a concrete 1 (the old behavior) silently served batch-1
+        # only (advisor r1/r2 finding).
+        sym_in_specs = None
+        manifest_shapes = None
+        if input_spec is not None and example_inputs is not None:
+            if len(input_spec) != len(example_inputs):
+                raise ValueError(
+                    f"input_spec has {len(input_spec)} entries but "
+                    f"example_inputs has {len(example_inputs)}")
+            for i, (s, a) in enumerate(zip(input_spec, example_inputs)):
+                ashape = tuple(np.shape(np.asarray(
+                    a.numpy() if isinstance(a, Tensor) else a)))
+                if len(s.shape) != len(ashape) or any(
+                        d is not None and d >= 0 and d != ad
+                        for d, ad in zip(s.shape, ashape)):
+                    raise ValueError(
+                        f"input_spec[{i}] shape {list(s.shape)} does not "
+                        f"match example_inputs[{i}] shape {list(ashape)}")
+        if input_spec is not None:
+            manifest_shapes = [[-1 if (d is None or d < 0) else int(d)
+                                for d in s.shape] for s in input_spec]
+            if any(d < 0 for shp in manifest_shapes for d in shp):
+                scope = jax.export.SymbolicScope()
+                sym_in_specs = []
+                for i, s in enumerate(input_spec):
+                    dims = ",".join(
+                        f"d{i}_{j}" if (d is None or d < 0) else str(d)
+                        for j, d in enumerate(s.shape))
+                    shape = jax.export.symbolic_shape(dims, scope=scope)
+                    sym_in_specs.append(jax.ShapeDtypeStruct(
+                        shape, np.dtype(convert_dtype(s.dtype))))
+        if example_inputs is None and input_spec is not None:
+            example_inputs = [
+                np.zeros([d if d and d > 0 else 1 for d in s.shape],
+                         convert_dtype(s.dtype)) for s in input_spec]
+        # weights always saved (also used by the pickle fallback path)
+        with open(path_prefix + ".pdiparams", "wb") as f:
+            pickle.dump({k: np.asarray(v) for k, v in params.items()}, f)
+        from .. import jit as _jit
+        _jit.save(layer, path_prefix)  # .pdmodel pickle fallback artifact
+
+        if example_inputs is None:
+            return path_prefix
+
+        def fwd(*flat):
+            n_par = len(jax.tree.leaves(params))
+            par = jax.tree.unflatten(jax.tree.structure(params),
+                                     flat[:n_par])
+            args = flat[n_par:]
+            out, _ = functional_call(layer, par,
+                                     tuple(Tensor(a) for a in args),
+                                     buffers=buffers)
+            if isinstance(out, (tuple, list)):
+                return tuple(o.value for o in out)
+            return (out.value,)
+
+        arrays = [jnp.asarray(np.asarray(
+            x.numpy() if isinstance(x, Tensor) else x))
+            for x in example_inputs]
+        in_specs = sym_in_specs if sym_in_specs is not None else [
+            jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+        specs = [jax.ShapeDtypeStruct(a.shape, a.dtype)
+                 for a in jax.tree.leaves(params)] + list(in_specs)
+        try:
+            exported = jax.export.export(jax.jit(fwd))(*specs)
+        except Exception as e:
+            if sym_in_specs is not None:
+                raise ValueError(
+                    "AOT export with dynamic dims "
+                    f"{[list(s.shape) for s in sym_in_specs]} failed "
+                    "(model not traceable with symbolic shapes: "
+                    f"{type(e).__name__}: {e}). Pass concrete "
+                    "example_inputs to export a fixed-shape artifact."
+                ) from e
+            raise
+        with open(path_prefix + ".pdexport", "wb") as f:
+            f.write(exported.serialize())
+        manifest = {
+            "input_names": [f"x{i}" for i in range(len(arrays))],
+            "output_names": [f"out{i}"
+                             for i in range(len(exported.out_avals))],
+            "input_specs": [{"shape": (manifest_shapes[i] if manifest_shapes
+                                       else list(a.shape)),
+                             "dtype": str(a.dtype)}
+                            for i, a in enumerate(arrays)],
+            "format": "jax.export/stablehlo",
+        }
+        with open(path_prefix + ".pdmodel.json", "w") as f:
+            json.dump(manifest, f, indent=2)
+        return path_prefix
+    finally:
+        if was_training:
+            layer.train()
+
+
+def load_inference_model(path_prefix, executor=None):
+    """Returns a Predictor (the fluid triple (program, feed, fetch) has no
+    TPU analog — the predictor IS the optimized program).
+    Reference: fluid io.py load_inference_model:1411."""
+    return Predictor(Config(path_prefix))

@@ -1,0 +1,433 @@
+"""dygraph→static AST conversion of data-dependent python control flow.
+
+Reference parity: python/paddle/fluid/dygraph/dygraph_to_static/
+(program_translator.py:233 StaticFunction.__call__ → ast_transformer.py
+DygraphToStaticAst; convert_operators.py convert_ifelse/convert_while).
+The reference rewrites python ``if``/``while``/``for`` over tensors into
+cond/while program ops; here the same source rewrite targets
+``static.nn.cond`` / ``static.nn.while_loop``, which lower to XLA's
+structured control flow — so a to_static'd model with data-dependent
+branching compiles into ONE jitted program with both branches live.
+
+Architecture (mirrors the reference's two halves, re-designed for jax):
+
+* AST pass (:class:`ControlFlowTransformer`): turns each ``if``/``while``/
+  ``for range()`` statement into nested closures plus a call to a runtime
+  dispatch helper. Writes inside a branch/loop-body become function
+  parameters + returns (closure conversion); reads come for free from
+  python's lexical scoping.
+* runtime dispatch (``_jst_if`` / ``_jst_while``): checks whether the
+  predicate is a traced/jax value at RUN time — tensor predicates route to
+  ``static.nn.cond``/``while_loop`` (compiled, both branches live), plain
+  python values run as ordinary python (the reference's
+  convert_operators.py:40 does exactly this dispatch).
+
+Unsupported constructs (break/continue inside converted loops, mixed
+return/fall-through branches) raise ConversionError; ``to_static`` then
+falls back to plain tracing, which is the reference's behavior for
+untransformable code paths.
+"""
+from __future__ import annotations
+
+import ast
+import functools
+import inspect
+import textwrap
+
+
+class ConversionError(Exception):
+    """Source can't be converted; caller falls back to plain tracing."""
+
+
+_UNDEF = object()  # placeholder for branch-local names unbound at entry
+
+
+def _is_traced(x):
+    import jax
+
+    from ..tensor import Tensor
+
+    if isinstance(x, Tensor):
+        x = x.value
+    return isinstance(x, (jax.Array, jax.core.Tracer))
+
+
+def _jst_bool(pred):
+    """Python truthiness for non-tensor predicates."""
+    return bool(pred)
+
+
+def _jst_if(pred, true_fn, false_fn, init_vals):
+    """convert_ifelse analog: tensor pred → static.nn.cond with both
+    branches traced; python pred → plain dispatch."""
+    if not _is_traced(pred):
+        return true_fn(*init_vals) if pred else false_fn(*init_vals)
+    from ..static import nn as snn
+
+    out = snn.cond(pred, lambda: _check_defined(true_fn(*init_vals)),
+                   lambda: _check_defined(false_fn(*init_vals)))
+    return out
+
+
+def _check_defined(vals):
+    if isinstance(vals, tuple):
+        for v in vals:
+            if v is _UNDEF:
+                raise ConversionError(
+                    "a variable assigned in only one branch of a converted "
+                    "`if` is used afterwards; assign it in both branches "
+                    "(or before the if) for tensor-predicate conversion")
+    return vals
+
+
+def _jst_while(cond_fn, body_fn, loop_vars):
+    """convert_while analog: tensor condition → static.nn.while_loop;
+    python condition → ordinary loop."""
+    first = cond_fn(*loop_vars)
+    if not _is_traced(first) and not any(_is_traced(v) for v in loop_vars):
+        vals = tuple(loop_vars)
+        while cond_fn(*vals):
+            out = body_fn(*vals)
+            vals = out if isinstance(out, tuple) else (out,)
+        return vals
+    from ..static import nn as snn
+
+    if any(v is _UNDEF for v in loop_vars):
+        raise ConversionError(
+            "a loop variable of a tensor-bounded converted loop is not "
+            "defined before the loop; initialize loop-local temporaries "
+            "before `while`/`for` when the trip count is a tensor")
+    return tuple(snn.while_loop(cond_fn, body_fn, tuple(loop_vars)))
+
+
+class _StoreCollector(ast.NodeVisitor):
+    """Names assigned (stored) in a statement list, in first-seen order.
+    Does not descend into nested function/class definitions."""
+
+    def __init__(self):
+        self.names: list[str] = []
+
+    def _add(self, n):
+        if n not in self.names:
+            self.names.append(n)
+
+    def visit_Name(self, node):
+        if isinstance(node.ctx, (ast.Store, ast.Del)):
+            self._add(node.id)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node):
+        if isinstance(node.target, ast.Name):
+            self._add(node.target.id)
+        self.generic_visit(node)
+
+    def visit_FunctionDef(self, node):
+        self._add(node.name)  # the def binds the name; don't descend
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_ClassDef(self, node):
+        self._add(node.name)
+
+    def visit_Lambda(self, node):
+        pass
+
+
+def _stores(stmts) -> list[str]:
+    c = _StoreCollector()
+    for s in stmts:
+        c.visit(s)
+    return c.names
+
+
+def _has(stmts, *types) -> bool:
+    for s in stmts:
+        for node in ast.walk(s):
+            if isinstance(node, types):
+                return True
+    return False
+
+
+def _name(id_, ctx=None):
+    return ast.Name(id=id_, ctx=ctx or ast.Load())
+
+
+def _try_eval_expr(var: str):
+    # _jst_maybe(lambda: var) — returns _UNDEF when the name is unbound
+    return ast.Call(
+        func=_name("_jst_maybe"),
+        args=[ast.Lambda(
+            args=ast.arguments(posonlyargs=[], args=[], kwonlyargs=[],
+                               kw_defaults=[], defaults=[]),
+            body=_name(var))],
+        keywords=[])
+
+
+def _jst_maybe(thunk):
+    try:
+        return thunk()
+    except (NameError, UnboundLocalError):
+        return _UNDEF
+
+
+class ControlFlowTransformer(ast.NodeTransformer):
+    """Closure-converts if/while/for-range statements into dispatch-helper
+    calls (the DygraphToStaticAst analog)."""
+
+    def __init__(self):
+        self._n = 0
+
+    def _uid(self):
+        self._n += 1
+        return self._n
+
+    # -- helpers ----------------------------------------------------------
+
+    def _fn_def(self, name, params, body, returns):
+        """def name(p0, p1, ...):  <body>;  return (r0, r1, ...)"""
+        body = list(body)
+        if returns is not None:
+            ret_val = (ast.Tuple(elts=[_name(r) for r in returns],
+                                 ctx=ast.Load())
+                       if len(returns) != 1 else _name(returns[0]))
+            body.append(ast.Return(value=ret_val))
+        if not body:
+            body = [ast.Pass()]
+        return ast.FunctionDef(
+            name=name,
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=p) for p in params],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=body, decorator_list=[])
+
+    def _assign_targets(self, names, value):
+        tgt = (ast.Tuple(elts=[_name(n, ast.Store()) for n in names],
+                         ctx=ast.Store())
+               if len(names) != 1 else _name(names[0], ast.Store()))
+        return ast.Assign(targets=[tgt], value=value)
+
+    # -- if ---------------------------------------------------------------
+
+    def visit_If(self, node):
+        node = self._generic_body_visit(node)
+        body, orelse = node.body, node.orelse
+
+        body_returns = _has(body, ast.Return)
+        else_returns = _has(orelse, ast.Return) if orelse else False
+        if body_returns or else_returns:
+            # only the uniform shape `if c: return a [else: return b]`
+            # (return as the final statement of each branch) converts;
+            # `if c: return a` + trailing statements was merged into this
+            # shape by _merge_tail_returns before transformation
+            def _ret_ok(stmts):
+                return (stmts and isinstance(stmts[-1], ast.Return)
+                        and not _has(stmts[:-1], ast.Return))
+
+            if not orelse or not (_ret_ok(body) and _ret_ok(orelse)):
+                raise ConversionError(
+                    "mixed return/fall-through in converted `if`")
+            t_body, f_body = body, orelse
+            uid = self._uid()
+            tfn, ffn = f"__jst_true_{uid}", f"__jst_false_{uid}"
+            t_def = ast.FunctionDef(
+                name=tfn, args=ast.arguments(
+                    posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                    defaults=[]),
+                body=t_body, decorator_list=[])
+            f_def = ast.FunctionDef(
+                name=ffn, args=ast.arguments(
+                    posonlyargs=[], args=[], kwonlyargs=[], kw_defaults=[],
+                    defaults=[]),
+                body=f_body, decorator_list=[])
+            call = ast.Call(func=_name("_jst_if"),
+                            args=[node.test,
+                                  _name(tfn), _name(ffn),
+                                  ast.Tuple(elts=[], ctx=ast.Load())],
+                            keywords=[])
+            return [t_def, f_def, ast.Return(value=call)]
+
+        out_vars = sorted(set(_stores(body)) | set(_stores(orelse)))
+        if not out_vars:
+            # side-effect-only branches (e.g. list.append) can't convert;
+            # leave as python `if` — works for python preds, traced preds
+            # will raise TracerBoolConversionError at jit time, matching
+            # the un-converted baseline
+            return node
+        uid = self._uid()
+        tfn, ffn = f"__jst_true_{uid}", f"__jst_false_{uid}"
+        t_def = self._fn_def(tfn, out_vars, body, out_vars)
+        f_def = self._fn_def(ffn, out_vars, orelse, out_vars)
+        init = ast.Tuple(elts=[_try_eval_expr(v) for v in out_vars],
+                         ctx=ast.Load())
+        call = ast.Call(func=_name("_jst_if"),
+                        args=[node.test, _name(tfn), _name(ffn), init],
+                        keywords=[])
+        return [t_def, f_def, self._assign_targets(out_vars, call)]
+
+    # -- while ------------------------------------------------------------
+
+    def visit_While(self, node):
+        node = self._generic_body_visit(node)
+        if node.orelse:
+            raise ConversionError("while/else does not convert")
+        if _has(node.body, ast.Break, ast.Continue, ast.Return):
+            raise ConversionError(
+                "break/continue/return inside a converted while loop")
+        loop_vars = _stores(node.body)
+        if not loop_vars:
+            return node
+        uid = self._uid()
+        cfn, bfn = f"__jst_cond_{uid}", f"__jst_body_{uid}"
+        c_def = self._fn_def(cfn, loop_vars,
+                             [ast.Return(value=node.test)], None)
+        b_def = self._fn_def(bfn, loop_vars, node.body, loop_vars)
+        init = ast.Tuple(elts=[_try_eval_expr(v) for v in loop_vars],
+                         ctx=ast.Load())
+        call = ast.Call(func=_name("_jst_while"),
+                        args=[_name(cfn), _name(bfn), init], keywords=[])
+        return [c_def, b_def, self._assign_targets(loop_vars, call)]
+
+    # -- for i in range(...) ---------------------------------------------
+
+    def visit_For(self, node):
+        node = self._generic_body_visit(node)
+        is_range = (isinstance(node.iter, ast.Call)
+                    and isinstance(node.iter.func, ast.Name)
+                    and node.iter.func.id == "range"
+                    and 1 <= len(node.iter.args) <= 3
+                    and not node.iter.keywords)
+        if not is_range or not isinstance(node.target, ast.Name):
+            return node  # generic iterables stay python (unrolled if traced)
+        if node.orelse:
+            raise ConversionError("for/else does not convert")
+        if _has(node.body, ast.Break, ast.Continue, ast.Return):
+            raise ConversionError(
+                "break/continue/return inside a converted for loop")
+        uid = self._uid()
+        it, stop, step = (f"__jst_it_{uid}", f"__jst_stop_{uid}",
+                          f"__jst_step_{uid}")
+        a = node.iter.args
+        if len(a) == 1:
+            start_e, stop_e, step_e = ast.Constant(0), a[0], ast.Constant(1)
+        elif len(a) == 2:
+            start_e, stop_e, step_e = a[0], a[1], ast.Constant(1)
+        else:
+            start_e, stop_e, step_e = a
+        pre = [
+            ast.Assign(targets=[_name(it, ast.Store())], value=start_e),
+            ast.Assign(targets=[_name(stop, ast.Store())], value=stop_e),
+            ast.Assign(targets=[_name(step, ast.Store())], value=step_e),
+            # pre-bind the target so it is a defined loop var on the
+            # traced path (python leaves it unbound for empty ranges;
+            # harmless deviation)
+            ast.Assign(targets=[_name(node.target.id, ast.Store())],
+                       value=_name(it)),
+        ]
+        # while __it*sign < __stop*sign:  i = __it; <body>; __it += __step
+        sign = ast.Call(func=_name("_jst_sign"), args=[_name(step)],
+                        keywords=[])
+        test = ast.Compare(
+            left=ast.BinOp(left=_name(it), op=ast.Mult(), right=sign),
+            ops=[ast.Lt()],
+            comparators=[ast.BinOp(left=_name(stop), op=ast.Mult(),
+                                   right=sign)])
+        body = ([ast.Assign(targets=[_name(node.target.id, ast.Store())],
+                            value=_name(it))]
+                + node.body
+                + [ast.AugAssign(target=_name(it, ast.Store()),
+                                 op=ast.Add(), value=_name(step))])
+        wh = ast.While(test=test, body=body, orelse=[])
+        out = pre + self.visit_While(wh)
+        return out
+
+    def _generic_body_visit(self, node):
+        """Recurse into child statement lists first (depth-first)."""
+        for field in ("body", "orelse"):
+            stmts = getattr(node, field, None)
+            if stmts is None:
+                continue
+            stmts = _merge_tail_returns(stmts)
+            new = []
+            for s in stmts:
+                r = self.visit(s) if isinstance(
+                    s, (ast.If, ast.While, ast.For)) else s
+                new.extend(r if isinstance(r, list) else [r])
+            setattr(node, field, new)
+        return node
+
+
+def _jst_sign(step):
+    import jax.numpy as jnp
+
+    if _is_traced(step):
+        return jnp.sign(step)
+    return 1 if step >= 0 else -1
+
+
+def convert_function(fn):
+    """Return an AST-converted version of `fn` (data-dependent python
+    control flow → static.nn dispatch), or raise ConversionError."""
+    try:
+        src = textwrap.dedent(inspect.getsource(fn))
+    except (OSError, TypeError) as e:
+        raise ConversionError(f"source unavailable: {e}") from e
+    try:
+        tree = ast.parse(src)
+    except SyntaxError as e:  # e.g. lambda fragment
+        raise ConversionError(f"unparsable source: {e}") from e
+    fdef = tree.body[0]
+    if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        raise ConversionError("not a function definition")
+    fdef.decorator_list = []  # strip @to_static etc. to avoid recursion
+
+    if not _has(fdef.body, ast.If, ast.While, ast.For):
+        raise ConversionError("no control flow to convert")
+
+    tr = ControlFlowTransformer()
+    new_body = []
+    # `if c: return a` + following statements first becomes if/else with
+    # the remainder as the else branch (ReturnTransformer analog), so the
+    # both-branches-return conversion applies
+    for s in _merge_tail_returns(fdef.body):
+        r = tr.visit(s) if isinstance(s, (ast.If, ast.While, ast.For)) else s
+        new_body.extend(r if isinstance(r, list) else [r])
+    fdef.body = new_body
+    ast.fix_missing_locations(tree)
+
+    glb = dict(fn.__globals__)
+    if fn.__closure__:
+        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
+            try:
+                glb[name] = cell.cell_contents
+            except ValueError as e:
+                raise ConversionError(f"empty closure cell {name}") from e
+    glb.update(_jst_if=_jst_if, _jst_while=_jst_while,
+               _jst_maybe=_jst_maybe, _jst_sign=_jst_sign,
+               _jst_bool=_jst_bool)
+    code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                   mode="exec")
+    ns: dict = {}
+    exec(code, glb, ns)
+    out = ns[fdef.name]
+    out = functools.wraps(fn)(out)
+    out.__dy2static__ = True
+    return out
+
+
+def _merge_tail_returns(body):
+    """Rewrite `if c: return a` followed by trailing statements into an
+    if/else with the remainder as the else branch (ReturnTransformer
+    analog for the most common early-return shape); recursive, so chains
+    of early returns fold into nested if/else."""
+    for i, s in enumerate(body):
+        if (isinstance(s, ast.If) and not s.orelse
+                and s.body and isinstance(s.body[-1], ast.Return)
+                and not _has(s.body[:-1], ast.Return)):
+            rest = _merge_tail_returns(body[i + 1:])
+            if not rest or not _has(rest, ast.Return):
+                break
+            merged = ast.If(test=s.test, body=s.body, orelse=rest)
+            ast.copy_location(merged, s)
+            return body[:i] + [merged]
+    return body

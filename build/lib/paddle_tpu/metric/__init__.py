@@ -1,0 +1,158 @@
+"""paddle.metric — Accuracy/Precision/Recall/Auc.
+
+Reference parity: python/paddle/metric/metrics.py + metric ops
+(operators/metrics/accuracy_op.cc, auc_op.cc).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from ..tensor import Tensor, unwrap
+from .. import tensor_ops as T
+
+
+class Metric:
+    def reset(self):
+        raise NotImplementedError
+
+    def update(self, *args):
+        raise NotImplementedError
+
+    def accumulate(self):
+        raise NotImplementedError
+
+    def name(self):
+        return self._name
+
+    def compute(self, *args):
+        return args
+
+
+class Accuracy(Metric):
+    def __init__(self, topk=(1,), name=None, *args, **kwargs):
+        self.topk = (topk,) if isinstance(topk, int) else tuple(topk)
+        self.maxk = max(self.topk)
+        self._name = name or "acc"
+        self.reset()
+
+    def compute(self, pred, label, *args):
+        pred_np = np.asarray(unwrap(pred))
+        label_np = np.asarray(unwrap(label))
+        idx = np.argsort(-pred_np, axis=-1)[..., : self.maxk]
+        if label_np.ndim == pred_np.ndim:
+            label_np = label_np.squeeze(-1)
+        correct = idx == label_np[..., None]
+        return Tensor(correct.astype(np.float32))
+
+    def update(self, correct, *args):
+        c = np.asarray(unwrap(correct))
+        accs = []
+        for k in self.topk:
+            num = c[..., :k].sum()
+            accs.append(num / max(c.shape[0], 1))
+            self.total[self.topk.index(k)] += num
+            self.count[self.topk.index(k)] += c.shape[0]
+        return accs[0] if len(accs) == 1 else accs
+
+    def reset(self):
+        self.total = [0.0] * len(self.topk)
+        self.count = [0] * len(self.topk)
+
+    def accumulate(self):
+        res = [t / c if c > 0 else 0.0 for t, c in zip(self.total, self.count)]
+        return res[0] if len(res) == 1 else res
+
+    def name(self):
+        if len(self.topk) == 1:
+            return [self._name]
+        return [f"{self._name}_top{k}" for k in self.topk]
+
+
+class Precision(Metric):
+    def __init__(self, name="precision", *args, **kwargs):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds)).round().astype(np.int32).ravel()
+        l = np.asarray(unwrap(labels)).astype(np.int32).ravel()
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fp += int(((p == 1) & (l == 0)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fp = 0
+
+    def accumulate(self):
+        d = self.tp + self.fp
+        return self.tp / d if d else 0.0
+
+
+class Recall(Metric):
+    def __init__(self, name="recall", *args, **kwargs):
+        self._name = name
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds)).round().astype(np.int32).ravel()
+        l = np.asarray(unwrap(labels)).astype(np.int32).ravel()
+        self.tp += int(((p == 1) & (l == 1)).sum())
+        self.fn += int(((p == 0) & (l == 1)).sum())
+
+    def reset(self):
+        self.tp = 0
+        self.fn = 0
+
+    def accumulate(self):
+        d = self.tp + self.fn
+        return self.tp / d if d else 0.0
+
+
+class Auc(Metric):
+    def __init__(self, curve="ROC", num_thresholds=4095, name="auc", *args,
+                 **kwargs):
+        self._name = name
+        self.num_thresholds = num_thresholds
+        self.reset()
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds))
+        if p.ndim == 2:
+            p = p[:, 1]
+        l = np.asarray(unwrap(labels)).ravel()
+        bins = np.clip((p * self.num_thresholds).astype(np.int64), 0,
+                       self.num_thresholds)
+        for b, y in zip(bins.ravel(), l):
+            if y:
+                self._stat_pos[b] += 1
+            else:
+                self._stat_neg[b] += 1
+
+    def reset(self):
+        self._stat_pos = np.zeros(self.num_thresholds + 1, np.int64)
+        self._stat_neg = np.zeros(self.num_thresholds + 1, np.int64)
+
+    def accumulate(self):
+        tot_pos = tot_neg = 0.0
+        auc = 0.0
+        for i in range(self.num_thresholds, -1, -1):
+            new_pos = tot_pos + self._stat_pos[i]
+            new_neg = tot_neg + self._stat_neg[i]
+            auc += (new_pos + tot_pos) * (new_neg - tot_neg) / 2
+            tot_pos, tot_neg = new_pos, new_neg
+        return auc / (tot_pos * tot_neg) if tot_pos and tot_neg else 0.0
+
+
+def accuracy(input, label, k=1, correct=None, total=None, name=None):
+    """functional accuracy (metrics/accuracy_op.cc)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(p, l):
+        topk_idx = jnp.argsort(-p, axis=-1)[..., :k]
+        ll = l if l.ndim == p.ndim - 1 else jnp.squeeze(l, -1)
+        c = jnp.any(topk_idx == ll[..., None], axis=-1)
+        return jnp.mean(c.astype(jnp.float32))
+
+    return apply(f, input, label)

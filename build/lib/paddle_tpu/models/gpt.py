@@ -1,0 +1,186 @@
+"""GPT decoder-only language model, tensor-parallel-ready.
+
+Workload parity: BASELINE.md config 5 (GPT-3 1.3B with TP+PP).  The reference
+tree has no GPT implementation (it lives in PaddleNLP); this is the TPU-native
+flagship: GSPMD tensor parallelism via the meta_parallel layers (weights carry
+PartitionSpecs; XLA inserts the Megatron collectives), optional
+sequence-parallel ring attention for long context, fused attention via the
+Pallas flash kernel on TPU (ops/fused.scaled_dot_product_attention).
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+from .. import tensor_ops as T
+from ..distributed.meta_parallel import (ColumnParallelLinear,
+                                         RowParallelLinear,
+                                         VocabParallelEmbedding,
+                                         shard_constraint)
+from ..nn import functional as F
+from ..nn import initializer as I
+from ..nn.layer_base import Layer, ParamAttr
+from ..nn.layer.common import Dropout, Embedding, Linear
+from ..nn.layer.norm import LayerNorm
+from ..ops import fused
+from ..tensor import Tensor
+
+
+@dataclass
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    ffn_hidden_size: int | None = None  # default 4*hidden
+    max_position_embeddings: int = 1024
+    dropout: float = 0.1
+    attn_dropout: float = 0.1
+    layer_norm_epsilon: float = 1e-5
+    initializer_range: float = 0.02
+    tensor_parallel: bool = False   # annotate weights for an `mp` mesh axis
+    sequence_parallel: bool = False  # ring attention over an `sp` mesh axis
+    tie_word_embeddings: bool = True
+
+    @property
+    def ffn_size(self):
+        return self.ffn_hidden_size or 4 * self.hidden_size
+
+
+def _init(cfg):
+    return ParamAttr(initializer=I.Normal(0.0, cfg.initializer_range))
+
+
+class GPTAttention(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        H = cfg.hidden_size
+        if cfg.tensor_parallel:
+            self.qkv = ColumnParallelLinear(H, 3 * H, weight_attr=_init(cfg),
+                                            gather_output=False)
+            self.out = RowParallelLinear(H, H, weight_attr=_init(cfg),
+                                         input_is_parallel=True)
+        else:
+            self.qkv = Linear(H, 3 * H, weight_attr=_init(cfg))
+            self.out = Linear(H, H, weight_attr=_init(cfg))
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        cfg = self.cfg
+        B, S = x.shape[0], x.shape[1]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = self.qkv(x)
+        qkv = T.reshape(qkv, [B, S, 3, nh, hd])
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        if cfg.tensor_parallel:
+            # heads follow the qkv column shards
+            q = shard_constraint(q, None, None, "mp", None)
+            k = shard_constraint(k, None, None, "mp", None)
+            v = shard_constraint(v, None, None, "mp", None)
+        if cfg.sequence_parallel:
+            from ..ops.ring_attention import ring_attention
+
+            ctx = ring_attention(q, k, v, causal=True)
+        else:
+            ctx = fused.scaled_dot_product_attention(
+                q, k, v, dropout_p=cfg.attn_dropout, is_causal=True,
+                training=self.training)
+        ctx = T.reshape(ctx, [B, S, cfg.hidden_size])
+        return self.dropout(self.out(ctx))
+
+
+class GPTMLP(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        H, FF = cfg.hidden_size, cfg.ffn_size
+        if cfg.tensor_parallel:
+            self.fc1 = ColumnParallelLinear(H, FF, weight_attr=_init(cfg),
+                                            gather_output=False)
+            self.fc2 = RowParallelLinear(FF, H, weight_attr=_init(cfg),
+                                         input_is_parallel=True)
+        else:
+            self.fc1 = Linear(H, FF, weight_attr=_init(cfg))
+            self.fc2 = Linear(FF, H, weight_attr=_init(cfg))
+        self.dropout = Dropout(cfg.dropout)
+
+    def forward(self, x):
+        return self.dropout(self.fc2(F.gelu(self.fc1(x))))
+
+
+class GPTBlock(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.ln_1 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.attn = GPTAttention(cfg)
+        self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+        self.mlp = GPTMLP(cfg)
+
+    def forward(self, x):
+        x = x + self.attn(self.ln_1(x))
+        x = x + self.mlp(self.ln_2(x))
+        return x
+
+
+class GPTModel(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        if cfg.tensor_parallel:
+            self.wte = VocabParallelEmbedding(cfg.vocab_size, cfg.hidden_size,
+                                              weight_attr=_init(cfg))
+        else:
+            self.wte = Embedding(cfg.vocab_size, cfg.hidden_size,
+                                 weight_attr=_init(cfg))
+        self.wpe = Embedding(cfg.max_position_embeddings, cfg.hidden_size,
+                             weight_attr=_init(cfg))
+        self.drop = Dropout(cfg.dropout)
+        self.h = [GPTBlock(cfg) for _ in range(cfg.num_layers)]
+        for i, blk in enumerate(self.h):
+            self.add_sublayer(f"h_{i}", blk)
+        self.ln_f = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
+
+    def forward(self, input_ids):
+        import paddle_tpu as paddle
+
+        pos = paddle.arange(input_ids.shape[1])
+        x = self.wte(input_ids) + self.wpe(pos)
+        x = self.drop(x)
+        for blk in self.h:
+            x = blk(x)
+        return self.ln_f(x)
+
+
+class GPTForCausalLM(Layer):
+    def __init__(self, cfg: GPTConfig):
+        super().__init__()
+        self.cfg = cfg
+        self.gpt = GPTModel(cfg)
+        if not cfg.tie_word_embeddings:
+            self.lm_head = Linear(cfg.hidden_size, cfg.vocab_size,
+                                  weight_attr=_init(cfg), bias_attr=False)
+
+    def forward(self, input_ids, labels=None):
+        hidden = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            logits = T.matmul(hidden, T.transpose(self.gpt.wte.weight, [1, 0]))
+        else:
+            logits = self.lm_head(hidden)
+        if labels is None:
+            return logits
+        loss = fused.softmax_cross_entropy(
+            logits[:, :-1], labels[:, 1:])
+        return logits, T.mean(loss)
+
+    def loss(self, input_ids):
+        """Next-token LM loss on a batch of token ids, via the chunked
+        fused LM-head matmul + cross entropy (ops/fused.py
+        fused_linear_cross_entropy) — the fp32 [B*S, V] logits never
+        materialize in HBM at once."""
+        hidden = self.gpt(input_ids)
+        if self.cfg.tie_word_embeddings:
+            w = T.transpose(self.gpt.wte.weight, [1, 0])
+        else:
+            w = self.lm_head.weight
+        loss = fused.fused_linear_cross_entropy(
+            hidden[:, :-1], w, input_ids[:, 1:])
+        return T.mean(loss)

@@ -1,0 +1,116 @@
+"""Gradient clipping.
+
+Reference parity: python/paddle/fluid/clip.py (ClipGradByValue:118,
+ClipGradByNorm:220, ClipGradByGlobalNorm:336).  Clips operate on
+(param, grad) lists eagerly, and on grad pytrees inside jitted steps — the
+same objects serve optimizer.grad_clip in both modes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply
+
+
+class ClipGradBase:
+    def __call__(self, params_grads):
+        return self._dygraph_clip(params_grads)
+
+    def clip_pytree(self, grads):
+        """Pure version used inside jitted train steps: grads pytree in/out."""
+        raise NotImplementedError
+
+
+class ClipGradByValue(ClipGradBase):
+    def __init__(self, max, min=None):  # noqa: A002
+        self.max = float(max)
+        self.min = float(min) if min is not None else -self.max
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply(lambda v: jnp.clip(v, self.min, self.max), g)))
+        return out
+
+    def clip_pytree(self, grads):
+        return jax.tree_util.tree_map(
+            lambda g: jnp.clip(g, self.min, self.max), grads)
+
+
+class ClipGradByNorm(ClipGradBase):
+    def __init__(self, clip_norm):
+        self.clip_norm = float(clip_norm)
+
+    def _clip_one(self, g):
+        n = jnp.sqrt(jnp.sum(jnp.square(g)))
+        scale = jnp.where(n > self.clip_norm, self.clip_norm / jnp.maximum(n, 1e-12), 1.0)
+        return g * scale
+
+    def _dygraph_clip(self, params_grads):
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+                continue
+            out.append((p, apply(self._clip_one, g)))
+        return out
+
+    def clip_pytree(self, grads):
+        return jax.tree_util.tree_map(self._clip_one, grads)
+
+
+class ClipGradByGlobalNorm(ClipGradBase):
+    def __init__(self, clip_norm, group_name="default_group"):
+        self.clip_norm = float(clip_norm)
+        self.group_name = group_name
+
+    def _dygraph_clip(self, params_grads):
+        gs = [g for p, g in params_grads
+              if g is not None and getattr(p, "need_clip", True)]
+        if not gs:
+            return params_grads
+        sq = [apply(lambda v: jnp.sum(jnp.square(v.astype(jnp.float32))), g)
+              for g in gs]
+        total = sq[0]
+        for s in sq[1:]:
+            total = total + s
+        gnorm = apply(jnp.sqrt, total)
+        scale = apply(lambda n: jnp.minimum(self.clip_norm / jnp.maximum(n, 1e-6),
+                                            1.0), gnorm)
+        out = []
+        for p, g in params_grads:
+            if g is None or not getattr(p, "need_clip", True):
+                out.append((p, g))
+            else:
+                out.append((p, apply(lambda v, s: v * s.astype(v.dtype), g, scale)))
+        return out
+
+    def clip_pytree(self, grads):
+        leaves = jax.tree_util.tree_leaves(grads)
+        total = sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves)
+        gnorm = jnp.sqrt(total)
+        scale = jnp.minimum(self.clip_norm / jnp.maximum(gnorm, 1e-6), 1.0)
+        return jax.tree_util.tree_map(lambda g: g * scale.astype(g.dtype), grads)
+
+
+# fluid-era aliases
+GradientClipByValue = ClipGradByValue
+GradientClipByNorm = ClipGradByNorm
+GradientClipByGlobalNorm = ClipGradByGlobalNorm
+
+
+def clip_grad_norm_(parameters, max_norm, norm_type=2.0, error_if_nonfinite=False):
+    params = [p for p in parameters if p.grad is not None]
+    if not params:
+        return Tensor(jnp.zeros([]))
+    total = sum(jnp.sum(jnp.square(p.grad.value.astype(jnp.float32)))
+                for p in params)
+    gnorm = jnp.sqrt(total)
+    scale = jnp.minimum(max_norm / jnp.maximum(gnorm, 1e-6), 1.0)
+    for p in params:
+        p.grad = Tensor(p.grad.value * scale.astype(p.grad.dtype))
+    return Tensor(gnorm)

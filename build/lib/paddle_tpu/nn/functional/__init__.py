@@ -1,0 +1,1220 @@
+"""nn.functional — stateless NN ops.
+
+Reference parity: python/paddle/nn/functional/* over the C++ op zoo
+(activation ops, conv2d/cudnn conv, pool2d, batch/layer/group norm, dropout,
+softmax_with_cross_entropy_op.cc:301, lookup_table_v2 embedding, ...).
+
+TPU-native: each op is a jnp/lax lowering; convs and matmuls lower to XLA
+convolution/dot (MXU); fused paths (flash attention, fused LN/softmax-xent)
+swap in Pallas kernels via paddle_tpu.ops when FLAGS_use_pallas_kernels is on.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...framework import random as _random
+from ...framework.flags import flag
+from ...tensor import Tensor, apply, unwrap
+from ... import tensor_ops as T
+
+pad = T.pad  # re-export (paddle.nn.functional.pad)
+
+
+# ---------------------------------------------------------------------------
+# activations (operators/activation_op.cc family)
+# ---------------------------------------------------------------------------
+def relu(x, name=None):
+    return apply(jax.nn.relu, x)
+
+
+def relu_(x, name=None):
+    out = relu(x)
+    x._value = out.value
+    return x
+
+
+def relu6(x, name=None):
+    return apply(lambda v: jnp.clip(v, 0.0, 6.0), x)
+
+
+def sigmoid(x, name=None):
+    return apply(jax.nn.sigmoid, x)
+
+
+def tanh(x, name=None):
+    return apply(jnp.tanh, x)
+
+
+def gelu(x, approximate=False, name=None):
+    return apply(lambda v: jax.nn.gelu(v, approximate=approximate), x)
+
+
+def leaky_relu(x, negative_slope=0.01, name=None):
+    return apply(lambda v: jax.nn.leaky_relu(v, negative_slope), x)
+
+
+def elu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.elu(v, alpha), x)
+
+
+def selu(x, scale=1.0507009873554805, alpha=1.6732632423543772, name=None):
+    return apply(lambda v: scale * jnp.where(v > 0, v, alpha * jnp.expm1(v)), x)
+
+
+def celu(x, alpha=1.0, name=None):
+    return apply(lambda v: jax.nn.celu(v, alpha), x)
+
+
+def silu(x, name=None):
+    return apply(jax.nn.silu, x)
+
+
+def swish(x, name=None):
+    return apply(jax.nn.silu, x)
+
+
+def mish(x, name=None):
+    return apply(lambda v: v * jnp.tanh(jax.nn.softplus(v)), x)
+
+
+def hardswish(x, name=None):
+    return apply(lambda v: v * jnp.clip(v + 3.0, 0.0, 6.0) / 6.0, x)
+
+
+def hardsigmoid(x, slope=0.1666667, offset=0.5, name=None):
+    return apply(lambda v: jnp.clip(v * slope + offset, 0.0, 1.0), x)
+
+
+def hardtanh(x, min=-1.0, max=1.0, name=None):  # noqa: A002
+    return apply(lambda v: jnp.clip(v, min, max), x)
+
+
+def hardshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.where(jnp.abs(v) > threshold, v, 0.0), x)
+
+
+def softshrink(x, threshold=0.5, name=None):
+    return apply(lambda v: jnp.sign(v) * jnp.maximum(jnp.abs(v) - threshold, 0.0), x)
+
+
+def tanhshrink(x, name=None):
+    return apply(lambda v: v - jnp.tanh(v), x)
+
+
+def softplus(x, beta=1.0, threshold=20.0, name=None):
+    return apply(lambda v: jnp.where(v * beta > threshold, v,
+                                     jax.nn.softplus(v * beta) / beta), x)
+
+
+def softsign(x, name=None):
+    return apply(jax.nn.soft_sign, x)
+
+
+def thresholded_relu(x, threshold=1.0, name=None):
+    return apply(lambda v: jnp.where(v > threshold, v, 0.0), x)
+
+
+def log_sigmoid(x, name=None):
+    return apply(jax.nn.log_sigmoid, x)
+
+
+def prelu(x, weight, data_format="NCHW", name=None):
+    def f(v, w):
+        if w.size == 1:
+            ww = w.reshape(())
+        else:
+            shape = [1] * v.ndim
+            c_axis = 1 if data_format[1] == "C" else v.ndim - 1
+            shape[c_axis] = w.size
+            ww = w.reshape(shape)
+        return jnp.where(v > 0, v, ww * v)
+    return apply(f, x, weight)
+
+
+def softmax(x, axis=-1, dtype=None, name=None):
+    def f(v):
+        if dtype is not None:
+            from ...framework.dtype import convert_dtype
+            v = v.astype(convert_dtype(dtype))
+        return jax.nn.softmax(v, axis=axis)
+    return apply(f, x)
+
+
+def log_softmax(x, axis=-1, dtype=None, name=None):
+    return apply(lambda v: jax.nn.log_softmax(v, axis=axis), x)
+
+
+def gumbel_softmax(x, temperature=1.0, hard=False, axis=-1, name=None):
+    key = _random.split_key()
+
+    def f(v):
+        g = -jnp.log(-jnp.log(
+            jax.random.uniform(key, v.shape, v.dtype, 1e-20, 1.0)))
+        y = jax.nn.softmax((v + g) / temperature, axis=axis)
+        if hard:
+            idx = jnp.argmax(y, axis=axis, keepdims=True)
+            y_hard = jnp.zeros_like(y)
+            y_hard = jnp.put_along_axis(y_hard, idx, 1.0, axis=axis) \
+                if hasattr(jnp, "put_along_axis") else \
+                jax.nn.one_hot(jnp.squeeze(idx, axis), y.shape[axis], axis=axis,
+                               dtype=y.dtype)
+            y = jax.lax.stop_gradient(y_hard - y) + y
+        return y
+    return apply(f, x)
+
+
+def glu(x, axis=-1, name=None):
+    return apply(lambda v: jax.nn.glu(v, axis=axis), x)
+
+
+def maxout(x, groups, axis=1, name=None):
+    def f(v):
+        c = v.shape[axis]
+        new_shape = list(v.shape)
+        new_shape[axis] = c // groups
+        new_shape.insert(axis + 1, groups)
+        return jnp.max(v.reshape(new_shape), axis=axis + 1)
+    return apply(f, x)
+
+
+# ---------------------------------------------------------------------------
+# linear / embedding
+# ---------------------------------------------------------------------------
+def linear(x, weight, bias=None, name=None):
+    """paddle convention: weight shape [in_features, out_features]."""
+    from ...amp import white_cast
+
+    if bias is None:
+        return apply(lambda v, w: jnp.matmul(*white_cast(v, w)), x, weight)
+
+    def f(v, w, b):
+        v, w = white_cast(v, w)
+        return v @ w + b.astype(v.dtype)
+
+    return apply(f, x, weight, bias)
+
+
+def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    def f(ids, w):
+        out = jnp.take(w, ids.astype(jnp.int32), axis=0)
+        if padding_idx is not None:
+            mask = (ids == padding_idx)[..., None]
+            out = jnp.where(mask, 0.0, out)
+        return out
+    return apply(f, x, weight)
+
+
+def one_hot(x, num_classes, name=None):
+    return apply(lambda v: jax.nn.one_hot(v, num_classes, dtype=jnp.float32), x)
+
+
+def label_smooth(label, prior_dist=None, epsilon=0.1, name=None):
+    def f(l, *pd):
+        k = l.shape[-1]
+        if pd:
+            return (1 - epsilon) * l + epsilon * pd[0]
+        return (1 - epsilon) * l + epsilon / k
+    args = (label,) if prior_dist is None else (label, prior_dist)
+    return apply(f, *args)
+
+
+# ---------------------------------------------------------------------------
+# convolution (conv2d + cudnn variants → XLA conv_general_dilated)
+# ---------------------------------------------------------------------------
+def _norm_tuple(v, n):
+    if isinstance(v, (int, np.integer)):
+        return (int(v),) * n
+    return tuple(int(u) for u in v)
+
+
+def _conv_padding(padding, nsp, strides=None):
+    if isinstance(padding, str):
+        return padding.upper()
+    if isinstance(padding, (int, np.integer)):
+        return [(int(padding), int(padding))] * nsp
+    padding = list(padding)
+    if all(isinstance(p, (list, tuple)) for p in padding):
+        # possibly includes batch/channel dims (paddle allows 4-elem pair list)
+        pairs = [tuple(p) for p in padding]
+        if len(pairs) == nsp + 2:
+            pairs = pairs[2:]
+        return pairs
+    if len(padding) == nsp:
+        return [(int(p), int(p)) for p in padding]
+    if len(padding) == 2 * nsp:
+        return [(int(padding[2 * i]), int(padding[2 * i + 1])) for i in range(nsp)]
+    raise ValueError(f"bad padding {padding}")
+
+
+def _dimension_numbers(nsp, channel_last):
+    sp = "DHW"[-nsp:]
+    if channel_last:
+        return (f"N{sp}C", f"{sp}IO"[::1].replace(sp, sp) if False else f"O{sp}I"[0:0] or f"{sp}",)  # unreachable
+    return None
+
+
+def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nsp,
+          transpose=False, output_padding=0):
+    channel_last = data_format[-1] == "C"
+    stride = _norm_tuple(stride, nsp)
+    dilation = _norm_tuple(dilation, nsp)
+    pad_spec = _conv_padding(padding, nsp)
+    sp = "DHW"[3 - nsp:]
+    if channel_last:
+        lhs_spec = "N" + sp + "C"
+        out_spec = "N" + sp + "C"
+    else:
+        lhs_spec = "NC" + sp
+        out_spec = "NC" + sp
+    rhs_spec = "OI" + sp  # paddle weight layout: [out_c, in_c/groups, *k]
+
+    def f(v, w, *b):
+        from ...amp import white_cast
+
+        v, w = white_cast(v, w)
+        if b:
+            b = (b[0].astype(v.dtype),)
+        if transpose:
+            # paddle conv_transpose weight: [in_c, out_c/groups, *k].
+            # Express as a fractionally-strided conv: dilate the input by
+            # `stride`, swap the kernel's I/O dims and flip it spatially
+            # (the gradient-of-conv identity).
+            k = w.shape[2:]
+            if isinstance(pad_spec, str):
+                pads = pad_spec
+            else:
+                # output = (in-1)*s - 2p + k (+ output_padding)
+                pads = [(d * (kk - 1) - p[0], d * (kk - 1) - p[1] + op)
+                        for kk, p, d, op in zip(
+                            k, pad_spec, dilation,
+                            _norm_tuple(output_padding, nsp))]
+            wt = jnp.swapaxes(w, 0, 1) if groups == 1 else _group_swap(w, groups)
+            wt = jnp.flip(wt, axis=tuple(range(2, wt.ndim)))
+            out = jax.lax.conv_general_dilated(
+                v, wt,
+                window_strides=(1,) * nsp,
+                padding=pads,
+                lhs_dilation=stride,
+                rhs_dilation=dilation,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                feature_group_count=groups,
+            )
+        else:
+            out = jax.lax.conv_general_dilated(
+                v, w,
+                window_strides=stride,
+                padding=pad_spec,
+                rhs_dilation=dilation,
+                dimension_numbers=(lhs_spec, rhs_spec, out_spec),
+                feature_group_count=groups,
+            )
+        if b:
+            bshape = [1] * out.ndim
+            bshape[out_spec.index("C")] = b[0].size
+            out = out + b[0].reshape(bshape)
+        return out
+
+    args = (x, weight) if bias is None else (x, weight, bias)
+    return apply(f, *args)
+
+
+def _group_swap(w, groups):
+    # [in_c, out_c/groups, *k] -> grouped OIHW-transposed layout
+    ic, ocg = w.shape[0], w.shape[1]
+    k = w.shape[2:]
+    w = w.reshape((groups, ic // groups, ocg) + k)
+    w = jnp.swapaxes(w, 1, 2)
+    return w.reshape((groups * ocg, ic // groups) + k)
+
+
+def conv1d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, fmt, 1)
+
+
+def conv2d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 2)
+
+
+def conv3d(x, weight, bias=None, stride=1, padding=0, dilation=1, groups=1,
+           data_format="NCDHW", name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format, 3)
+
+
+def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCL",
+                     name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _conv(x, weight, bias, stride, padding, dilation, groups, fmt, 1,
+                 transpose=True, output_padding=output_padding)
+
+
+def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCHW",
+                     name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
+                 2, transpose=True, output_padding=output_padding)
+
+
+def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
+                     dilation=1, groups=1, output_size=None, data_format="NCDHW",
+                     name=None):
+    return _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
+                 3, transpose=True, output_padding=output_padding)
+
+
+# ---------------------------------------------------------------------------
+# pooling (pool2d op → lax.reduce_window)
+# ---------------------------------------------------------------------------
+def _pool(x, kernel, stride, padding, nsp, data_format, op, ceil_mode=False,
+          include_pad=False, count_include_pad=True):
+    channel_last = data_format[-1] == "C"
+    kernel = _norm_tuple(kernel, nsp)
+    stride = _norm_tuple(stride if stride is not None else kernel, nsp)
+    pad_spec = _conv_padding(padding, nsp)
+
+    def f(v):
+        if channel_last:
+            window = (1,) + kernel + (1,)
+            strides = (1,) + stride + (1,)
+            pads = [(0, 0)] + (pad_spec if isinstance(pad_spec, list)
+                               else [(0, 0)] * nsp) + [(0, 0)] \
+                if not isinstance(pad_spec, str) else pad_spec
+        else:
+            window = (1, 1) + kernel
+            strides = (1, 1) + stride
+            pads = [(0, 0), (0, 0)] + (pad_spec if isinstance(pad_spec, list)
+                                       else [(0, 0)] * nsp) \
+                if not isinstance(pad_spec, str) else pad_spec
+        if isinstance(pads, str):
+            pads_resolved = jax.lax.padtype_to_pads(v.shape, window, strides,
+                                                    pads)
+        else:
+            pads_resolved = pads
+        if ceil_mode and not isinstance(pads_resolved, str):
+            # extend right pads so ceil-divided windows fit
+            pads_resolved = list(pads_resolved)
+            sp_offset = 1 if channel_last else 2
+            for i in range(nsp):
+                d = sp_offset + i
+                size = v.shape[d] + pads_resolved[d][0] + pads_resolved[d][1]
+                rem = (size - kernel[i]) % stride[i]
+                if rem:
+                    pads_resolved[d] = (pads_resolved[d][0],
+                                        pads_resolved[d][1] + stride[i] - rem)
+        if op == "max":
+            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
+                jnp.iinfo(v.dtype).min
+            return jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
+                                         pads_resolved)
+        # avg
+        ones = jnp.ones_like(v)
+        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
+                                  pads_resolved)
+        if count_include_pad:
+            denom = float(np.prod(kernel))
+            return s / denom
+        cnt = jax.lax.reduce_window(ones, 0.0, jax.lax.add, window, strides,
+                                    pads_resolved)
+        return s / cnt
+
+    return apply(f, x)
+
+
+def max_pool1d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, fmt, "max", ceil_mode)
+
+
+def max_pool2d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "max", ceil_mode)
+
+
+def max_pool3d(x, kernel_size, stride=None, padding=0, return_mask=False,
+               ceil_mode=False, data_format="NCDHW", name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "max", ceil_mode)
+
+
+def avg_pool1d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, data_format="NCL", name=None):
+    fmt = "NCW" if data_format in ("NCL", "NCW") else "NWC"
+    return _pool(x, kernel_size, stride, padding, 1, fmt, "avg", ceil_mode,
+                 count_include_pad=not exclusive)
+
+
+def avg_pool2d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 2, data_format, "avg",
+                 ceil_mode, count_include_pad=not exclusive)
+
+
+def avg_pool3d(x, kernel_size, stride=None, padding=0, exclusive=True,
+               ceil_mode=False, divisor_override=None, data_format="NCDHW",
+               name=None):
+    return _pool(x, kernel_size, stride, padding, 3, data_format, "avg",
+                 ceil_mode, count_include_pad=not exclusive)
+
+
+def adaptive_avg_pool2d(x, output_size, data_format="NCHW", name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def f(v):
+        channel_last = data_format[-1] == "C"
+        h_ax, w_ax = (1, 2) if channel_last else (2, 3)
+        H, W = v.shape[h_ax], v.shape[w_ax]
+        oh, ow = out_hw
+        if H % oh == 0 and W % ow == 0:
+            kh, kw = H // oh, W // ow
+            window = [1, 1, 1, 1]
+            window[h_ax], window[w_ax] = kh, kw
+            s = jax.lax.reduce_window(v, 0.0, jax.lax.add, tuple(window),
+                                      tuple(window), "VALID")
+            return s / (kh * kw)
+        # general: mean over computed bins (static shapes)
+        hi = [(int(math.floor(i * H / oh)), int(math.ceil((i + 1) * H / oh)))
+              for i in range(oh)]
+        wi = [(int(math.floor(j * W / ow)), int(math.ceil((j + 1) * W / ow)))
+              for j in range(ow)]
+        rows = []
+        for (h0, h1) in hi:
+            cols = []
+            for (w0, w1) in wi:
+                sl = [slice(None)] * v.ndim
+                sl[h_ax], sl[w_ax] = slice(h0, h1), slice(w0, w1)
+                cols.append(jnp.mean(v[tuple(sl)], axis=(h_ax, w_ax),
+                                     keepdims=True))
+            rows.append(jnp.concatenate(cols, axis=w_ax))
+        return jnp.concatenate(rows, axis=h_ax)
+
+    return apply(f, x)
+
+
+def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
+    out_hw = _norm_tuple(output_size, 2)
+
+    def f(v):
+        H, W = v.shape[2], v.shape[3]
+        oh, ow = out_hw
+        kh, kw = H // oh, W // ow
+        return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max,
+                                     (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
+    return apply(f, x)
+
+
+def adaptive_avg_pool1d(x, output_size, name=None):
+    def f(v):
+        L = v.shape[-1]
+        o = output_size if isinstance(output_size, int) else output_size[0]
+        k = L // o
+        return jax.lax.reduce_window(v, 0.0, jax.lax.add, (1, 1, k), (1, 1, k),
+                                     "VALID") / k
+    return apply(f, x)
+
+
+# ---------------------------------------------------------------------------
+# normalization
+# ---------------------------------------------------------------------------
+def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
+               name=None):
+    ns = (normalized_shape,) if isinstance(normalized_shape, int) \
+        else tuple(normalized_shape)
+    naxes = len(ns)
+
+    from ...ops import fused as _fused
+    if (flag("FLAGS_use_pallas_kernels") and naxes == 1 and weight is not None
+            and bias is not None):
+        return _fused.layer_norm(x, weight, bias, epsilon)
+
+    def f(v, *wb):
+        axes = tuple(range(v.ndim - naxes, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.mean(jnp.square(v - mean), axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i]
+            i += 1
+        if bias is not None:
+            out = out + wb[i]
+        return out
+
+    args = [a for a in (x, weight, bias) if a is not None]
+    return apply(f, *args)
+
+
+def batch_norm(x, running_mean, running_var, weight=None, bias=None,
+               training=False, momentum=0.9, epsilon=1e-5, data_format="NCHW",
+               use_global_stats=None, name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+
+    def f(v, rm, rv, *wb):
+        c_ax = v.ndim - 1 if channel_last else (1 if v.ndim > 1 else 0)
+        axes = tuple(i for i in range(v.ndim) if i != c_ax)
+        use_batch = training and not use_global_stats
+        if use_batch:
+            mean = jnp.mean(v, axis=axes)
+            var = jnp.var(v, axis=axes)
+        else:
+            mean, var = rm, rv
+        shape = [1] * v.ndim
+        shape[c_ax] = v.shape[c_ax]
+        out = (v - mean.reshape(shape)) * jax.lax.rsqrt(var.reshape(shape) + epsilon)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+
+    args = [a for a in (x, running_mean, running_var, weight, bias)
+            if a is not None]
+    out = apply(f, *args)
+
+    # running-stat update (mirrors batch_norm_op: stats updated in forward)
+    if training and not use_global_stats:
+        v = unwrap(x)
+        c_ax = v.ndim - 1 if channel_last else (1 if v.ndim > 1 else 0)
+        axes = tuple(i for i in range(v.ndim) if i != c_ax)
+        with jax.ensure_compile_time_eval() if False else _noop_ctx():
+            bm = jnp.mean(v, axis=axes)
+            n = np.prod([v.shape[a] for a in axes])
+            bv = jnp.var(v, axis=axes) * (n / max(n - 1, 1))
+            running_mean.set_value(running_mean.value * momentum + bm * (1 - momentum))
+            running_var.set_value(running_var.value * momentum + bv * (1 - momentum))
+    return out
+
+
+import contextlib as _ctxlib
+
+
+def _noop_ctx():
+    return _ctxlib.nullcontext()
+
+
+def instance_norm(x, running_mean=None, running_var=None, weight=None, bias=None,
+                  use_input_stats=True, momentum=0.9, eps=1e-5,
+                  data_format="NCHW", name=None):
+    def f(v, *wb):
+        axes = tuple(range(2, v.ndim))
+        mean = jnp.mean(v, axis=axes, keepdims=True)
+        var = jnp.var(v, axis=axes, keepdims=True)
+        out = (v - mean) * jax.lax.rsqrt(var + eps)
+        shape = [1, v.shape[1]] + [1] * (v.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        return out
+    args = [a for a in (x, weight, bias) if a is not None]
+    return apply(f, *args)
+
+
+def group_norm(x, num_groups, epsilon=1e-5, weight=None, bias=None,
+               data_format="NCHW", name=None):
+    channel_last = data_format[-1] == "C" and len(data_format) > 2
+
+    def f(v, *wb):
+        if channel_last:
+            v_ = jnp.moveaxis(v, -1, 1)
+        else:
+            v_ = v
+        N, C = v_.shape[0], v_.shape[1]
+        g = v_.reshape((N, num_groups, C // num_groups) + v_.shape[2:])
+        axes = tuple(range(2, g.ndim))
+        mean = jnp.mean(g, axis=axes, keepdims=True)
+        var = jnp.var(g, axis=axes, keepdims=True)
+        out = ((g - mean) * jax.lax.rsqrt(var + epsilon)).reshape(v_.shape)
+        shape = [1, C] + [1] * (v_.ndim - 2)
+        i = 0
+        if weight is not None:
+            out = out * wb[i].reshape(shape)
+            i += 1
+        if bias is not None:
+            out = out + wb[i].reshape(shape)
+        if channel_last:
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+    args = [a for a in (x, weight, bias) if a is not None]
+    return apply(f, *args)
+
+
+def normalize(x, p=2, axis=1, epsilon=1e-12, name=None):
+    return apply(lambda v: v / jnp.maximum(
+        jnp.linalg.norm(v, ord=p, axis=axis, keepdims=True), epsilon), x)
+
+
+def local_response_norm(x, size, alpha=1e-4, beta=0.75, k=1.0,
+                        data_format="NCHW", name=None):
+    def f(v):
+        sq = jnp.square(v)
+        half = size // 2
+        c_ax = 1
+        pad_width = [(0, 0)] * v.ndim
+        pad_width[c_ax] = (half, size - half - 1)
+        padded = jnp.pad(sq, pad_width)
+        window = [1] * v.ndim
+        window[c_ax] = size
+        s = jax.lax.reduce_window(padded, 0.0, jax.lax.add, tuple(window),
+                                  (1,) * v.ndim, "VALID")
+        return v / jnp.power(k + alpha * s, beta)
+    return apply(f, x)
+
+
+# ---------------------------------------------------------------------------
+# dropout
+# ---------------------------------------------------------------------------
+def dropout(x, p=0.5, axis=None, training=True, mode="upscale_in_train",
+            name=None):
+    if not training or p == 0.0:
+        return x if isinstance(x, Tensor) else Tensor(x)
+    key = _random.split_key()
+
+    def f(v):
+        shape = list(v.shape)
+        if axis is not None:
+            axes = (axis,) if isinstance(axis, int) else tuple(axis)
+            shape = [s if i in axes else 1 for i, s in enumerate(shape)]
+        keep = jax.random.bernoulli(key, 1.0 - p, tuple(shape))
+        if mode == "upscale_in_train":
+            return jnp.where(keep, v / (1.0 - p), 0.0)
+        return jnp.where(keep, v, 0.0)
+
+    return apply(f, x)
+
+
+def dropout2d(x, p=0.5, training=True, data_format="NCHW", name=None):
+    ax = (0, 1) if data_format == "NCHW" else (0, 3)
+    return dropout(x, p, axis=ax, training=training)
+
+
+def dropout3d(x, p=0.5, training=True, data_format="NCDHW", name=None):
+    ax = (0, 1) if data_format == "NCDHW" else (0, 4)
+    return dropout(x, p, axis=ax, training=training)
+
+
+def alpha_dropout(x, p=0.5, training=True, name=None):
+    if not training or p == 0.0:
+        return x
+    key = _random.split_key()
+
+    def f(v):
+        alpha = 1.6732632423543772
+        scale = 1.0507009873554805
+        alpha_p = -alpha * scale
+        keep = jax.random.bernoulli(key, 1.0 - p, v.shape)
+        a = (1.0 / math.sqrt((1 - p) * (1 + p * alpha_p ** 2))) if p < 1 else 0.0
+        b = -a * alpha_p * p
+        return a * jnp.where(keep, v, alpha_p) + b
+    return apply(f, x)
+
+
+# ---------------------------------------------------------------------------
+# losses (softmax_with_cross_entropy_op.cc:301 etc.)
+# ---------------------------------------------------------------------------
+def _reduce_loss(loss_fn_out, reduction):
+    if reduction == "mean":
+        return T.mean(loss_fn_out)
+    if reduction == "sum":
+        return T.sum(loss_fn_out)
+    return loss_fn_out
+
+
+def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
+                  soft_label=False, axis=-1, use_softmax=True, name=None):
+    from ...ops import fused as _fused
+    if (flag("FLAGS_use_pallas_kernels") and use_softmax and not soft_label
+            and weight is None and axis in (-1, None)):
+        raw = _fused.softmax_cross_entropy(input, label, ignore_index)
+        return _reduce_loss(raw, reduction) if reduction != "none" else raw
+
+    def f(logits, lbl, *w):
+        if use_softmax:
+            logp = jax.nn.log_softmax(logits, axis=axis)
+        else:
+            logp = jnp.log(jnp.clip(logits, 1e-15, 1.0))
+        if soft_label:
+            loss = -jnp.sum(lbl * logp, axis=axis)
+        else:
+            lbl_i = lbl.astype(jnp.int32)
+            if lbl_i.ndim == logp.ndim:
+                lbl_i = jnp.squeeze(lbl_i, axis)
+            picked = jnp.take_along_axis(
+                logp, lbl_i[..., None] if axis in (-1, logp.ndim - 1)
+                else jnp.expand_dims(lbl_i, axis), axis=axis)
+            loss = -jnp.squeeze(picked, axis)
+            valid = lbl_i != ignore_index
+            loss = jnp.where(valid, loss, 0.0)
+            if w:
+                cw = jnp.take(w[0], jnp.clip(lbl_i, 0, None), axis=0)
+                loss = loss * jnp.where(valid, cw, 0.0)
+        return loss
+
+    args = [input, label] + ([weight] if weight is not None else [])
+    raw = apply(f, *args)
+    if reduction == "none":
+        return raw
+    if reduction == "sum":
+        return T.sum(raw)
+    if soft_label or (ignore_index == -100 and weight is None):
+        return T.mean(raw)
+
+    # mean over valid entries, weighted if a class-weight vector was given
+    nd = len(unwrap(input).shape)
+
+    def denom_fn(l, *w):
+        li = l.astype(jnp.int32)
+        if li.ndim == nd:
+            li = jnp.squeeze(li, axis)
+        valid = li != ignore_index
+        if w:
+            cw = jnp.take(w[0], jnp.clip(li, 0, None), axis=0)
+            return jnp.sum(jnp.where(valid, cw, 0.0))
+        return jnp.sum(valid.astype(jnp.float32))
+
+    denom = apply(denom_fn, label, *([weight] if weight is not None else []))
+    return T.sum(raw) / denom
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False,
+                               ignore_index=-100, numeric_stable_mode=True,
+                               return_softmax=False, axis=-1):
+    loss = cross_entropy(logits, label, soft_label=soft_label,
+                         ignore_index=ignore_index, reduction="none", axis=axis)
+    loss = T.unsqueeze(loss, axis)
+    if return_softmax:
+        return loss, softmax(logits, axis=axis)
+    return loss
+
+
+def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
+             name=None):
+    def f(logp, lbl, *w):
+        lbl_i = lbl.astype(jnp.int32)
+        picked = jnp.take_along_axis(logp, lbl_i[..., None], axis=-1)
+        loss = -jnp.squeeze(picked, -1)
+        if w:
+            loss = loss * jnp.take(w[0], lbl_i, axis=0)
+        return jnp.where(lbl_i == ignore_index, 0.0, loss)
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _reduce_loss(apply(f, *args), reduction)
+
+
+def mse_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(apply(lambda a, b: jnp.square(a - b), input, label),
+                        reduction)
+
+
+def l1_loss(input, label, reduction="mean", name=None):
+    return _reduce_loss(apply(lambda a, b: jnp.abs(a - b), input, label),
+                        reduction)
+
+
+def smooth_l1_loss(input, label, reduction="mean", delta=1.0, name=None):
+    def f(a, b):
+        d = jnp.abs(a - b)
+        return jnp.where(d < delta, 0.5 * d * d / delta, d - 0.5 * delta) * delta \
+            / delta
+    return _reduce_loss(apply(lambda a, b: jnp.where(
+        jnp.abs(a - b) < delta, 0.5 * jnp.square(a - b) / delta,
+        jnp.abs(a - b) - 0.5 * delta), input, label), reduction)
+
+
+def binary_cross_entropy(input, label, weight=None, reduction="mean", name=None):
+    def f(p, l, *w):
+        eps = 1e-12
+        loss = -(l * jnp.log(jnp.clip(p, eps, None))
+                 + (1 - l) * jnp.log(jnp.clip(1 - p, eps, None)))
+        if w:
+            loss = loss * w[0]
+        return loss
+    args = [input, label] + ([weight] if weight is not None else [])
+    return _reduce_loss(apply(f, *args), reduction)
+
+
+def binary_cross_entropy_with_logits(logit, label, weight=None, reduction="mean",
+                                     pos_weight=None, name=None):
+    def f(z, l, *rest):
+        i = 0
+        w = None
+        pw = None
+        if weight is not None:
+            w = rest[i]
+            i += 1
+        if pos_weight is not None:
+            pw = rest[i]
+        log_sig = jax.nn.log_sigmoid(z)
+        log_one_minus = jax.nn.log_sigmoid(-z)
+        if pw is not None:
+            loss = -(pw * l * log_sig + (1 - l) * log_one_minus)
+        else:
+            loss = -(l * log_sig + (1 - l) * log_one_minus)
+        if w is not None:
+            loss = loss * w
+        return loss
+    args = [logit, label] + [a for a in (weight, pos_weight) if a is not None]
+    return _reduce_loss(apply(f, *args), reduction)
+
+
+def kl_div(input, label, reduction="mean", name=None):
+    raw = apply(lambda lp, t: t * (jnp.log(jnp.clip(t, 1e-12, None)) - lp),
+                input, label)
+    if reduction == "batchmean":
+        return T.sum(raw) / unwrap(input).shape[0]
+    return _reduce_loss(raw, reduction)
+
+
+def margin_ranking_loss(input, other, label, margin=0.0, reduction="mean",
+                        name=None):
+    return _reduce_loss(apply(
+        lambda a, b, l: jnp.maximum(0.0, -l * (a - b) + margin),
+        input, other, label), reduction)
+
+
+def hinge_embedding_loss(input, label, margin=1.0, reduction="mean", name=None):
+    return _reduce_loss(apply(
+        lambda a, l: jnp.where(l == 1, a, jnp.maximum(0.0, margin - a)),
+        input, label), reduction)
+
+
+def cosine_similarity(x1, x2, axis=1, eps=1e-8):
+    def f(a, b):
+        dot = jnp.sum(a * b, axis=axis)
+        na = jnp.linalg.norm(a, axis=axis)
+        nb = jnp.linalg.norm(b, axis=axis)
+        return dot / jnp.maximum(na * nb, eps)
+    return apply(f, x1, x2)
+
+
+def cosine_embedding_loss(input1, input2, label, margin=0, reduction="mean",
+                          name=None):
+    sim = cosine_similarity(input1, input2, axis=1)
+    return _reduce_loss(apply(
+        lambda s, l: jnp.where(l == 1, 1 - s, jnp.maximum(0.0, s - margin)),
+        sim, label), reduction)
+
+
+def sigmoid_focal_loss(logit, label, normalizer=None, alpha=0.25, gamma=2.0,
+                       reduction="sum", name=None):
+    def f(z, l, *n):
+        p = jax.nn.sigmoid(z)
+        ce = -(l * jax.nn.log_sigmoid(z) + (1 - l) * jax.nn.log_sigmoid(-z))
+        p_t = p * l + (1 - p) * (1 - l)
+        a_t = alpha * l + (1 - alpha) * (1 - l)
+        loss = a_t * jnp.power(1 - p_t, gamma) * ce
+        if n:
+            loss = loss / n[0]
+        return loss
+    args = [logit, label] + ([normalizer] if normalizer is not None else [])
+    return _reduce_loss(apply(f, *args), reduction)
+
+
+def square_error_cost(input, label):
+    return apply(lambda a, b: jnp.square(a - b), input, label)
+
+
+def ctc_loss(log_probs, labels, input_lengths, label_lengths, blank=0,
+             reduction="mean", norm_by_times=False):
+    """CTC via the standard alpha recursion in log space (warpctc analog)."""
+    def f(lp, lab, il, ll):
+        # lp: [T, B, C] logits; convert to log-probs
+        lp = jax.nn.log_softmax(lp, axis=-1)
+        Tmax, B, C = lp.shape
+        L = lab.shape[1]
+        S = 2 * L + 1
+        ext = jnp.full((B, S), blank, dtype=jnp.int32)
+        ext = ext.at[:, 1::2].set(lab.astype(jnp.int32))
+        neg_inf = -1e30
+        alpha0 = jnp.full((B, S), neg_inf)
+        alpha0 = alpha0.at[:, 0].set(lp[0, jnp.arange(B), ext[:, 0]])
+        alpha0 = alpha0.at[:, 1].set(
+            jnp.where(ll > 0, lp[0, jnp.arange(B), ext[:, 1]], neg_inf))
+
+        same = jnp.concatenate(
+            [jnp.full((B, 2), False),
+             ext[:, 2:] == ext[:, :-2]], axis=1)
+
+        def step(alpha, lp_t):
+            a = alpha
+            a1 = jnp.concatenate([jnp.full((B, 1), neg_inf), a[:, :-1]], 1)
+            a2 = jnp.concatenate([jnp.full((B, 2), neg_inf), a[:, :-2]], 1)
+            a2 = jnp.where(same, neg_inf, a2)
+            m = jnp.maximum(jnp.maximum(a, a1), a2)
+            new = m + jnp.log(jnp.exp(a - m) + jnp.exp(a1 - m)
+                              + jnp.exp(a2 - m) + 1e-30)
+            emit = jnp.take_along_axis(lp_t, ext, axis=1)
+            return new + emit, None
+
+        def scan_body(carry, t):
+            alpha = carry
+            new, _ = step(alpha, lp[t])
+            alpha = jnp.where((t < il)[:, None], new, alpha)
+            return alpha, None
+
+        alpha, _ = jax.lax.scan(scan_body, alpha0, jnp.arange(1, Tmax))
+        idx_last = 2 * ll.astype(jnp.int32)
+        b_idx = jnp.arange(B)
+        final = jnp.logaddexp(
+            alpha[b_idx, idx_last],
+            jnp.where(ll > 0, alpha[b_idx, jnp.maximum(idx_last - 1, 0)], neg_inf))
+        return -final
+
+    raw = apply(f, log_probs, labels, input_lengths, label_lengths)
+    if reduction == "mean":
+        return T.mean(apply(lambda r, ll: r / jnp.maximum(ll, 1), raw,
+                            label_lengths))
+    return _reduce_loss(raw, reduction)
+
+
+# ---------------------------------------------------------------------------
+# attention + sequence utilities
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True,
+                                 name=None):
+    """[B, S, H, D] layout. Uses the Pallas flash-attention kernel on TPU
+    when enabled (ops/pallas/flash_attention.py), else an XLA softmax path."""
+    from ...ops import fused as _fused
+    return _fused.scaled_dot_product_attention(
+        query, key, value, attn_mask=attn_mask, dropout_p=dropout_p,
+        is_causal=is_causal, training=training)
+
+
+def sequence_mask(lengths, maxlen=None, dtype="int64", name=None):
+    from ...framework.dtype import convert_dtype
+    ml = maxlen
+
+    def f(l):
+        m = ml if ml is not None else int(jnp.max(l))
+        ar = jnp.arange(m)
+        return (ar[None, :] < l[..., None]).astype(convert_dtype(dtype))
+    return apply(f, lengths)
+
+
+# ---------------------------------------------------------------------------
+# vision ops
+# ---------------------------------------------------------------------------
+def interpolate(x, size=None, scale_factor=None, mode="nearest",
+                align_corners=False, align_mode=0, data_format="NCHW",
+                name=None):
+    def f(v):
+        channel_last = data_format[-1] == "C"
+        sp_axes = list(range(1, v.ndim - 1)) if channel_last \
+            else list(range(2, v.ndim))
+        in_sizes = [v.shape[a] for a in sp_axes]
+        if size is not None:
+            out_sizes = [int(unwrap(s)) for s in
+                         (size if isinstance(size, (list, tuple)) else [size])]
+        else:
+            sf = scale_factor if isinstance(scale_factor, (list, tuple)) \
+                else [scale_factor] * len(in_sizes)
+            out_sizes = [int(s * f_) for s, f_ in zip(in_sizes, sf)]
+        new_shape = list(v.shape)
+        for a, s in zip(sp_axes, out_sizes):
+            new_shape[a] = s
+        m = {"nearest": "nearest", "bilinear": "linear", "linear": "linear",
+             "trilinear": "linear", "bicubic": "cubic", "area": "linear"}[mode]
+        if m == "nearest":
+            return jax.image.resize(v, new_shape, method="nearest")
+        if align_corners:
+            # jax.image.resize has no align_corners; emulate via per-axis map
+            out = v
+            for a, s_out in zip(sp_axes, out_sizes):
+                s_in = out.shape[a]
+                if s_out == s_in:
+                    continue
+                idx = jnp.linspace(0.0, s_in - 1, s_out)
+                lo = jnp.floor(idx).astype(jnp.int32)
+                hi = jnp.minimum(lo + 1, s_in - 1)
+                w = (idx - lo).astype(v.dtype)
+                shape = [1] * out.ndim
+                shape[a] = s_out
+                wv = w.reshape(shape)
+                out = jnp.take(out, lo, axis=a) * (1 - wv) + \
+                    jnp.take(out, hi, axis=a) * wv
+            return out
+        return jax.image.resize(v, new_shape, method=m)
+    return apply(f, x)
+
+
+def upsample(x, size=None, scale_factor=None, mode="nearest",
+             align_corners=False, align_mode=0, data_format="NCHW", name=None):
+    return interpolate(x, size, scale_factor, mode, align_corners, align_mode,
+                       data_format)
+
+
+def pixel_shuffle(x, upscale_factor, data_format="NCHW", name=None):
+    r = upscale_factor
+
+    def f(v):
+        N, C, H, W = v.shape
+        v = v.reshape(N, C // (r * r), r, r, H, W)
+        v = v.transpose(0, 1, 4, 2, 5, 3)
+        return v.reshape(N, C // (r * r), H * r, W * r)
+    return apply(f, x)
+
+
+def _unfold_pads(paddings):
+    """1/2/4-int padding forms (reference unfold_op): 1 → all sides,
+    2 → (ph, pw), 4 → (top, left, bottom, right). Returns ((pt,pb),(pl,pr))."""
+    if isinstance(paddings, int):
+        return (paddings, paddings), (paddings, paddings)
+    p = list(paddings)
+    if len(p) == 1:
+        return (p[0], p[0]), (p[0], p[0])
+    if len(p) == 2:
+        return (p[0], p[0]), (p[1], p[1])
+    if len(p) == 4:
+        return (p[0], p[2]), (p[1], p[3])
+    raise ValueError(f"paddings must have 1, 2 or 4 elements, got {p}")
+
+
+def unfold(x, kernel_sizes, strides=1, paddings=0, dilations=1, name=None):
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    (pt, pb), (pl, pr) = _unfold_pads(paddings)
+    d = _norm_tuple(dilations, 2)
+
+    def f(v):
+        N, C, H, W = v.shape
+        patches = jax.lax.conv_general_dilated_patches(
+            v, k, s, [(pt, pb), (pl, pr)], rhs_dilation=d,
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+        L = patches.shape[2] * patches.shape[3]
+        return patches.reshape(N, C * k[0] * k[1], L)
+    return apply(f, x)
+
+
+def fold(x, output_sizes, kernel_sizes, strides=1, paddings=0, dilations=1,
+         name=None):
+    """col2im — inverse of unfold (operators/fold_op): x [N, C*kh*kw, L]
+    -> [N, C, H, W] with overlapping patches summed (scatter-add via the
+    transpose of the patch-extraction convolution)."""
+    out = _norm_tuple(output_sizes, 2)
+    k = _norm_tuple(kernel_sizes, 2)
+    s = _norm_tuple(strides, 2)
+    (pt, pb), (pl, pr) = _unfold_pads(paddings)
+    d = _norm_tuple(dilations, 2)
+
+    def f(v):
+        N, CKK, L = v.shape
+        C = CKK // (k[0] * k[1])
+        oh = (out[0] + pt + pb - d[0] * (k[0] - 1) - 1) // s[0] + 1
+        ow = (out[1] + pl + pr - d[1] * (k[1] - 1) - 1) // s[1] + 1
+        cols = v.reshape(N, C, k[0], k[1], oh, ow)
+        # scatter-add each kernel tap into the padded output
+        acc = jnp.zeros((N, C, out[0] + pt + pb, out[1] + pl + pr),
+                        v.dtype)
+        for i in range(k[0]):
+            for j in range(k[1]):
+                ys = i * d[0]
+                xs = j * d[1]
+                acc = acc.at[:, :, ys:ys + oh * s[0]:s[0],
+                             xs:xs + ow * s[1]:s[1]].add(cols[:, :, i, j])
+        return acc[:, :, pt:pt + out[0], pl:pl + out[1]]
+
+    return apply(f, x)
+
+
+def affine_grid(theta, out_shape, align_corners=True, name=None):
+    """Sampling grid from affine matrices (operators/affine_grid_op):
+    theta [N,2,3], out_shape [N,C,H,W] -> grid [N,H,W,2] for grid_sample."""
+    if hasattr(out_shape, "numpy"):
+        out_shape = [int(x) for x in np.asarray(out_shape.numpy())]
+    N, C, H, W = (int(x) for x in out_shape)
+
+    def f(th):
+        if align_corners:
+            ys = jnp.linspace(-1.0, 1.0, H)
+            xs = jnp.linspace(-1.0, 1.0, W)
+        else:
+            ys = (jnp.arange(H) * 2 + 1) / H - 1
+            xs = (jnp.arange(W) * 2 + 1) / W - 1
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        base = jnp.stack([gx, gy, jnp.ones_like(gx)], -1)  # [H,W,3]
+        return jnp.einsum("hwk,nik->nhwi", base,
+                          th.astype(jnp.float32)).astype(th.dtype)
+
+    return apply(f, theta)
+
+
+def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
+                   name=None):
+    """TSM channel shift along time (operators/temporal_shift_op):
+    x [N*T, C, H, W] -> same shape with the first fold of channels shifted
+    back one step in time, the second fold forward."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"data_format must be NCHW or NHWC, got {data_format}")
+
+    def f(v):
+        if data_format == "NHWC":
+            v = jnp.moveaxis(v, -1, 1)
+        NT, C, H, W = v.shape
+        T = seg_num
+        B = NT // T
+        c1 = int(C * shift_ratio)
+        c2 = int(C * 2 * shift_ratio)
+        v = v.reshape(B, T, C, H, W)
+        back = jnp.concatenate([v[:, 1:, :c1], jnp.zeros_like(v[:, :1, :c1])],
+                               axis=1)
+        fwd = jnp.concatenate([jnp.zeros_like(v[:, :1, c1:c2]),
+                               v[:, :-1, c1:c2]], axis=1)
+        out = jnp.concatenate([back, fwd, v[:, :, c2:]], axis=2)
+        out = out.reshape(NT, C, H, W)
+        if data_format == "NHWC":
+            out = jnp.moveaxis(out, 1, -1)
+        return out
+
+    return apply(f, x)
+
+
+def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
+                align_corners=True, name=None):
+    def f(v, g):
+        N, C, H, W = v.shape
+        gx, gy = g[..., 0], g[..., 1]
+        if align_corners:
+            ix = (gx + 1) * (W - 1) / 2
+            iy = (gy + 1) * (H - 1) / 2
+        else:
+            ix = ((gx + 1) * W - 1) / 2
+            iy = ((gy + 1) * H - 1) / 2
+
+        def sample(img, yy, xx):
+            x0 = jnp.floor(xx).astype(jnp.int32)
+            y0 = jnp.floor(yy).astype(jnp.int32)
+            x1, y1 = x0 + 1, y0 + 1
+            wx = xx - x0
+            wy = yy - y0
+
+            def get(ix_, iy_):
+                inb = (ix_ >= 0) & (ix_ < W) & (iy_ >= 0) & (iy_ < H)
+                ic = jnp.clip(ix_, 0, W - 1)
+                jc = jnp.clip(iy_, 0, H - 1)
+                val = img[:, jc, ic]  # [C, Ho, Wo]
+                return jnp.where(inb[None], val, 0.0)
+
+            return (get(x0, y0) * (1 - wx) * (1 - wy)
+                    + get(x1, y0) * wx * (1 - wy)
+                    + get(x0, y1) * (1 - wx) * wy
+                    + get(x1, y1) * wx * wy)
+
+        out = jax.vmap(sample)(v, iy, ix)
+        return out
+    return apply(f, x, grid)
+
+
+# alias namespace used by reference code: paddle.nn.functional.common
+def linear_compat(*args, **kwargs):
+    return linear(*args, **kwargs)

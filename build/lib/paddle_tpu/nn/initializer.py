@@ -1,0 +1,170 @@
+"""Weight initializers.
+
+Reference parity: python/paddle/fluid/initializer.py (ConstantInitializer,
+NormalInitializer, XavierInitializer, MSRAInitializer, ...) and
+paddle.nn.initializer.  TPU-native: initializers are pure functions of
+(shape, dtype, PRNG key) — values materialize on device via jax.random, no
+fill ops in a startup program.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.dtype import convert_dtype, get_default_dtype
+
+
+class Initializer:
+    def __call__(self, shape, dtype=None):
+        dtype = convert_dtype(dtype) or get_default_dtype()
+        return self.generate(tuple(int(s) for s in shape), dtype)
+
+    def generate(self, shape, dtype):
+        raise NotImplementedError
+
+
+def _fans(shape):
+    if len(shape) == 0:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        # paddle convention: weight is [in, out]
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[2:]))
+    # conv weight [out_c, in_c, *k]
+    return shape[1] * receptive, shape[0] * receptive
+
+
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        self.value = value
+
+    def generate(self, shape, dtype):
+        return jnp.full(shape, self.value, dtype)
+
+
+class Assign(Initializer):
+    def __init__(self, value):
+        self.value = value
+
+    def generate(self, shape, dtype):
+        v = np.asarray(getattr(self.value, "numpy", lambda: self.value)())
+        return jnp.asarray(v, dtype).reshape(shape)
+
+
+class Uniform(Initializer):
+    def __init__(self, low=-1.0, high=1.0):
+        self.low, self.high = low, high
+
+    def generate(self, shape, dtype):
+        return jax.random.uniform(_random.split_key(), shape, jnp.float32,
+                                  self.low, self.high).astype(dtype)
+
+
+class Normal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def generate(self, shape, dtype):
+        return (jax.random.normal(_random.split_key(), shape, jnp.float32)
+                * self.std + self.mean).astype(dtype)
+
+
+class TruncatedNormal(Initializer):
+    def __init__(self, mean=0.0, std=1.0):
+        self.mean, self.std = mean, std
+
+    def generate(self, shape, dtype):
+        n = jax.random.truncated_normal(_random.split_key(), -2.0, 2.0, shape,
+                                        jnp.float32)
+        return (n * self.std + self.mean).astype(dtype)
+
+
+class XavierUniform(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def generate(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        limit = self.gain * math.sqrt(6.0 / (fi + fo))
+        return jax.random.uniform(_random.split_key(), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class XavierNormal(Initializer):
+    def __init__(self, fan_in=None, fan_out=None, gain=1.0):
+        self.fan_in, self.fan_out, self.gain = fan_in, fan_out, gain
+
+    def generate(self, shape, dtype):
+        fi, fo = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        fo = self.fan_out if self.fan_out is not None else fo
+        std = self.gain * math.sqrt(2.0 / (fi + fo))
+        return (jax.random.normal(_random.split_key(), shape, jnp.float32)
+                * std).astype(dtype)
+
+
+class KaimingUniform(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def generate(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        limit = gain * math.sqrt(3.0 / fi)
+        return jax.random.uniform(_random.split_key(), shape, jnp.float32,
+                                  -limit, limit).astype(dtype)
+
+
+class KaimingNormal(Initializer):
+    def __init__(self, fan_in=None, negative_slope=0.0, nonlinearity="relu"):
+        self.fan_in = fan_in
+        self.negative_slope = negative_slope
+
+    def generate(self, shape, dtype):
+        fi, _ = _fans(shape)
+        fi = self.fan_in if self.fan_in is not None else fi
+        gain = math.sqrt(2.0 / (1 + self.negative_slope ** 2))
+        std = gain / math.sqrt(fi)
+        return (jax.random.normal(_random.split_key(), shape, jnp.float32)
+                * std).astype(dtype)
+
+
+class Orthogonal(Initializer):
+    def __init__(self, gain=1.0):
+        self.gain = gain
+
+    def generate(self, shape, dtype):
+        return (jax.nn.initializers.orthogonal(self.gain)(
+            _random.split_key(), shape, jnp.float32)).astype(dtype)
+
+
+class Dirac(Initializer):
+    def __init__(self, groups=1):
+        self.groups = groups
+
+    def generate(self, shape, dtype):
+        out = np.zeros(shape, np.float32)
+        oc, ic = shape[0], shape[1]
+        for i in range(min(oc, ic * self.groups)):
+            idx = tuple([i, i % ic] + [s // 2 for s in shape[2:]])
+            out[idx] = 1.0
+        return jnp.asarray(out, dtype)
+
+
+# default aliases matching fluid.initializer
+ConstantInitializer = Constant
+NormalInitializer = Normal
+UniformInitializer = Uniform
+XavierInitializer = XavierUniform
+MSRAInitializer = KaimingNormal
+TruncatedNormalInitializer = TruncatedNormal

@@ -1,0 +1,322 @@
+"""Recurrent layers. Reference: python/paddle/nn/layer/rnn.py over
+lstm/gru/cudnn_lstm ops (operators/rnn_op, cudnn_lstm_op.cu).
+
+TPU-native: the whole sequence recurrence is ONE `lax.scan` inside a single
+apply() — XLA compiles the loop body once; no per-timestep python dispatch,
+and the scan differentiates through cleanly on the tape (the cudnn_lstm
+analog).  Gate order is [i, f, g, o] (LSTM) / [r, z, n] (GRU), matching the
+reference kernels.
+"""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, apply
+from ..layer_base import Layer
+from .. import functional as F
+from .. import initializer as I
+
+
+def _lstm_step(params, h, c, x):
+    w_ih, w_hh, b_ih, b_hh = params
+    gates = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    i, f, g, o = jnp.split(gates, 4, axis=-1)
+    i, f, o = jax.nn.sigmoid(i), jax.nn.sigmoid(f), jax.nn.sigmoid(o)
+    g = jnp.tanh(g)
+    c_new = f * c + i * g
+    h_new = o * jnp.tanh(c_new)
+    return h_new, c_new
+
+
+def _gru_step(params, h, x):
+    w_ih, w_hh, b_ih, b_hh = params
+    gi = x @ w_ih.T + b_ih
+    gh = h @ w_hh.T + b_hh
+    ri, zi, ni = jnp.split(gi, 3, axis=-1)
+    rh, zh, nh = jnp.split(gh, 3, axis=-1)
+    r = jax.nn.sigmoid(ri + rh)
+    z = jax.nn.sigmoid(zi + zh)
+    n = jnp.tanh(ni + r * nh)
+    return (1 - z) * n + z * h
+
+
+def _rnn_step(params, h, x, activation):
+    w_ih, w_hh, b_ih, b_hh = params
+    a = x @ w_ih.T + h @ w_hh.T + b_ih + b_hh
+    return jnp.tanh(a) if activation == "tanh" else jax.nn.relu(a)
+
+
+class _RNNCellBase(Layer):
+    def __init__(self, input_size, hidden_size, gate_mult, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        self.weight_ih = self.create_parameter(
+            [gate_mult * hidden_size, input_size], attr=weight_ih_attr,
+            default_initializer=init)
+        self.weight_hh = self.create_parameter(
+            [gate_mult * hidden_size, hidden_size], attr=weight_hh_attr,
+            default_initializer=init)
+        self.bias_ih = self.create_parameter(
+            [gate_mult * hidden_size], attr=bias_ih_attr, is_bias=True,
+            default_initializer=init)
+        self.bias_hh = self.create_parameter(
+            [gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True,
+            default_initializer=init)
+
+    def get_initial_states(self, batch_size, dtype="float32"):
+        z = Tensor(jnp.zeros([batch_size, self.hidden_size]))
+        return z
+
+
+class LSTMCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 4, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            b = inputs.shape[0]
+            states = (self.get_initial_states(b), self.get_initial_states(b))
+        h, c = states
+
+        def f(x, h_, c_, wi, wh, bi, bh):
+            return _lstm_step((wi, wh, bi, bh), h_, c_, x)
+
+        h_new, c_new = apply(f, inputs, h, c, self.weight_ih, self.weight_hh,
+                             self.bias_ih, self.bias_hh, _multi_out=True)
+        return h_new, (h_new, c_new)
+
+
+class GRUCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, weight_ih_attr=None,
+                 weight_hh_attr=None, bias_ih_attr=None, bias_hh_attr=None,
+                 name=None):
+        super().__init__(input_size, hidden_size, 3, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+        h = states
+
+        def f(x, h_, wi, wh, bi, bh):
+            return _gru_step((wi, wh, bi, bh), h_, x)
+
+        h_new = apply(f, inputs, h, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh)
+        return h_new, h_new
+
+
+class SimpleRNNCell(_RNNCellBase):
+    def __init__(self, input_size, hidden_size, activation="tanh",
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__(input_size, hidden_size, 1, weight_ih_attr,
+                         weight_hh_attr, bias_ih_attr, bias_hh_attr)
+        self.activation = activation
+
+    def forward(self, inputs, states=None):
+        if states is None:
+            states = self.get_initial_states(inputs.shape[0])
+        h = states
+
+        def f(x, h_, wi, wh, bi, bh):
+            return _rnn_step((wi, wh, bi, bh), h_, x, self.activation)
+
+        h_new = apply(f, inputs, h, self.weight_ih, self.weight_hh,
+                      self.bias_ih, self.bias_hh)
+        return h_new, h_new
+
+
+class RNN(Layer):
+    """Run a cell over a sequence (python loop — use LSTM/GRU classes for the
+    fused scan path)."""
+
+    def __init__(self, cell, is_reverse=False, time_major=False):
+        super().__init__()
+        self.cell = cell
+        self.is_reverse = is_reverse
+        self.time_major = time_major
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor_ops as T
+
+        if not self.time_major:
+            inputs = T.transpose(inputs, [1, 0, 2])
+        steps = range(inputs.shape[0])
+        if self.is_reverse:
+            steps = reversed(list(steps))
+        states = initial_states
+        outs = []
+        for t in steps:
+            out, states = self.cell(inputs[t], states)
+            outs.append(out)
+        if self.is_reverse:
+            outs = outs[::-1]
+        out_seq = T.stack(outs, axis=0)
+        if not self.time_major:
+            out_seq = T.transpose(out_seq, [1, 0, 2])
+        return out_seq, states
+
+
+class _RNNBase(Layer):
+    """Multi-layer (optionally bidirectional) recurrence via lax.scan."""
+
+    MODE = "LSTM"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 weight_ih_attr=None, weight_hh_attr=None, bias_ih_attr=None,
+                 bias_hh_attr=None, name=None):
+        super().__init__()
+        self.input_size = input_size
+        self.hidden_size = hidden_size
+        self.num_layers = num_layers
+        self.direction = direction
+        self.time_major = time_major
+        self.dropout = dropout
+        self.num_directions = 2 if direction in ("bidirect", "bidirectional") else 1
+        gate_mult = {"LSTM": 4, "GRU": 3, "RNN_TANH": 1, "RNN_RELU": 1}[self.MODE]
+        std = 1.0 / math.sqrt(hidden_size)
+        init = I.Uniform(-std, std)
+        for layer in range(num_layers):
+            for d in range(self.num_directions):
+                in_sz = input_size if layer == 0 \
+                    else hidden_size * self.num_directions
+                sfx = f"_l{layer}" + ("_reverse" if d else "")
+                self.add_parameter(
+                    f"weight_ih{sfx}",
+                    self.create_parameter([gate_mult * hidden_size, in_sz],
+                                          default_initializer=init))
+                self.add_parameter(
+                    f"weight_hh{sfx}",
+                    self.create_parameter([gate_mult * hidden_size, hidden_size],
+                                          default_initializer=init))
+                self.add_parameter(
+                    f"bias_ih{sfx}",
+                    self.create_parameter([gate_mult * hidden_size],
+                                          default_initializer=init, is_bias=True))
+                self.add_parameter(
+                    f"bias_hh{sfx}",
+                    self.create_parameter([gate_mult * hidden_size],
+                                          default_initializer=init, is_bias=True))
+
+    def _layer_params(self, layer, d):
+        sfx = f"_l{layer}" + ("_reverse" if d else "")
+        return (self._parameters[f"weight_ih{sfx}"],
+                self._parameters[f"weight_hh{sfx}"],
+                self._parameters[f"bias_ih{sfx}"],
+                self._parameters[f"bias_hh{sfx}"])
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor_ops as T
+
+        is_lstm = self.MODE == "LSTM"
+        L, D, H = self.num_layers, self.num_directions, self.hidden_size
+        batch_axis = 1 if self.time_major else 0
+        B = inputs.shape[batch_axis]
+
+        if initial_states is None:
+            z = Tensor(jnp.zeros([L * D, B, H], dtype=inputs.dtype))
+            initial_states = (z, z.clone()) if is_lstm else z
+
+        mode = self.MODE
+
+        def run(x, h0, c0, *flat_params):
+            # x: [B,S,I] or [S,B,I] -> time-major [S,B,I]
+            if not self.time_major:
+                x = jnp.swapaxes(x, 0, 1)
+            params = [flat_params[i * 4:(i + 1) * 4]
+                      for i in range(L * D)]
+            h_outs, c_outs = [], []
+            for layer in range(L):
+                dir_outs = []
+                for d in range(D):
+                    p = params[layer * D + d]
+                    xs = jnp.flip(x, 0) if d else x
+                    h_init = h0[layer * D + d]
+                    c_init = c0[layer * D + d] if is_lstm else None
+
+                    if mode == "LSTM":
+                        def step(carry, xt, p=p):
+                            h_, c_ = carry
+                            hn, cn = _lstm_step(p, h_, c_, xt)
+                            return (hn, cn), hn
+                        (hT, cT), ys = jax.lax.scan(step, (h_init, c_init), xs)
+                        c_outs.append(cT)
+                    elif mode == "GRU":
+                        def step(h_, xt, p=p):
+                            hn = _gru_step(p, h_, xt)
+                            return hn, hn
+                        hT, ys = jax.lax.scan(step, h_init, xs)
+                    else:
+                        act = "tanh" if mode == "RNN_TANH" else "relu"
+
+                        def step(h_, xt, p=p, act=act):
+                            hn = _rnn_step(p, h_, xt, act)
+                            return hn, hn
+                        hT, ys = jax.lax.scan(step, h_init, xs)
+                    h_outs.append(hT)
+                    if d:
+                        ys = jnp.flip(ys, 0)
+                    dir_outs.append(ys)
+                x = jnp.concatenate(dir_outs, axis=-1) if D > 1 else dir_outs[0]
+            out = x if self.time_major else jnp.swapaxes(x, 0, 1)
+            hs = jnp.stack(h_outs, 0)
+            cs = jnp.stack(c_outs, 0) if is_lstm else jnp.zeros_like(hs)
+            return out, hs, cs
+
+        flat = []
+        for layer in range(L):
+            for d in range(D):
+                flat.extend(self._layer_params(layer, d))
+        h0, c0 = (initial_states if is_lstm else (initial_states, initial_states))
+        out, hs, cs = apply(run, inputs, h0, c0, *flat, _multi_out=True)
+        if is_lstm:
+            return out, (hs, cs)
+        return out, hs
+
+
+class LSTM(_RNNBase):
+    MODE = "LSTM"
+
+
+class GRU(_RNNBase):
+    MODE = "GRU"
+
+
+class SimpleRNN(_RNNBase):
+    MODE = "RNN_TANH"
+
+    def __init__(self, input_size, hidden_size, num_layers=1,
+                 direction="forward", time_major=False, dropout=0.0,
+                 activation="tanh", **kwargs):
+        self.MODE = "RNN_TANH" if activation == "tanh" else "RNN_RELU"
+        super().__init__(input_size, hidden_size, num_layers, direction,
+                         time_major, dropout, **kwargs)
+
+
+class BiRNN(Layer):
+    def __init__(self, cell_fw, cell_bw, time_major=False):
+        super().__init__()
+        self.rnn_fw = RNN(cell_fw, is_reverse=False, time_major=time_major)
+        self.rnn_bw = RNN(cell_bw, is_reverse=True, time_major=time_major)
+
+    def forward(self, inputs, initial_states=None, sequence_length=None):
+        from ... import tensor_ops as T
+
+        if initial_states is None:
+            initial_states = (None, None)
+        out_fw, st_fw = self.rnn_fw(inputs, initial_states[0])
+        out_bw, st_bw = self.rnn_bw(inputs, initial_states[1])
+        return T.concat([out_fw, out_bw], axis=-1), (st_fw, st_bw)

@@ -1,0 +1,439 @@
+"""nn.Layer: the module base class, plus the functional bridge to jax.jit.
+
+Reference parity: python/paddle/fluid/dygraph/layers.py (Layer — parameters,
+sublayers, hooks, state_dict) and framework.py ParamAttr/Parameter (:5244).
+
+TPU-native twist: a Layer is simultaneously
+  (a) an eager stateful module (paddle dygraph UX: params are attributes,
+      forward mutates running stats, loss.backward() works), and
+  (b) a pure function of its parameters via `functional_call`, which swaps
+      traced arrays into the Parameter slots and runs the same forward code
+      under jax tracing.  This is what lets jax.jit/pjit compile whole train
+      steps without an AST translator (the reference needs
+      dygraph_to_static/program_translator.py:233 for this; here tracing IS
+      the execution model).
+"""
+from __future__ import annotations
+
+import contextlib
+from collections import OrderedDict
+from typing import Any, Callable, Iterator
+
+import jax
+import numpy as np
+
+from .. import autograd
+from ..framework import random as _random
+from ..framework.dtype import convert_dtype, get_default_dtype
+from ..tensor import Tensor
+from . import initializer as I
+
+
+class Parameter(Tensor):
+    def __init__(self, value, dtype=None, name=None, trainable=True):
+        super().__init__(value, dtype=dtype, stop_gradient=not trainable, name=name)
+        self.optimize_attr = {"learning_rate": 1.0}
+        self.regularizer = None
+        self.need_clip = True
+
+    @property
+    def trainable(self):
+        return not self.stop_gradient
+
+    @trainable.setter
+    def trainable(self, v):
+        self.stop_gradient = not v
+
+    def set_value(self, value):
+        v = value.value if isinstance(value, Tensor) else jax.numpy.asarray(value)
+        self._value = v.astype(self.dtype) if v.dtype != self.dtype else v
+
+    def __repr__(self):
+        return "Parameter containing:\n" + super().__repr__()
+
+
+# give plain Tensors set_value too (used for buffers)
+def _tensor_set_value(self, value):
+    v = value.value if isinstance(value, Tensor) else jax.numpy.asarray(value)
+    self._value = v
+
+
+Tensor.set_value = _tensor_set_value
+
+
+class ParamAttr:
+    """Reference parity: python/paddle/fluid/param_attr.py ParamAttr."""
+
+    def __init__(self, name=None, initializer=None, learning_rate=1.0,
+                 regularizer=None, trainable=True, do_model_average=True,
+                 need_clip=True):
+        self.name = name
+        self.initializer = initializer
+        self.learning_rate = learning_rate
+        self.regularizer = regularizer
+        self.trainable = trainable
+        self.need_clip = need_clip
+
+    @staticmethod
+    def _to_attr(attr):
+        if attr is None:
+            return ParamAttr()
+        if isinstance(attr, ParamAttr):
+            return attr
+        if attr is False:
+            return False
+        if isinstance(attr, str):
+            return ParamAttr(name=attr)
+        if isinstance(attr, I.Initializer):
+            return ParamAttr(initializer=attr)
+        raise TypeError(f"Cannot make ParamAttr from {attr!r}")
+
+
+_name_counters: dict[str, int] = {}
+
+
+def _unique_name(prefix: str) -> str:
+    n = _name_counters.get(prefix, 0)
+    _name_counters[prefix] = n + 1
+    return f"{prefix}_{n}"
+
+
+class Layer:
+    def __init__(self, name_scope: str | None = None, dtype="float32"):
+        self.training = True
+        self._dtype = convert_dtype(dtype) or get_default_dtype()
+        self._full_name = _unique_name(name_scope or type(self).__name__.lower())
+        self._parameters: OrderedDict[str, Parameter] = OrderedDict()
+        self._sub_layers: OrderedDict[str, "Layer"] = OrderedDict()
+        self._buffers: OrderedDict[str, Tensor] = OrderedDict()
+        self._non_persistable_buffer_names: set[str] = set()
+        self._forward_pre_hooks: OrderedDict[int, Callable] = OrderedDict()
+        self._forward_post_hooks: OrderedDict[int, Callable] = OrderedDict()
+
+    # -- attribute routing -------------------------------------------------
+    def __setattr__(self, name, value):
+        params = self.__dict__.get("_parameters")
+        subs = self.__dict__.get("_sub_layers")
+        bufs = self.__dict__.get("_buffers")
+        if params is not None and isinstance(value, Parameter):
+            for d in (subs, bufs):
+                d.pop(name, None)
+            params[name] = value
+            self.__dict__.pop(name, None)
+        elif subs is not None and isinstance(value, Layer):
+            for d in (params, bufs):
+                d.pop(name, None)
+            subs[name] = value
+            self.__dict__.pop(name, None)
+        elif bufs is not None and isinstance(value, Tensor):
+            for d in (params, subs):
+                d.pop(name, None)
+            bufs[name] = value
+            self._non_persistable_buffer_names.add(name)
+            self.__dict__.pop(name, None)
+        else:
+            for d in (params, subs, bufs):
+                if d is not None:
+                    d.pop(name, None)
+            object.__setattr__(self, name, value)
+
+    def __getattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                return d[name]
+        raise AttributeError(f"{type(self).__name__!r} has no attribute {name!r}")
+
+    def __delattr__(self, name):
+        for store in ("_parameters", "_sub_layers", "_buffers"):
+            d = self.__dict__.get(store)
+            if d is not None and name in d:
+                del d[name]
+                return
+        object.__delattr__(self, name)
+
+    def __dir__(self):
+        return list(super().__dir__()) + list(self._parameters) + \
+            list(self._sub_layers) + list(self._buffers)
+
+    # -- construction helpers ---------------------------------------------
+    def create_parameter(self, shape, attr=None, dtype=None, is_bias=False,
+                         default_initializer=None) -> Parameter | None:
+        attr = ParamAttr._to_attr(attr)
+        if attr is False:
+            return None
+        dtype = convert_dtype(dtype) or self._dtype
+        init = attr.initializer or default_initializer or \
+            (I.Constant(0.0) if is_bias else I.XavierUniform())
+        value = init(shape, dtype)
+        p = Parameter(value, name=attr.name or _unique_name("param"),
+                      trainable=attr.trainable)
+        p.optimize_attr["learning_rate"] = attr.learning_rate
+        p.regularizer = attr.regularizer
+        p.need_clip = attr.need_clip
+        return p
+
+    def add_parameter(self, name, parameter):
+        self._parameters[name] = parameter
+        return parameter
+
+    def add_sublayer(self, name, sublayer):
+        self._sub_layers[str(name)] = sublayer
+        return sublayer
+
+    def register_buffer(self, name, tensor, persistable=True):
+        self._buffers[name] = tensor
+        if not persistable:
+            self._non_persistable_buffer_names.add(name)
+        else:
+            self._non_persistable_buffer_names.discard(name)
+        return tensor
+
+    def create_tensor(self, name=None, persistable=False, dtype=None):
+        import jax.numpy as jnp
+
+        t = Tensor(jnp.zeros([], convert_dtype(dtype) or self._dtype))
+        if name:
+            self.register_buffer(name, t, persistable)
+        return t
+
+    # -- traversal ---------------------------------------------------------
+    def named_sublayers(self, prefix="", include_self=False, layers_set=None
+                        ) -> Iterator[tuple[str, "Layer"]]:
+        if layers_set is None:
+            layers_set = set()
+        if id(self) in layers_set:
+            return
+        layers_set.add(id(self))
+        if include_self:
+            yield prefix, self
+        for name, sub in self._sub_layers.items():
+            if sub is None:
+                continue
+            p = f"{prefix}.{name}" if prefix else name
+            yield from sub.named_sublayers(prefix=p, include_self=True,
+                                           layers_set=layers_set)
+
+    def sublayers(self, include_self=False):
+        return [l for _, l in self.named_sublayers(include_self=include_self)]
+
+    def children(self):
+        return list(self._sub_layers.values())
+
+    def named_children(self):
+        return list(self._sub_layers.items())
+
+    def named_parameters(self, prefix="", include_sublayers=True
+                         ) -> Iterator[tuple[str, Parameter]]:
+        seen = set()
+        for layer_name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for pname, p in layer._parameters.items():
+                if p is None or id(p) in seen:
+                    continue
+                seen.add(id(p))
+                yield (f"{layer_name}.{pname}" if layer_name else pname), p
+
+    def parameters(self, include_sublayers=True) -> list[Parameter]:
+        return [p for _, p in self.named_parameters()]
+
+    def named_buffers(self, prefix="", include_sublayers=True):
+        seen = set()
+        for layer_name, layer in self.named_sublayers(prefix=prefix, include_self=True):
+            for bname, b in layer._buffers.items():
+                if b is None or id(b) in seen:
+                    continue
+                seen.add(id(b))
+                yield (f"{layer_name}.{bname}" if layer_name else bname), b
+
+    def buffers(self, include_sublayers=True):
+        return [b for _, b in self.named_buffers()]
+
+    def apply(self, fn):
+        for l in self.sublayers(include_self=True):
+            fn(l)
+        return self
+
+    # -- mode --------------------------------------------------------------
+    def train(self):
+        for l in self.sublayers(include_self=True):
+            l.training = True
+        return self
+
+    def eval(self):
+        for l in self.sublayers(include_self=True):
+            l.training = False
+        return self
+
+    # -- state dict ---------------------------------------------------------
+    def state_dict(self, destination=None, include_sublayers=True,
+                   structured_name_prefix="", use_hook=True):
+        dest = destination if destination is not None else OrderedDict()
+        for name, p in self.named_parameters(prefix=structured_name_prefix):
+            dest[name] = p
+        for name, b in self.named_buffers(prefix=structured_name_prefix):
+            short = name.rsplit(".", 1)[-1]
+            owner = self
+            if "." in name:
+                # find owning layer to check persistability
+                path = name.rsplit(".", 1)[0]
+                for ln, l in self.named_sublayers(include_self=True):
+                    if ln == path:
+                        owner = l
+                        break
+            if short not in owner._non_persistable_buffer_names:
+                dest[name] = b
+        return dest
+
+    def set_state_dict(self, state_dict, use_structured_name=True):
+        own = self.state_dict()
+        missing, unexpected = [], []
+        for name, value in state_dict.items():
+            if name not in own:
+                unexpected.append(name)
+                continue
+            target = own[name]
+            v = value.value if isinstance(value, Tensor) else np.asarray(value)
+            if tuple(np.shape(v)) != tuple(target.shape):
+                raise ValueError(
+                    f"shape mismatch for {name}: loaded {np.shape(v)} vs "
+                    f"{tuple(target.shape)}")
+            target.set_value(v)
+        for name in own:
+            if name not in state_dict:
+                missing.append(name)
+        return missing, unexpected
+
+    load_dict = set_state_dict
+    set_dict = set_state_dict
+
+    # -- hooks ---------------------------------------------------------------
+    def register_forward_pre_hook(self, hook):
+        h = _HookRemoveHelper(self._forward_pre_hooks, hook)
+        return h
+
+    def register_forward_post_hook(self, hook):
+        h = _HookRemoveHelper(self._forward_post_hooks, hook)
+        return h
+
+    # -- execution -----------------------------------------------------------
+    def forward(self, *args, **kwargs):
+        raise NotImplementedError
+
+    def __call__(self, *args, **kwargs):
+        for hook in self._forward_pre_hooks.values():
+            res = hook(self, args)
+            if res is not None:
+                args = res if isinstance(res, tuple) else (res,)
+        out = self.forward(*args, **kwargs)
+        for hook in self._forward_post_hooks.values():
+            res = hook(self, args, out)
+            if res is not None:
+                out = res
+        return out
+
+    # -- dtype / device conversion -------------------------------------------
+    def to(self, device=None, dtype=None, blocking=None):
+        if dtype is not None:
+            self.astype(dtype)
+        return self
+
+    def astype(self, dtype):
+        dt = convert_dtype(dtype)
+        for p in self.parameters():
+            p._value = p._value.astype(dt)
+        for b in self.buffers():
+            if jax.numpy.issubdtype(b.dtype, jax.numpy.floating):
+                b._value = b._value.astype(dt)
+        for l in self.sublayers(include_self=True):
+            l._dtype = dt
+        return self
+
+    def float(self):
+        return self.astype("float32")
+
+    def half(self):
+        return self.astype("float16")
+
+    def bfloat16(self):
+        return self.astype("bfloat16")
+
+    def full_name(self):
+        return self._full_name
+
+    def clear_gradients(self):
+        for p in self.parameters():
+            p.clear_grad()
+
+    def extra_repr(self) -> str:
+        return ""
+
+    def __repr__(self):
+        extra = self.extra_repr()
+        lines = [f"{type(self).__name__}({extra}"]
+        for name, sub in self._sub_layers.items():
+            sub_repr = repr(sub).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {sub_repr}")
+        return "\n".join(lines) + ")" if len(lines) > 1 else lines[0] + ")"
+
+    @contextlib.contextmanager
+    def no_sync(self):
+        yield  # DataParallel grad-sync pause: a no-op outside DP
+
+
+class _HookRemoveHelper:
+    _next_id = 0
+
+    def __init__(self, hooks_dict, hook):
+        self._hooks = hooks_dict
+        self._id = _HookRemoveHelper._next_id
+        _HookRemoveHelper._next_id += 1
+        hooks_dict[self._id] = hook
+
+    def remove(self):
+        self._hooks.pop(self._id, None)
+
+
+# ---------------------------------------------------------------------------
+# functional bridge: Layer -> pure function of params (the jit path)
+# ---------------------------------------------------------------------------
+def state_pytrees(layer: Layer):
+    """Extract (params, buffers) as flat {name: jax.Array} dicts."""
+    params = {k: p.value for k, p in layer.named_parameters()}
+    buffers = {k: b.value for k, b in layer.named_buffers()}
+    return params, buffers
+
+
+@contextlib.contextmanager
+def _swapped_state(layer: Layer, params: dict | None, buffers: dict | None):
+    saved: list[tuple[Tensor, Any]] = []
+    pmap = dict(layer.named_parameters())
+    bmap = dict(layer.named_buffers())
+    try:
+        for name, val in (params or {}).items():
+            t = pmap[name]
+            saved.append((t, t._value))
+            t._value = val
+        # snapshot ALL buffers: forward may rebind them (running stats) and a
+        # traced value must never leak into eager layer state
+        for name, t in bmap.items():
+            saved.append((t, t._value))
+            if buffers and name in buffers:
+                t._value = buffers[name]
+        yield bmap
+    finally:
+        for t, old in saved:
+            t._value = old
+
+
+def functional_call(layer: Layer, params: dict | None, args=(), kwargs=None,
+                    buffers: dict | None = None, rng=None, mutable: bool = True):
+    """Run layer.forward with `params`/`buffers` substituted, returning
+    (output, new_buffers).  Safe to call inside jax.jit/grad tracing: the
+    tape is suspended and randomness must come from `rng`.
+    """
+    kwargs = kwargs or {}
+    ctx = _random.rng_guard(rng) if rng is not None else contextlib.nullcontext()
+    with autograd.suspend_tape(), ctx, _swapped_state(layer, params, buffers) as bmap:
+        out = layer(*args, **kwargs)
+        new_buffers = {k: t.value for k, t in bmap.items()} if mutable else None
+    return out, new_buffers

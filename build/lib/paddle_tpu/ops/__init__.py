@@ -1,0 +1,39 @@
+"""Op registry: name -> lowering.
+
+Reference parity: paddle/fluid/framework/op_registry.h (REGISTER_OPERATOR /
+REGISTER_OP_*_KERNEL) + OpInfoMap (op_info.h:132).  TPU-native: an op is a
+python callable lowering to jnp/lax/Pallas; the registry exists for (a) API
+parity tooling (coverage reports vs the reference's 546 op types), (b) test
+harness dispatch (tests/op_test.py), and (c) fused-kernel substitution — a
+"kernel key" here is just which implementation (xla | pallas) serves a name.
+"""
+from __future__ import annotations
+
+from typing import Callable
+
+_OPS: dict[str, dict[str, Callable]] = {}
+
+
+def register_op(name: str, impl: str = "xla"):
+    def deco(fn):
+        _OPS.setdefault(name, {})[impl] = fn
+        return fn
+    return deco
+
+
+def get_op(name: str, impl: str | None = None) -> Callable:
+    entry = _OPS[name]
+    if impl is not None:
+        return entry[impl]
+    from ..framework.flags import flag
+
+    if flag("FLAGS_use_pallas_kernels") and "pallas" in entry:
+        return entry["pallas"]
+    return entry["xla"]
+
+
+def registered_ops() -> list[str]:
+    return sorted(_OPS)
+
+
+from . import fused  # noqa: E402,F401

@@ -1,0 +1,279 @@
+"""Fused transformer-path ops.
+
+Reference parity: paddle/fluid/operators/fused/ — multihead_matmul_op.cu
+(BERT attention), skip_layernorm_op.cu (residual+LN), layer_norm_op.cu fused
+kernels, softmax_with_cross_entropy_op.cu (fused loss), and
+math/bert_encoder_functor.cu.  BASELINE.json additionally names
+fused_attention / fused_feedforward / fused_multi_transformer as intent.
+
+TPU-native: each fused op has an XLA composite implementation (XLA fuses the
+elementwise pieces into the matmuls on its own) and, for the hot ones, a
+Pallas TPU kernel (ops/pallas/) that takes over when FLAGS_use_pallas_kernels
+is on AND the arrays live on a TPU backend.  Selection happens here.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+from ..framework.flags import flag
+from ..tensor import Tensor, apply, unwrap
+
+
+@functools.lru_cache(maxsize=1)
+def _tpu_available() -> bool:
+    try:
+        return jax.default_backend() not in ("cpu",)
+    except Exception:
+        return False
+
+
+def _use_pallas() -> bool:
+    return bool(flag("FLAGS_use_pallas_kernels")) and _tpu_available()
+
+
+# ---------------------------------------------------------------------------
+# layer norm (fused scale+shift; Pallas row kernel on TPU)
+# ---------------------------------------------------------------------------
+def layer_norm(x, weight, bias, epsilon=1e-5):
+    if _use_pallas():
+        from .pallas import layer_norm as pln
+
+        try:
+            return apply(lambda v, w, b: pln.layer_norm(v, w, b, epsilon),
+                         x, weight, bias)
+        except Exception:
+            pass
+
+    def f(v, w, b):
+        mean = jnp.mean(v, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(v - mean), axis=-1, keepdims=True)
+        return (v - mean) * jax.lax.rsqrt(var + epsilon) * w + b
+
+    return apply(f, x, weight, bias)
+
+
+def skip_layer_norm(x, residual, weight, bias, epsilon=1e-5):
+    """residual-add + LN in one op (skip_layernorm_op.cu analog)."""
+    def f(v, r, w, b):
+        h = v + r
+        mean = jnp.mean(h, axis=-1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mean), axis=-1, keepdims=True)
+        return (h - mean) * jax.lax.rsqrt(var + epsilon) * w + b
+    return apply(f, x, residual, weight, bias)
+
+
+# ---------------------------------------------------------------------------
+# softmax cross entropy (fused, numerically stable)
+# ---------------------------------------------------------------------------
+def softmax_cross_entropy(logits, label, ignore_index=-100):
+    def f(z, l):
+        li = l.astype(jnp.int32)
+        if li.ndim == z.ndim:
+            li = jnp.squeeze(li, -1)
+        m = jnp.max(z, axis=-1, keepdims=True)
+        shifted = z - jax.lax.stop_gradient(m)
+        lse = jnp.log(jnp.sum(jnp.exp(shifted), axis=-1))
+        picked = jnp.take_along_axis(shifted, li[..., None], axis=-1)[..., 0]
+        loss = lse - picked
+        return jnp.where(li == ignore_index, 0.0, loss)
+    return apply(f, logits, label)
+
+
+# ---------------------------------------------------------------------------
+# fused LM-head matmul + cross entropy, chunked over the vocab
+# ---------------------------------------------------------------------------
+def _flce_impl(h, w, labels, chunk):
+    """Online-logsumexp over vocab chunks: never materializes the full
+    [N, V] logits in fp32 (the [B*S, 30k+] fp32 buffer is the single
+    largest allocation in a BERT/GPT loss)."""
+    N, H = h.shape
+    V = w.shape[1]
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    wp = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    w_chunks = wp.reshape(H, n_chunks, chunk).transpose(1, 0, 2)
+    hf = h.astype(jnp.float32)
+    li = labels.astype(jnp.int32)
+
+    def body(carry, wc_i):
+        m, s, picked = carry
+        wc, i = wc_i
+        z = (hf @ wc.astype(jnp.float32))              # [N, chunk] fp32
+        base = i * chunk
+        # mask padded vocab tail
+        valid = (base + jnp.arange(chunk)) < V
+        z = jnp.where(valid[None, :], z, -jnp.inf)
+        m_new = jnp.maximum(m, z.max(-1))
+        s = s * jnp.exp(m - m_new) + jnp.exp(
+            z - m_new[:, None]).sum(-1)
+        in_chunk = (li >= base) & (li < base + chunk)
+        local = jnp.clip(li - base, 0, chunk - 1)
+        picked = picked + jnp.where(
+            in_chunk, jnp.take_along_axis(z, local[:, None], 1)[:, 0], 0.0)
+        return (m_new, s, picked), None
+
+    init = (jnp.full((N,), -jnp.inf, jnp.float32),
+            jnp.zeros((N,), jnp.float32), jnp.zeros((N,), jnp.float32))
+    (m, s, picked), _ = jax.lax.scan(
+        body, init, (w_chunks, jnp.arange(n_chunks)))
+    return jnp.log(s) + m - picked, (m, s)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3,))
+def _flce(h, w, labels, chunk):
+    loss, _ = _flce_impl(h, w, labels, chunk)
+    return loss
+
+
+def _flce_fwd(h, w, labels, chunk):
+    loss, (m, s) = _flce_impl(h, w, labels, chunk)
+    return loss, (h, w, labels, m, s)
+
+
+def _flce_bwd(chunk, res, g):
+    h, w, labels, m, s = res
+    N, H = h.shape
+    V = w.shape[1]
+    n_chunks = -(-V // chunk)
+    Vp = n_chunks * chunk
+    wp = jnp.pad(w, ((0, 0), (0, Vp - V)))
+    w_chunks = wp.reshape(H, n_chunks, chunk).transpose(1, 0, 2)
+    hf = h.astype(jnp.float32)
+    li = labels.astype(jnp.int32)
+    lse = jnp.log(s) + m
+    gf = g.astype(jnp.float32)
+
+    def body(dh, wc_i):
+        wc, i = wc_i
+        wcf = wc.astype(jnp.float32)
+        z = hf @ wcf
+        base = i * chunk
+        valid = (base + jnp.arange(chunk)) < V
+        p = jnp.where(valid[None, :], jnp.exp(z - lse[:, None]), 0.0)
+        onehot = ((li[:, None] - base) ==
+                  jnp.arange(chunk)[None, :]).astype(jnp.float32)
+        dz = (p - onehot) * gf[:, None]               # [N, chunk]
+        dh = dh + dz @ wcf.T
+        dwc = hf.T @ dz                               # [H, chunk]
+        return dh, dwc
+
+    dh, dwcs = jax.lax.scan(body, jnp.zeros((N, H), jnp.float32),
+                            (w_chunks, jnp.arange(n_chunks)))
+    dw = dwcs.transpose(1, 0, 2).reshape(H, Vp)[:, :V]
+    return dh.astype(h.dtype), dw.astype(w.dtype), None
+
+
+_flce.defvjp(_flce_fwd, _flce_bwd)
+
+
+def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192):
+    """loss = cross_entropy(hidden @ weight, labels), streamed over vocab
+    chunks (TPU-native extension; the reference's closest analog is the
+    fused softmax_with_cross_entropy_op.cc — this additionally fuses the
+    LM-head matmul so the fp32 [N, V] logits never hit HBM at once).
+
+    hidden [..., H], weight [H, V], labels [...] int. Returns per-token
+    loss with hidden's leading shape.
+    """
+    def f(h, w, l):
+        lead = h.shape[:-1]
+        hf = h.reshape(-1, h.shape[-1])
+        lf = l.reshape(-1)
+        loss = _flce(hf, w, lf, chunk_size)
+        return loss.reshape(lead)
+
+    return apply(f, hidden, weight, labels)
+
+
+# ---------------------------------------------------------------------------
+# attention
+# ---------------------------------------------------------------------------
+def scaled_dot_product_attention(query, key, value, attn_mask=None,
+                                 dropout_p=0.0, is_causal=False, training=True):
+    """[B, S, H, D] in, [B, S, H, D] out (paddle layout)."""
+    if (_use_pallas() and dropout_p == 0.0 and attn_mask is None):
+        from .pallas import flash_attention as fa
+
+        try:
+            return apply(
+                lambda q, k, v: fa.flash_attention(q, k, v, causal=is_causal),
+                query, key, value)
+        except Exception:
+            pass
+
+    from ..framework import random as _random
+
+    key_rng = _random.split_key() if (dropout_p > 0.0 and training) else None
+
+    def f(q, k, v, *mask):
+        scale = 1.0 / jnp.sqrt(q.shape[-1]).astype(q.dtype)
+        # [B,S,H,D] -> [B,H,S,D]
+        qh = jnp.swapaxes(q, 1, 2)
+        kh = jnp.swapaxes(k, 1, 2)
+        vh = jnp.swapaxes(v, 1, 2)
+        logits = jnp.einsum("bhsd,bhtd->bhst", qh, kh) * scale
+        if is_causal:
+            s, t = logits.shape[-2], logits.shape[-1]
+            cm = jnp.tril(jnp.ones((s, t), bool))
+            logits = jnp.where(cm, logits, jnp.asarray(-1e30, logits.dtype))
+        if mask:
+            m = mask[0]
+            if m.dtype == jnp.bool_:
+                logits = jnp.where(m, logits, jnp.asarray(-1e30, logits.dtype))
+            else:
+                logits = logits + m
+        w = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+        if key_rng is not None:
+            keep = jax.random.bernoulli(key_rng, 1.0 - dropout_p, w.shape)
+            w = jnp.where(keep, w / (1.0 - dropout_p), 0.0)
+        out = jnp.einsum("bhst,bhtd->bhsd", w, vh)
+        return jnp.swapaxes(out, 1, 2)
+
+    args = (query, key, value) + ((attn_mask,) if attn_mask is not None else ())
+    return apply(f, *args)
+
+
+# ---------------------------------------------------------------------------
+# fused feedforward (fused_feedforward intent): LN -> linear -> act -> linear
+# ---------------------------------------------------------------------------
+def fused_feedforward(x, w1, b1, w2, b2, ln_scale=None, ln_bias=None,
+                      activation="gelu", dropout_p=0.0, training=True,
+                      pre_layer_norm=True, epsilon=1e-5):
+    act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
+
+    def f(v, w1_, b1_, w2_, b2_, *ln):
+        h = v
+        if pre_layer_norm and ln:
+            mean = jnp.mean(h, -1, keepdims=True)
+            var = jnp.mean(jnp.square(h - mean), -1, keepdims=True)
+            h = (h - mean) * jax.lax.rsqrt(var + epsilon) * ln[0] + ln[1]
+        h = act(h @ w1_ + b1_)
+        h = h @ w2_ + b2_
+        out = v + h
+        if not pre_layer_norm and ln:
+            mean = jnp.mean(out, -1, keepdims=True)
+            var = jnp.mean(jnp.square(out - mean), -1, keepdims=True)
+            out = (out - mean) * jax.lax.rsqrt(var + epsilon) * ln[0] + ln[1]
+        return out
+
+    args = [x, w1, b1, w2, b2]
+    if ln_scale is not None:
+        args += [ln_scale, ln_bias]
+    return apply(f, *args)
+
+
+def fused_embedding_layernorm(word_ids, pos_ids, type_ids, word_emb, pos_emb,
+                              type_emb, ln_scale, ln_bias, epsilon=1e-5):
+    """fused_embedding_eltwise_layernorm analog (BERT embedding fusion)."""
+    def f(wi, pi, ti, we, pe, te, s, b):
+        h = jnp.take(we, wi.astype(jnp.int32), 0) \
+            + jnp.take(pe, pi.astype(jnp.int32), 0) \
+            + jnp.take(te, ti.astype(jnp.int32), 0)
+        mean = jnp.mean(h, -1, keepdims=True)
+        var = jnp.mean(jnp.square(h - mean), -1, keepdims=True)
+        return (h - mean) * jax.lax.rsqrt(var + epsilon) * s + b
+    return apply(f, word_ids, pos_ids, type_ids, word_emb, pos_emb, type_emb,
+                 ln_scale, ln_bias)

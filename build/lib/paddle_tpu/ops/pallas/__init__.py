@@ -1,0 +1,19 @@
+"""Pallas TPU kernels for the fused transformer path (SURVEY.md §7 step 8)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def interpret_default() -> bool:
+    """Pallas kernels interpret on CPU (tests), compile via Mosaic on TPU."""
+    return jax.default_backend() == "cpu"
+
+
+def im(f):
+    """Index-map wrapper forcing literal ints to i32 (the framework enables
+    jax_enable_x64 for float64 API parity; Mosaic rejects i64 block indices)."""
+    def g(*idx):
+        return tuple(jnp.int32(v) if isinstance(v, int) else v
+                     for v in f(*idx))
+    return g

@@ -1,0 +1,484 @@
+"""Optimizers.
+
+Reference parity: python/paddle/optimizer/* and fluid/optimizer.py:58 (the
+Optimizer base: minimize = backward + apply_gradients; 15 optimizers) plus
+the per-op C++ kernels (operators/optimizers/adam_op.cc, momentum_op.cc,
+lamb_op.cc, lars_momentum_op.cc ...).
+
+TPU-native: each optimizer is ONE pure update rule
+    _update(param, grad, slots, lr, t) -> (new_param, new_slots)
+used two ways:
+  * eagerly by `step()` (dygraph UX: grads read off `.grad`),
+  * functionally by `apply_pytree()` inside a jitted/pjit'd train step, where
+    `slots` live in an explicit opt-state pytree (and can carry ZeRO-style
+    PartitionSpecs — see paddle_tpu.distributed.sharding).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..autograd import no_grad
+from ..nn.clip import ClipGradBase
+from ..nn.layer_base import Parameter
+from ..tensor import Tensor
+from . import lr as lr_mod
+from .lr import LRScheduler
+
+
+class Optimizer:
+    def __init__(self, learning_rate=0.001, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kwargs):
+        self._learning_rate = learning_rate
+        self._parameter_list = list(parameters) if parameters is not None else None
+        self._grad_clip = grad_clip
+        self._weight_decay = self._parse_wd(weight_decay)
+        # per-parameter slot storage keyed by id(param)
+        self._slots: dict[int, dict[str, Any]] = {}
+        self._step_count = 0
+
+    @staticmethod
+    def _parse_wd(weight_decay):
+        if weight_decay is None:
+            return 0.0
+        if isinstance(weight_decay, (int, float)):
+            return float(weight_decay)
+        if callable(weight_decay):
+            # paddle.regularizer.L1Decay/L2Decay — a grad transform
+            return weight_decay
+        return float(getattr(weight_decay, "_regularization_coeff",
+                             getattr(weight_decay, "coeff", 0.0)))
+
+    # -- lr ----------------------------------------------------------------
+    def get_lr(self) -> float:
+        if isinstance(self._learning_rate, LRScheduler):
+            return self._learning_rate()
+        return float(self._learning_rate)
+
+    def set_lr(self, value):
+        if isinstance(self._learning_rate, LRScheduler):
+            raise RuntimeError("cannot set_lr when using an LRScheduler")
+        self._learning_rate = float(value)
+
+    @property
+    def _lr_scheduler(self):
+        return self._learning_rate if isinstance(self._learning_rate, LRScheduler) \
+            else None
+
+    # -- update rule (override) -------------------------------------------
+    def _slot_names(self) -> list[str]:
+        return []
+
+    def _init_slot(self, name: str, p_val) -> Any:
+        return jnp.zeros_like(p_val)
+
+    def _update(self, p, g, slots: dict, lr, t):
+        """Pure. p/g jax arrays, slots dict of arrays, lr scalar, t step."""
+        raise NotImplementedError
+
+    # -- eager path --------------------------------------------------------
+    def _get_slots(self, p: Parameter) -> dict:
+        key = id(p)
+        if key not in self._slots:
+            self._slots[key] = {n: self._init_slot(n, p.value)
+                                for n in self._slot_names()}
+        return self._slots[key]
+
+    @no_grad()
+    def step(self):
+        self._step_count += 1
+        params = self._parameter_list or []
+        params_grads = [(p, p.grad) for p in params
+                        if p.grad is not None and not p.stop_gradient]
+        if self._grad_clip is not None:
+            params_grads = self._grad_clip(params_grads)
+        base_lr = self.get_lr()
+        for p, g in params_grads:
+            if g is None:
+                continue
+            lr = base_lr * p.optimize_attr.get("learning_rate", 1.0) \
+                if hasattr(p, "optimize_attr") else base_lr
+            slots = self._get_slots(p)
+            g_val = g.value.astype(p.dtype) if g.dtype != p.dtype else g.value
+            g_val = self._apply_decay(p.value, g_val)
+            new_p, new_slots = self._update(p.value, g_val, slots, lr,
+                                            self._step_count)
+            p._value = new_p
+            self._slots[id(p)] = new_slots
+
+    def _apply_decay(self, p_val, g_val):
+        """Coupled decay (fluid regularizer semantics); AdamW overrides.
+        A callable regularizer (L1Decay/L2Decay) transforms the grad."""
+        wd = self._weight_decay
+        if callable(wd):
+            return wd(p_val, g_val)
+        if wd:
+            return g_val + wd * p_val
+        return g_val
+
+    def clear_grad(self, set_to_zero=False):
+        for p in self._parameter_list or []:
+            p.clear_grad()
+
+    clear_gradients = clear_grad
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad) for p in (self._parameter_list or [])]
+
+    # -- functional path (jit/pjit train steps) ----------------------------
+    def init_pytree(self, params: dict):
+        """Opt-state pytree for a {name: array} param dict."""
+        return {
+            name: {n: self._init_slot(n, v) for n in self._slot_names()}
+            for name, v in params.items()
+        }
+
+    def apply_pytree(self, params: dict, grads: dict, state: dict,
+                     lr=None, step=None):
+        """Pure update over {name: array} pytrees. Returns (params, state).
+        Call inside jit; lr/step may be traced scalars."""
+        lr = self.get_lr() if lr is None else lr
+        t = (self._step_count + 1) if step is None else step
+        if self._grad_clip is not None:
+            grads = self._grad_clip.clip_pytree(grads)
+        new_params, new_state = {}, {}
+        for name, p in params.items():
+            g = grads.get(name)
+            if g is None:
+                new_params[name] = p
+                new_state[name] = state[name]
+                continue
+            g = self._apply_decay(p, g.astype(p.dtype))
+            new_params[name], new_state[name] = self._update(
+                p, g, state[name], lr, t)
+        return new_params, new_state
+
+    # -- checkpointing ----------------------------------------------------
+    def state_dict(self):
+        sd = {"step_count": self._step_count}
+        params = self._parameter_list or []
+        for i, p in enumerate(params):
+            if id(p) in self._slots:
+                for n, v in self._slots[id(p)].items():
+                    sd[f"{p.name or i}__{n}"] = Tensor(v) if not isinstance(v, Tensor) else v
+        if self._lr_scheduler is not None:
+            sd["LR_Scheduler"] = self._lr_scheduler.state_dict()
+        return sd
+
+    def set_state_dict(self, state_dict):
+        self._step_count = int(state_dict.get("step_count", 0))
+        params = self._parameter_list or []
+        for i, p in enumerate(params):
+            slots = {}
+            for n in self._slot_names():
+                key = f"{p.name or i}__{n}"
+                if key in state_dict:
+                    v = state_dict[key]
+                    slots[n] = v.value if isinstance(v, Tensor) else jnp.asarray(v)
+            if slots:
+                self._slots[id(p)] = slots
+        if "LR_Scheduler" in state_dict and self._lr_scheduler is not None:
+            self._lr_scheduler.set_state_dict(state_dict["LR_Scheduler"])
+
+    set_dict = set_state_dict
+
+
+class SGD(Optimizer):
+    def _update(self, p, g, slots, lr, t):
+        return p - lr * g, slots
+
+
+class Momentum(Optimizer):
+    def __init__(self, learning_rate=0.001, momentum=0.9, parameters=None,
+                 use_nesterov=False, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._momentum = momentum
+        self._use_nesterov = use_nesterov
+
+    def _slot_names(self):
+        return ["velocity"]
+
+    def _update(self, p, g, slots, lr, t):
+        v = self._momentum * slots["velocity"] + g
+        if self._use_nesterov:
+            new_p = p - lr * (g + self._momentum * v)
+        else:
+            new_p = p - lr * v
+        return new_p, {"velocity": v}
+
+
+class Adagrad(Optimizer):
+    def __init__(self, learning_rate, epsilon=1e-6, parameters=None,
+                 weight_decay=None, grad_clip=None, initial_accumulator_value=0.0,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._epsilon = epsilon
+        self._init_acc = initial_accumulator_value
+
+    def _slot_names(self):
+        return ["moment"]
+
+    def _init_slot(self, name, p_val):
+        return jnp.full_like(p_val, self._init_acc)
+
+    def _update(self, p, g, slots, lr, t):
+        m = slots["moment"] + jnp.square(g)
+        return p - lr * g / (jnp.sqrt(m) + self._epsilon), {"moment": m}
+
+
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, lazy_mode=False, multi_precision=False,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1 = beta1
+        self._beta2 = beta2
+        self._epsilon = epsilon
+
+    def _slot_names(self):
+        return ["moment1", "moment2"]
+
+    def _update(self, p, g, slots, lr, t):
+        b1, b2 = self._beta1, self._beta2
+        g32 = g.astype(jnp.float32)
+        m = b1 * slots["moment1"] + (1 - b1) * g32
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g32)
+        t = jnp.asarray(t, jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = lr * mhat / (jnp.sqrt(vhat) + self._epsilon)
+        return (p - upd.astype(p.dtype)), {"moment1": m, "moment2": v}
+
+    def _init_slot(self, name, p_val):
+        return jnp.zeros(p_val.shape, jnp.float32)
+
+
+class AdamW(Adam):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=0.01,
+                 lr_ratio=None, apply_decay_param_fun=None, grad_clip=None,
+                 lazy_mode=False, multi_precision=False, name=None, **kw):
+        super().__init__(learning_rate, beta1, beta2, epsilon, parameters,
+                         None, grad_clip)
+        from ..regularizer import L1Decay
+        if isinstance(weight_decay, L1Decay):
+            raise TypeError(
+                "AdamW applies DECOUPLED L2 weight decay; L1Decay has no "
+                "decoupled analog here — use paddle.optimizer.Adam with "
+                "weight_decay=L1Decay(...) for coupled L1")
+        self._wd_coeff = float(weight_decay) if not hasattr(weight_decay, "_regularization_coeff") \
+            else float(weight_decay._regularization_coeff)
+        self._apply_decay_param_fun = apply_decay_param_fun
+
+    def _apply_decay(self, p_val, g_val):
+        return g_val  # decoupled
+
+    def _update(self, p, g, slots, lr, t):
+        new_p, new_slots = super()._update(p, g, slots, lr, t)
+        # decoupled decay (the adamw flag in optimizers/adam_op.cc)
+        wd = self._wd_coeff if self._decay_enabled else 0.0
+        new_p = new_p - lr * wd * p
+        return new_p, new_slots
+
+    _decay_enabled = True
+
+    def step(self):
+        if self._apply_decay_param_fun is None:
+            return super().step()
+        # per-parameter decay decision: split the param list, run twice
+        all_params = self._parameter_list
+        decay = [p for p in all_params
+                 if self._apply_decay_param_fun(p.name or "")]
+        decay_ids = {id(p) for p in decay}
+        nodecay = [p for p in all_params if id(p) not in decay_ids]
+        try:
+            self._parameter_list = decay
+            self._decay_enabled = True
+            super().step()
+            self._step_count -= 1  # counted once for both halves
+            self._parameter_list = nodecay
+            self._decay_enabled = False
+            super().step()
+        finally:
+            self._parameter_list = all_params
+            self._decay_enabled = True
+
+
+class Adamax(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+
+    def _slot_names(self):
+        return ["moment", "inf_norm"]
+
+    def _update(self, p, g, slots, lr, t):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment"] + (1 - b1) * g
+        u = jnp.maximum(b2 * slots["inf_norm"], jnp.abs(g))
+        t = jnp.asarray(t, jnp.float32)
+        new_p = p - (lr / (1 - b1 ** t)) * m / (u + self._epsilon)
+        return new_p, {"moment": m, "inf_norm": u}
+
+
+class Adadelta(Optimizer):
+    def __init__(self, learning_rate=0.001, epsilon=1e-6, rho=0.95,
+                 parameters=None, weight_decay=None, grad_clip=None,
+                 name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+
+    def _slot_names(self):
+        return ["avg_squared_grad", "avg_squared_update"]
+
+    def _update(self, p, g, slots, lr, t):
+        rho, eps = self._rho, self._epsilon
+        asg = rho * slots["avg_squared_grad"] + (1 - rho) * jnp.square(g)
+        upd = g * jnp.sqrt(slots["avg_squared_update"] + eps) / jnp.sqrt(asg + eps)
+        asu = rho * slots["avg_squared_update"] + (1 - rho) * jnp.square(upd)
+        return p - lr * upd, {"avg_squared_grad": asg, "avg_squared_update": asu}
+
+
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate, rho=0.95, epsilon=1e-6, momentum=0.0,
+                 centered=False, parameters=None, weight_decay=None,
+                 grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, weight_decay, grad_clip)
+        self._rho, self._epsilon = rho, epsilon
+        self._momentum, self._centered = momentum, centered
+
+    def _slot_names(self):
+        return ["mean_square", "mean_grad", "momentum"]
+
+    def _update(self, p, g, slots, lr, t):
+        rho, eps = self._rho, self._epsilon
+        ms = rho * slots["mean_square"] + (1 - rho) * jnp.square(g)
+        if self._centered:
+            mg = rho * slots["mean_grad"] + (1 - rho) * g
+            denom = jnp.sqrt(ms - jnp.square(mg) + eps)
+        else:
+            mg = slots["mean_grad"]
+            denom = jnp.sqrt(ms + eps)
+        mom = self._momentum * slots["momentum"] + lr * g / denom
+        return p - mom, {"mean_square": ms, "mean_grad": mg, "momentum": mom}
+
+
+class Lamb(Optimizer):
+    def __init__(self, learning_rate=0.001, lamb_weight_decay=0.01, beta1=0.9,
+                 beta2=0.999, epsilon=1e-6, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay_fn=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._beta1, self._beta2, self._epsilon = beta1, beta2, epsilon
+        self._lamb_wd = lamb_weight_decay
+        self._exclude_fn = exclude_from_weight_decay_fn
+
+    def _slot_names(self):
+        return ["moment1", "moment2"]
+
+    def _update(self, p, g, slots, lr, t):
+        b1, b2 = self._beta1, self._beta2
+        m = b1 * slots["moment1"] + (1 - b1) * g
+        v = b2 * slots["moment2"] + (1 - b2) * jnp.square(g)
+        t = jnp.asarray(t, jnp.float32)
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        r = mhat / (jnp.sqrt(vhat) + self._epsilon) + self._lamb_wd * p
+        w_norm = jnp.linalg.norm(p.ravel())
+        r_norm = jnp.linalg.norm(r.ravel())
+        trust = jnp.where((w_norm > 0) & (r_norm > 0), w_norm / r_norm, 1.0)
+        return p - lr * trust * r, {"moment1": m, "moment2": v}
+
+
+class LarsMomentum(Optimizer):
+    """LARS (optimizers/lars_momentum_op.cc; fluid LarsMomentumOptimizer:1612)."""
+
+    def __init__(self, learning_rate=0.001, momentum=0.9, lars_coeff=0.001,
+                 lars_weight_decay=0.0005, parameters=None, grad_clip=None,
+                 exclude_from_weight_decay=None, epsilon=0, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._momentum = momentum
+        self._lars_coeff = lars_coeff
+        self._lars_wd = lars_weight_decay
+        self._eps = epsilon
+
+    def _slot_names(self):
+        return ["velocity"]
+
+    def _update(self, p, g, slots, lr, t):
+        w_norm = jnp.linalg.norm(p.ravel())
+        g_norm = jnp.linalg.norm(g.ravel())
+        local_lr = jnp.where(
+            (w_norm > 0) & (g_norm > 0),
+            self._lars_coeff * w_norm /
+            (g_norm + self._lars_wd * w_norm + self._eps), 1.0)
+        v = self._momentum * slots["velocity"] + \
+            lr * local_lr * (g + self._lars_wd * p)
+        return p - v, {"velocity": v}
+
+
+class Ftrl(Optimizer):
+    def __init__(self, learning_rate, l1=0.0, l2=0.0, lr_power=-0.5,
+                 parameters=None, grad_clip=None, name=None, **kw):
+        super().__init__(learning_rate, parameters, None, grad_clip)
+        self._l1, self._l2, self._lr_power = l1, l2, lr_power
+
+    def _slot_names(self):
+        return ["squared", "linear"]
+
+    def _update(self, p, g, slots, lr, t):
+        sq_new = slots["squared"] + jnp.square(g)
+        lp = -self._lr_power
+        sigma = (sq_new ** lp - slots["squared"] ** lp) / lr
+        lin = slots["linear"] + g - sigma * p
+        quad = sq_new ** lp / lr + 2 * self._l2
+        pre = jnp.sign(lin) * self._l1 - lin
+        new_p = jnp.where(jnp.abs(lin) > self._l1, pre / quad, 0.0)
+        return new_p, {"squared": sq_new, "linear": lin}
+
+
+class Dpsgd(SGD):
+    """Differentially-private SGD (optimizers/dpsgd_op.cc) — noise added to
+    grads; simplified gaussian mechanism."""
+
+    def __init__(self, learning_rate=0.001, clip=10.0, batch_size=16,
+                 sigma=1.0, parameters=None, **kw):
+        super().__init__(learning_rate, parameters)
+        self._clip, self._batch, self._sigma = clip, batch_size, sigma
+
+    def _update(self, p, g, slots, lr, t):
+        from ..framework import random as _random
+
+        gn = jnp.linalg.norm(g.ravel())
+        g = g / jnp.maximum(1.0, gn / self._clip)
+        noise = jax.random.normal(_random.split_key(), g.shape, jnp.float32) \
+            * self._sigma * self._clip / self._batch
+        return p - lr * (g + noise.astype(g.dtype)), slots
+
+
+# fluid-era name aliases (fluid.optimizer.*Optimizer)
+SGDOptimizer = SGD
+MomentumOptimizer = Momentum
+AdagradOptimizer = Adagrad
+AdamOptimizer = Adam
+AdamaxOptimizer = Adamax
+AdadeltaOptimizer = Adadelta
+RMSPropOptimizer = RMSProp
+LambOptimizer = Lamb
+LarsMomentumOptimizer = LarsMomentum
+FtrlOptimizer = Ftrl
+DpsgdOptimizer = Dpsgd
+
+from .lr import *  # noqa: F401,F403,E402
+from . import lr  # noqa: F401,E402
+from .wrappers import (ModelAverage, ExponentialMovingAverage,  # noqa: E402
+                       EMA, LookaheadOptimizer)  # noqa: F401

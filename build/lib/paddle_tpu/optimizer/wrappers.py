@@ -1,0 +1,273 @@
+"""Optimizer wrappers: ModelAverage / ExponentialMovingAverage / Lookahead.
+
+Reference parity: python/paddle/fluid/optimizer.py — ModelAverage:3141
+(three-bucket average_accumulates semantics, apply()/restore() contexts),
+ExponentialMovingAverage:3450 (shadow vars, thres_steps decay ramp),
+LookaheadOptimizer:5212 (slow/fast weights, k-step interpolation).
+
+TPU-native: each wrapper is BOTH
+  * an eager helper over a parameter list (update()/apply()/restore() — the
+    reference dygraph UX), and
+  * a pure pytree transform (init_pytree/update_pytree/average_pytree)
+    whose state threads through jitted train steps — all branching is
+    jnp.where, so a wrapper step compiles into the same XLA program.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor
+
+__all__ = ["ModelAverage", "ExponentialMovingAverage", "EMA",
+           "LookaheadOptimizer"]
+
+
+def _values(parameter_list):
+    return [p.value if isinstance(p, Tensor) else jnp.asarray(p)
+            for p in parameter_list]
+
+
+# kMaxNumAccumulates in average_accumulates_op.h — sum_1 spills into sum_2
+# every this many updates so a single bucket never grows unboundedly stale
+_MAX_NUM_ACCUMULATES = 16384
+
+
+class ModelAverage:
+    """Running average of parameters over a trailing window
+    (optimizer.py:3141 + operators/average_accumulates_op.h).
+
+    average_window_rate bounds the window to rate * num_updates, clipped to
+    [min_average_window, max_average_window].  apply() swaps averaged
+    params in (eager), restore() swaps back.
+    """
+
+    def __init__(self, average_window_rate, parameters=None,
+                 min_average_window=10000, max_average_window=10000,
+                 name=None):
+        self._rate = float(average_window_rate)
+        self._min_window = int(min_average_window)
+        self._max_window = int(max_average_window)
+        self._parameter_list = list(parameters) if parameters else None
+        self._state = None
+        self._backup = None
+
+    # -- functional (pytree) ---------------------------------------------
+    def init_pytree(self, params):
+        zeros = jax.tree.map(jnp.zeros_like, params)
+        # jax arrays are immutable, so the three buckets may share leaves
+        return {"sum_1": zeros, "sum_2": zeros, "sum_3": zeros,
+                "num_accumulates": jnp.zeros((), jnp.int32),
+                "old_num_accumulates": jnp.zeros((), jnp.int32),
+                "num_updates": jnp.zeros((), jnp.int32)}
+
+    def update_pytree(self, params, state):
+        """One accumulation step (the average_accumulates op, jit-safe)."""
+        num_updates = state["num_updates"] + 1
+        num_acc = state["num_accumulates"] + 1
+        sum_1 = jax.tree.map(jnp.add, state["sum_1"], params)
+        sum_2, sum_3 = state["sum_2"], state["sum_3"]
+
+        spill = num_updates % _MAX_NUM_ACCUMULATES == 0
+        sum_2 = jax.tree.map(
+            lambda s2, s1: jnp.where(spill, s2 + s1, s2), sum_2, sum_1)
+        sum_1 = jax.tree.map(
+            lambda s1: jnp.where(spill, jnp.zeros_like(s1), s1), sum_1)
+
+        window = jnp.minimum(
+            jnp.int32(self._max_window),
+            jnp.maximum(jnp.int32(self._min_window),
+                        (num_updates.astype(jnp.float32)
+                         * self._rate).astype(jnp.int32)))
+        restart = num_acc >= window
+        sum_3 = jax.tree.map(
+            lambda s3, s1, s2: jnp.where(restart, s1 + s2, s3),
+            sum_3, sum_1, sum_2)
+        sum_1 = jax.tree.map(
+            lambda s1: jnp.where(restart, jnp.zeros_like(s1), s1), sum_1)
+        sum_2 = jax.tree.map(
+            lambda s2: jnp.where(restart, jnp.zeros_like(s2), s2), sum_2)
+        old_num = jnp.where(restart, num_acc, state["old_num_accumulates"])
+        num_acc = jnp.where(restart, jnp.int32(0), num_acc)
+        return {"sum_1": sum_1, "sum_2": sum_2, "sum_3": sum_3,
+                "num_accumulates": num_acc, "old_num_accumulates": old_num,
+                "num_updates": num_updates}
+
+    def average_pytree(self, state):
+        """Averaged parameters from an accumulation state."""
+        total = (state["num_accumulates"]
+                 + state["old_num_accumulates"]).astype(jnp.float32)
+        total = jnp.maximum(total, 1.0)
+        return jax.tree.map(
+            lambda s1, s2, s3: ((s1 + s2 + s3) / total).astype(s1.dtype),
+            state["sum_1"], state["sum_2"], state["sum_3"])
+
+    # -- eager ------------------------------------------------------------
+    def update(self):
+        if self._parameter_list is None:
+            raise ValueError("ModelAverage.update() needs parameters=")
+        vals = {str(i): v for i, v in
+                enumerate(_values(self._parameter_list))}
+        if self._state is None:
+            self._state = self.init_pytree(vals)
+        self._state = self.update_pytree(vals, self._state)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        """Swap averaged params in (reference ModelAverage.apply:3364)."""
+        if self._state is None:
+            raise ValueError("call update() at least once before apply()")
+        avg = self.average_pytree(self._state)
+        self._backup = _values(self._parameter_list)
+        for i, p in enumerate(self._parameter_list):
+            p._value = avg[str(i)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, v in zip(self._parameter_list, self._backup):
+            p._value = v
+        self._backup = None
+
+
+class ExponentialMovingAverage:
+    """shadow = decay * shadow + (1 - decay) * param
+    (optimizer.py:3450), with the thres_steps ramp
+    decay_t = min(decay, (1 + t) / (10 + t)) when enabled.
+    """
+
+    def __init__(self, decay=0.999, thres_steps=None, parameters=None,
+                 name=None):
+        self._decay = float(decay)
+        self._thres_steps = thres_steps  # None | True (use step counter)
+        self._parameter_list = list(parameters) if parameters else None
+        self._state = None
+        self._backup = None
+
+    def _decay_at(self, step):
+        if self._thres_steps is None:
+            return jnp.float32(self._decay)
+        t = step.astype(jnp.float32)
+        return jnp.minimum(jnp.float32(self._decay), (1.0 + t) / (10.0 + t))
+
+    # -- functional -------------------------------------------------------
+    def init_pytree(self, params):
+        return {"shadow": params, "step": jnp.zeros((), jnp.int32)}
+
+    def update_pytree(self, params, state):
+        step = state["step"] + 1
+        d = self._decay_at(state["step"])
+        shadow = jax.tree.map(
+            lambda s, p: (d * s + (1.0 - d) * p).astype(s.dtype),
+            state["shadow"], params)
+        return {"shadow": shadow, "step": step}
+
+    def average_pytree(self, state):
+        return state["shadow"]
+
+    # -- eager ------------------------------------------------------------
+    def update(self):
+        if self._parameter_list is None:
+            raise ValueError("EMA.update() needs parameters=")
+        vals = {str(i): v for i, v in
+                enumerate(_values(self._parameter_list))}
+        if self._state is None:
+            self._state = self.init_pytree(vals)
+        self._state = self.update_pytree(vals, self._state)
+
+    @contextlib.contextmanager
+    def apply(self, executor=None, need_restore=True):
+        if self._state is None:
+            raise ValueError("call update() at least once before apply()")
+        self._backup = _values(self._parameter_list)
+        for i, p in enumerate(self._parameter_list):
+            p._value = self._state["shadow"][str(i)]
+        try:
+            yield
+        finally:
+            if need_restore:
+                self.restore()
+
+    def restore(self, executor=None):
+        if self._backup is None:
+            return
+        for p, v in zip(self._parameter_list, self._backup):
+            p._value = v
+        self._backup = None
+
+
+EMA = ExponentialMovingAverage
+
+
+class LookaheadOptimizer:
+    """k-step lookahead (optimizer.py:5212): fast weights step every
+    iteration; every k steps slow += alpha * (fast - slow), fast = slow."""
+
+    def __init__(self, inner_optimizer, alpha=0.5, k=5):
+        if inner_optimizer is None:
+            raise ValueError("inner optimizer cannot be None")
+        if not 0.0 <= alpha <= 1.0:
+            raise ValueError("alpha must be in [0, 1]")
+        if not (isinstance(k, int) and k > 0):
+            raise ValueError("k must be a positive integer")
+        self.inner_optimizer = inner_optimizer
+        self.alpha = float(alpha)
+        self.k = int(k)
+
+    # -- functional -------------------------------------------------------
+    def init_pytree(self, params):
+        return {"inner": self.inner_optimizer.init_pytree(params),
+                "slow": params,
+                "step": jnp.zeros((), jnp.int32)}
+
+    def apply_pytree(self, params, grads, state, lr=None, step=None):
+        fast, inner = self.inner_optimizer.apply_pytree(
+            params, grads, state["inner"], lr=lr, step=step)
+        t = state["step"] + 1
+        sync = (t % self.k) == 0
+        slow = jax.tree.map(
+            lambda s, f: jnp.where(sync,
+                                   (s + self.alpha * (f - s)).astype(s.dtype),
+                                   s),
+            state["slow"], fast)
+        fast = jax.tree.map(
+            lambda f, s: jnp.where(sync, s, f), fast, slow)
+        return fast, {"inner": inner, "slow": slow, "step": t}
+
+    def _slot_names(self):
+        return self.inner_optimizer._slot_names()
+
+    # -- eager ------------------------------------------------------------
+    def step(self):
+        inner = self.inner_optimizer
+        params = inner._parameter_list or []
+        if not hasattr(self, "_slow"):
+            self._slow = _values(params)
+            self._t = 0
+        inner.step()
+        self._t += 1
+        if self._t % self.k == 0:
+            for p, s in zip(params, self._slow):
+                new_slow = s + self.alpha * (p.value - s)
+                p._value = new_slow.astype(p.value.dtype)
+            self._slow = _values(params)
+
+    def minimize(self, loss, startup_program=None, parameters=None,
+                 no_grad_set=None):
+        loss.backward()
+        self.step()
+        return None, [(p, p.grad)
+                      for p in (self.inner_optimizer._parameter_list or [])]
+
+    def clear_grad(self):
+        return self.inner_optimizer.clear_grad()
+
+    def get_lr(self):
+        return self.inner_optimizer.get_lr()

@@ -1,0 +1,51 @@
+"""paddle.regularizer — weight decay regularizers.
+
+Reference parity: python/paddle/fluid/regularizer.py (L1DecayRegularizer /
+L2DecayRegularizer — appended as grad-modifying ops by
+Optimizer.apply_gradients) and the paddle.regularizer 2.x aliases.
+
+TPU-native: regularizers are pure grad transforms consumed by
+Optimizer._apply_decay (optimizer/__init__.py): L2 adds coeff*p to the
+gradient (coupled decay, fluid semantics; AdamW's decoupled decay
+overrides), L1 adds coeff*sign(p).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+__all__ = ["L1Decay", "L2Decay", "L1DecayRegularizer", "L2DecayRegularizer"]
+
+
+class WeightDecayRegularizer:
+    def __init__(self, regularization_coeff=0.0, coeff=None):
+        self._regularization_coeff = float(
+            coeff if coeff is not None else regularization_coeff)
+
+    @property
+    def coeff(self):
+        return self._regularization_coeff
+
+    def __call__(self, param, grad):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}(coeff={self._regularization_coeff})"
+
+
+class L2Decay(WeightDecayRegularizer):
+    """grad += coeff * param (fluid L2DecayRegularizer append_regularization)."""
+
+    def __call__(self, param, grad):
+        return grad + self._regularization_coeff * param
+
+
+class L1Decay(WeightDecayRegularizer):
+    """grad += coeff * sign(param) (fluid L1DecayRegularizer)."""
+
+    def __call__(self, param, grad):
+        return grad + self._regularization_coeff * jnp.sign(param)
+
+
+# fluid-era names
+L1DecayRegularizer = L1Decay
+L2DecayRegularizer = L2Decay

@@ -1,0 +1,160 @@
+"""paddle.static — compatibility surface.
+
+Reference parity: python/paddle/static (Program/Executor/program_guard/
+InputSpec/data).  TPU-native stance (SURVEY.md §7): static mode IS
+`jax.jit` of traced functions — there is no separate graph-building API.
+This module keeps the entrypoints so reference scripts can be ported: a
+"Program" records a python callable + input specs and Executor.run jit-runs
+it.  New code should use paddle_tpu.jit.to_static directly.
+"""
+from __future__ import annotations
+
+import contextlib
+import threading
+
+from ..jit import InputSpec  # re-export (paddle.static.InputSpec)
+from ..tensor import Tensor
+from . import nn  # noqa: F401  (paddle.static.nn.while_loop/cond/...)
+
+
+class _Mode(threading.local):
+    def __init__(self):
+        self.static = False
+
+
+_mode = _Mode()
+
+
+def enable_static():
+    _mode.static = True
+
+
+def disable_static():
+    _mode.static = False
+
+
+def in_static_mode() -> bool:
+    return _mode.static
+
+
+class Program:
+    """A deferred computation: body callables appended under program_guard.
+    Minimal emulation of fluid framework.py Program:4094."""
+
+    def __init__(self):
+        self._builders = []  # callables executed by Executor.run
+        self.random_seed = 0
+
+    def clone(self, for_test=False):
+        p = Program()
+        p._builders = list(self._builders)
+        return p
+
+    def global_block(self):
+        return self
+
+    def __repr__(self):
+        return f"Program(num_builders={len(self._builders)})"
+
+
+_default_main = Program()
+_default_startup = Program()
+
+
+def default_main_program():
+    return _default_main
+
+
+def default_startup_program():
+    return _default_startup
+
+
+@contextlib.contextmanager
+def program_guard(main_program, startup_program=None):
+    global _default_main, _default_startup
+    prev = (_default_main, _default_startup)
+    _default_main = main_program
+    if startup_program is not None:
+        _default_startup = startup_program
+    try:
+        yield
+    finally:
+        _default_main, _default_startup = prev
+
+
+def data(name, shape, dtype="float32", lod_level=0):
+    raise NotImplementedError(
+        "Static placeholder graphs are not part of the TPU-native design: "
+        "wrap your computation in a function and use "
+        "paddle_tpu.jit.to_static / Executor.run(fn, feed=...) instead "
+        "(SURVEY.md §7: tracing is the execution model).")
+
+
+class Executor:
+    """Minimal Executor parity: runs a python callable over feeds, jitted.
+    Reference: fluid/executor.py Executor:475/run:916."""
+
+    def __init__(self, place=None):
+        self.place = place
+        self._cache = {}
+
+    def run(self, program=None, feed=None, fetch_list=None, fn=None, **kw):
+        if fn is None and callable(program):
+            fn = program
+        if fn is None:
+            raise NotImplementedError(
+                "Executor.run requires a callable (the traced-step model); "
+                "ProgramDesc interpretation does not exist on TPU")
+        feed = feed or {}
+        out = fn(**{k: (v if isinstance(v, Tensor) else Tensor(v))
+                    for k, v in feed.items()})
+        if fetch_list:
+            return [out[k] if isinstance(out, dict) else out for k in fetch_list]
+        return out
+
+
+@contextlib.contextmanager
+def scope_guard(scope):
+    yield
+
+
+def global_scope():
+    return None
+
+
+class CompiledProgram:
+    """Parity shim for fluid/compiler.py CompiledProgram — on TPU the
+    multi-device build strategy is a sharding decision, see
+    paddle_tpu.distributed."""
+
+    def __init__(self, program, build_strategy=None):
+        self.program = program
+        self.build_strategy = build_strategy
+
+    def with_data_parallel(self, loss_name=None, **kw):
+        return self
+
+
+class BuildStrategy:
+    """Knob struct parity (framework/details/build_strategy.h) — consumed as
+    hints; XLA performs the fusions these flags used to toggle."""
+
+    class ReduceStrategy:
+        AllReduce = 0
+        Reduce = 1
+
+    def __init__(self):
+        self.reduce_strategy = BuildStrategy.ReduceStrategy.AllReduce
+        self.fuse_all_reduce_ops = True
+        self.fuse_bn_act_ops = True
+        self.fuse_elewise_add_act_ops = True
+        self.enable_inplace = True
+        self.memory_optimize = True
+        self.sequential_execution = False
+        self.sync_batch_norm = False
+
+
+class ExecutionStrategy:
+    def __init__(self):
+        self.num_threads = 1
+        self.num_iteration_per_drop_scope = 100

@@ -1,0 +1,15 @@
+from . import profiler  # noqa: F401
+from .lazy_import import try_import  # noqa: F401
+
+
+def run_check():
+    """paddle.utils.run_check parity: verify the accelerator works."""
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((8, 8))
+    y = (x @ x).sum()
+    dev = jax.devices()[0]
+    print(f"paddle_tpu works on {dev.platform} ({dev}) — matmul check "
+          f"{float(y)} == 512.0")
+    return True

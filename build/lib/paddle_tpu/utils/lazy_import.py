@@ -1,0 +1,8 @@
+import importlib
+
+
+def try_import(name, err_msg=None):
+    try:
+        return importlib.import_module(name)
+    except ImportError:
+        raise ImportError(err_msg or f"module {name} is required but not installed")

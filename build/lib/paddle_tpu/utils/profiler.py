@@ -1,0 +1,95 @@
+"""Profiler.
+
+Reference parity: paddle/fluid/platform/profiler.* (RecordEvent RAII scopes,
+EnableProfiler/DisableProfiler, chrome-trace via tools/timeline.py) and
+python fluid/profiler.py.
+
+TPU-native: jax.profiler does the heavy lifting — traces carry XLA/TPU
+device activity and land in TensorBoard/perfetto format (the
+CUPTI DeviceTracer + timeline.py analog).  RecordEvent maps to
+jax.profiler.TraceAnnotation so named scopes appear inside device traces.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+
+import jax
+
+
+class RecordEvent:
+    """Named scope visible in profiler traces (platform/profiler.cc:53).
+    Annotates both the XLA device trace (jax.profiler) and the native host
+    event buffer (csrc/core.cc) when host profiling is enabled."""
+
+    def __init__(self, name: str):
+        self.name = name
+        self._ann = jax.profiler.TraceAnnotation(name)
+        self.begin = None
+
+    def __enter__(self):
+        from .. import core as _native
+        self._native = _native if _native.profiler_enabled() else None
+        if self._native:
+            self._native.event_push(self.name)
+        self.begin = time.perf_counter()
+        self._ann.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._ann.__exit__(*exc)
+        self.elapsed = time.perf_counter() - self.begin
+        if self._native:
+            self._native.event_pop()
+        return False
+
+
+_trace_dir = None
+
+
+def start_profiler(log_dir="/tmp/paddle_tpu_profile", state=None,
+                   tracer_option=None):
+    global _trace_dir
+    _trace_dir = log_dir
+    from .. import core as _native
+    _native.trace_clear()
+    _native.profiler_enable(True)
+    jax.profiler.start_trace(log_dir)
+
+
+def stop_profiler(sorted_key=None, profile_path=None):
+    import os
+
+    jax.profiler.stop_trace()
+    from .. import core as _native
+    _native.profiler_enable(False)
+    if _native.available():
+        # profile_path may be the jax trace DIRECTORY (the fluid API passes
+        # one path for both); host events go to a file inside it
+        target = profile_path
+        if not target or os.path.isdir(target):
+            target = os.path.join(target or _trace_dir or ".",
+                                  "host_trace.json")
+        n = export_chrome_trace(target)
+        if n < 0:
+            print(f"warning: host trace export to {target} failed")
+    print(f"profiler trace written to {_trace_dir} "
+          "(open with TensorBoard or perfetto)")
+
+
+def export_chrome_trace(path: str) -> int:
+    """Dump host RecordEvent scopes as chrome://tracing JSON — the
+    tools/timeline.py analog. Returns number of events."""
+    from .. import core as _native
+    return _native.trace_export(path)
+
+
+@contextlib.contextmanager
+def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile",
+             tracer_option=None):
+    """fluid.profiler.profiler context-manager parity (profiler.py:255)."""
+    start_profiler(profile_path, state, tracer_option)
+    try:
+        yield
+    finally:
+        stop_profiler(sorted_key, profile_path)

@@ -1,0 +1,16 @@
+from .lenet import LeNet  # noqa: F401
+
+# resnet / vgg / mobilenet land with the DP milestone (SURVEY.md §7 step 6)
+try:
+    from .resnet import ResNet, resnet18, resnet34, resnet50, resnet101, resnet152  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .vgg import VGG, vgg11, vgg13, vgg16, vgg19  # noqa: F401
+except ImportError:
+    pass
+try:
+    from .mobilenetv1 import MobileNetV1, mobilenet_v1  # noqa: F401
+    from .mobilenetv2 import MobileNetV2, mobilenet_v2  # noqa: F401
+except ImportError:
+    pass

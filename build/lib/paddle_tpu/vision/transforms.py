@@ -1,0 +1,140 @@
+"""Vision transforms. Reference: python/paddle/vision/transforms (functional
+numpy/PIL pipeline) — host-side preprocessing stays numpy (it feeds the
+device prefetch pipeline, not XLA)."""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+
+class Compose:
+    def __init__(self, transforms):
+        self.transforms = transforms
+
+    def __call__(self, img):
+        for t in self.transforms:
+            img = t(img)
+        return img
+
+
+class BaseTransform:
+    def __call__(self, img):
+        return self._apply_image(img)
+
+
+class ToTensor(BaseTransform):
+    def __init__(self, data_format="CHW", keys=None):
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        if arr.max() > 1.5:
+            arr = arr / 255.0
+        if arr.ndim == 2:
+            arr = arr[None] if self.data_format == "CHW" else arr[..., None]
+        elif self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
+            arr = arr.transpose(2, 0, 1)
+        return arr
+
+
+class Normalize(BaseTransform):
+    def __init__(self, mean=0.0, std=1.0, data_format="CHW", to_rgb=False,
+                 keys=None):
+        if isinstance(mean, numbers.Number):
+            mean = [mean, mean, mean]
+        if isinstance(std, numbers.Number):
+            std = [std, std, std]
+        self.mean = np.asarray(mean, np.float32)
+        self.std = np.asarray(std, np.float32)
+        self.data_format = data_format
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        c = arr.shape[0] if self.data_format == "CHW" else arr.shape[-1]
+        mean = self.mean[:c]
+        std = self.std[:c]
+        if self.data_format == "CHW":
+            return (arr - mean[:, None, None]) / std[:, None, None]
+        return (arr - mean) / std
+
+
+class Resize(BaseTransform):
+    def __init__(self, size, interpolation="bilinear", keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img, np.float32)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        oh, ow = self.size
+        ih, iw = arr.shape[h_ax], arr.shape[w_ax]
+        yi = (np.arange(oh) * ih / oh).astype(np.int64).clip(0, ih - 1)
+        xi = (np.arange(ow) * iw / ow).astype(np.int64).clip(0, iw - 1)
+        arr = np.take(arr, yi, axis=h_ax)
+        arr = np.take(arr, xi, axis=w_ax)
+        return arr
+
+
+class RandomHorizontalFlip(BaseTransform):
+    def __init__(self, prob=0.5, keys=None):
+        self.prob = prob
+
+    def _apply_image(self, img):
+        if np.random.rand() < self.prob:
+            arr = np.asarray(img)
+            chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+            return arr[..., ::-1].copy() if not chw else arr[:, :, ::-1].copy()
+        return img
+
+
+class RandomCrop(BaseTransform):
+    def __init__(self, size, padding=0, keys=None, **kw):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+        self.padding = padding
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        if self.padding:
+            pad = [(0, 0)] * arr.ndim
+            pad[h_ax] = (self.padding, self.padding)
+            pad[w_ax] = (self.padding, self.padding)
+            arr = np.pad(arr, pad)
+        th, tw = self.size
+        h, w = arr.shape[h_ax], arr.shape[w_ax]
+        y = np.random.randint(0, max(h - th, 0) + 1)
+        x = np.random.randint(0, max(w - tw, 0) + 1)
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(y, y + th)
+        sl[w_ax] = slice(x, x + tw)
+        return arr[tuple(sl)]
+
+
+class CenterCrop(BaseTransform):
+    def __init__(self, size, keys=None):
+        self.size = (size, size) if isinstance(size, int) else tuple(size)
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
+        h_ax, w_ax = (1, 2) if chw else (0, 1)
+        th, tw = self.size
+        y = max((arr.shape[h_ax] - th) // 2, 0)
+        x = max((arr.shape[w_ax] - tw) // 2, 0)
+        sl = [slice(None)] * arr.ndim
+        sl[h_ax] = slice(y, y + th)
+        sl[w_ax] = slice(x, x + tw)
+        return arr[tuple(sl)]
+
+
+class Transpose(BaseTransform):
+    def __init__(self, order=(2, 0, 1), keys=None):
+        self.order = order
+
+    def _apply_image(self, img):
+        arr = np.asarray(img)
+        if arr.ndim == 2:
+            arr = arr[..., None]
+        return arr.transpose(self.order)

@@ -27,15 +27,22 @@ import jax.numpy as jnp
 
 
 class GradNode:
-    __slots__ = ("vjp_fn", "input_ids", "input_refs", "output_ids", "out_specs", "multi_out")
+    __slots__ = ("vjp_fn", "input_ids", "input_refs", "output_ids",
+                 "out_specs", "multi_out", "fwd_fn")
 
-    def __init__(self, vjp_fn, input_refs, output_ids, out_specs, multi_out):
+    def __init__(self, vjp_fn, input_refs, output_ids, out_specs, multi_out,
+                 fwd_fn=None):
         self.vjp_fn = vjp_fn
         self.input_refs = input_refs  # Tensors we differentiate w.r.t.
         self.input_ids = [id(t) for t in input_refs]
         self.output_ids = output_ids
         self.out_specs = out_specs  # [(shape, dtype)] aligned with output_ids
         self.multi_out = multi_out
+        # the closed-over forward (diff inputs -> outputs); kept so
+        # create_graph=True can re-derive the vjp AS A TAPED OP (double
+        # grad: the reference's double_grad op chain, e.g.
+        # imperative/partial_grad_engine.cc + *_grad_grad kernels)
+        self.fwd_fn = fwd_fn
 
 
 class _TapeState(threading.local):
@@ -124,26 +131,47 @@ def backward(tensors: Sequence[Any], grad_tensors=None, retain_graph: bool = Fal
             g_val = g.value if isinstance(g, Tensor) else jnp.asarray(g)
         pending[id(t)] = pending.get(id(t), 0) + g_val
 
+    # Hooks fire ONCE on the FULLY ACCUMULATED gradient of a tensor (the
+    # reference GradAccumulator contract), not per contributing op. A
+    # non-leaf's accumulation completes exactly when its producing node
+    # pops it (reverse-chronological order: all consumers ran first); a
+    # leaf's completes at the end of the walk.
+    hooked = {}
+    for node in _tape.nodes:
+        for t in node.input_refs:
+            if getattr(t, "_grad_hooks", None):
+                hooked[id(t)] = t
+    for t in tensors:
+        if getattr(t, "_grad_hooks", None):
+            hooked[id(t)] = t
+
+    leaf_acc: dict[int, Any] = {}
+    leaf_ref: dict[int, Any] = {}
+
+    def _pop(oid):
+        g = pending.pop(oid)
+        if oid in hooked:
+            g = _apply_hooks(hooked[oid], g)
+        return g
+
     for node in reversed(_tape.nodes):
         if not any(oid in pending for oid in node.output_ids):
             continue
         if node.multi_out:
             cotangents = tuple(
-                pending.pop(oid, None) if oid in pending else _zeros_like_spec(spec)
+                _pop(oid) if oid in pending else _zeros_like_spec(spec)
                 for oid, spec in zip(node.output_ids, node.out_specs)
             )
-            cotangents = tuple(
-                c if c is not None else _zeros_like_spec(spec)
-                for c, spec in zip(cotangents, node.out_specs)
-            )
         else:
-            cotangents = pending.pop(node.output_ids[0])
+            cotangents = _pop(node.output_ids[0])
         in_grads = node.vjp_fn(cotangents)
         for t, g in zip(node.input_refs, in_grads):
             if g is None:
                 continue
             if t.is_leaf:
-                t._accumulate_grad(g)
+                prev = leaf_acc.get(id(t))
+                leaf_acc[id(t)] = g if prev is None else prev + g
+                leaf_ref[id(t)] = t
             else:
                 prev = pending.get(id(t))
                 pending[id(t)] = g if prev is None else prev + g
@@ -151,7 +179,16 @@ def backward(tensors: Sequence[Any], grad_tensors=None, retain_graph: bool = Fal
     # leaves may also be targets of backward() directly (grad of x wrt x)
     for t, _ in zip(tensors, grad_tensors):
         if t.is_leaf and id(t) in pending:
-            t._accumulate_grad(pending.pop(id(t)))
+            prev = leaf_acc.get(id(t))
+            g = pending.pop(id(t))
+            leaf_acc[id(t)] = g if prev is None else prev + g
+            leaf_ref[id(t)] = t
+
+    for tid, g in leaf_acc.items():
+        t = leaf_ref[tid]
+        if tid in hooked:
+            g = _apply_hooks(t, g)
+        t._accumulate_grad(g)
 
     if not retain_graph:
         clear_tape()
@@ -169,11 +206,6 @@ def grad(
     of `outputs` w.r.t. `inputs` without touching `.grad` fields."""
     from ..tensor import Tensor
 
-    if create_graph:
-        raise NotImplementedError(
-            "create_graph=True (double grad) is served by the jit path: "
-            "use jax.grad composition via paddle_tpu.jit"
-        )
     outputs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
     inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
     if grad_outputs is None:
@@ -190,17 +222,39 @@ def grad(
     want = {id(t): i for i, t in enumerate(inputs)}
     results: list[Any] = [None] * len(inputs)
 
-    for node in reversed(_tape.nodes):
+    # snapshot: the create_graph walk APPENDS new nodes to the tape (the
+    # re-derived vjp ops) — iterate over the pre-walk graph only
+    walk_nodes = list(_tape.nodes)
+
+    # hooks fire once per tensor on its ACCUMULATED gradient (see
+    # backward()): non-leaves at pop time, requested inputs at the end
+    hooked = {}
+    for node in walk_nodes:
+        for t in node.input_refs:
+            if getattr(t, "_grad_hooks", None):
+                hooked[id(t)] = t
+    input_ids = {id(t) for t in inputs}
+
+    def _pop(oid):
+        g = pending.pop(oid)
+        if oid in hooked and oid not in input_ids:
+            g = _apply_hooks(hooked[oid], g)
+        return g
+
+    for node in reversed(walk_nodes):
         if not any(oid in pending for oid in node.output_ids):
             continue
         if node.multi_out:
             cotangents = tuple(
-                pending.pop(oid) if oid in pending else _zeros_like_spec(spec)
+                _pop(oid) if oid in pending else _zeros_like_spec(spec)
                 for oid, spec in zip(node.output_ids, node.out_specs)
             )
         else:
-            cotangents = pending.pop(node.output_ids[0])
-        in_grads = node.vjp_fn(cotangents)
+            cotangents = _pop(node.output_ids[0])
+        if create_graph and node.fwd_fn is not None:
+            in_grads = _taped_vjp(node, cotangents)
+        else:
+            in_grads = node.vjp_fn(_unwrap_ct(cotangents))
         for t, g in zip(node.input_refs, in_grads):
             if g is None:
                 continue
@@ -209,13 +263,70 @@ def grad(
 
     for t in inputs:
         if id(t) in pending:
-            results[want[id(t)]] = Tensor(pending[id(t)], stop_gradient=True)
+            g = pending[id(t)]
+            if getattr(t, "_grad_hooks", None):
+                g = _apply_hooks(t, g)
+            if create_graph:
+                results[want[id(t)]] = (g if isinstance(g, Tensor)
+                                        else Tensor(g, stop_gradient=False))
+            else:
+                results[want[id(t)]] = Tensor(
+                    g.value if isinstance(g, Tensor) else g,
+                    stop_gradient=True)
         elif not allow_unused:
             raise RuntimeError(
                 "One of the differentiated tensors appears unused in the graph "
                 "(pass allow_unused=True to return None for it)"
             )
 
-    if not retain_graph:
+    if not retain_graph and not create_graph:
         clear_tape()
     return results if len(results) > 1 else results[0]
+
+
+def _unwrap_ct(ct):
+    from ..tensor import Tensor
+
+    if isinstance(ct, tuple):
+        return tuple(c.value if isinstance(c, Tensor) else c for c in ct)
+    return ct.value if isinstance(ct, Tensor) else ct
+
+
+def _taped_vjp(node, cotangents):
+    """Re-derive this node's vjp as a TAPED eager op so the produced
+    gradients carry grad history themselves (create_graph=True — the
+    reference's double-grad path, partial_grad_engine.cc create_graph).
+    Recomputes the node's forward inside jax.vjp: double grad trades one
+    extra forward for differentiability, as the *_grad_grad kernels do."""
+    from ..tensor import Tensor, apply
+
+    cts = list(cotangents) if node.multi_out else [cotangents]
+    ct_tensors = [c if isinstance(c, Tensor) else Tensor(c) for c in cts]
+    n_in = len(node.input_refs)
+
+    def revf(*vals):
+        dv, ct = vals[:n_in], vals[n_in:]
+        _, vf = jax.vjp(node.fwd_fn, *dv)
+        grads = vf(tuple(ct) if node.multi_out else ct[0])
+        return tuple(grads) if n_in > 1 else grads[0]
+
+    out = apply(revf, *node.input_refs, *ct_tensors,
+                _multi_out=n_in > 1)
+    return list(out) if isinstance(out, (tuple, list)) else [out]
+
+
+def _apply_hooks(t, g):
+    """Run a tensor's registered grad hooks (tensor.register_hook) on its
+    freshly produced gradient; a hook returning None leaves g unchanged."""
+    from ..tensor import Tensor
+
+    hooks = getattr(t, "_grad_hooks", None)
+    if not hooks:
+        return g
+    was_tensor = isinstance(g, Tensor)
+    gt = g if was_tensor else Tensor(g)
+    for h in list(hooks.values()):
+        res = h(gt)
+        if res is not None:
+            gt = res if isinstance(res, Tensor) else Tensor(res)
+    return gt if was_tensor else gt.value

@@ -153,7 +153,9 @@ class Predictor:
             self._input_names = manifest["input_names"]
             self._output_names = manifest["output_names"]
             params = {}
-            with open(prefix + ".pdiparams", "rb") as f:
+            aot_params = prefix + ".pdaotparams"
+            with open(aot_params if os.path.exists(aot_params)
+                      else prefix + ".pdiparams", "rb") as f:
                 raw = pickle.load(f)
             for k, v in raw.items():
                 params[k] = jnp.asarray(v)
@@ -288,14 +290,33 @@ def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
             example_inputs = [
                 np.zeros([d if d and d > 0 else 1 for d in s.shape],
                          convert_dtype(s.dtype)) for s in input_spec]
-        # weights always saved (also used by the pickle fallback path)
-        with open(path_prefix + ".pdiparams", "wb") as f:
-            pickle.dump({k: np.asarray(v) for k, v in params.items()}, f)
         from .. import jit as _jit
-        _jit.save(layer, path_prefix)  # .pdmodel pickle fallback artifact
+        _jit.save(layer, path_prefix)  # .pdmodel + .pdiparams (full state)
+        # AOT arg payload: PARAMS ONLY — buffers are baked into the
+        # exported graph as constants, so the .call() arg structure must
+        # match exactly (a buffer-carrying model, e.g. BN or QAT scales,
+        # would otherwise mismatch the exported pytree)
+        with open(path_prefix + ".pdaotparams", "wb") as f:
+            pickle.dump({k: np.asarray(v) for k, v in params.items()}, f)
 
         if example_inputs is None:
             return path_prefix
+
+        # export compiles the forward, so data-dependent python control
+        # flow must be AST-converted here exactly as @to_static would
+        # (otherwise an eager-trained model with `if tensor:` branches
+        # fails at trace time); no-op when nothing converts
+        import types
+
+        from ..jit import _maybe_convert
+
+        cls_fwd = type(layer).forward
+        conv_fwd = _maybe_convert(cls_fwd)
+        if conv_fwd is not cls_fwd and "forward" not in layer.__dict__:
+            layer.forward = types.MethodType(conv_fwd, layer)
+            converted_patch = True
+        else:
+            converted_patch = False
 
         def fwd(*flat):
             n_par = len(jax.tree.leaves(params))
@@ -344,6 +365,8 @@ def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
             json.dump(manifest, f, indent=2)
         return path_prefix
     finally:
+        if locals().get("converted_patch"):
+            layer.__dict__.pop("forward", None)
         if was_training:
             layer.train()
 

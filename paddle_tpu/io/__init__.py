@@ -52,6 +52,17 @@ class TensorDataset(Dataset):
     def __len__(self):
         return self.tensors[0].shape[0]
 
+    # ship to loader workers as plain numpy: unpickling device arrays in a
+    # forkserver/spawn child would import jax there (slow, and the site
+    # TPU plugin must never run in a worker); samples re-wrap as Tensors
+    # in the parent's collate
+    def __getstate__(self):
+        return {"tensors": [np.asarray(t.numpy() if isinstance(t, Tensor)
+                                       else t) for t in self.tensors]}
+
+    def __setstate__(self, state):
+        self.tensors = state["tensors"]
+
 
 class ComposeDataset(Dataset):
     def __init__(self, datasets):
@@ -356,26 +367,72 @@ class DataLoader:
         for idx_batch in self.batch_sampler:
             yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
+    def _pick_start_method(self):
+        """forkserver by default: fork() in a JAX process (multithreaded)
+        is a documented deadlock risk and warns on every worker start.
+        forkserver workers descend from a clean helper process that never
+        imported jax. Requires a picklable dataset/collate/init_fn — a
+        preflight checks this and falls back to fork with a warning
+        (reference worker model pickles too: dataloader_iter.py).
+        Override with PADDLE_TPU_MP_START=fork|forkserver|spawn."""
+        import multiprocessing as mp
+        import os
+        import pickle
+
+        env = os.environ.get("PADDLE_TPU_MP_START", "").strip().lower()
+        if env:
+            return env
+        try:
+            pickle.dumps((self.dataset, self.collate_fn,
+                          self.worker_init_fn))
+        except Exception:
+            import warnings
+            warnings.warn(
+                "DataLoader dataset/collate_fn/worker_init_fn is not "
+                "picklable; falling back to fork-based workers (deadlock "
+                "risk in multithreaded processes). Define them at module "
+                "scope to enable forkserver workers.", RuntimeWarning)
+            return "fork"
+        return ("forkserver" if "forkserver" in mp.get_all_start_methods()
+                else "spawn")
+
     def _produce_multiprocess(self):
         """Multi-process map-style loading (reference:
         fluid/reader.py dataloader_iter.py _DataLoaderIterMultiProcess:478 —
-        worker pool + result reordering).  Workers are forked and do
-        numpy-only work (fetch + collate); device transfer stays in the
-        main process, the fork-safety boundary for XLA."""
+        worker pool + result reordering).  Workers do numpy-only work
+        (fetch + collate); device transfer stays in the main process, the
+        process boundary for XLA."""
         import multiprocessing as mp
+        import os
 
-        ctx = mp.get_context("fork")
+        ctx = mp.get_context(self._pick_start_method())
         index_queues = [ctx.Queue() for _ in range(self.num_workers)]
         result_queue = ctx.Queue()
         workers = []
-        for wid, iq in enumerate(index_queues):
-            w = ctx.Process(
-                target=_worker_loop,
-                args=(self.dataset, self.collate_fn, iq, result_queue, wid,
-                      self.worker_init_fn),
-                daemon=True)
-            w.start()
-            workers.append(w)
+        # Workers must never touch the accelerator: a child re-importing
+        # jax through the site TPU plugin would dial the tunnel the parent
+        # holds and hang. Env is captured at child (and forkserver-server)
+        # start, so pin it around the spawn window: force-CPU AND disable
+        # the tunnel plugin registration outright.
+        prev = {k: os.environ.get(k)
+                for k in ("JAX_PLATFORMS", "PALLAS_AXON_POOL_IPS")}
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        try:
+            for wid, iq in enumerate(index_queues):
+                w = ctx.Process(
+                    target=_worker_loop,
+                    args=(self.dataset, self.collate_fn, iq, result_queue,
+                          wid, self.worker_init_fn),
+                    daemon=True)
+                w.start()
+                workers.append(w)
+        finally:
+            for k, v in prev.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
         try:
             batches = list(self.batch_sampler)
             # dispatch round-robin, keep prefetch_factor per worker in flight
@@ -408,7 +465,16 @@ class DataLoader:
                         if not w.is_alive() and w.exitcode != 0:
                             raise RuntimeError(
                                 f"DataLoader worker pid={w.pid} died with "
-                                f"exit code {w.exitcode}")
+                                f"exit code {w.exitcode}. If this "
+                                "happened at startup, the launching "
+                                "script probably lacks an `if __name__ "
+                                "== '__main__':` guard — forkserver/"
+                                "spawn workers re-import the main module "
+                                "(same contract as torch DataLoader on "
+                                "spawn platforms). Guard the script, or "
+                                "set PADDLE_TPU_MP_START=fork to opt "
+                                "back into fork workers (deadlock risk "
+                                "in multithreaded/JAX processes).")
                     if deadline is not None and _time.monotonic() > deadline:
                         raise RuntimeError(
                             f"DataLoader worker timed out after "

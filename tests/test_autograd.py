@@ -159,3 +159,82 @@ class TestLeafSemantics:
         loss = paddle.sum(mid)
         loss.backward()
         assert a.grad is not None and mid.grad is None
+
+
+class TestDoubleGrad:
+    """create_graph=True re-derives each vjp as a taped op (the reference's
+    double-grad path, partial_grad_engine.cc)."""
+
+    def test_second_derivative_of_cube(self):
+        x = paddle.to_tensor(np.array([3.0], np.float32),
+                             stop_gradient=False)
+        y = (x ** 3).sum()
+        g = paddle.grad(y, [x], create_graph=True)
+        g = g if isinstance(g, list) else [g]
+        np.testing.assert_allclose(g[0].numpy(), [27.0], rtol=1e-5)
+        gg = paddle.grad(g[0].sum(), [x])
+        gg = gg if isinstance(gg, list) else [gg]
+        np.testing.assert_allclose(gg[0].numpy(), [18.0], rtol=1e-5)
+
+    def test_grad_penalty_through_matmul(self):
+        """WGAN-GP shape: d/dw of ||dL/dx||^2."""
+        w = paddle.to_tensor(np.array([[2.0]], np.float32),
+                             stop_gradient=False)
+        x = paddle.to_tensor(np.array([[3.0]], np.float32),
+                             stop_gradient=False)
+        y = paddle.matmul(x, w).sum()          # y = x w
+        gx = paddle.grad(y, [x], create_graph=True)
+        gx = gx if isinstance(gx, list) else [gx]
+        # gx = w; penalty = w^2; d penalty/dw = 2w = 4
+        penalty = (gx[0] ** 2).sum()
+        gw = paddle.grad(penalty, [w])
+        gw = gw if isinstance(gw, list) else [gw]
+        np.testing.assert_allclose(gw[0].numpy(), [[4.0]], rtol=1e-5)
+
+    def test_without_create_graph_still_raises_nothing(self):
+        x = paddle.to_tensor(np.array([2.0], np.float32),
+                             stop_gradient=False)
+        y = (x ** 2).sum()
+        g = paddle.grad(y, [x])
+        g = g if isinstance(g, list) else [g]
+        np.testing.assert_allclose(g[0].numpy(), [4.0], rtol=1e-6)
+
+
+class TestTensorHooks:
+    def test_hook_observes_and_replaces_grad(self):
+        x = paddle.to_tensor(np.ones(3, np.float32), stop_gradient=False)
+        seen = []
+        h = x.register_hook(
+            lambda g: seen.append(np.asarray(g.numpy())) or g * 2)
+        (x * 5.0).sum().backward()
+        np.testing.assert_allclose(seen[0], [5.0, 5.0, 5.0])
+        np.testing.assert_allclose(x.grad.numpy(), [10.0, 10.0, 10.0])
+
+    def test_hook_remove(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        h = x.register_hook(lambda g: g * 100)
+        h.remove()
+        (x * 3.0).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [3.0, 3.0])
+
+    def test_hook_none_return_keeps_grad(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        calls = []
+        x.register_hook(lambda g: calls.append(1) and None)
+        (x * 7.0).sum().backward()
+        assert calls
+        np.testing.assert_allclose(x.grad.numpy(), [7.0, 7.0])
+
+    def test_hook_on_stop_gradient_raises(self):
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        with pytest.raises(RuntimeError):
+            x.register_hook(lambda g: g)
+
+    def test_hook_on_intermediate(self):
+        x = paddle.to_tensor(np.ones(2, np.float32), stop_gradient=False)
+        mid = x * 2.0
+        seen = []
+        mid.register_hook(lambda g: seen.append(np.asarray(g.numpy())))
+        (mid * 3.0).sum().backward()
+        np.testing.assert_allclose(seen[0], [3.0, 3.0])
+        np.testing.assert_allclose(x.grad.numpy(), [6.0, 6.0])

@@ -208,3 +208,56 @@ class TestIncrement:
         x = paddle.full([1], 1.0)
         y = snn.increment(x, 2.0)
         assert float(y.value[0]) == 3.0
+
+
+class TestWhileLoopReverseMode:
+    """max_iters lowers while_loop to a masked bounded scan, which
+    reverse-differentiates (ref while_op.cc:209 WhileGradOp)."""
+
+    def test_grad_through_dynamic_while(self):
+        import jax
+        import jax.numpy as jnp
+        from paddle_tpu.static.nn import while_loop
+
+        def f(x, n):
+            # x doubles until i == n (data-dependent trip count)
+            def cond(i, v):
+                return i < n
+
+            def body(i, v):
+                return i + 1, v * 2.0
+
+            _, out = while_loop(cond, body, (jnp.int32(0), x), max_iters=8)
+            return out.sum()
+
+        x = jnp.ones((3,), jnp.float32)
+        for n in (0, 3, 5, 8):
+            val, g = jax.value_and_grad(f)(x, jnp.int32(n))
+            assert val == 3 * 2.0 ** n
+            np.testing.assert_allclose(np.asarray(g), 2.0 ** n)
+
+    def test_masked_scan_matches_while(self):
+        import jax.numpy as jnp
+        from paddle_tpu.static.nn import while_loop
+
+        def cond(i, acc):
+            return i < 5
+
+        def body(i, acc):
+            return i + 1, acc + jnp.float32(i)
+
+        i1, a1 = while_loop(cond, body, (jnp.int32(0), jnp.float32(0)))
+        i2, a2 = while_loop(cond, body, (jnp.int32(0), jnp.float32(0)),
+                            max_iters=9)
+        assert int(i1) == int(i2) == 5
+        assert float(a1) == float(a2) == 10.0
+
+    def test_tensor_loop_vars(self):
+        from paddle_tpu.static.nn import while_loop
+
+        x = paddle.to_tensor(np.float32(1.0))
+        i = paddle.to_tensor(np.int32(0))
+        io, xo = while_loop(lambda i, x: i < 4,
+                            lambda i, x: (i + 1, x * 3.0), (i, x),
+                            max_iters=6)
+        assert float(xo.numpy()) == 81.0

@@ -1,0 +1,248 @@
+"""Detection-op tests (round-3 breadth) — numpy references per the OpTest
+contract (reference operators/detection/*.cc; python wrappers in
+fluid/layers/detection.py)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.vision import ops as V
+
+
+def T(x):
+    return paddle.to_tensor(np.asarray(x))
+
+
+class TestSimpleOps:
+    def test_iou_similarity_matches_box_iou(self):
+        a = np.array([[0, 0, 10, 10], [5, 5, 15, 15]], np.float32)
+        b = np.array([[0, 0, 10, 10]], np.float32)
+        out = np.asarray(V.iou_similarity(T(a), T(b)).numpy())
+        np.testing.assert_allclose(out[0, 0], 1.0, atol=1e-6)
+        # IoU(a2, b1): inter 5x5=25, union 100+100-25=175
+        np.testing.assert_allclose(out[1, 0], 25 / 175, atol=1e-6)
+
+    def test_box_clip(self):
+        boxes = np.array([[-5.0, -5.0, 30.0, 40.0]], np.float32)
+        im_info = np.array([20.0, 25.0, 1.0], np.float32)  # H, W, scale
+        out = np.asarray(V.box_clip(T(boxes), T(im_info)).numpy())
+        np.testing.assert_allclose(out[0], [0, 0, 24, 19])
+
+    def test_polygon_box_transform(self):
+        x = np.zeros((1, 2, 2, 3), np.float32)
+        out = np.asarray(V.polygon_box_transform(T(x)).numpy())
+        # even channel: 4*col; odd channel: 4*row
+        np.testing.assert_allclose(out[0, 0], [[0, 4, 8], [0, 4, 8]])
+        np.testing.assert_allclose(out[0, 1], [[0, 0, 0], [4, 4, 4]])
+
+    def test_target_assign(self):
+        x = np.arange(12, dtype=np.float32).reshape(3, 4)
+        midx = np.array([[2, -1, 0]], np.int32)
+        out, w = V.target_assign(T(x), T(midx), mismatch_value=7)
+        out = np.asarray(out.numpy())
+        np.testing.assert_allclose(out[0, 0], x[2])
+        np.testing.assert_allclose(out[0, 1], [7, 7, 7, 7])
+        np.testing.assert_allclose(out[0, 2], x[0])
+        np.testing.assert_allclose(np.asarray(w.numpy())[0, :, 0],
+                                   [1, 0, 1])
+
+
+class TestAnchors:
+    def test_anchor_generator_shapes_and_centers(self):
+        fm = np.zeros((1, 8, 2, 3), np.float32)
+        anc, var = V.anchor_generator(
+            fm, anchor_sizes=[32, 64], aspect_ratios=[1.0],
+            variances=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0])
+        anc = np.asarray(anc.numpy())
+        assert anc.shape == (2, 3, 2, 4)
+        # first cell center at (0.5*16, 0.5*16); size-32 anchor spans ±16
+        np.testing.assert_allclose(anc[0, 0, 0], [-8, -8, 24, 24])
+        np.testing.assert_allclose(np.asarray(var.numpy())[0, 0, 0],
+                                   [0.1, 0.1, 0.2, 0.2])
+
+    def test_density_prior_box_counts(self):
+        fm = np.zeros((1, 8, 4, 4), np.float32)
+        img = np.zeros((1, 3, 32, 32), np.float32)
+        boxes, var = V.density_prior_box(
+            fm, img, densities=[2, 1], fixed_sizes=[8.0, 16.0],
+            fixed_ratios=[1.0], clip=True)
+        b = np.asarray(boxes.numpy())
+        # densities 2 and 1 with one ratio: 4 + 1 anchors per cell
+        assert b.shape == (4, 4, 5, 4)
+        assert (b >= 0).all() and (b <= 1).all()
+
+
+class TestFocalLoss:
+    def test_matches_numpy_reference(self):
+        rng = np.random.RandomState(0)
+        x = rng.randn(6, 3).astype(np.float32)
+        label = np.array([[1], [0], [3], [2], [0], [1]], np.int32)
+        fg = np.array([4], np.int32)
+        gamma, alpha = 2.0, 0.25
+        out = np.asarray(V.sigmoid_focal_loss(
+            T(x), T(label), T(fg), gamma, alpha).numpy())
+        p = 1 / (1 + np.exp(-x))
+        expect = np.zeros_like(x)
+        for i in range(6):
+            for c in range(3):
+                pos = label[i, 0] == c + 1
+                if pos:
+                    expect[i, c] = -alpha * (1 - p[i, c]) ** gamma * \
+                        np.log(p[i, c])
+                else:
+                    expect[i, c] = -(1 - alpha) * p[i, c] ** gamma * \
+                        np.log(1 - p[i, c])
+        expect /= 4.0
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-6)
+
+
+class TestMatrixNMS:
+    def test_suppresses_duplicates_keeps_distinct(self):
+        boxes = np.array([[[0, 0, 10, 10], [0, 0, 10, 10],
+                           [50, 50, 60, 60]]], np.float32)
+        scores = np.zeros((1, 2, 3), np.float32)
+        scores[0, 1] = [0.9, 0.8, 0.7]
+        out, idx, num = V.matrix_nms(T(boxes), T(scores),
+                                     score_threshold=0.1,
+                                     post_threshold=0.3,
+                                     return_index=True)
+        out = np.asarray(out.numpy())
+        # duplicate of the 0.9 box decays to ~0 and drops; distinct stays
+        assert int(np.asarray(num.numpy())[0]) == 2
+        np.testing.assert_allclose(sorted(out[:, 1], reverse=True),
+                                   out[:, 1])
+        assert 0.9 in out[:, 1] and 0.7 in out[:, 1]
+
+    def test_gaussian_decay(self):
+        boxes = np.array([[[0, 0, 10, 10], [1, 1, 11, 11]]], np.float32)
+        scores = np.zeros((1, 2, 2), np.float32)
+        scores[0, 1] = [0.9, 0.8]
+        out = V.matrix_nms(T(boxes), T(scores), score_threshold=0.1,
+                           use_gaussian=True, gaussian_sigma=2.0,
+                           return_rois_num=False)
+        out = np.asarray(out.numpy())
+        assert out.shape[0] == 2
+        # second box decayed: exp(-iou^2/sigma) < 1
+        assert out[1, 1] < 0.8
+
+
+class TestBipartiteMatch:
+    def test_greedy_global_order(self):
+        dist = np.array([[0.9, 0.1, 0.3],
+                         [0.8, 0.7, 0.2]], np.float32)
+        idx, d = V.bipartite_match(T(dist))
+        idx = np.asarray(idx.numpy())[0]
+        d = np.asarray(d.numpy())[0]
+        # global max 0.9 -> row0/col0; next best among remaining: row1/col1
+        assert idx[0] == 0 and idx[1] == 1 and idx[2] == -1
+        np.testing.assert_allclose(d[:2], [0.9, 0.7])
+
+    def test_per_prediction_fills_leftovers(self):
+        dist = np.array([[0.9, 0.1, 0.6]], np.float32)
+        idx, d = V.bipartite_match(T(dist), match_type="per_prediction",
+                                   dist_threshold=0.5)
+        idx = np.asarray(idx.numpy())[0]
+        assert idx[0] == 0      # greedy match
+        assert idx[2] == 0      # filled: 0.6 > 0.5
+        assert idx[1] == -1     # 0.1 < threshold
+
+    def test_jit_safe(self):
+        import jax
+
+        dist = np.random.RandomState(0).rand(4, 6).astype(np.float32)
+
+        @jax.jit
+        def f(d):
+            i, dd = V.bipartite_match(paddle.Tensor(d))
+            return i.value, dd.value
+
+        i1, _ = f(dist)
+        i2 = np.asarray(V.bipartite_match(T(dist))[0].numpy())
+        np.testing.assert_array_equal(np.asarray(i1), i2)
+
+
+class TestMineHardExamples:
+    def test_quota_and_ranking(self):
+        cls_loss = np.array([[5.0, 1.0, 4.0, 3.0, 2.0]], np.float32)
+        midx = np.array([[1, -1, -1, -1, -1]], np.int32)  # 1 positive
+        sel = np.asarray(V.mine_hard_examples(
+            T(cls_loss), match_indices=T(midx),
+            neg_pos_ratio=2.0).numpy())
+        # 1 positive * ratio 2 = 2 negatives: the two highest-loss negs
+        assert sel[0].tolist() == [0, 0, 1, 1, 0]
+
+
+class TestGenerateProposals:
+    def _inputs(self, N=1, A=2, H=3, W=3):
+        rng = np.random.RandomState(7)
+        scores = rng.rand(N, A, H, W).astype(np.float32)
+        deltas = (rng.randn(N, 4 * A, H, W) * 0.1).astype(np.float32)
+        fm = np.zeros((N, 8, H, W), np.float32)
+        anchors, var = V.anchor_generator(
+            fm, anchor_sizes=[16.0], aspect_ratios=[1.0, 2.0],
+            variances=[1.0, 1.0, 1.0, 1.0], stride=[8.0, 8.0])
+        im_shape = np.array([[24.0, 24.0]] * N, np.float32)
+        return scores, deltas, im_shape, anchors, var
+
+    def test_basic_pipeline(self):
+        scores, deltas, im_shape, anchors, var = self._inputs()
+        rois, probs, num = V.generate_proposals(
+            T(scores), T(deltas), T(im_shape), anchors, var,
+            pre_nms_top_n=12, post_nms_top_n=5, nms_thresh=0.7,
+            min_size=1.0, return_rois_num=True)
+        r = np.asarray(rois.numpy())
+        p = np.asarray(probs.numpy())
+        n = int(np.asarray(num.numpy())[0])
+        assert r.shape[0] == p.shape[0] == n <= 5
+        assert (r[:, 0] >= 0).all() and (r[:, 2] <= 23).all()
+        assert (p[:-1, 0] >= p[1:, 0]).all()  # score-sorted
+
+    def test_min_size_filters(self):
+        scores, deltas, im_shape, anchors, var = self._inputs()
+        rois, _ = V.generate_proposals(
+            T(scores), T(deltas), T(im_shape), anchors, var,
+            min_size=1e6)
+        assert np.asarray(rois.numpy()).shape[0] == 0
+
+
+class TestFPN:
+    def test_distribute_and_restore(self):
+        rois = np.array([[0, 0, 10, 10],       # small -> low level
+                         [0, 0, 200, 200],     # large -> high level
+                         [0, 0, 14, 14]], np.float32)
+        multi, restore = V.distribute_fpn_proposals(
+            T(rois), min_level=2, max_level=5, refer_level=4,
+            refer_scale=224)
+        sizes = [np.asarray(m.numpy()).shape[0] for m in multi]
+        assert sum(sizes) == 3
+        assert sizes[0] == 2          # both small boxes at min level
+        ridx = np.asarray(restore.numpy())[:, 0]
+        cat = np.concatenate([np.asarray(m.numpy()) for m in multi], 0)
+        np.testing.assert_allclose(cat[ridx], rois)
+
+    def test_collect_top_k(self):
+        r1 = np.array([[0, 0, 1, 1], [0, 0, 2, 2]], np.float32)
+        r2 = np.array([[0, 0, 3, 3]], np.float32)
+        s1 = np.array([0.2, 0.9], np.float32)
+        s2 = np.array([0.5], np.float32)
+        out = V.collect_fpn_proposals([T(r1), T(r2)], [T(s1), T(s2)],
+                                      2, 3, post_nms_top_n=2)
+        out = np.asarray(out.numpy())
+        np.testing.assert_allclose(out[0], [0, 0, 2, 2])  # 0.9 first
+        np.testing.assert_allclose(out[1], [0, 0, 3, 3])  # then 0.5
+
+
+class TestBoxDecoderAndAssign:
+    def test_decode_and_pick_best_class(self):
+        priors = np.array([[0, 0, 10, 10]], np.float32)
+        pvar = np.array([[1, 1, 1, 1]], np.float32)
+        targets = np.zeros((1, 8), np.float32)  # 2 classes, zero deltas
+        targets[0, 4:] = [0.1, 0.1, 0.0, 0.0]   # class-2 shifted
+        scores = np.array([[0.1, 0.2, 0.7]], np.float32)  # bg, c1, c2
+        dec, assigned = V.box_decoder_and_assign(
+            T(priors), T(pvar), T(targets), T(scores))
+        dec = np.asarray(dec.numpy())
+        a = np.asarray(assigned.numpy())
+        # zero deltas decode back to the prior
+        np.testing.assert_allclose(dec[0, :4], [0, 0, 10, 10], atol=1e-5)
+        # best class (c2) is the shifted box
+        np.testing.assert_allclose(a[0], dec[0, 4:], atol=1e-5)

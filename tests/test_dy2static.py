@@ -1,0 +1,172 @@
+"""dygraph→static AST conversion tests (reference:
+dygraph_to_static/program_translator.py:233 + convert_operators.py —
+python control flow over tensors must survive to_static with BOTH branches
+live in the compiled program)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.jit import dy2static
+
+
+class BranchNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(4, 4)
+        self.fc2 = nn.Linear(4, 4)
+
+    def forward(self, x):
+        if x.sum() > 0:
+            y = self.fc1(x)
+        else:
+            y = self.fc2(x)
+        return y
+
+
+class TestConvertIf:
+    def test_both_branches_live_after_to_static(self):
+        net = BranchNet()
+        paddle.jit.to_static(net)
+        xp = paddle.to_tensor(np.ones((2, 4), np.float32))
+        xn = paddle.to_tensor(-np.ones((2, 4), np.float32))
+        np.testing.assert_allclose(np.asarray(net(xp).numpy()),
+                                   np.asarray(net.fc1(xp).numpy()),
+                                   atol=1e-6)
+        np.testing.assert_allclose(np.asarray(net(xn).numpy()),
+                                   np.asarray(net.fc2(xn).numpy()),
+                                   atol=1e-6)
+
+    def test_early_return_pattern(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                return x * 2.0
+            return x - 1.0
+
+        pos = f(paddle.to_tensor(np.ones(2, np.float32)))
+        neg = f(paddle.to_tensor(-np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(pos.numpy()), [2.0, 2.0])
+        np.testing.assert_allclose(np.asarray(neg.numpy()), [-2.0, -2.0])
+
+    def test_python_pred_stays_python(self):
+        @paddle.jit.to_static
+        def f(x, flag):
+            if flag:          # python bool argument
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        # flag traces as an array; the converted dispatch still works
+        out = f(paddle.to_tensor(np.ones(2, np.float32)), True)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])
+
+    def test_var_assigned_in_one_branch_only_raises_clearly(self):
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                z = x * 3.0  # noqa: F841 — deliberate one-sided assign
+            return y  # noqa: F821
+
+        with pytest.raises(Exception):
+            f(paddle.to_tensor(np.ones(2, np.float32)))
+
+
+class TestConvertWhile:
+    def test_data_dependent_trip_count(self):
+        @paddle.jit.to_static
+        def collatz(x):
+            n = 0
+            while x > 1.0:
+                x = paddle.where((x % 2.0) == 0.0, x / 2.0, 3.0 * x + 1.0)
+                n = n + 1
+            return n
+
+        r = collatz(paddle.to_tensor(np.float32(6.0)))
+        assert int(np.asarray(r.numpy() if hasattr(r, "numpy") else r)) == 8
+        r = collatz(paddle.to_tensor(np.float32(1.0)))
+        assert int(np.asarray(r.numpy() if hasattr(r, "numpy") else r)) == 0
+
+    def test_for_over_traced_range(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            acc = paddle.zeros([2])
+            for i in range(n):
+                acc = acc + x * (i + 1.0)
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)), 3)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [6.0, 6.0])
+
+    def test_python_range_still_python(self):
+        @paddle.jit.to_static
+        def f(x):
+            acc = x
+            for _ in range(3):
+                acc = acc * 2.0
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [8.0, 8.0])
+
+
+class TestSaveLoadRoundtrip:
+    def test_saved_model_keeps_both_branches(self, tmp_path):
+        from paddle_tpu.inference import (load_inference_model,
+                                          save_inference_model)
+
+        net = BranchNet()
+        paddle.jit.to_static(net)
+        prefix = str(tmp_path / "branchy")
+        save_inference_model(
+            prefix, net,
+            example_inputs=[np.ones((2, 4), np.float32)])
+        pred = load_inference_model(prefix)
+        xp = np.ones((2, 4), np.float32)
+        xn = -np.ones((2, 4), np.float32)
+        op, = pred.run([xp])
+        on, = pred.run([xn])
+        np.testing.assert_allclose(
+            op, np.asarray(net.fc1(paddle.to_tensor(xp)).numpy()),
+            atol=1e-5)
+        np.testing.assert_allclose(
+            on, np.asarray(net.fc2(paddle.to_tensor(xn)).numpy()),
+            atol=1e-5)
+
+
+class TestConversionFallbacks:
+    def test_unsupported_constructs_fall_back(self):
+        # break inside a loop: conversion declines, plain tracing still
+        # works because the loop is over a python range
+        @paddle.jit.to_static
+        def f(x):
+            acc = x
+            for i in range(5):
+                if i >= 2:
+                    break
+                acc = acc * 2.0
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 4.0])
+
+    def test_no_control_flow_is_not_converted(self):
+        def f(x):
+            return x * 2.0
+
+        with pytest.raises(dy2static.ConversionError):
+            dy2static.convert_function(f)
+
+    def test_not_to_static_opts_out(self):
+        @paddle.jit.not_to_static
+        def f(x):
+            if isinstance(x, str):
+                return None
+            return x * 2.0
+
+        g = paddle.jit.to_static(f)
+        out = g(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])

@@ -170,3 +170,23 @@ class TestSpawn:
                            text=True, timeout=120,
                            env=dict(os.environ, JAX_PLATFORMS="cpu"))
         assert r.returncode == 0, r.stderr + r.stdout
+
+
+class TestPackaging:
+    """Packaging parity (reference setup.py.in:513-515 console scripts)."""
+
+    def test_pyproject_declares_fleetrun(self):
+        import os
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        text = open(os.path.join(root, "pyproject.toml")).read()
+        assert 'fleetrun = "paddle_tpu.distributed.launch:launch"' in text
+        assert 'libpaddle_tpu_core.so' in text
+
+    def test_module_launch_help(self):
+        import subprocess
+        import sys
+        p = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--help"], capture_output=True, text=True, timeout=120)
+        assert p.returncode == 0
+        assert "nproc_per_node" in p.stdout

@@ -150,17 +150,49 @@ class TestBatchAssemble:
             core.assemble_batch(samples)
 
 
+class _IotaDataset(paddle.io.Dataset):
+    """Module-scope (picklable) so forkserver workers can load it."""
+
+    def __init__(self, n=64):
+        self.n = n
+
+    def __len__(self):
+        return self.n
+
+    def __getitem__(self, i):
+        return (np.full((4, 4), i, np.float32), np.int64(i))
+
+
+class _PoisonDataset(paddle.io.Dataset):
+    def __len__(self):
+        return 8
+
+    def __getitem__(self, i):
+        if i == 5:
+            raise ValueError("poison-idx-5")
+        return np.zeros(2, np.float32)
+
+
+class _DieDataset(paddle.io.Dataset):
+    def __len__(self):
+        return 16
+
+    def __getitem__(self, i):
+        import os
+        import time as _t
+        if i >= 4:
+            _t.sleep(0.3)
+            os._exit(9)  # simulate segfault/OOM-kill
+        return np.zeros(2, np.float32)
+
+
+def _check_wid_init(wid):
+    assert wid in (0, 1)
+
+
 class TestMultiprocessDataLoader:
     def _dataset(self, n=64):
-        class DS(paddle.io.Dataset):
-            def __len__(self):
-                return n
-
-            def __getitem__(self, i):
-                return (np.full((4, 4), i, np.float32),
-                        np.int64(i))
-
-        return DS()
+        return _IotaDataset(n)
 
     def test_workers_match_single_process(self):
         ds = self._dataset()
@@ -177,18 +209,32 @@ class TestMultiprocessDataLoader:
                                           np.asarray(y2.numpy()))
 
     def test_worker_exception_propagates(self):
-        class Bad(paddle.io.Dataset):
-            def __len__(self):
-                return 8
-
-            def __getitem__(self, i):
-                if i == 5:
-                    raise ValueError("poison-idx-5")
-                return np.zeros(2, np.float32)
-
-        dl = paddle.io.DataLoader(Bad(), batch_size=2, num_workers=2)
+        dl = paddle.io.DataLoader(_PoisonDataset(), batch_size=2,
+                                  num_workers=2)
         with pytest.raises(RuntimeError, match="poison-idx-5"):
             list(dl)
+
+    def test_unpicklable_dataset_falls_back_to_fork(self):
+        """Local (unpicklable) datasets still work via fork, with a
+        warning recommending module scope."""
+        n = 8
+
+        class Local(paddle.io.Dataset):
+            def __len__(self):
+                return n
+
+            def __getitem__(self, i):
+                return np.full((2,), i, np.float32)
+
+        dl = paddle.io.DataLoader(Local(), batch_size=4, num_workers=1)
+        with pytest.warns(RuntimeWarning, match="not\\s+picklable"):
+            out = list(dl)
+        assert len(out) == 2
+
+    def test_forkserver_is_default_for_picklable(self):
+        assert paddle.io.DataLoader(
+            _IotaDataset(8), batch_size=4,
+            num_workers=1)._pick_start_method() in ("forkserver", "spawn")
 
     def test_tensor_dataset_parity(self):
         """Tensor samples must stack identically with and without workers."""
@@ -225,31 +271,15 @@ class TestMultiprocessDataLoader:
     def test_dead_worker_raises(self):
         """A worker killed mid-flight must raise, not hang (reference:
         dataloader SIGCHLD watch, fluid/reader.py)."""
-        class Slow(paddle.io.Dataset):
-            def __len__(self):
-                return 16
-
-            def __getitem__(self, i):
-                import os
-                import time as _t
-                if i >= 4:
-                    _t.sleep(0.3)
-                    os._exit(9)  # simulate segfault/OOM-kill
-                return np.zeros(2, np.float32)
-
-        dl = paddle.io.DataLoader(Slow(), batch_size=4, num_workers=1)
+        dl = paddle.io.DataLoader(_DieDataset(), batch_size=4,
+                                  num_workers=1)
         with pytest.raises(RuntimeError, match="died|failed"):
             list(dl)
 
     def test_worker_init_fn_called(self):
-        ds = self._dataset(8)
-        calls = []
-
-        def init_fn(wid):
-            # runs in the child; observable effect must come through data,
-            # so just assert it doesn't crash the pipeline
-            assert wid in (0, 1)
-
-        dl = paddle.io.DataLoader(ds, batch_size=4, num_workers=2,
-                                  worker_init_fn=init_fn)
+        # init fn runs in the child; observable effect must come through
+        # data, so just assert it doesn't crash the pipeline
+        dl = paddle.io.DataLoader(self._dataset(8), batch_size=4,
+                                  num_workers=2,
+                                  worker_init_fn=_check_wid_init)
         assert len(list(dl)) == 2

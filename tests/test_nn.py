@@ -212,3 +212,71 @@ def test_hooks():
     h.remove()
     l(paddle.randn([1, 2]))
     assert calls == [1]
+
+
+def test_spectral_norm_scales_to_unit_sigma():
+    sn = nn.SpectralNorm([8, 6], dim=0, power_iters=25)
+    w = paddle.randn([8, 6])
+    wn = sn(w)
+    top_sv = np.linalg.svd(np.asarray(wn.numpy()), compute_uv=False)[0]
+    np.testing.assert_allclose(top_sv, 1.0, rtol=1e-4)
+    # u/v buffers persist across calls (power iteration warm start)
+    u0 = np.asarray(sn.weight_u.numpy()).copy()
+    sn(w)
+    assert not np.allclose(u0, 0)
+    # conv-style 4D weight with dim=1
+    sn4 = nn.SpectralNorm([3, 8, 2, 2], dim=1, power_iters=25)
+    w4 = paddle.randn([3, 8, 2, 2])
+    wn4 = sn4(w4)
+    m = np.transpose(np.asarray(wn4.numpy()), (1, 0, 2, 3)).reshape(8, -1)
+    np.testing.assert_allclose(
+        np.linalg.svd(m, compute_uv=False)[0], 1.0, rtol=1e-4)
+
+
+def test_viterbi_decoder_matches_brute_force():
+    import itertools
+
+    from paddle_tpu.text import ViterbiDecoder
+
+    C, L = 4, 5
+    rng = np.random.RandomState(3)
+    trans = rng.randn(C, C).astype(np.float32)
+    pot = rng.randn(2, L, C).astype(np.float32)
+    lens = np.array([L, 3], np.int64)
+    dec = ViterbiDecoder(paddle.to_tensor(trans), include_bos_eos_tag=True)
+    scores, paths = dec(paddle.to_tensor(pot), paddle.to_tensor(lens))
+    scores = np.asarray(scores.numpy())
+    paths = np.asarray(paths.numpy())
+
+    for b, n in enumerate(lens):
+        best, bp = -1e9, None
+        for seq in itertools.product(range(C), repeat=int(n)):
+            s = trans[C - 2, seq[0]] + pot[b, 0, seq[0]]
+            for t in range(1, int(n)):
+                s += trans[seq[t - 1], seq[t]] + pot[b, t, seq[t]]
+            s += trans[seq[-1], C - 1]
+            if s > best:
+                best, bp = s, seq
+        np.testing.assert_allclose(scores[b], best, rtol=1e-5)
+        assert tuple(paths[b, :int(n)]) == bp
+        assert (paths[b, int(n):] == 0).all()
+
+
+def test_viterbi_decoder_jits():
+    import jax
+
+    from paddle_tpu.text import ViterbiDecoder
+
+    C = 4
+    rng = np.random.RandomState(5)
+    dec = ViterbiDecoder(paddle.to_tensor(rng.randn(C, C).astype(np.float32)),
+                         include_bos_eos_tag=False)
+
+    @jax.jit
+    def f(pot, lens):
+        s, p = dec(paddle.Tensor(pot), paddle.Tensor(lens))
+        return s.value, p.value
+
+    s, p = f(rng.randn(3, 6, C).astype(np.float32),
+             np.array([6, 6, 2], np.int64))
+    assert s.shape == (3,) and p.shape == (3, 6)

@@ -1,0 +1,144 @@
+"""slim/quantization tests (reference: contrib/slim/quantization/
+quantization_pass.py + imperative/qat.py): QAT wrapping, STE gradients,
+convergence, and the int8 export artifact served by the Predictor."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.slim import (QAT, QuantizedLinear, fake_quant,
+                             load_quantized_predictor)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 16)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Linear(16, 2)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+class ConvNet(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.conv = nn.Conv2D(1, 4, 3, padding=1)
+        self.fc = nn.Linear(4 * 4 * 4, 2)
+
+    def forward(self, x):
+        h = paddle.nn.functional.relu(self.conv(x))
+        return self.fc(h.reshape([h.shape[0], -1]))
+
+
+class TestFakeQuant:
+    def test_rounds_to_grid(self):
+        x = paddle.to_tensor(np.linspace(-1, 1, 9).astype(np.float32))
+        s = paddle.to_tensor(np.float32(1.0))
+        q = np.asarray(fake_quant(x, s, bits=8).numpy())
+        step = 1.0 / 127
+        np.testing.assert_allclose(q / step, np.round(q / step),
+                                   atol=1e-5)
+        np.testing.assert_allclose(q, np.asarray(x.numpy()), atol=step)
+
+    def test_ste_gradient_is_identity(self):
+        x = paddle.to_tensor(np.array([0.3, -0.7], np.float32),
+                             stop_gradient=False)
+        s = paddle.to_tensor(np.float32(1.0))
+        fake_quant(x, s).sum().backward()
+        np.testing.assert_allclose(x.grad.numpy(), [1.0, 1.0])
+
+    def test_saturates_at_scale(self):
+        x = paddle.to_tensor(np.array([10.0], np.float32))
+        s = paddle.to_tensor(np.float32(1.0))
+        q = float(np.asarray(fake_quant(x, s, bits=8).numpy()))
+        assert abs(q - 1.0) < 1e-5
+
+
+class TestQATTransform:
+    def test_wraps_quantizable_layers(self):
+        net = MLP()
+        QAT().quantize(net)
+        assert isinstance(net.fc1, QuantizedLinear)
+        assert isinstance(net.fc2, QuantizedLinear)
+        assert isinstance(net.relu, nn.ReLU)  # untouched
+
+    def test_observer_tracks_scale(self):
+        net = MLP()
+        QAT(moving_rate=0.0).quantize(net)  # rate 0: scale = last abs-max
+        net.train()
+        x = paddle.to_tensor(np.full((2, 8), 3.0, np.float32))
+        net(x)
+        np.testing.assert_allclose(
+            float(np.asarray(net.fc1.act_scale.numpy())), 3.0, rtol=1e-5)
+
+    def test_qat_converges_on_separable_data(self):
+        paddle.seed(0)
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(8, 1).astype(np.float32)
+        X = rng.randn(256, 8).astype(np.float32)
+        y = (X @ w_true > 0).astype(np.int64).reshape(-1)
+
+        net = MLP()
+        QAT().quantize(net)
+        net.train()
+        opt = paddle.optimizer.Adam(learning_rate=0.01,
+                                    parameters=net.parameters())
+        ce = nn.CrossEntropyLoss()
+        for _ in range(60):
+            logits = net(paddle.to_tensor(X))
+            loss = ce(logits, paddle.to_tensor(y))
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        net.eval()
+        pred = np.asarray(net(paddle.to_tensor(X)).numpy()).argmax(1)
+        acc = (pred == y).mean()
+        assert acc > 0.9, f"QAT failed to converge, acc={acc}"
+
+
+class TestInt8Export:
+    def test_export_and_serve(self, tmp_path):
+        paddle.seed(1)
+        net = MLP()
+        qat = QAT()
+        qat.quantize(net)
+        net.train()
+        net(paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32)))
+        prefix = str(tmp_path / "qmodel")
+        qat.save_quantized_model(
+            net, prefix,
+            example_inputs=[np.zeros((4, 8), np.float32)])
+
+        assert os.path.exists(prefix + ".pdqparams")
+        assert os.path.exists(prefix + ".pdexport")
+        pred = load_quantized_predictor(prefix)
+        x = np.random.RandomState(1).randn(4, 8).astype(np.float32)
+        out, = pred.run([x])
+        # served output matches the QAT model's eval forward
+        net.eval()
+        expect = np.asarray(net(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)
+        # real int8 payload with sane scales
+        q = pred.quant_params
+        assert len(q) == 2
+        for v in q.values():
+            assert v["int8_weight"].dtype == np.int8
+            assert v["weight_scale"] > 0
+
+    def test_conv_qat_smoke(self, tmp_path):
+        net = ConvNet()
+        QAT().quantize(net)
+        from paddle_tpu.slim import QuantizedConv2D
+        assert isinstance(net.conv, QuantizedConv2D)
+        net.train()
+        x = paddle.to_tensor(np.random.RandomState(0)
+                             .randn(2, 1, 4, 4).astype(np.float32))
+        out = net(x)
+        loss = (out ** 2).mean()
+        loss.backward()
+        assert out.shape[0] == 2

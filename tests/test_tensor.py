@@ -141,3 +141,11 @@ def test_inplace_setitem():
     assert t.numpy()[1, 1] == 5.0
     t[0] = paddle.ones([3])
     np.testing.assert_allclose(t.numpy()[0], 1.0)
+
+
+def test_mod_dunder():
+    """Regression: _install_methods' local `mod = globals()` shadowed the
+    mod() op, so Tensor % y raised TypeError('dict' not callable)."""
+    x = paddle.to_tensor(np.array([5.0, 6.0], np.float32))
+    np.testing.assert_allclose(np.asarray((x % 2.0).numpy()), [1.0, 0.0])
+    np.testing.assert_allclose(np.asarray((7.0 % x).numpy()), [2.0, 1.0])
