@@ -409,17 +409,22 @@ def anchor_generator(input, anchor_sizes, aspect_ratios, variances,
     wh = []
     for ar in aspect_ratios:
         for s in anchor_sizes:
-            # reference: area = s^2; w = s/sqrt(ar), h = s*sqrt(ar)
-            wh.append((s / np.sqrt(ar), s * np.sqrt(ar)))
+            # reference kernel (anchor_generator_op.h:66-73): base dims are
+            # ROUNDED from the stride cell's area, then scaled by size/stride
+            base_w = np.round(np.sqrt(sw * sh / ar))
+            base_h = np.round(base_w * ar)
+            wh.append(((s / sw) * base_w, (s / sh) * base_h))
     A = len(wh)
     wh = np.asarray(wh, np.float32)
-    cx = (np.arange(fw, dtype=np.float32) + offset) * sw
-    cy = (np.arange(fh, dtype=np.float32) + offset) * sh
+    # centers use the (stride-1) pixel convention (anchor_generator_op.h:55)
+    cx = np.arange(fw, dtype=np.float32) * sw + offset * (sw - 1.0)
+    cy = np.arange(fh, dtype=np.float32) * sh + offset * (sh - 1.0)
     out = np.zeros((fh, fw, A, 4), np.float32)
-    out[..., 0] = cx[None, :, None] - wh[None, None, :, 0] / 2
-    out[..., 1] = cy[:, None, None] - wh[None, None, :, 1] / 2
-    out[..., 2] = cx[None, :, None] + wh[None, None, :, 0] / 2
-    out[..., 3] = cy[:, None, None] + wh[None, None, :, 1] / 2
+    # corners use the +/-0.5*(dim-1) convention (anchor_generator_op.h:74-81)
+    out[..., 0] = cx[None, :, None] - (wh[None, None, :, 0] - 1.0) / 2
+    out[..., 1] = cy[:, None, None] - (wh[None, None, :, 1] - 1.0) / 2
+    out[..., 2] = cx[None, :, None] + (wh[None, None, :, 0] - 1.0) / 2
+    out[..., 3] = cy[:, None, None] + (wh[None, None, :, 1] - 1.0) / 2
     var = np.broadcast_to(np.asarray(variances, np.float32),
                           out.shape).copy()
     return Tensor(out), Tensor(var)

@@ -54,10 +54,42 @@ class TestAnchors:
             variances=[0.1, 0.1, 0.2, 0.2], stride=[16.0, 16.0])
         anc = np.asarray(anc.numpy())
         assert anc.shape == (2, 3, 2, 4)
-        # first cell center at (0.5*16, 0.5*16); size-32 anchor spans ±16
-        np.testing.assert_allclose(anc[0, 0, 0], [-8, -8, 24, 24])
+        # reference pixel convention: center 0.5*(16-1)=7.5, size-32 anchor
+        # spans +/-0.5*(32-1) => [-8, 23]
+        np.testing.assert_allclose(anc[0, 0, 0], [-8, -8, 23, 23])
         np.testing.assert_allclose(np.asarray(var.numpy())[0, 0, 0],
                                    [0.1, 0.1, 0.2, 0.2])
+
+    def test_anchor_generator_matches_reference_kernel(self):
+        # direct numpy replica of anchor_generator_op.h:53-86 (round-3
+        # advisor finding: rounded base dims, (dim-1) corner convention,
+        # offset*(stride-1) centers)
+        sizes, ratios = [32.0, 64.0], [0.5, 1.0, 2.0]
+        sw, sh, offset = 16.0, 12.0, 0.5
+        fh, fw = 3, 4
+        fm = np.zeros((1, 8, fh, fw), np.float32)
+        anc, _ = V.anchor_generator(
+            fm, anchor_sizes=sizes, aspect_ratios=ratios,
+            variances=[0.1, 0.1, 0.2, 0.2], stride=[sw, sh], offset=offset)
+        anc = np.asarray(anc.numpy())
+        exp = np.zeros((fh, fw, len(ratios) * len(sizes), 4), np.float32)
+        for hi in range(fh):
+            for wi in range(fw):
+                x_ctr = wi * sw + offset * (sw - 1)
+                y_ctr = hi * sh + offset * (sh - 1)
+                idx = 0
+                for ar in ratios:
+                    for s in sizes:
+                        base_w = np.round(np.sqrt(sw * sh / ar))
+                        base_h = np.round(base_w * ar)
+                        w = (s / sw) * base_w
+                        h = (s / sh) * base_h
+                        exp[hi, wi, idx] = [x_ctr - 0.5 * (w - 1),
+                                            y_ctr - 0.5 * (h - 1),
+                                            x_ctr + 0.5 * (w - 1),
+                                            y_ctr + 0.5 * (h - 1)]
+                        idx += 1
+        np.testing.assert_allclose(anc, exp, rtol=1e-6)
 
     def test_density_prior_box_counts(self):
         fm = np.zeros((1, 8, 4, 4), np.float32)
