@@ -23,6 +23,7 @@ class _GeneratorState(threading.local):
     def __init__(self):
         self._key = None
         self.seed_value = 0
+        self.counter = 0  # eager draw counter (python int: trace-safe)
         # stack of explicitly-provided keys for traced code
         self.guard_stack: list = []
 
@@ -46,6 +47,7 @@ def seed(s: int):
     # normal reproducibility pattern)
     _state.seed_value = int(s)
     _state._key = None
+    _state.counter = 0
     return _state
 
 
@@ -55,14 +57,23 @@ def get_seed() -> int:
 
 def split_key(n: int = 1):
     """Draw fresh subkey(s). Inside an rng_guard, split the guarded key
-    (pure w.r.t. the trace); otherwise advance the global eager chain."""
+    (pure w.r.t. the trace); otherwise derive from the global chain via
+    fold_in(base, counter).  The global state holds only the CONCRETE base
+    key plus a python-int counter — under omnistaging every primitive
+    inside a jit trace yields a tracer, so a split-and-store chain would
+    leak a tracer into module state and poison the next trace (seen via
+    save_inference_model → next to_static call)."""
     if _state.guard_stack:
         key = _state.guard_stack[-1]
         keys = jax.random.split(key, n + 1)
         _state.guard_stack[-1] = keys[0]
         return keys[1] if n == 1 else keys[1:]
-    _state.key, *sub = jax.random.split(_state.key, n + 1)
-    return sub[0] if n == 1 else sub
+    base = _state.key
+    c = _state.counter
+    _state.counter = c + n
+    if n == 1:
+        return jax.random.fold_in(base, c)
+    return [jax.random.fold_in(base, c + i) for i in range(n)]
 
 
 @contextlib.contextmanager

@@ -113,7 +113,17 @@ def _maybe_convert(method):
 
     try:
         return dy2static.convert_function(method)
-    except dy2static.ConversionError:
+    except dy2static.BenignNoConversion:
+        return method  # nothing to convert: plain tracing is not a hazard
+    except dy2static.ConversionError as e:
+        import warnings
+
+        warnings.warn(
+            f"to_static: AST conversion of "
+            f"{getattr(method, '__qualname__', method)} failed ({e}); "
+            "falling back to plain tracing — any tensor-dependent python "
+            "`if`/`while` in it will be baked to the traced branch",
+            stacklevel=3)
         return method
 
 

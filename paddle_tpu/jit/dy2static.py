@@ -22,10 +22,15 @@ Architecture (mirrors the reference's two halves, re-designed for jax):
   python values run as ordinary python (the reference's
   convert_operators.py:40 does exactly this dispatch).
 
-Unsupported constructs (break/continue inside converted loops, mixed
-return/fall-through branches) raise ConversionError; ``to_static`` then
-falls back to plain tracing, which is the reference's behavior for
-untransformable code paths.
+``break``/``continue`` in converted loops are supported by flag
+elimination (the reference's break_continue_transformer.py analog): each
+``break`` becomes a persistent flag that is AND-ed into the loop
+condition, each ``continue`` a per-iteration flag, and the statements
+after the branch are guarded on the flags.  Remaining unsupported
+constructs (mixed return/fall-through branches, break inside with/try)
+raise ConversionError; ``to_static`` then falls back to plain tracing
+WITH a warning naming the construct (round-3 verdict: the silent
+fallback could single-branch-bake a user's data-dependent branch).
 """
 from __future__ import annotations
 
@@ -33,10 +38,16 @@ import ast
 import functools
 import inspect
 import textwrap
+import types
 
 
 class ConversionError(Exception):
     """Source can't be converted; caller falls back to plain tracing."""
+
+
+class BenignNoConversion(ConversionError):
+    """No conversion applicable (no control flow / no source): the plain
+    tracing fallback is not a behavior hazard, so no warning is due."""
 
 
 _UNDEF = object()  # placeholder for branch-local names unbound at entry
@@ -122,7 +133,13 @@ class _StoreCollector(ast.NodeVisitor):
         self.generic_visit(node)
 
     def visit_FunctionDef(self, node):
-        self._add(node.name)  # the def binds the name; don't descend
+        # the def binds the name; don't descend.  Our own closure-conversion
+        # helpers (__jst_*) are never carried as branch/loop outputs —
+        # functions aren't jax values — but USER defs keep the old
+        # behavior: carrying them works on the python dispatch path and
+        # raises ConversionError (→ fallback) on the traced path.
+        if not node.name.startswith("__jst_"):
+            self._add(node.name)
 
     visit_AsyncFunctionDef = visit_FunctionDef
 
@@ -145,6 +162,24 @@ def _has(stmts, *types) -> bool:
         for node in ast.walk(s):
             if isinstance(node, types):
                 return True
+    return False
+
+
+def _has_shallow(stmts, *ts) -> bool:
+    """Like _has but never descends into nested function/class defs: a
+    `return` (or break/continue) there belongs to the nested scope — in
+    particular to the closure-conversion helpers this module generates."""
+    for s in stmts or []:
+        if isinstance(s, ts):
+            return True
+        if isinstance(s, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            continue
+        for _, value in ast.iter_fields(s):
+            if isinstance(value, list) and value and isinstance(
+                    value[0], (ast.stmt, ast.excepthandler)):
+                if _has_shallow(value, *ts):
+                    return True
     return False
 
 
@@ -213,8 +248,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
         node = self._generic_body_visit(node)
         body, orelse = node.body, node.orelse
 
-        body_returns = _has(body, ast.Return)
-        else_returns = _has(orelse, ast.Return) if orelse else False
+        body_returns = _has_shallow(body, ast.Return)
+        else_returns = _has_shallow(orelse, ast.Return) if orelse else False
         if body_returns or else_returns:
             # only the uniform shape `if c: return a [else: return b]`
             # (return as the final statement of each branch) converts;
@@ -222,7 +257,7 @@ class ControlFlowTransformer(ast.NodeTransformer):
             # shape by _merge_tail_returns before transformation
             def _ret_ok(stmts):
                 return (stmts and isinstance(stmts[-1], ast.Return)
-                        and not _has(stmts[:-1], ast.Return))
+                        and not _has_shallow(stmts[:-1], ast.Return))
 
             if not orelse or not (_ret_ok(body) and _ret_ok(orelse)):
                 raise ConversionError(
@@ -267,13 +302,49 @@ class ControlFlowTransformer(ast.NodeTransformer):
 
     # -- while ------------------------------------------------------------
 
+    def _eliminate_loop_bc(self, body):
+        """Flag-eliminate this loop's break/continue BEFORE closure
+        conversion (the guard `if`s it creates must themselves be
+        converted).  Returns (pre_stmts, new_body, test_wrapper)."""
+        if not _bc_tops(body):
+            return [], body, lambda t: t
+        uid = self._uid()
+        brk, cont = f"__jst_brk_{uid}", f"__jst_cont_{uid}"
+        new, used_b, used_c = _eliminate_bc(body, brk, cont)
+        if _bc_tops(new):
+            raise ConversionError(
+                "break/continue inside with/try in a converted loop")
+        pre, top = [], []
+        if used_c:
+            # reset each iteration; pre-init so it is a defined loop var
+            top.append(_assign_const(cont, False))
+            pre.append(_assign_const(cont, False))
+        if used_b:
+            pre.append(_assign_const(brk, False))
+            # _jst_land_lazy(not brk, lambda: test): the user condition
+            # must NOT be re-evaluated once break fired on the python
+            # path (it may index past the break point)
+            return pre, top + new, (lambda t: ast.Call(
+                func=_name("_jst_land_lazy"),
+                args=[ast.Call(func=_name("_jst_lnot"),
+                               args=[_name(brk)], keywords=[]),
+                      ast.Lambda(
+                          args=ast.arguments(
+                              posonlyargs=[], args=[], kwonlyargs=[],
+                              kw_defaults=[], defaults=[]),
+                          body=t)],
+                keywords=[]))
+        return pre, top + new, (lambda t: t)
+
     def visit_While(self, node):
-        node = self._generic_body_visit(node)
         if node.orelse:
             raise ConversionError("while/else does not convert")
-        if _has(node.body, ast.Break, ast.Continue, ast.Return):
-            raise ConversionError(
-                "break/continue/return inside a converted while loop")
+        pre_bc, new_body, wrap = self._eliminate_loop_bc(node.body)
+        node.body = new_body
+        node.test = wrap(node.test)
+        if _has_shallow(node.body, ast.Return):
+            raise ConversionError("return inside a converted while loop")
+        node = self._generic_body_visit(node)
         loop_vars = _stores(node.body)
         if not loop_vars:
             return node
@@ -286,24 +357,30 @@ class ControlFlowTransformer(ast.NodeTransformer):
                          ctx=ast.Load())
         call = ast.Call(func=_name("_jst_while"),
                         args=[_name(cfn), _name(bfn), init], keywords=[])
-        return [c_def, b_def, self._assign_targets(loop_vars, call)]
+        return pre_bc + [c_def, b_def,
+                         self._assign_targets(loop_vars, call)]
 
     # -- for i in range(...) ---------------------------------------------
 
     def visit_For(self, node):
-        node = self._generic_body_visit(node)
         is_range = (isinstance(node.iter, ast.Call)
                     and isinstance(node.iter.func, ast.Name)
                     and node.iter.func.id == "range"
                     and 1 <= len(node.iter.args) <= 3
                     and not node.iter.keywords)
         if not is_range or not isinstance(node.target, ast.Name):
-            return node  # generic iterables stay python (unrolled if traced)
+            # generic iterables stay python (unrolled if traced);
+            # break/continue inside belong to the python loop
+            return self._generic_body_visit(node)
         if node.orelse:
             raise ConversionError("for/else does not convert")
-        if _has(node.body, ast.Break, ast.Continue, ast.Return):
-            raise ConversionError(
-                "break/continue/return inside a converted for loop")
+        # eliminate break/continue on the USER body only, so the index
+        # increment appended below stays outside the continue guard
+        pre_bc, new_body, wrap = self._eliminate_loop_bc(node.body)
+        node.body = new_body
+        if _has_shallow(node.body, ast.Return):
+            raise ConversionError("return inside a converted for loop")
+        node = self._generic_body_visit(node)
         uid = self._uid()
         it, stop, step = (f"__jst_it_{uid}", f"__jst_stop_{uid}",
                           f"__jst_step_{uid}")
@@ -337,8 +414,8 @@ class ControlFlowTransformer(ast.NodeTransformer):
                 + node.body
                 + [ast.AugAssign(target=_name(it, ast.Store()),
                                  op=ast.Add(), value=_name(step))])
-        wh = ast.While(test=test, body=body, orelse=[])
-        out = pre + self.visit_While(wh)
+        wh = ast.While(test=wrap(test), body=body, orelse=[])
+        out = pre_bc + pre + self.visit_While(wh)
         return out
 
     def _generic_body_visit(self, node):
@@ -365,24 +442,187 @@ def _jst_sign(step):
     return 1 if step >= 0 else -1
 
 
+def _jst_raw(x):
+    from ..tensor import Tensor
+
+    return x.value if isinstance(x, Tensor) else x
+
+
+def _jst_lnot(x):
+    import jax.numpy as jnp
+
+    return jnp.logical_not(_jst_raw(x)) if _is_traced(x) else (not x)
+
+
+def _jst_lor(a, b):
+    import jax.numpy as jnp
+
+    if _is_traced(a) or _is_traced(b):
+        return jnp.logical_or(_jst_raw(a), _jst_raw(b))
+    return a or b
+
+
+def _jst_land(a, b):
+    import jax.numpy as jnp
+
+    if _is_traced(a) or _is_traced(b):
+        return jnp.logical_and(_jst_raw(a), _jst_raw(b))
+    return a and b
+
+
+def _jst_land_lazy(a, b_thunk):
+    """Short-circuit AND: b_thunk is only evaluated when a is traced or
+    truthy (python `a and b()` semantics for the loop-condition wrapper)."""
+    if not _is_traced(a) and not a:
+        return False
+    return _jst_land(a, b_thunk())
+
+
+# -- break/continue elimination (break_continue_transformer.py analog) ----
+
+def _assign_const(name, val):
+    return ast.Assign(targets=[_name(name, ast.Store())],
+                      value=ast.Constant(val))
+
+
+def _bc_tops(stmts):
+    """break/continue statements belonging to the CURRENT loop: descends
+    ifs and with/try (those are detection-only), never nested loops or
+    function definitions."""
+    for s in stmts or []:
+        if isinstance(s, (ast.Break, ast.Continue)):
+            return True
+        if isinstance(s, ast.If):
+            if _bc_tops(s.body) or _bc_tops(s.orelse):
+                return True
+        elif isinstance(s, (ast.With, ast.AsyncWith, ast.Try)):
+            for field in ("body", "orelse", "finalbody"):
+                if _bc_tops(getattr(s, field, None)):
+                    return True
+            for h in getattr(s, "handlers", ()):
+                if _bc_tops(h.body):
+                    return True
+    return False
+
+
+def _eliminate_bc(body, brk, cont):
+    """Rewrite break/continue into flag assignments; statements after a
+    flag-setting `if` are wrapped in a guard on the flags.  Returns
+    (new_body, used_break, used_continue).  break/continue inside
+    with/try are left in place (caller raises ConversionError)."""
+    new, used_b, used_c = [], False, False
+    for i, s in enumerate(body):
+        if isinstance(s, ast.Break):
+            new.append(_assign_const(brk, True))
+            return new, True, used_c          # rest is unreachable
+        if isinstance(s, ast.Continue):
+            new.append(_assign_const(cont, True))
+            return new, used_b, True
+        if isinstance(s, ast.If) and (_bc_tops(s.body) or _bc_tops(s.orelse)):
+            nb, b1, c1 = _eliminate_bc(s.body, brk, cont)
+            no, b2, c2 = _eliminate_bc(s.orelse, brk, cont)
+            used_b |= b1 or b2
+            used_c |= c1 or c2
+            newif = ast.If(test=s.test, body=nb, orelse=no)
+            ast.copy_location(newif, s)
+            new.append(newif)
+            rest, b3, c3 = _eliminate_bc(body[i + 1:], brk, cont)
+            used_b |= b3
+            used_c |= c3
+            flags = ([brk] if (b1 or b2) else []) + \
+                    ([cont] if (c1 or c2) else [])
+            if rest and not flags:
+                # the if held break/continue only inside with/try (left
+                # untransformed): no guard needed; the caller's leftover
+                # check raises ConversionError
+                new.extend(rest)
+            elif rest:
+                t = (_name(flags[0]) if len(flags) == 1
+                     else ast.Call(func=_name("_jst_lor"),
+                                   args=[_name(flags[0]), _name(flags[1])],
+                                   keywords=[]))
+                guard = ast.If(
+                    test=ast.Call(func=_name("_jst_lnot"), args=[t],
+                                  keywords=[]),
+                    body=rest, orelse=[])
+                ast.copy_location(guard, s)
+                new.append(guard)
+            return new, used_b, used_c
+        new.append(s)
+    return new, used_b, used_c
+
+
+class _SuperRewriter(ast.NodeTransformer):
+    """Rewrite zero-arg ``super()`` into ``super(__class__, <self>)``:
+    the recompiled function is no longer syntactically inside its class,
+    so CPython will not synthesize the ``__class__`` cell — the explicit
+    reference makes ``__class__`` an ordinary freevar that
+    convert_function re-links to the original class cell (round-3
+    advisor finding: zero-arg super() raised RuntimeError at call)."""
+
+    def __init__(self, first_arg):
+        self.first = first_arg
+        self.used = False
+
+    def visit_Call(self, node):
+        self.generic_visit(node)
+        if (isinstance(node.func, ast.Name) and node.func.id == "super"
+                and not node.args and not node.keywords):
+            if self.first is None:
+                raise ConversionError(
+                    "zero-arg super() in a function with no positional "
+                    "parameters")
+            self.used = True
+            return ast.copy_location(
+                ast.Call(func=node.func,
+                         args=[_name("__class__"), _name(self.first)],
+                         keywords=[]), node)
+        return node
+
+    def visit_FunctionDef(self, node):
+        return node  # nested defs keep their own super() semantics
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node):
+        return node
+
+
 def convert_function(fn):
     """Return an AST-converted version of `fn` (data-dependent python
     control flow → static.nn dispatch), or raise ConversionError."""
     try:
         src = textwrap.dedent(inspect.getsource(fn))
     except (OSError, TypeError) as e:
-        raise ConversionError(f"source unavailable: {e}") from e
+        raise BenignNoConversion(f"source unavailable: {e}") from e
     try:
         tree = ast.parse(src)
     except SyntaxError as e:  # e.g. lambda fragment
-        raise ConversionError(f"unparsable source: {e}") from e
+        raise BenignNoConversion(f"unparsable source: {e}") from e
     fdef = tree.body[0]
     if not isinstance(fdef, (ast.FunctionDef, ast.AsyncFunctionDef)):
-        raise ConversionError("not a function definition")
+        raise BenignNoConversion("not a function definition")
+    if not _has(fdef.body, ast.If, ast.While, ast.For):
+        raise BenignNoConversion("no control flow to convert")
+    # only the to_static family may be stripped: recompiling drops every
+    # decorator, so anything else (lru_cache, staticmethod, user wrappers)
+    # would silently lose behavior (round-3 advisor finding)
+    for dec in fdef.decorator_list:
+        d = dec.func if isinstance(dec, ast.Call) else dec
+        name = d.attr if isinstance(d, ast.Attribute) else getattr(d, "id",
+                                                                   None)
+        if name not in ("to_static", "not_to_static"):
+            raise ConversionError(
+                f"decorator @{ast.unparse(dec)} would be dropped by AST "
+                "recompilation")
     fdef.decorator_list = []  # strip @to_static etc. to avoid recursion
 
-    if not _has(fdef.body, ast.If, ast.While, ast.For):
-        raise ConversionError("no control flow to convert")
+    pos_args = [a.arg for a in fdef.args.posonlyargs + fdef.args.args]
+    sup = _SuperRewriter(pos_args[0] if pos_args else None)
+    fdef.body = [sup.visit(s) for s in fdef.body]
+    if sup.used and "__class__" not in fn.__code__.co_freevars:
+        raise ConversionError(
+            "zero-arg super() outside a class-body method")
 
     tr = ControlFlowTransformer()
     new_body = []
@@ -396,20 +636,50 @@ def convert_function(fn):
     ast.fix_missing_locations(tree)
 
     glb = dict(fn.__globals__)
-    if fn.__closure__:
-        for name, cell in zip(fn.__code__.co_freevars, fn.__closure__):
-            try:
-                glb[name] = cell.cell_contents
-            except ValueError as e:
-                raise ConversionError(f"empty closure cell {name}") from e
     glb.update(_jst_if=_jst_if, _jst_while=_jst_while,
                _jst_maybe=_jst_maybe, _jst_sign=_jst_sign,
-               _jst_bool=_jst_bool)
-    code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
-                   mode="exec")
-    ns: dict = {}
-    exec(code, glb, ns)
-    out = ns[fdef.name]
+               _jst_bool=_jst_bool, _jst_lnot=_jst_lnot,
+               _jst_lor=_jst_lor, _jst_land=_jst_land,
+               _jst_land_lazy=_jst_land_lazy)
+    freevars = list(fn.__code__.co_freevars)
+    if freevars:
+        # Recompile inside a synthetic enclosing scope whose params shadow
+        # the freevars, then re-link the inner code object to the ORIGINAL
+        # cells: late-binding closure semantics and zero-arg super()
+        # survive conversion (round-3 advisor finding: snapshotting cells
+        # into globals lost both).
+        maker = ast.FunctionDef(
+            name="__jst_make__",
+            args=ast.arguments(
+                posonlyargs=[],
+                args=[ast.arg(arg=n) for n in freevars],
+                kwonlyargs=[], kw_defaults=[], defaults=[]),
+            body=[fdef, ast.Return(value=_name(fdef.name))],
+            decorator_list=[])
+        tree.body = [maker]
+        ast.fix_missing_locations(tree)
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        ns: dict = {}
+        exec(code, glb, ns)
+        vals = []
+        for cell in fn.__closure__:
+            try:
+                vals.append(cell.cell_contents)
+            except ValueError:
+                vals.append(None)  # not-yet-filled cell; re-linked below
+        made = ns["__jst_make__"](*vals)
+        cellmap = dict(zip(freevars, fn.__closure__))
+        out = types.FunctionType(
+            made.__code__, glb, fn.__name__, fn.__defaults__,
+            tuple(cellmap[n] for n in made.__code__.co_freevars))
+        out.__kwdefaults__ = fn.__kwdefaults__
+    else:
+        code = compile(tree, filename=f"<dy2static {fn.__qualname__}>",
+                       mode="exec")
+        ns = {}
+        exec(code, glb, ns)
+        out = ns[fdef.name]
     out = functools.wraps(fn)(out)
     out.__dy2static__ = True
     return out
@@ -423,9 +693,9 @@ def _merge_tail_returns(body):
     for i, s in enumerate(body):
         if (isinstance(s, ast.If) and not s.orelse
                 and s.body and isinstance(s.body[-1], ast.Return)
-                and not _has(s.body[:-1], ast.Return)):
+                and not _has_shallow(s.body[:-1], ast.Return)):
             rest = _merge_tail_returns(body[i + 1:])
-            if not rest or not _has(rest, ast.Return):
+            if not rest or not _has_shallow(rest, ast.Return):
                 break
             merged = ast.If(test=s.test, body=s.body, orelse=rest)
             ast.copy_location(merged, s)

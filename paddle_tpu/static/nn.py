@@ -43,6 +43,50 @@ def _scalar_bool(x):
     return v.reshape(()).astype(jnp.bool_)
 
 
+def _strip_tensors(tree):
+    """Replace Tensor leaves with their raw arrays, recording (stop_gradient,
+    name) metadata in flatten order.  Tensor carries aux data in its pytree
+    treedef, so two branches (or a loop's init vs body output) that differ
+    only in stop_gradient would otherwise be a structure mismatch inside
+    lax.cond / lax.while_loop."""
+    metas = []
+
+    def f(x):
+        if isinstance(x, Tensor):
+            metas.append((x.stop_gradient, x.name))
+            return x.value
+        metas.append(None)
+        return x
+
+    stripped = jax.tree_util.tree_map(
+        f, tree, is_leaf=lambda x: isinstance(x, Tensor))
+    return stripped, metas
+
+
+def _rewrap_tensors(tree, metas):
+    """Inverse of _strip_tensors (same flatten order)."""
+    it = iter(metas)
+
+    def f(x):
+        m = next(it)
+        return Tensor(x, stop_gradient=m[0], name=m[1]) if m else x
+
+    return jax.tree_util.tree_map(f, tree)
+
+
+def _merge_metas(a, b):
+    """Join branch metadata: a leaf is a Tensor if either branch made it
+    one; gradient flows (stop_gradient False) if either branch tracked."""
+    out = []
+    for ma, mb in zip(a, b):
+        if ma is None and mb is None:
+            out.append(None)
+        else:
+            sg = ((ma[0] if ma else True) and (mb[0] if mb else True))
+            out.append((sg, (ma or mb)[1]))
+    return out
+
+
 def while_loop(cond, body, loop_vars, is_test=False, name=None,
                max_iters=None):
     """Repeat `body` until `cond` is False (control_flow.py:1111).
@@ -64,19 +108,23 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
     if not loop_vars:
         raise ValueError("loop_vars is empty")
     vars_t = tuple(loop_vars)
+    vars_s, metas = _strip_tensors(vars_t)
+    body_metas = {}
 
     def cond_fn(vs):
-        return _scalar_bool(cond(*vs))
+        return _scalar_bool(cond(*_rewrap_tensors(vs, metas)))
 
     def body_fn(vs):
-        out = body(*vs)
+        out = body(*_rewrap_tensors(vs, metas))
         if not isinstance(out, (list, tuple)):
             out = (out,)
         if len(out) != len(vars_t):
             raise ValueError(
                 f"body must return {len(vars_t)} values like loop_vars, "
                 f"got {len(out)}")
-        return tuple(out)
+        stripped, m = _strip_tensors(tuple(out))
+        body_metas["m"] = m
+        return stripped
 
     if max_iters is not None:
         def scan_body(vs, _):
@@ -86,10 +134,16 @@ def while_loop(cond, body, loop_vars, is_test=False, name=None,
                 lambda a, b: jnp.where(live, b, a), vs, new)
             return vs, None
 
-        out, _ = jax.lax.scan(scan_body, vars_t, None,
+        out, _ = jax.lax.scan(scan_body, vars_s, None,
                               length=int(max_iters))
     else:
-        out = jax.lax.while_loop(cond_fn, body_fn, vars_t)
+        out = jax.lax.while_loop(cond_fn, body_fn, vars_s)
+    # a leaf tracks gradients (stop_gradient False) if EITHER the init or
+    # the body output tracked it — rewrapping with init metas alone would
+    # silently mark grad-carrying outputs stop_gradient=True
+    out_metas = (_merge_metas(metas, body_metas["m"])
+                 if "m" in body_metas else metas)
+    out = _rewrap_tensors(out, out_metas)
     return list(out) if isinstance(loop_vars, list) else out
 
 
@@ -109,8 +163,19 @@ def cond(pred, true_fn=None, false_fn=None, name=None):
     f_fn = false_fn or (lambda: None)
     if isinstance(pred, bool):  # python-static predicate: pick eagerly
         return t_fn() if pred else f_fn()
-    return jax.lax.cond(_scalar_bool(pred),
-                        lambda _: t_fn(), lambda _: f_fn(), 0)
+
+    info = {}
+
+    def branch(fn, key):
+        def g(_):
+            stripped, metas = _strip_tensors(fn())
+            info[key] = metas
+            return stripped
+        return g
+
+    out = jax.lax.cond(_scalar_bool(pred), branch(t_fn, "t"),
+                       branch(f_fn, "f"), 0)
+    return _rewrap_tensors(out, _merge_metas(info["t"], info["f"]))
 
 
 def case(pred_fn_pairs, default=None, name=None):
@@ -135,9 +200,7 @@ def case(pred_fn_pairs, default=None, name=None):
         if isinstance(pred, bool):
             out = fn() if pred else out
             continue
-        out = jax.lax.cond(_scalar_bool(pred),
-                           lambda _, fn=fn: fn(),
-                           lambda _, o=out: o, 0)
+        out = cond(pred, fn, lambda o=out: o)
     return out
 
 
@@ -176,9 +239,22 @@ def switch_case(branch_index, branch_fns, default=None, name=None):
     keys_arr = jnp.asarray(keys, jnp.int32)
     matched = (keys_arr == bi)
     pos = jnp.where(matched.any(), jnp.argmax(matched), len(keys))
-    fns = [lambda _, fn=fn: fn() for _, fn in pairs]
-    fns.append(lambda _: default())
-    return jax.lax.switch(pos, fns, 0)
+    metas_by_slot = {}
+
+    def wrap(fn, slot):
+        def g(_):
+            stripped, metas = _strip_tensors(fn())
+            metas_by_slot[slot] = metas
+            return stripped
+        return g
+
+    fns = [wrap(fn, i) for i, (_, fn) in enumerate(pairs)]
+    fns.append(wrap(default, len(pairs)))
+    out = jax.lax.switch(pos, fns, 0)
+    merged = metas_by_slot[0]
+    for i in range(1, len(fns)):
+        merged = _merge_metas(merged, metas_by_slot[i])
+    return _rewrap_tensors(out, merged)
 
 
 def increment(x, value=1.0, in_place=True):

@@ -56,6 +56,29 @@ class TestWhileLoop:
             snn.while_loop(lambda x: x, lambda x: (x,),
                            [jnp.zeros((2,), jnp.bool_)])
 
+    def test_mixed_stop_gradient_branchs_and_carry(self):
+        # Tensor carries stop_gradient in its pytree aux: a loop whose
+        # body flips it (zeros init + param-derived update) must not be a
+        # lax structure mismatch, and the output must keep tracking
+        w = paddle.to_tensor(np.float32(2.0))
+        w.stop_gradient = False
+        acc0 = paddle.zeros([])          # stop_gradient True
+
+        def cond(i, a):
+            return i < 3
+
+        def body(i, a):
+            return (i + 1, a + w)
+
+        _, out = snn.while_loop(cond, body, (paddle.zeros([], "int32"),
+                                             acc0))
+        assert out.stop_gradient is False  # grad flows if body tracked
+        # cond with branch-dependent stop_gradient must unify too
+        r = snn.cond(paddle.to_tensor(np.bool_(True)),
+                     lambda: acc0 + w, lambda: acc0)
+        assert float(np.asarray(r.numpy())) == 2.0
+        assert r.stop_gradient is False
+
 
 class TestCond:
     def test_scalar_pred_branches(self):

@@ -137,10 +137,11 @@ class TestSaveLoadRoundtrip:
             atol=1e-5)
 
 
-class TestConversionFallbacks:
-    def test_unsupported_constructs_fall_back(self):
-        # break inside a loop: conversion declines, plain tracing still
-        # works because the loop is over a python range
+class TestBreakContinue:
+    """break/continue flag-elimination (reference
+    break_continue_transformer.py analog)."""
+
+    def test_break_python_range(self):
         @paddle.jit.to_static
         def f(x):
             acc = x
@@ -152,6 +153,102 @@ class TestConversionFallbacks:
 
         out = f(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 4.0])
+
+    def test_break_tensor_condition_compiles_both_ways(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            acc = paddle.zeros([2])
+            for i in range(n):          # traced trip count
+                if (acc.sum() > 5.0):   # tensor break condition
+                    break
+                acc = acc + x
+            return acc
+
+        # n traced: 2 ones per step; after 3 steps sum=6>5 -> stops at 3
+        out = f(paddle.to_tensor(np.ones(2, np.float32)), 10)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, 3.0])
+
+    def test_continue_tensor_condition(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            acc = paddle.zeros([])
+            for i in range(n):
+                if (i % 2) == 1:   # traced parity -> tensor condition
+                    continue
+                acc = acc + 1.0
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)), 6)
+        assert float(np.asarray(out.numpy())) == 3.0
+
+    def test_break_in_while_tensor(self):
+        @paddle.jit.to_static
+        def f(x):
+            i = paddle.zeros([])
+            while i < 100.0:
+                if x.sum() * i > 4.0:
+                    break
+                i = i + 1.0
+            return i
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32) * 0.5))
+        # x.sum()=1.0; break when i>4 -> loop leaves i==5
+        assert float(np.asarray(out.numpy())) == 5.0
+
+    def test_python_break_condition_not_reevaluated(self):
+        # the loop condition must not re-run after break fires on the
+        # python path (it may index past the break point)
+        q = [1.0, 2.0, 3.0]
+
+        @paddle.jit.to_static
+        def f(x):
+            i = 0
+            while q[i] > 0:
+                i = i + 1
+                if i == len(q):
+                    break
+            return x * float(i)
+
+        out = f(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, 3.0])
+
+    def test_break_only_inside_try_falls_back_cleanly(self):
+        import warnings
+
+        def f(x):
+            acc = x
+            for i in range(4):
+                if i >= 1:
+                    try:
+                        break
+                    finally:
+                        pass
+                acc = acc * 2.0
+            return acc
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            g = paddle.jit.to_static(f)
+            out = g(paddle.to_tensor(np.ones(2, np.float32)))
+        assert any("falling back" in str(x.message) for x in w)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])
+
+    def test_statements_after_breaking_if_are_guarded(self):
+        @paddle.jit.to_static
+        def f(x, n):
+            acc = paddle.zeros([])
+            for i in range(n):
+                if acc > 2.5:
+                    break
+                acc = acc + x.sum()
+                acc = acc + 0.0
+            return acc
+
+        out = f(paddle.to_tensor(np.ones(1, np.float32)), 10)
+        assert float(np.asarray(out.numpy())) == 3.0
+
+
+class TestConversionFallbacks:
 
     def test_no_control_flow_is_not_converted(self):
         def f(x):
@@ -170,3 +267,97 @@ class TestConversionFallbacks:
         g = paddle.jit.to_static(f)
         out = g(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])
+
+    def test_fallback_warns_loudly(self):
+        import warnings
+
+        # with/try around break does not convert -> ConversionError -> the
+        # fallback must WARN (round-3 verdict: silent fallback could bake
+        # a data-dependent branch with no signal)
+        def f(x):
+            acc = x
+            for i in range(3):
+                try:
+                    if i >= 1:
+                        break
+                finally:
+                    pass
+                acc = acc * 2.0
+            return acc
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            g = paddle.jit.to_static(f)
+            out = g(paddle.to_tensor(np.ones(2, np.float32)))
+        assert any("falling back to plain tracing" in str(x.message)
+                   for x in w)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [2.0, 2.0])
+
+    def test_foreign_decorator_refused_with_warning(self):
+        import functools
+        import warnings
+
+        def deco(fn):
+            @functools.wraps(fn)
+            def inner(*a, **k):
+                return fn(*a, **k) + 1.0
+            return inner
+
+        @deco
+        def f(x):
+            if x.shape[0] > 0:  # static-shape branch: plain trace works
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            g = paddle.jit.to_static(f)
+            out = g(paddle.to_tensor(np.ones(2, np.float32)))
+        assert any("decorator" in str(x.message) for x in w)
+        # fallback keeps the decorator's behavior (2*x + 1)
+        np.testing.assert_allclose(np.asarray(out.numpy()), [3.0, 3.0])
+
+
+class TestClosureSemantics:
+    def test_late_binding_closure_preserved(self):
+        scale = [2.0]
+
+        def f(x):
+            if x.sum() > 0:
+                y = x * scale[0]
+            else:
+                y = x * 0.0
+            return y
+
+        g = dy2static.convert_function(f)
+        x = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(g(x).numpy()), [2.0, 2.0])
+        scale[0] = 5.0  # late rebinding must be visible post-conversion
+        np.testing.assert_allclose(np.asarray(g(x).numpy()), [5.0, 5.0])
+
+    def test_zero_arg_super_survives_conversion(self):
+        class Base(nn.Layer):
+            def forward(self, x):
+                return x + 1.0
+
+        class Child(Base):
+            def forward(self, x):
+                if x.sum() > 0:
+                    y = super().forward(x) * 2.0
+                else:
+                    y = x * 0.0
+                return y
+
+        net = Child()
+        # conversion itself must succeed (no ConversionError fallback) and
+        # the converted function must run zero-arg super() correctly
+        conv = dy2static.convert_function(Child.forward)
+        assert getattr(conv, "__dy2static__", False)
+        out = conv(net, paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 4.0])
+        # and end-to-end through to_static
+        paddle.jit.to_static(net)
+        out = net(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 4.0])

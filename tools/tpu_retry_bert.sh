@@ -1,0 +1,19 @@
+#!/bin/bash
+# Retry loop: capture the BERT headline on TPU when the tunnel recovers.
+# (round-3 verdict #1: record TPU evidence whenever the chip is reachable)
+cd /root/repo
+for i in $(seq 1 60); do
+  probe=$(timeout 150 python bench.py --probe 2>/dev/null | tail -1)
+  if echo "$probe" | grep -q '"ok": true' && ! echo "$probe" | grep -q '"platform": "cpu"'; then
+    echo "$(date -u +%FT%TZ) TPU up, running bert" >> /tmp/bert_tpu_retry.log
+    timeout 1800 python bench.py --config bert > /tmp/bert_try.json 2>>/tmp/bert_tpu_retry.log
+    if grep -q 'samples_per_sec_per_chip' /tmp/bert_try.json; then
+      cp /tmp/bert_try.json /tmp/bert_tpu_line.json
+      echo "$(date -u +%FT%TZ) SUCCESS" >> /tmp/bert_tpu_retry.log
+      exit 0
+    fi
+  else
+    echo "$(date -u +%FT%TZ) probe down" >> /tmp/bert_tpu_retry.log
+  fi
+  sleep 420
+done
