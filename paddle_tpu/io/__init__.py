@@ -382,9 +382,36 @@ class DataLoader:
         env = os.environ.get("PADDLE_TPU_MP_START", "").strip().lower()
         if env:
             return env
+        cached = getattr(self, "_mp_start_cache", None)
+        if cached is not None:
+            return cached
+
+        class _CapHit(Exception):
+            pass
+
+        class _NullSink:
+            # stream to nowhere with a byte cap: the preflight only needs
+            # to know whether pickling FAILS (lambdas, locks — which fail
+            # early), not the bytes.  pickle.dumps of a large in-memory
+            # dataset would burn CPU and transiently hold the whole
+            # serialization (round-3 advisor finding).
+            def __init__(self, cap=64 << 20):
+                self.n, self.cap = 0, cap
+
+            def write(self, b):
+                self.n += len(b)
+                if self.n > self.cap:
+                    raise _CapHit
+
         try:
-            pickle.dumps((self.dataset, self.collate_fn,
-                          self.worker_init_fn))
+            # fns first and UNCAPPED: they are tiny, and the usual
+            # unpicklables (lambdas, bound methods) live here — a huge
+            # dataset must not cap the probe before they are reached
+            pickle.Pickler(_NullSink(cap=1 << 62)).dump(
+                (self.collate_fn, self.worker_init_fn))
+            pickle.Pickler(_NullSink()).dump(self.dataset)
+        except _CapHit:
+            pass  # huge but structurally picklable: forkserver is fine
         except Exception:
             import warnings
             warnings.warn(
@@ -392,9 +419,12 @@ class DataLoader:
                 "picklable; falling back to fork-based workers (deadlock "
                 "risk in multithreaded processes). Define them at module "
                 "scope to enable forkserver workers.", RuntimeWarning)
+            self._mp_start_cache = "fork"
             return "fork"
-        return ("forkserver" if "forkserver" in mp.get_all_start_methods()
-                else "spawn")
+        method = ("forkserver"
+                  if "forkserver" in mp.get_all_start_methods() else "spawn")
+        self._mp_start_cache = method
+        return method
 
     def _produce_multiprocess(self):
         """Multi-process map-style loading (reference:

@@ -156,3 +156,248 @@ def accuracy(input, label, k=1, correct=None, total=None, name=None):
         return jnp.mean(c.astype(jnp.float32))
 
     return apply(f, input, label)
+
+
+class PrecisionRecall(Metric):
+    """Streaming multi-class precision/recall/F1
+    (operators/metrics/precision_recall_op.cc): per-class TP/FP/FN from
+    argmax predictions, macro + micro averages."""
+
+    def __init__(self, num_classes, name="precision_recall"):
+        self._name = name
+        self.num_classes = num_classes
+        self.reset()
+
+    def reset(self):
+        self._tp = np.zeros(self.num_classes, np.int64)
+        self._fp = np.zeros(self.num_classes, np.int64)
+        self._fn = np.zeros(self.num_classes, np.int64)
+
+    def update(self, preds, labels):
+        p = np.asarray(unwrap(preds))
+        if p.ndim == 2:
+            p = p.argmax(-1)
+        y = np.asarray(unwrap(labels)).ravel()
+        for c in range(self.num_classes):
+            self._tp[c] += int(((p == c) & (y == c)).sum())
+            self._fp[c] += int(((p == c) & (y != c)).sum())
+            self._fn[c] += int(((p != c) & (y == c)).sum())
+
+    @staticmethod
+    def _prf(tp, fp, fn):
+        prec = tp / (tp + fp) if tp + fp else 0.0
+        rec = tp / (tp + fn) if tp + fn else 0.0
+        f1 = 2 * prec * rec / (prec + rec) if prec + rec else 0.0
+        return prec, rec, f1
+
+    def accumulate(self):
+        """Returns (macro_p, macro_r, macro_f1, micro_p, micro_r,
+        micro_f1) — the reference op's six accumulated outputs."""
+        per = [self._prf(int(t), int(f), int(n))
+               for t, f, n in zip(self._tp, self._fp, self._fn)]
+        macro = tuple(float(np.mean([x[i] for x in per]))
+                      for i in range(3))
+        micro = self._prf(int(self._tp.sum()), int(self._fp.sum()),
+                          int(self._fn.sum()))
+        return macro + tuple(float(x) for x in micro)
+
+
+def mean_iou(input, label, num_classes):
+    """Mean intersection-over-union over classes
+    (operators/metrics/mean_iou_op.h): returns (miou, per-class iou,
+    present-class mask)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(p, y):
+        p = p.reshape(-1).astype(jnp.int32)
+        y = y.reshape(-1).astype(jnp.int32)
+        inter = jnp.zeros((num_classes,), jnp.float32).at[p].add(
+            (p == y).astype(jnp.float32))
+        pred_c = jnp.zeros((num_classes,), jnp.float32).at[p].add(1.0)
+        lab_c = jnp.zeros((num_classes,), jnp.float32).at[y].add(1.0)
+        union = pred_c + lab_c - inter
+        present = union > 0
+        iou = jnp.where(present, inter / jnp.maximum(union, 1.0), 0.0)
+        miou = iou.sum() / jnp.maximum(present.sum(), 1)
+        return miou, iou, present
+
+    return apply(f, input, label, _multi_out=True)
+
+
+def edit_distance(hyps, hyp_lens, refs, ref_lens, normalized=True):
+    """Batch Levenshtein distance (operators/edit_distance_op.h): padded
+    id arrays + lens; host-side DP like the reference CPU kernel.
+    Returns (distances [B,1], sequence_num)."""
+    h = np.asarray(unwrap(hyps))
+    r = np.asarray(unwrap(refs))
+    hl = np.asarray(unwrap(hyp_lens)).ravel().astype(int)
+    rl = np.asarray(unwrap(ref_lens)).ravel().astype(int)
+    out = np.zeros((len(hl), 1), np.float32)
+    for b in range(len(hl)):
+        a, bseq = h[b, :hl[b]], r[b, :rl[b]]
+        n, m = len(a), len(bseq)
+        d = np.arange(m + 1, dtype=np.int64)
+        for i in range(1, n + 1):
+            prev, d[0] = d[0], i
+            for j in range(1, m + 1):
+                cur = min(d[j] + 1, d[j - 1] + 1,
+                          prev + (a[i - 1] != bseq[j - 1]))
+                prev, d[j] = d[j], cur
+        dist = float(d[m])
+        out[b, 0] = dist / m if (normalized and m) else dist
+    from ..tensor import Tensor
+    return Tensor(out), len(hl)
+
+
+class ChunkEvaluator(Metric):
+    """Chunking F1 for IOB tagging (operators/metrics/chunk_eval_op.h
+    re-designed host-side): update with padded tag ids + lens,
+    accumulate (precision, recall, f1).
+
+    Numeric tag scheme (the reference's): for ``num_chunk_types`` n,
+    tag 2k = B-type-k, tag 2k+1 = I-type-k, and any tag >= 2n (typically
+    2n itself) is Outside.  Pass num_chunk_types (or a label_list whose
+    length is 2n+1); without either, every tag is treated as B/I."""
+
+    def __init__(self, label_list=None, scheme="IOB", name="chunk",
+                 num_chunk_types=None, excluded_chunk_types=()):
+        if scheme.upper() != "IOB":
+            raise NotImplementedError(
+                f"chunk scheme {scheme!r}: only IOB is implemented "
+                "(reference also supports IOE/IOBES/plain)")
+        self._name = name
+        self.label_list = label_list
+        self.scheme = scheme
+        if num_chunk_types is None and label_list is not None:
+            num_chunk_types = (len(label_list) - 1) // 2
+        self.num_chunk_types = num_chunk_types
+        self.excluded = set(excluded_chunk_types)
+        self.reset()
+
+    def reset(self):
+        self._correct = self._infer = self._label = 0
+
+    def _is_outside(self, t):
+        return t < 0 or (self.num_chunk_types is not None
+                         and t >= 2 * self.num_chunk_types)
+
+    def _chunks(self, tags):
+        """(type, start, end) chunks from a numeric IOB tag sequence."""
+        chunks, start, ctype = [], None, None
+
+        def flush(end):
+            nonlocal start, ctype
+            if start is not None and ctype not in self.excluded:
+                chunks.append((ctype, start, end))
+            start = ctype = None
+
+        for i, t in enumerate(tags):
+            t = int(t)
+            if self._is_outside(t):
+                flush(i)
+            elif t % 2 == 0:            # B-
+                flush(i)
+                start, ctype = i, t // 2
+            elif start is not None and t // 2 == ctype:
+                continue                # I- of same type
+            else:                       # dangling I-: starts a chunk
+                flush(i)
+                start, ctype = i, t // 2
+        flush(len(tags))
+        return set(chunks)
+
+    def update(self, inferences, labels, seq_lens):
+        inf = np.asarray(unwrap(inferences))
+        lab = np.asarray(unwrap(labels))
+        lens = np.asarray(unwrap(seq_lens)).ravel().astype(int)
+        for b, n in enumerate(lens):
+            ci = self._chunks(inf[b, :n])
+            cl = self._chunks(lab[b, :n])
+            self._correct += len(ci & cl)
+            self._infer += len(ci)
+            self._label += len(cl)
+
+    def accumulate(self):
+        p = self._correct / self._infer if self._infer else 0.0
+        r = self._correct / self._label if self._label else 0.0
+        f1 = 2 * p * r / (p + r) if p + r else 0.0
+        return p, r, f1
+
+
+class DetectionMAP(Metric):
+    """VOC-style detection mAP (operators/detection_map_op.h, 11-point or
+    integral): update with per-image detections and ground truth."""
+
+    def __init__(self, overlap_threshold=0.5, ap_version="integral",
+                 name="mAP"):
+        self._name = name
+        self.thresh = overlap_threshold
+        self.ap_version = ap_version
+        self.reset()
+
+    def reset(self):
+        self._dets = {}   # class -> list of (score, is_tp)
+        self._npos = {}   # class -> gt count
+
+    @staticmethod
+    def _iou(a, b):
+        ix = max(0.0, min(a[2], b[2]) - max(a[0], b[0]))
+        iy = max(0.0, min(a[3], b[3]) - max(a[1], b[1]))
+        inter = ix * iy
+        ua = ((a[2] - a[0]) * (a[3] - a[1])
+              + (b[2] - b[0]) * (b[3] - b[1]) - inter)
+        return inter / ua if ua > 0 else 0.0
+
+    def update(self, det_boxes, det_scores, det_labels, gt_boxes,
+               gt_labels):
+        """One image: detections [N,4]/[N]/[N] + ground truth [M,4]/[M]."""
+        db = np.asarray(unwrap(det_boxes), np.float64).reshape(-1, 4)
+        ds = np.asarray(unwrap(det_scores), np.float64).ravel()
+        dl = np.asarray(unwrap(det_labels)).ravel().astype(int)
+        gb = np.asarray(unwrap(gt_boxes), np.float64).reshape(-1, 4)
+        gl = np.asarray(unwrap(gt_labels)).ravel().astype(int)
+        for c in np.unique(gl):
+            self._npos[int(c)] = self._npos.get(int(c), 0) + int(
+                (gl == c).sum())
+        for c in np.unique(dl):
+            c = int(c)
+            idx = np.where(dl == c)[0][np.argsort(-ds[dl == c])]
+            taken = np.zeros(len(gb), bool)
+            for i in idx:
+                best, bj = 0.0, -1
+                for j in np.where(gl == c)[0]:
+                    v = self._iou(db[i], gb[j])
+                    if v > best:
+                        best, bj = v, j
+                tp = best >= self.thresh and bj >= 0 and not taken[bj]
+                if tp:
+                    taken[bj] = True
+                self._dets.setdefault(c, []).append((float(ds[i]), tp))
+
+    def accumulate(self):
+        aps = []
+        for c, npos in self._npos.items():
+            dets = sorted(self._dets.get(c, []), reverse=True)
+            if not dets or npos == 0:
+                aps.append(0.0)
+                continue
+            tp = np.cumsum([d[1] for d in dets])
+            fp = np.cumsum([not d[1] for d in dets])
+            rec = tp / npos
+            prec = tp / np.maximum(tp + fp, 1e-12)
+            if self.ap_version == "11point":
+                ap = float(np.mean([
+                    prec[rec >= t].max() if (rec >= t).any() else 0.0
+                    for t in np.linspace(0, 1, 11)]))
+            else:
+                mrec = np.concatenate([[0], rec, [1]])
+                mpre = np.concatenate([[0], prec, [0]])
+                for i in range(len(mpre) - 2, -1, -1):
+                    mpre[i] = max(mpre[i], mpre[i + 1])
+                idx = np.where(mrec[1:] != mrec[:-1])[0]
+                ap = float(((mrec[idx + 1] - mrec[idx])
+                            * mpre[idx + 1]).sum())
+            aps.append(ap)
+        return float(np.mean(aps)) if aps else 0.0

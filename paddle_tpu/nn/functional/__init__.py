@@ -1226,3 +1226,190 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
 # alias namespace used by reference code: paddle.nn.functional.common
 def linear_compat(*args, **kwargs):
     return linear(*args, **kwargs)
+
+
+# --------------------------------------------------------------------------
+# op-registry tail (COVERAGE.md round-4): direct functional lowerings of
+# the remaining reference kernels
+# --------------------------------------------------------------------------
+
+def add_position_encoding(x, alpha=1.0, beta=1.0, name=None):
+    """alpha*x + beta*PE (operators/add_position_encoding_op.h): first
+    half of the feature dim gets sin(pos/10000^(i/half)), second half
+    cos, matching the reference's split layout."""
+    def f(v):
+        B, T, D = v.shape
+        half = D // 2
+        pos = jnp.arange(T, dtype=v.dtype)[:, None]
+        i = jnp.arange(half, dtype=v.dtype)[None, :]
+        div = jnp.power(jnp.asarray(10000.0, v.dtype), i / jnp.maximum(half - 1, 1))
+        pe = jnp.concatenate([jnp.sin(pos / div), jnp.cos(pos / div)], -1)
+        if pe.shape[-1] < D:  # odd feature dim: pad last column
+            pe = jnp.pad(pe, ((0, 0), (0, D - pe.shape[-1])))
+        return alpha * v + beta * pe[None]
+    return apply(f, x)
+
+
+def bilinear(x1, x2, weight, bias=None, name=None):
+    """x1^T W x2 per output channel (operators/bilinear_tensor_product_op.h):
+    x1 [B,M], x2 [B,N], weight [O,M,N] -> [B,O]."""
+    def f(a, b, w, *rest):
+        out = jnp.einsum("bm,omn,bn->bo", a, w, b)
+        return out + rest[0] if rest else out
+    args = (x1, x2, weight) + ((bias,) if bias is not None else ())
+    return apply(f, *args)
+
+
+def bpr_loss(input, label, name=None):
+    """Bayesian personalized ranking (operators/bpr_loss_op.h): for each
+    row, -mean_{j != y} log(sigmoid(x_y - x_j))."""
+    def f(x, y):
+        B, C = x.shape
+        y = y.reshape(-1)  # accept [B] or the paddle-standard [B,1]
+        pos = jnp.take_along_axis(x, y[:, None], 1)
+        diff = pos - x
+        logsig = jax.nn.log_sigmoid(diff)
+        mask = jnp.ones_like(x).at[jnp.arange(B), y].set(0)
+        return -(logsig * mask).sum(1, keepdims=True) / (C - 1)
+    return apply(f, input, label)
+
+
+def center_loss(input, label, centers, alpha=0.1, update=True, name=None):
+    """0.5*||x - c_y||^2 with EMA center updates
+    (operators/center_loss_op.h): returns (loss [B,1], new_centers).
+    `centers [K,D]` is caller-held state (functional re-design of the
+    reference's in-place CenterUpdate)."""
+    def f(x, y, c):
+        cy = c[y]
+        diff = x - cy
+        loss = 0.5 * (diff ** 2).sum(1, keepdims=True)
+        if not update:
+            return loss, c
+        cnt = jnp.zeros((c.shape[0],), x.dtype).at[y].add(1.0)
+        upd = jnp.zeros_like(c).at[y].add(diff)
+        new_c = c + alpha * upd / (cnt[:, None] + 1.0)
+        return loss, new_c
+    return apply(f, input, label, centers, _multi_out=True)
+
+
+def conv_shift(x, y, name=None):
+    """Circular correlation (operators/conv_shift_op.cc): x [B,N],
+    y [B,M] (M odd, M<=N) -> out[b,i] = sum_j x[b,(i+j-M//2) mod N]*y[b,j]."""
+    def f(a, b):
+        N, M = a.shape[1], b.shape[1]
+        i = jnp.arange(N)[:, None]
+        j = jnp.arange(M)[None, :]
+        src = (i + j - M // 2) % N
+        return jnp.einsum("bnm,bm->bn", a[:, src], b)
+    return apply(f, x, y)
+
+
+def ctc_align(ids, input_length, blank=0, merge_repeated=True, name=None):
+    """CTC greedy-path collapse (operators/ctc_align_op.h): merge repeats
+    then drop blanks; output packed left, zero-padded, plus new lens."""
+    def f(v, ln):
+        B, T = v.shape
+        t = jnp.arange(T)[None, :]
+        valid = t < ln[:, None]
+        if merge_repeated:
+            first = jnp.concatenate(
+                [jnp.ones((B, 1), bool), v[:, 1:] != v[:, :-1]], 1)
+        else:
+            first = jnp.ones((B, T), bool)
+        keep = valid & first & (v != blank)
+        order = jnp.argsort(jnp.where(keep, t, T + t), axis=1)
+        packed = jnp.take_along_axis(v, order, axis=1)
+        new_len = keep.sum(1)
+        packed = jnp.where(t < new_len[:, None], packed, 0)
+        return packed, new_len
+    return apply(f, ids, input_length, _multi_out=True)
+
+
+def hinge_loss(logits, labels, name=None):
+    """max(0, 1 - (2y-1)*x) (operators/hinge_loss_op.h), labels in {0,1}."""
+    return apply(lambda x, y: jnp.maximum(
+        0.0, 1.0 - (2.0 * y - 1.0) * x), logits, labels)
+
+
+def log_loss(input, label, epsilon=1e-4, name=None):
+    """-(y log(p+eps) + (1-y) log(1-p+eps)) (operators/log_loss_op.h)."""
+    return apply(lambda p, y: -y * jnp.log(p + epsilon)
+                 - (1.0 - y) * jnp.log(1.0 - p + epsilon), input, label)
+
+
+def rank_loss(label, left, right, name=None):
+    """RankNet pairwise loss (operators/rank_loss_op.h):
+    log(1+exp(o)) - y*o with o = left - right."""
+    return apply(lambda y, a, b: jnp.logaddexp(0.0, a - b) - y * (a - b),
+                 label, left, right)
+
+
+def row_conv(x, weight, name=None):
+    """Lookahead convolution (operators/row_conv_op.h): x [B,T,D],
+    weight [k+1,D] -> out[t] = sum_{j=0..k} x[t+j]*w[j] (zeros past T)."""
+    def f(v, w):
+        B, T, D = v.shape
+        K = w.shape[0]
+        t = jnp.arange(T)[None, :, None]
+        j = jnp.arange(K)[None, None, :]
+        src = t + j
+        valid = src < T
+        g = v[jnp.arange(B)[:, None, None], jnp.clip(src, 0, T - 1)]
+        g = jnp.where(valid[..., None], g, 0)
+        return jnp.einsum("btkd,kd->btd", g, w)
+    return apply(f, x, weight)
+
+
+def spp(x, pyramid_height=3, pool_type="max", name=None):
+    """Spatial pyramid pooling (operators/spp_op.h): concat adaptive
+    2^l x 2^l poolings, flattened -> [B, C*sum(4^l)]."""
+    def f(v):
+        outs = []
+        for lvl in range(pyramid_height):
+            bins = 2 ** lvl
+            p = _adaptive_pool2d_impl(v, bins, pool_type)
+            outs.append(p.reshape(v.shape[0], -1))
+        return jnp.concatenate(outs, axis=1)
+    return apply(f, x)
+
+
+def _adaptive_pool2d_impl(v, bins, pool_type):
+    B, C, H, W = v.shape
+    hs = [int(np.floor(i * H / bins)) for i in range(bins)] + [H]
+    ws = [int(np.floor(i * W / bins)) for i in range(bins)] + [W]
+    rows = []
+    for i in range(bins):
+        cols = []
+        for j in range(bins):
+            cell = v[:, :, hs[i]:max(hs[i + 1], hs[i] + 1),
+                     ws[j]:max(ws[j + 1], ws[j] + 1)]
+            red = cell.max((2, 3)) if pool_type == "max" else cell.mean((2, 3))
+            cols.append(red)
+        rows.append(jnp.stack(cols, -1))
+    return jnp.stack(rows, -2)  # [B,C,bins,bins]
+
+
+def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
+                 output_size=None, name=None):
+    """Inverse of max_pool2d-with-index (operators/unpool_op.h): scatter
+    pooled values back to their argmax flat positions."""
+    ks = (kernel_size, kernel_size) if isinstance(kernel_size, int) \
+        else tuple(kernel_size)
+    st = ks if stride is None else ((stride, stride)
+                                    if isinstance(stride, int)
+                                    else tuple(stride))
+
+    def f(v, idx):
+        B, C, H, W = v.shape
+        if output_size is not None:
+            oh, ow = output_size[-2], output_size[-1]
+        else:
+            oh = (H - 1) * st[0] + ks[0] - 2 * padding
+            ow = (W - 1) * st[1] + ks[1] - 2 * padding
+        flat = jnp.zeros((B, C, oh * ow), v.dtype)
+        out = flat.at[
+            jnp.arange(B)[:, None, None],
+            jnp.arange(C)[None, :, None],
+            idx.reshape(B, C, -1)].set(v.reshape(B, C, -1), mode="drop")
+        return out.reshape(B, C, oh, ow)
+    return apply(f, x, indices)

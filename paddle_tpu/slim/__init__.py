@@ -161,7 +161,12 @@ def save_quantized_model(model, path_prefix, input_spec=None,
     for holder, name, sub in _walk(model):
         if isinstance(sub, _QuantWrapper):
             w = np.asarray(unwrap(sub.inner.weight))
-            scale = float(np.asarray(unwrap(sub.weight_scale)))
+            # abs-max of the CURRENT weight — the same value _wscale()
+            # returns during the eval-mode export trace below.  The
+            # weight_scale buffer only updates on training forwards, so
+            # after the final optimizer step it is stale and the packed
+            # int8 payload would not reproduce the served numerics.
+            scale = float(np.max(np.abs(w)))
             qmax = 2 ** (sub._wbits - 1) - 1
             step = max(scale, 1e-8) / qmax
             wq = np.clip(np.round(w / step), -qmax, qmax).astype(np.int8)

@@ -158,3 +158,37 @@ class ExecutionStrategy:
     def __init__(self):
         self.num_threads = 1
         self.num_iteration_per_drop_scope = 100
+
+
+def Print(input, first_n=-1, message=None, summarize=20,
+          print_tensor_name=True, print_tensor_type=True,
+          print_tensor_shape=True, print_tensor_layout=True,
+          print_tensor_lod=False, print_phase="both"):
+    """Print a tensor's value and pass it through (operators/print_op.cc).
+    Eager values print immediately; under a trace this lowers to
+    jax.debug.print, so the compiled program prints at run time — the
+    TPU-native equivalent of the reference's host-side PrintOp."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor, apply
+
+    msg = message or ""
+    name = getattr(input, "name", None) or "var"
+    head = f"{msg} {name if print_tensor_name else ''}".strip()
+
+    def f(v):
+        if isinstance(v, jax.core.Tracer):
+            jax.debug.print(head + " {}", v)
+        else:
+            parts = [head]
+            if print_tensor_shape:
+                parts.append(f"shape={tuple(v.shape)}")
+            if print_tensor_type:
+                parts.append(f"dtype={v.dtype}")
+            flat = jnp.ravel(v)[:summarize]
+            parts.append(f"data={flat}")
+            print("  ".join(parts))
+        return v
+
+    return apply(f, input)

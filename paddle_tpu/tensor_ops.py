@@ -9,6 +9,8 @@ for the reference's fusion_group codegen here.
 """
 from __future__ import annotations
 
+import builtins
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -970,6 +972,97 @@ class _Linalg:
 
 
 linalg = _Linalg()
+
+
+# top-level aliases of the linalg namespace (paddle exposes both; the
+# C++ registry names are `inverse`/`cholesky`: operators/inverse_op.cc,
+# cholesky_op.cc)
+def inverse(x, name=None):
+    return linalg.inv(x)
+
+
+def cholesky(x, upper=False, name=None):
+    return linalg.cholesky(x, upper)
+
+
+def add_n(inputs, name=None):
+    """Sum a list of same-shape tensors (operators/sum_op.cc)."""
+    if isinstance(inputs, (list, tuple)):
+        def f(*vs):  # NB: `sum` here is paddle's reduce, not builtins.sum
+            out = vs[0]
+            for v in vs[1:]:
+                out = out + v
+            return out
+        return apply(f, *inputs)
+    return apply(lambda v: v, inputs)
+
+
+def addmm(input, x, y, beta=1.0, alpha=1.0, name=None):
+    """beta*input + alpha*(x @ y) (operators/addmm_op.cc)."""
+    return apply(lambda i, a, b: beta * i + alpha * (a @ b), input, x, y)
+
+
+def stanh(x, scale_a=0.67, scale_b=1.7159, name=None):
+    """scale_b * tanh(scale_a * x) (activation_op.h STanhFunctor)."""
+    return apply(lambda v: scale_b * jnp.tanh(scale_a * v), x)
+
+
+def slice(x, axes, starts, ends, name=None):  # noqa: A001 - paddle API name
+    """Static multi-axis slice (operators/slice_op.cc): negative indices
+    wrap, out-of-range clamps."""
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e in zip(axes, starts, ends):
+            idx[ax] = builtins.slice(int(s), int(e))
+        return v[tuple(idx)]
+    return apply(f, x)
+
+
+def strided_slice(x, axes, starts, ends, strides, name=None):
+    """slice with per-axis stride (operators/strided_slice_op.cc);
+    negative strides walk backwards like python slicing."""
+    def f(v):
+        idx = [builtins.slice(None)] * v.ndim
+        for ax, s, e, st in zip(axes, starts, ends, strides):
+            st = int(st)
+            s, e = int(s), int(e)
+            if st < 0 and e == -1:
+                e = None  # walk through index 0 inclusively
+            idx[ax] = builtins.slice(s, e, st)
+        return v[tuple(idx)]
+    return apply(f, x)
+
+
+def _num_segments(ids, num_segments, op):
+    if num_segments is not None:
+        return int(num_segments)
+    if isinstance(ids, jax.core.Tracer):
+        raise ValueError(
+            f"{op}: segment_ids is traced, so the output row count cannot "
+            "be derived from max(ids); pass num_segments= explicitly "
+            "inside jit (XLA needs a static output shape)")
+    return int(jnp.max(ids)) + 1
+
+
+def segment_sum(data, segment_ids, num_segments=None, name=None):
+    """Sum rows sharing a segment id (operators/segment_pool_op.cc,
+    pooltype SUM).  Output has max(ids)+1 rows eagerly; under jit pass
+    num_segments= (a traced max would make the result shape dynamic)."""
+    def f(v, ids):
+        n = _num_segments(ids, num_segments, "segment_sum")
+        return jax.ops.segment_sum(v, ids.astype(jnp.int32),
+                                   num_segments=n)
+    return apply(f, data, segment_ids)
+
+
+def segment_mean(data, segment_ids, num_segments=None, name=None):
+    def f(v, ids):
+        n = _num_segments(ids, num_segments, "segment_mean")
+        ids = ids.astype(jnp.int32)
+        s = jax.ops.segment_sum(v, ids, num_segments=n)
+        c = jax.ops.segment_sum(jnp.ones_like(v), ids, num_segments=n)
+        return s / jnp.maximum(c, 1)
+    return apply(f, data, segment_ids)
 
 
 # ---------------------------------------------------------------------------

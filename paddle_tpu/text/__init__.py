@@ -12,6 +12,7 @@ import os
 import numpy as np
 
 from ..io import Dataset
+from . import sequence  # noqa: F401 — paddle_tpu.text.sequence op family
 
 _CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
 
@@ -196,3 +197,122 @@ class ViterbiDecoder:
             return scores, paths.astype(jnp.int64)
 
         return apply(f, potentials, lengths, _multi_out=True)
+
+
+# --------------------------------------------------------------------------
+# decoding ops (operators/gather_tree_op.cc, beam_search_op.cc,
+# beam_search_decode_op.cc, linear_chain_crf_op.cc) — dense [B,...]
+# re-designs of the reference's LoD forms
+# --------------------------------------------------------------------------
+
+def gather_tree(ids, parents):
+    """Backtrack beam parent pointers into full sequences
+    (gather_tree_op.cc): ids/parents [T, B, W] -> [T, B, W] where output
+    step t holds the token on the surviving path through beam parents."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(idv, par):
+        T, B, W = idv.shape
+        b = jnp.arange(B)[:, None]
+
+        def step(beam, t):
+            tok = idv[t, b, beam]
+            beam2 = par[t, b, beam]
+            return beam2, tok
+
+        last = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+        _, toks = jax.lax.scan(step, last, jnp.arange(T - 1, -1, -1))
+        return toks[::-1]  # scanned back-to-front
+
+    return apply(f, ids, parents)
+
+
+def beam_search_step(log_probs, pre_scores, beam_size, end_token=None,
+                     finished=None):
+    """One beam expansion (beam_search_op.cc re-designed functionally):
+    log_probs [B, W, V] for the current step, pre_scores [B, W] running
+    scores -> (ids [B, beam], parents [B, beam], scores [B, beam]) by
+    top-k over the W*V joint candidates.  Finished beams (optional mask
+    [B, W]) keep their score and only propose end_token."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(lp, ps, *rest):
+        B, W, V = lp.shape
+        if rest:
+            fin = rest[0]
+            keep = jnp.full((V,), -jnp.inf, lp.dtype).at[end_token].set(0.0)
+            lp = jnp.where(fin[..., None], keep[None, None, :], lp)
+        total = ps[..., None] + lp
+        flat = total.reshape(B, W * V)
+        scores, idx = jax.lax.top_k(flat, beam_size)
+        return idx % V, idx // V, scores
+
+    args = (log_probs, pre_scores) + ((finished,) if finished is not None
+                                      else ())
+    return apply(f, *args, _multi_out=True)
+
+
+def beam_search_decode(step_ids, step_parents, final_scores):
+    """Assemble beam outputs into ranked sequences
+    (beam_search_decode_op.cc): step_ids/step_parents [T, B, W] plus
+    final scores [B, W] -> (sequences [B, W, T], scores [B, W])."""
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor, unwrap
+
+    toks = gather_tree(step_ids, step_parents)
+    seq = jnp.transpose(unwrap(toks), (1, 2, 0))
+    return Tensor(seq), (final_scores if isinstance(final_scores, Tensor)
+                         else Tensor(final_scores))
+
+
+def linear_chain_crf(emission, transition, label, seq_len):
+    """Per-sequence CRF log-likelihood (linear_chain_crf_op.h):
+    emission [B, T, K]; transition [K+2, K] with row 0 = start weights,
+    row 1 = stop weights, rows 2: = square transition matrix (the
+    reference's layout); label [B, T]; seq_len [B] -> ll [B].
+
+    Forward algorithm as a lax.scan over time with a validity mask —
+    differentiable, so -ll.mean() trains the CRF end to end."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import apply
+
+    def f(em, tr, lab, ln):
+        B, T, K = em.shape
+        start, stop, trans = tr[0], tr[1], tr[2:]
+
+        # --- partition (log Z) via masked forward recursion
+        alpha0 = start[None, :] + em[:, 0]            # [B, K]
+
+        def fwd(alpha, t):
+            nxt = jax.scipy.special.logsumexp(
+                alpha[:, :, None] + trans[None], axis=1) + em[:, t]
+            live = (t < ln)[:, None]
+            return jnp.where(live, nxt, alpha), None
+
+        alpha, _ = jax.lax.scan(fwd, alpha0, jnp.arange(1, T))
+        logz = jax.scipy.special.logsumexp(alpha + stop[None, :], axis=1)
+
+        # --- gold path score
+        t_idx = jnp.arange(T)[None, :]
+        valid = t_idx < ln[:, None]
+        em_g = jnp.take_along_axis(em, lab[..., None], -1)[..., 0]
+        em_score = jnp.where(valid, em_g, 0).sum(1)
+        prev, cur = lab[:, :-1], lab[:, 1:]
+        tr_g = trans[prev, cur]
+        tr_score = jnp.where(valid[:, 1:], tr_g, 0).sum(1)
+        first = lab[:, 0]
+        last_idx = jnp.maximum(ln - 1, 0)
+        last_lab = jnp.take_along_axis(lab, last_idx[:, None], 1)[:, 0]
+        gold = start[first] + em_score + tr_score + stop[last_lab]
+        return gold - logz
+
+    return apply(f, emission, transition, label, seq_len)

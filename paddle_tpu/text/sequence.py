@@ -1,0 +1,302 @@
+"""Sequence ops — the dense+mask re-design of the reference's LoD family.
+
+Reference parity: paddle/fluid/operators/sequence_ops/*.cc
+(sequence_pool, sequence_conv, sequence_pad/unpad, sequence_expand(_as),
+sequence_reverse, sequence_softmax, sequence_erase, sequence_enumerate,
+sequence_slice, sequence_reshape, sequence_scatter, sequence_concat).
+
+TPU-native design: the reference represents variable-length batches as
+LoD (level-of-detail) tensors — a flat value buffer plus host-side
+offset tables — and every sequence op walks the offsets.  XLA has static
+shapes, so here a batch is a PADDED dense array ``[B, T, ...]`` plus an
+explicit ``seq_len [B]`` int vector.  Ops whose output shape is
+data-independent are pure jnp (jit-safe, differentiable); ops whose
+output is inherently ragged (pad/unpad/expand/reshape between flat and
+padded forms) run eagerly on concrete arrays and raise a clear error
+under tracing — inside jit you stay padded+masked.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, apply, unwrap
+
+__all__ = [
+    "sequence_mask", "sequence_pool", "sequence_softmax",
+    "sequence_reverse", "sequence_conv", "sequence_concat",
+    "sequence_erase", "sequence_enumerate", "sequence_slice",
+    "sequence_scatter", "sequence_pad", "sequence_unpad",
+    "sequence_expand", "sequence_expand_as", "sequence_reshape",
+]
+
+
+def _eager(x, op):
+    v = unwrap(x) if isinstance(x, Tensor) else x
+    if isinstance(v, jax.core.Tracer):
+        raise TypeError(
+            f"{op} produces a data-dependent (ragged) shape and cannot run "
+            "under jit; keep the padded [B,T,...] + seq_len form inside "
+            "compiled code and call this op eagerly at the host boundary")
+    return np.asarray(v)
+
+
+def sequence_mask(seq_len, maxlen, dtype="bool"):
+    """[B, maxlen] validity mask (sequence_mask_op.cc... the one LoD util
+    the reference itself exposes as a dense op)."""
+    def f(ln):
+        m = jnp.arange(maxlen)[None, :] < ln[:, None]
+        return m if dtype == "bool" else m.astype(dtype)
+    return apply(f, seq_len)
+
+
+def sequence_pool(x, seq_len, pool_type="SUM", pad_value=0.0):
+    """Per-sequence pooling over the time axis
+    (sequence_ops/sequence_pool_op.cc): SUM / AVERAGE / SQRT / MAX /
+    MIN / LAST / FIRST.  x [B,T,...], seq_len [B] -> [B,...]."""
+    pt = pool_type.upper()
+
+    def f(v, ln):
+        T = v.shape[1]
+        mask = jnp.arange(T)[None, :] < ln[:, None]
+        m = mask.reshape(mask.shape + (1,) * (v.ndim - 2))
+        ln_f = jnp.maximum(ln, 1).astype(v.dtype)
+        ln_f = ln_f.reshape((-1,) + (1,) * (v.ndim - 2))
+        if pt == "SUM":
+            out = jnp.where(m, v, 0).sum(axis=1)
+        elif pt == "AVERAGE":
+            out = jnp.where(m, v, 0).sum(axis=1) / ln_f
+        elif pt == "SQRT":
+            out = jnp.where(m, v, 0).sum(axis=1) / jnp.sqrt(ln_f)
+        elif pt == "MAX":
+            out = jnp.where(m, v, -jnp.inf).max(axis=1)
+        elif pt == "MIN":
+            out = jnp.where(m, v, jnp.inf).min(axis=1)
+        elif pt == "FIRST":
+            out = v[:, 0]
+        elif pt == "LAST":
+            idx = jnp.maximum(ln - 1, 0)
+            out = jnp.take_along_axis(
+                v, idx.reshape((-1, 1) + (1,) * (v.ndim - 2)), axis=1
+            ).squeeze(1)
+        else:
+            raise ValueError(f"unknown pool_type {pool_type}")
+        # empty sequences pool to pad_value (reference behavior)
+        empty = (ln == 0).reshape((-1,) + (1,) * (out.ndim - 1))
+        return jnp.where(empty, jnp.asarray(pad_value, out.dtype), out)
+
+    return apply(f, x, seq_len)
+
+
+def sequence_softmax(x, seq_len):
+    """Masked softmax over the valid prefix of each row
+    (sequence_softmax_op.cc).  x [B,T] -> [B,T] with zeros at padding."""
+    def f(v, ln):
+        mask = jnp.arange(v.shape[1])[None, :] < ln[:, None]
+        z = jnp.where(mask, v, -jnp.inf)
+        p = jax.nn.softmax(z, axis=1)
+        return jnp.where(mask, p, 0)
+    return apply(f, x, seq_len)
+
+
+def sequence_reverse(x, seq_len):
+    """Reverse each valid prefix, padding stays in place
+    (sequence_reverse_op.h).  x [B,T,...]."""
+    def f(v, ln):
+        T = v.shape[1]
+        t = jnp.arange(T)[None, :]
+        src = jnp.where(t < ln[:, None], ln[:, None] - 1 - t, t)
+        return jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)), axis=1)
+    return apply(f, x, seq_len)
+
+
+def sequence_conv(x, seq_len, filter, context_length, context_start=None,
+                  padding=True):
+    """Context-window convolution over time (sequence_conv_op.cc):
+    gather a [context_length] window around each step (zeros outside the
+    valid range), flatten to [B,T,ctx*D], matmul with
+    filter [ctx*D, num_filters]."""
+    if context_start is None:
+        context_start = -((context_length - 1) // 2)
+
+    def f(v, ln, w):
+        B, T, D = v.shape
+        t = jnp.arange(T)[None, :, None]                 # [1,T,1]
+        off = jnp.arange(context_length)[None, None, :]  # [1,1,C]
+        src = t + off + context_start                    # [1,T,C]
+        valid = (src >= 0) & (src < ln[:, None, None])
+        src_c = jnp.clip(src, 0, T - 1)
+        g = v[jnp.arange(B)[:, None, None], src_c]       # [B,T,C,D]
+        g = jnp.where(valid[..., None], g, 0)
+        out = g.reshape(B, T, context_length * D) @ w
+        mask = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+        return jnp.where(mask, out, 0)
+
+    return apply(f, x, seq_len, filter)
+
+
+def sequence_concat(xs, seq_lens):
+    """Concatenate per-row valid prefixes (sequence_concat_op.cc):
+    ([B,T1,...],[B,T2,...]) + lens -> [B, sum(Ti), ...] packed left,
+    new lens = sum of lens.  jit-safe scatter build."""
+    vs = [unwrap(x) if isinstance(x, Tensor) else jnp.asarray(x)
+          for x in xs]
+    lns = [unwrap(l).astype(jnp.int32) if isinstance(l, Tensor)
+           else jnp.asarray(l, jnp.int32) for l in seq_lens]
+    B = vs[0].shape[0]
+    T_out = sum(v.shape[1] for v in vs)
+    feat = vs[0].shape[2:]
+    out = jnp.zeros((B, T_out) + feat, vs[0].dtype)
+    base = jnp.zeros((B,), jnp.int32)
+    for v, ln in zip(vs, lns):
+        T = v.shape[1]
+        t = jnp.arange(T)[None, :]
+        dst = base[:, None] + t                       # [B,T]
+        valid = t < ln[:, None]
+        dst_c = jnp.where(valid, dst, T_out)          # OOB drops
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], dst_c.shape)
+        out = out.at[bidx.reshape(-1), dst_c.reshape(-1)].set(
+            v.reshape((-1,) + feat), mode="drop")
+        base = base + ln
+    total = base
+    return Tensor(out), Tensor(total)
+
+
+def sequence_erase(ids, seq_len, tokens):
+    """Remove the given token values, shift survivors left, update lens
+    (sequence_erase_op.cc).  ids [B,T] int -> ([B,T], new_len [B]);
+    vacated positions are zero-filled."""
+    tokens = jnp.asarray(list(tokens))
+
+    def f(v, ln):
+        T = v.shape[1]
+        t = jnp.arange(T)[None, :]
+        valid = t < ln[:, None]
+        keep = valid & ~jnp.isin(v, tokens)
+        # stable order of kept elements: sort by (not keep, position)
+        order = jnp.argsort(jnp.where(keep, t, T + t), axis=1)
+        packed = jnp.take_along_axis(v, order, axis=1)
+        new_len = keep.sum(axis=1)
+        packed = jnp.where(t < new_len[:, None], packed, 0)
+        return packed, new_len
+
+    out = apply(f, ids, seq_len, _multi_out=True)
+    return out
+
+
+def sequence_enumerate(ids, seq_len, win_size, pad_value=0):
+    """Sliding windows (sequence_enumerate_op.cc): out[b,t,k] =
+    ids[b,t+k] while t+k is valid, else pad_value.  [B,T] -> [B,T,win]."""
+    def f(v, ln):
+        B, T = v.shape
+        t = jnp.arange(T)[None, :, None]
+        k = jnp.arange(win_size)[None, None, :]
+        src = t + k
+        valid = (src < ln[:, None, None])
+        src_c = jnp.clip(src, 0, T - 1)
+        g = v[jnp.arange(B)[:, None, None], src_c]
+        g = jnp.where(valid, g, pad_value)
+        row_valid = (jnp.arange(T)[None, :] < ln[:, None])[..., None]
+        return jnp.where(row_valid, g, pad_value)
+    return apply(f, ids, seq_len)
+
+
+def sequence_slice(x, seq_len, offset, length):
+    """Per-row subsequence (sequence_slice_op.h): take length[b] steps
+    starting at offset[b]; output packed left in the same container,
+    new lens = length."""
+    def f(v, ln, off, lgt):
+        B, T = v.shape[0], v.shape[1]
+        t = jnp.arange(T)[None, :]
+        src = jnp.clip(off[:, None] + t, 0, T - 1)
+        g = jnp.take_along_axis(
+            v, src.reshape(src.shape + (1,) * (v.ndim - 2)), axis=1)
+        valid = t < lgt[:, None]
+        m = valid.reshape(valid.shape + (1,) * (v.ndim - 2))
+        return jnp.where(m, g, 0), lgt
+
+    return apply(f, x, seq_len, offset, length, _multi_out=True)
+
+
+def sequence_scatter(x, index, updates, seq_len):
+    """Scatter-add each sequence's updates into its row
+    (sequence_scatter_op.cc): x [B,D]; index/updates [B,T] padded with
+    seq_len valid entries; out[b, index[b,k]] += updates[b,k]."""
+    def f(v, idx, upd, ln):
+        B, D = v.shape
+        T = idx.shape[1]
+        t = jnp.arange(T)[None, :]
+        valid = t < ln[:, None]
+        idx_c = jnp.where(valid, idx, D)  # OOB drops
+        bidx = jnp.broadcast_to(jnp.arange(B)[:, None], idx_c.shape)
+        return v.at[bidx.reshape(-1), idx_c.reshape(-1)].add(
+            jnp.where(valid, upd, 0).reshape(-1), mode="drop")
+    return apply(f, x, index, updates, seq_len)
+
+
+# ---- ragged <-> padded converters (eager: data-dependent shapes) ---------
+
+def sequence_pad(x, seq_len, maxlen=None, pad_value=0.0):
+    """Flat [sum(len), ...] + lens -> padded [B, maxlen, ...]
+    (sequence_pad_op.cc).  Eager-only: the flat layout itself is the
+    dynamic-shape artifact."""
+    v = _eager(x, "sequence_pad")
+    ln = _eager(seq_len, "sequence_pad").astype(np.int64)
+    B = len(ln)
+    T = int(maxlen) if maxlen else int(ln.max() if B else 0)
+    out = np.full((B, T) + v.shape[1:], pad_value, v.dtype)
+    o = 0
+    for b, n in enumerate(ln):
+        n = int(n)
+        out[b, :n] = v[o:o + n]
+        o += n
+    return Tensor(out), Tensor(ln)
+
+
+def sequence_unpad(x, seq_len):
+    """Padded [B,T,...] + lens -> flat [sum(len), ...]
+    (sequence_unpad_op.cc).  Eager-only (ragged output)."""
+    v = _eager(x, "sequence_unpad")
+    ln = _eager(seq_len, "sequence_unpad").astype(np.int64)
+    return Tensor(np.concatenate(
+        [v[b, :int(n)] for b, n in enumerate(ln)], axis=0)
+        if len(ln) else v[:0].reshape((0,) + v.shape[2:]))
+
+
+def sequence_expand(x, x_len, ref_len):
+    """Repeat each sequence by its reference count
+    (sequence_expand_op.cc, ref_level=0): row-block b of x is tiled
+    ref_len[b] times.  Eager-only (ragged output)."""
+    v = _eager(x, "sequence_expand")
+    xl = _eager(x_len, "sequence_expand").astype(np.int64)
+    rl = _eager(ref_len, "sequence_expand").astype(np.int64)
+    chunks, o = [], 0
+    for n, r in zip(xl, rl):
+        n = int(n)
+        chunks.extend([v[o:o + n]] * int(r))
+        o += n
+    return Tensor(np.concatenate(chunks, axis=0) if chunks
+                  else v[:0])
+
+
+def sequence_expand_as(x, ref_len):
+    """Row b of x repeated ref_len[b] times (sequence_expand_as_op.cc).
+    Eager-only (ragged output)."""
+    v = _eager(x, "sequence_expand_as")
+    rl = _eager(ref_len, "sequence_expand_as").astype(np.int64)
+    return Tensor(np.repeat(v, rl, axis=0))
+
+
+def sequence_reshape(x, seq_len, new_dim):
+    """Flat [sum, D] -> [sum*D/new_dim, new_dim]; lens scale by
+    D/new_dim (sequence_reshape_op.cc).  Eager-only."""
+    v = _eager(x, "sequence_reshape")
+    ln = _eager(seq_len, "sequence_reshape").astype(np.int64)
+    D = v.shape[-1]
+    if (ln * D) .sum() % new_dim:
+        raise ValueError("total elements not divisible by new_dim")
+    new_len = ln * D // new_dim
+    return Tensor(v.reshape(-1, new_dim)), Tensor(new_len)

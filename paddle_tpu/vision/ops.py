@@ -783,3 +783,523 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
 
     return apply(f, prior_box, prior_box_var, target_box, box_score,
                  _multi_out=True)
+
+
+# --------------------------------------------------------------------------
+# op-registry tail (COVERAGE.md round-4)
+# --------------------------------------------------------------------------
+
+def affine_channel(x, scale, bias, data_layout="NCHW"):
+    """Per-channel x*scale+bias (operators/affine_channel_op.cc)."""
+    def f(v, s, b):
+        if data_layout == "NCHW":
+            shape = (1, -1) + (1,) * (v.ndim - 2)
+        else:
+            shape = (1,) * (v.ndim - 1) + (-1,)
+        return v * s.reshape(shape) + b.reshape(shape)
+    return apply(f, x, scale, bias)
+
+
+def channel_shuffle(x, groups, data_format="NCHW"):
+    """Interleave channel groups (operators/shuffle_channel_op.h)."""
+    if data_format not in ("NCHW", "NHWC"):
+        raise ValueError(f"unsupported data_format {data_format!r}")
+
+    def f(v):
+        if data_format == "NCHW":
+            B, C, H, W = v.shape
+            return v.reshape(B, groups, C // groups, H, W) \
+                .swapaxes(1, 2).reshape(B, C, H, W)
+        B, H, W, C = v.shape
+        return v.reshape(B, H, W, groups, C // groups) \
+            .swapaxes(3, 4).reshape(B, H, W, C)
+    return apply(f, x)
+
+
+def space_to_depth(x, blocksize):
+    """Rearrange spatial blocks into channels
+    (operators/space_to_depth_op.cc)."""
+    def f(v):
+        B, C, H, W = v.shape
+        b = blocksize
+        v = v.reshape(B, C, H // b, b, W // b, b)
+        return v.transpose(0, 3, 5, 1, 2, 4).reshape(
+            B, C * b * b, H // b, W // b)
+    return apply(f, x)
+
+
+def correlation(x1, x2, pad_size, kernel_size, max_displacement,
+                stride1=1, stride2=1, corr_type_multiply=1):
+    """FlowNet cost volume (operators/correlation_op.cc): mean over
+    channels of x1[h,w] * x2[h+dy, w+dx] for each displacement in the
+    (2*max_displacement/stride2+1)^2 window.  kernel_size=1 form."""
+    def f(a, b):
+        B, C, H, W = a.shape
+        d = max_displacement // stride2
+        pads = ((0, 0), (0, 0), (max_displacement, max_displacement),
+                (max_displacement, max_displacement))
+        bp = jnp.pad(b, pads)
+        outs = []
+        for dy in range(-d, d + 1):
+            for dx in range(-d, d + 1):
+                oy = max_displacement + dy * stride2
+                ox = max_displacement + dx * stride2
+                shifted = jax.lax.dynamic_slice(
+                    bp, (0, 0, oy, ox), (B, C, H, W))
+                outs.append((a * shifted).mean(1))
+        return jnp.stack(outs, 1)  # [B, (2d+1)^2, H, W]
+    return apply(f, x1, x2)
+
+
+def deform_conv2d(x, offset, weight, mask=None, stride=1, padding=0,
+                  dilation=1, deformable_groups=1, groups=1, bias=None):
+    """Deformable convolution v1/v2 (operators/deformable_conv_op.cc,
+    deformable_conv_v1_op.cc): each kernel tap samples the input at a
+    learned fractional offset (bilinear); v2 additionally modulates each
+    tap with a mask.  offset [B, 2*dg*kh*kw, Ho, Wo] (y,x interleaved per
+    tap, the reference layout), mask [B, dg*kh*kw, Ho, Wo]."""
+    st = (stride, stride) if isinstance(stride, int) else tuple(stride)
+    pd = (padding, padding) if isinstance(padding, int) else tuple(padding)
+    dl = (dilation, dilation) if isinstance(dilation, int) \
+        else tuple(dilation)
+
+    def f(v, off, w, *rest):
+        B, C, H, W = v.shape
+        O, Cg, kh, kw = w.shape
+        Ho = (H + 2 * pd[0] - dl[0] * (kh - 1) - 1) // st[0] + 1
+        Wo = (W + 2 * pd[1] - dl[1] * (kw - 1) - 1) // st[1] + 1
+        K = kh * kw
+        off = off.reshape(B, deformable_groups, K, 2, Ho, Wo)
+
+        oy = jnp.arange(Ho) * st[0] - pd[0]
+        ox = jnp.arange(Wo) * st[1] - pd[1]
+        ky = jnp.arange(kh) * dl[0]
+        kx = jnp.arange(kw) * dl[1]
+        # base sample positions [K, Ho, Wo]
+        base_y = (oy[None, :, None] + ky.repeat(kw)[:, None, None])
+        base_x = (ox[None, None, :] + jnp.tile(kx, kh)[:, None, None])
+        py = base_y[None, None] + off[:, :, :, 0]      # [B,dg,K,Ho,Wo]
+        px = base_x[None, None] + off[:, :, :, 1]
+
+        y0 = jnp.floor(py); x0 = jnp.floor(px)
+        wy = py - y0; wx = px - x0
+
+        def gather(vv, yy, xx):
+            # vv [B,C,H,W]; yy/xx [B,dg,K,Ho,Wo] -> [B,dg,K,Ho,Wo,cg]
+            valid = ((yy >= 0) & (yy < H) & (xx >= 0) & (xx < W))
+            yc = jnp.clip(yy, 0, H - 1).astype(jnp.int32)
+            xc = jnp.clip(xx, 0, W - 1).astype(jnp.int32)
+            cg = C // deformable_groups
+            vg = jnp.moveaxis(            # [B,dg,H,W,cg]
+                vv.reshape(B, deformable_groups, cg, H, W), 2, -1)
+            bidx = jnp.arange(B)[:, None, None, None, None]
+            gidx = jnp.arange(deformable_groups)[None, :, None, None, None]
+            g = vg[bidx, gidx, yc, xc]
+            return jnp.where(valid[..., None], g, 0.0)
+
+        g00 = gather(v, y0, x0)
+        g01 = gather(v, y0, x0 + 1)
+        g10 = gather(v, y0 + 1, x0)
+        g11 = gather(v, y0 + 1, x0 + 1)
+        wy_ = wy[..., None]; wx_ = wx[..., None]
+        samp = (g00 * (1 - wy_) * (1 - wx_) + g01 * (1 - wy_) * wx_
+                + g10 * wy_ * (1 - wx_) + g11 * wy_ * wx_)
+        if rest:  # v2 modulation mask
+            m = rest[0].reshape(B, deformable_groups, K, Ho, Wo)
+            samp = samp * m[..., None]
+        # samp [B,dg,K,Ho,Wo,cg] -> im2col [B, C, K, Ho, Wo]
+        samp = jnp.moveaxis(samp, -1, 3)   # [B,dg,K,cg,Ho,Wo]
+        colk = jnp.moveaxis(samp, 2, 3).reshape(B, C, K, Ho, Wo)
+        wk = w.reshape(O, Cg, K)
+        if groups == 1:
+            out = jnp.einsum("bckhw,ock->bohw", colk, wk)
+        else:
+            cg2 = C // groups
+            og = O // groups
+            colg = colk.reshape(B, groups, cg2, K, Ho, Wo)
+            wg = wk.reshape(groups, og, Cg, K)
+            out = jnp.einsum("bgckhw,gock->bgohw", colg, wg).reshape(
+                B, O, Ho, Wo)
+        return out
+
+    args = (x, offset, weight) + ((mask,) if mask is not None else ())
+    out = apply(f, *args)
+    if bias is not None:
+        out = apply(lambda o, b: o + b.reshape(1, -1, 1, 1), out, bias)
+    return out
+
+
+def psroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               output_channels=None):
+    """Position-sensitive RoI average pooling (operators/detection/
+    psroi_pool_op.cc): output channel c at bin (ph, pw) averages input
+    channel (c*ph_total + ph)*pw_total + pw — the reference's
+    CHANNEL-MAJOR block layout (psroi_pool_op.h:125).  boxes_num assigns
+    rois to batch images like roi_align above."""
+    ps = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(v, rois):
+        B, C, H, W = v.shape
+        oc = output_channels or C // (ps[0] * ps[1])
+        R = rois.shape[0]
+        if boxes_num is not None:
+            bn = jnp.asarray(_v(boxes_num))
+            bidx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                              total_repeat_length=R).astype(jnp.int32)
+        else:
+            bidx = jnp.zeros((R,), jnp.int32)
+        # input channel for (c, ph, pw): (c*ps0 + ph)*ps1 + pw
+        cidx = ((jnp.arange(oc)[:, None, None] * ps[0]
+                 + jnp.arange(ps[0])[None, :, None]) * ps[1]
+                + jnp.arange(ps[1])[None, None, :])     # [oc,ph,pw]
+
+        def one(r):
+            roi = rois[r]
+            img = v[bidx[r]]
+            x1, y1, x2, y2 = [roi[i] * spatial_scale for i in range(4)]
+            rh = jnp.maximum(y2 - y1, 0.1) / ps[0]
+            rw = jnp.maximum(x2 - x1, 0.1) / ps[1]
+            ys = jnp.arange(H, dtype=v.dtype)
+            xs = jnp.arange(W, dtype=v.dtype)
+            ph = jnp.arange(ps[0], dtype=v.dtype)
+            pw = jnp.arange(ps[1], dtype=v.dtype)
+            ys_in = (ys[None, :] >= jnp.floor(y1 + ph[:, None] * rh)) & \
+                    (ys[None, :] < jnp.ceil(y1 + (ph[:, None] + 1) * rh))
+            xs_in = (xs[None, :] >= jnp.floor(x1 + pw[:, None] * rw)) & \
+                    (xs[None, :] < jnp.ceil(x1 + (pw[:, None] + 1) * rw))
+            m = ys_in[:, None, :, None] & xs_in[None, :, None, :]
+            cnt = jnp.maximum(m.sum((2, 3)), 1)            # [ph,pw]
+            blocks = img[cidx]                             # [oc,ph,pw,H,W]
+            val = (blocks * m[None]).sum((3, 4)) / cnt[None]
+            return val                                     # [oc,ph,pw]
+
+        return jax.vmap(one)(jnp.arange(R))
+
+    return apply(f, x, boxes)
+
+
+def prroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
+               samples=4):
+    """Precise RoI pooling (operators/prroi_pool_op.cc): continuous
+    average of the bilinearly-interpolated feature over each bin,
+    computed here by dense sub-sampling (`samples`^2 points per bin — the
+    integral-free approximation; exact closed-form integration is the
+    reference's CUDA path).  boxes_num assigns rois to batch images."""
+    ps = (output_size, output_size) if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(v, rois):
+        B, C, H, W = v.shape
+        R = rois.shape[0]
+        if boxes_num is not None:
+            bn = jnp.asarray(_v(boxes_num))
+            bidx = jnp.repeat(jnp.arange(bn.shape[0]), bn,
+                              total_repeat_length=R).astype(jnp.int32)
+        else:
+            bidx = jnp.zeros((R,), jnp.int32)
+
+        def bilinear(img, y, x_):
+            y0 = jnp.floor(y); x0 = jnp.floor(x_)
+            wy = y - y0; wx = x_ - x0
+
+            def at(yy, xx):
+                ok = (yy >= 0) & (yy < H) & (xx >= 0) & (xx < W)
+                g = img[:, jnp.clip(yy, 0, H - 1).astype(jnp.int32),
+                        jnp.clip(xx, 0, W - 1).astype(jnp.int32)]
+                return jnp.where(ok, g, 0.0)
+
+            return (at(y0, x0) * (1 - wy) * (1 - wx)
+                    + at(y0, x0 + 1) * (1 - wy) * wx
+                    + at(y0 + 1, x0) * wy * (1 - wx)
+                    + at(y0 + 1, x0 + 1) * wy * wx)
+
+        def one(r):
+            roi = rois[r]
+            img = v[bidx[r]]
+            x1, y1, x2, y2 = [roi[i] * spatial_scale for i in range(4)]
+            bh = (y2 - y1) / ps[0]
+            bw = (x2 - x1) / ps[1]
+            ph = jnp.arange(ps[0], dtype=v.dtype)
+            pw = jnp.arange(ps[1], dtype=v.dtype)
+            s = (jnp.arange(samples, dtype=v.dtype) + 0.5) / samples
+            yy = y1 + (ph[:, None] + s[None, :]) * bh   # [ph, s]
+            xx = x1 + (pw[:, None] + s[None, :]) * bw   # [pw, s]
+            g = jax.vmap(lambda y: jax.vmap(
+                lambda x_: bilinear(img, y, x_))(xx.reshape(-1)))(
+                    yy.reshape(-1))
+            # g [ph*s, pw*s, C] -> bins
+            g = g.reshape(ps[0], samples, ps[1], samples, C)
+            return g.mean((1, 3)).transpose(2, 0, 1)
+
+        return jax.vmap(one)(jnp.arange(R))
+
+    return apply(f, x, boxes)
+
+
+def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
+                      rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
+                      rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
+                      rpn_negative_overlap=0.3, use_random=False):
+    """Anchor-GT assignment for RPN training (operators/detection/
+    rpn_target_assign_op.cc, host-side like the reference CPU kernel):
+    label 1 = fg (IoU >= positive_overlap or argmax per gt), 0 = bg
+    (IoU < negative_overlap), -1 = ignore; subsample to batch size.
+    Returns (loc_index, score_index, tgt_label, tgt_bbox)."""
+    an = np.asarray(unwrap(anchors), np.float64).reshape(-1, 4)
+    gt = np.asarray(unwrap(gt_boxes), np.float64).reshape(-1, 4)
+    n = len(an)
+    iou = np.zeros((n, max(len(gt), 1)))
+    for j, g in enumerate(gt):
+        ix = np.maximum(0, np.minimum(an[:, 2], g[2])
+                        - np.maximum(an[:, 0], g[0]))
+        iy = np.maximum(0, np.minimum(an[:, 3], g[3])
+                        - np.maximum(an[:, 1], g[1]))
+        inter = ix * iy
+        ua = ((an[:, 2] - an[:, 0]) * (an[:, 3] - an[:, 1])
+              + (g[2] - g[0]) * (g[3] - g[1]) - inter)
+        iou[:, j] = np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0)
+    best = iou.max(1) if len(gt) else np.zeros(n)
+    argbest = iou.argmax(1) if len(gt) else np.zeros(n, int)
+    label = -np.ones(n, np.int64)
+    label[best < rpn_negative_overlap] = 0
+    if len(gt):
+        label[iou.argmax(0)] = 1          # best anchor per gt
+        label[best >= rpn_positive_overlap] = 1
+    fg = np.where(label == 1)[0]
+    num_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+    if len(fg) > num_fg:
+        drop = fg[num_fg:] if not use_random else np.random.choice(
+            fg, len(fg) - num_fg, replace=False)
+        label[drop] = -1
+        fg = np.where(label == 1)[0]
+    bg = np.where(label == 0)[0]
+    num_bg = rpn_batch_size_per_im - len(fg)
+    if len(bg) > num_bg:
+        drop = bg[num_bg:] if not use_random else np.random.choice(
+            bg, len(bg) - num_bg, replace=False)
+        label[drop] = -1
+        bg = np.where(label == 0)[0]
+    # bbox regression targets for fg anchors (box_coder encode_center_size)
+    tgt = np.zeros((len(fg), 4), np.float32)
+    for k, i in enumerate(fg):
+        g = gt[argbest[i]]
+        aw = an[i, 2] - an[i, 0] + 1.0
+        ah = an[i, 3] - an[i, 1] + 1.0
+        ax = an[i, 0] + aw / 2
+        ay = an[i, 1] + ah / 2
+        gw = g[2] - g[0] + 1.0
+        gh = g[3] - g[1] + 1.0
+        gx = g[0] + gw / 2
+        gy = g[1] + gh / 2
+        tgt[k] = [(gx - ax) / aw, (gy - ay) / ah,
+                  np.log(gw / aw), np.log(gh / ah)]
+    score_index = np.concatenate([fg, bg]).astype(np.int64)
+    tgt_label = np.concatenate(
+        [np.ones(len(fg), np.int64), np.zeros(len(bg), np.int64)])
+    return (Tensor(fg.astype(np.int64)), Tensor(score_index),
+            Tensor(tgt_label), Tensor(tgt))
+
+
+def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
+                             batch_size_per_im=256, fg_fraction=0.25,
+                             fg_thresh=0.5, bg_thresh_hi=0.5,
+                             bg_thresh_lo=0.0, num_classes=81,
+                             use_random=False):
+    """Sample RoIs for the RCNN head (operators/detection/
+    generate_proposal_labels_op.cc, host-side): fg if IoU>=fg_thresh
+    (labeled with its gt class), bg if bg_thresh_lo<=IoU<bg_thresh_hi
+    (label 0).  Returns (rois, labels, bbox_targets)."""
+    rois = np.asarray(unwrap(rpn_rois), np.float64).reshape(-1, 4)
+    gtc = np.asarray(unwrap(gt_classes)).ravel().astype(int)
+    gtb = np.asarray(unwrap(gt_boxes), np.float64).reshape(-1, 4)
+    rois = np.concatenate([rois, gtb], 0)  # gt boxes join the pool
+    n = len(rois)
+    iou = np.zeros((n, max(len(gtb), 1)))
+    for j, g in enumerate(gtb):
+        ix = np.maximum(0, np.minimum(rois[:, 2], g[2])
+                        - np.maximum(rois[:, 0], g[0]))
+        iy = np.maximum(0, np.minimum(rois[:, 3], g[3])
+                        - np.maximum(rois[:, 1], g[1]))
+        inter = ix * iy
+        ua = ((rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1])
+              + (g[2] - g[0]) * (g[3] - g[1]) - inter)
+        iou[:, j] = np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0)
+    best = iou.max(1) if len(gtb) else np.zeros(n)
+    arg = iou.argmax(1) if len(gtb) else np.zeros(n, int)
+    fg = np.where(best >= fg_thresh)[0]
+    bg = np.where((best < bg_thresh_hi) & (best >= bg_thresh_lo))[0]
+    num_fg = min(int(fg_fraction * batch_size_per_im), len(fg))
+    num_bg = min(batch_size_per_im - num_fg, len(bg))
+    if use_random:
+        fg = np.random.permutation(fg)
+        bg = np.random.permutation(bg)
+    fg, bg = fg[:num_fg], bg[:num_bg]
+    keep = np.concatenate([fg, bg])
+    labels = np.concatenate([gtc[arg[fg]], np.zeros(len(bg), int)])
+    tgt = np.zeros((len(keep), 4), np.float32)
+    for k, i in enumerate(fg):
+        g = gtb[arg[i]]
+        r = rois[i]
+        rw = r[2] - r[0] + 1.0
+        rh = r[3] - r[1] + 1.0
+        rx, ry = r[0] + rw / 2, r[1] + rh / 2
+        gw = g[2] - g[0] + 1.0
+        gh = g[3] - g[1] + 1.0
+        gx, gy = g[0] + gw / 2, g[1] + gh / 2
+        tgt[k] = [(gx - rx) / rw, (gy - ry) / rh,
+                  np.log(gw / rw), np.log(gh / rh)]
+    return (Tensor(rois[keep].astype(np.float32)),
+            Tensor(labels.astype(np.int64)), Tensor(tgt))
+
+
+def retinanet_detection_output(bboxes, scores, anchors, im_info=None,
+                               score_threshold=0.05, nms_top_k=1000,
+                               keep_top_k=100, nms_threshold=0.5,
+                               nms_eta=1.0):
+    """RetinaNet post-processing (operators/detection/
+    retinanet_detection_output_op.cc): decode per-level deltas against
+    anchors, threshold scores, NMS per class, keep top-k overall.
+    bboxes/scores/anchors: lists per FPN level ([A,4] deltas [A,C]
+    scores [A,4] anchors).  Host-side like the reference CPU kernel."""
+    all_boxes, all_scores = [], []
+    for dl, sc, an in zip(bboxes, scores, anchors):
+        d = np.asarray(unwrap(dl), np.float64).reshape(-1, 4)
+        s = np.asarray(unwrap(sc), np.float64)
+        a = np.asarray(unwrap(an), np.float64).reshape(-1, 4)
+        aw = a[:, 2] - a[:, 0] + 1.0
+        ah = a[:, 3] - a[:, 1] + 1.0
+        ax = a[:, 0] + aw / 2
+        ay = a[:, 1] + ah / 2
+        cx = d[:, 0] * aw + ax
+        cy = d[:, 1] * ah + ay
+        w = np.exp(np.clip(d[:, 2], -10, 10)) * aw
+        h = np.exp(np.clip(d[:, 3], -10, 10)) * ah
+        boxes = np.stack([cx - w / 2, cy - h / 2,
+                          cx + w / 2, cy + h / 2], 1)
+        all_boxes.append(boxes)
+        all_scores.append(s)
+    boxes = np.concatenate(all_boxes, 0)
+    scores_c = np.concatenate(all_scores, 0)
+    C = scores_c.shape[1]
+    out = []
+    for c in range(C):
+        s = scores_c[:, c]
+        keep = s > score_threshold
+        if not keep.any():
+            continue
+        b, s = boxes[keep], s[keep]
+        order = np.argsort(-s)[:nms_top_k]
+        b, s = b[order], s[order]
+        picked = _greedy_nms_numpy(b, s, nms_threshold)
+        for i in picked:
+            out.append([c, s[i], *b[i]])
+    out = sorted(out, key=lambda r: -r[1])[:keep_top_k]
+    return Tensor(np.asarray(out, np.float32).reshape(-1, 6))
+
+
+def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+              ignore_thresh=0.7, downsample_ratio=32, gt_score=None,
+              use_label_smooth=False):
+    """YOLOv3 training loss (operators/detection/yolov3_loss_op.h):
+    x [B, A*(5+C), H, W] raw head output; gt_box [B,G,4] (cx,cy,w,h in
+    [0,1] image units), gt_label [B,G].  Objectness uses the best-anchor
+    assignment rule; predictions overlapping any gt above ignore_thresh
+    are excluded from the no-object loss."""
+    am = list(anchor_mask)
+    A = len(am)
+
+    def f(xv, gb, gl):
+        B, _, H, W = xv.shape
+        C = class_num
+        p = xv.reshape(B, A, 5 + C, H, W)
+        px, py = jax.nn.sigmoid(p[:, :, 0]), jax.nn.sigmoid(p[:, :, 1])
+        pw, ph = p[:, :, 2], p[:, :, 3]
+        pobj = p[:, :, 4]
+        pcls = p[:, :, 5:]
+        G = gb.shape[1]
+        anc = jnp.asarray(anchors, jnp.float32).reshape(-1, 2)
+        anc_m = anc[jnp.asarray(am)]
+        in_w, in_h = W * downsample_ratio, H * downsample_ratio
+
+        # gt in grid units
+        gx = gb[:, :, 0] * W
+        gy = gb[:, :, 1] * H
+        gw = gb[:, :, 2] * in_w
+        gh = gb[:, :, 3] * in_h
+        gi = jnp.clip(gx.astype(jnp.int32), 0, W - 1)
+        gj = jnp.clip(gy.astype(jnp.int32), 0, H - 1)
+        valid = (gb[:, :, 2] > 0) & (gb[:, :, 3] > 0)
+
+        # best anchor (over the FULL anchor set, reference rule) per gt
+        def wh_iou(w1, h1, w2, h2):
+            inter = jnp.minimum(w1, w2) * jnp.minimum(h1, h2)
+            return inter / (w1 * h1 + w2 * h2 - inter + 1e-9)
+
+        ious_a = wh_iou(gw[..., None], gh[..., None],
+                        anc[:, 0][None, None],
+                        anc[:, 1][None, None])             # [B,G,Atot]
+        best_a = ious_a.argmax(-1)                         # [B,G]
+        # responsible only if best anchor is in this level's mask
+        mask_arr = jnp.asarray(am)
+        resp_slot = (best_a[..., None] == mask_arr[None, None])  # [B,G,A]
+        resp = resp_slot.any(-1) & valid
+
+        obj_tgt = jnp.zeros((B, A, H, W))
+        loss_xy = loss_wh = loss_cls = 0.0
+        bidx = jnp.arange(B)[:, None]
+        slot = resp_slot.argmax(-1)                        # [B,G]
+        # scatter per-gt losses (stop-gradient-free, masked sums)
+        tx = gx - jnp.floor(gx)
+        ty = gy - jnp.floor(gy)
+        tw = jnp.log(jnp.maximum(gw, 1e-9) /
+                     jnp.maximum(anc_m[slot][..., 0], 1e-9))
+        th = jnp.log(jnp.maximum(gh, 1e-9) /
+                     jnp.maximum(anc_m[slot][..., 1], 1e-9))
+        scale = 2.0 - gb[:, :, 2] * gb[:, :, 3]  # small-box upweight
+        px_g = px[bidx, slot, gj, gi]
+        py_g = py[bidx, slot, gj, gi]
+        pw_g = pw[bidx, slot, gj, gi]
+        ph_g = ph[bidx, slot, gj, gi]
+        m = resp.astype(jnp.float32) * scale
+        bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(  # noqa
+            jnp.exp(-jnp.abs(z)))
+        loss_xy = (m * ((px_g - tx) ** 2 + (py_g - ty) ** 2)).sum()
+        loss_wh = (m * ((pw_g - tw) ** 2 + (ph_g - th) ** 2)).sum()
+        cls_logit = pcls[bidx, slot, :, gj, gi]            # [B,G,C]
+        smooth = 1.0 / C if use_label_smooth else 0.0
+        tgt_cls = jax.nn.one_hot(gl, C) * (1 - 2 * smooth) + smooth
+        loss_cls = (resp[..., None] * bce(cls_logit, tgt_cls)).sum()
+        obj_tgt = obj_tgt.at[bidx, slot, gj, gi].max(
+            resp.astype(jnp.float32))
+
+        # ignore mask: predicted boxes with IoU>thresh vs any gt
+        cell_x = (jnp.arange(W)[None, None, None] + px) / W
+        cell_y = (jnp.arange(H)[None, None, :, None] + py) / H
+        bw = jnp.exp(jnp.clip(pw, -10, 10)) * anc_m[:, 0][None, :, None,
+                                                          None] / in_w
+        bh = jnp.exp(jnp.clip(ph, -10, 10)) * anc_m[:, 1][None, :, None,
+                                                          None] / in_h
+
+        def box_iou_xywh(x1, y1, w1, h1, x2, y2, w2, h2):
+            l = jnp.maximum(x1 - w1 / 2, x2 - w2 / 2)   # noqa: E741
+            r = jnp.minimum(x1 + w1 / 2, x2 + w2 / 2)
+            t = jnp.maximum(y1 - h1 / 2, y2 - h2 / 2)
+            b = jnp.minimum(y1 + h1 / 2, y2 + h2 / 2)
+            inter = jnp.maximum(r - l, 0) * jnp.maximum(b - t, 0)
+            return inter / (w1 * h1 + w2 * h2 - inter + 1e-9)
+
+        iou_pg = box_iou_xywh(
+            cell_x[..., None], cell_y[..., None], bw[..., None],
+            bh[..., None],
+            gb[:, None, None, None, :, 0], gb[:, None, None, None, :, 1],
+            gb[:, None, None, None, :, 2], gb[:, None, None, None, :, 3])
+        iou_best = jnp.where(valid[:, None, None, None],
+                             iou_pg, 0.0).max(-1)
+        noobj_ok = (iou_best < ignore_thresh).astype(jnp.float32)
+        loss_obj = (obj_tgt * bce(pobj, jnp.ones_like(pobj))
+                    + (1 - obj_tgt) * noobj_ok
+                    * bce(pobj, jnp.zeros_like(pobj))).sum()
+        return (loss_xy + loss_wh + loss_cls + loss_obj) / B
+
+    return apply(f, x, gt_box, gt_label)

@@ -130,6 +130,37 @@ class TestInt8Export:
             assert v["int8_weight"].dtype == np.int8
             assert v["weight_scale"] > 0
 
+    def test_packed_int8_matches_served_numerics(self, tmp_path):
+        # round-3 advisor finding: packing used the stale training-time
+        # weight_scale buffer while the export trace fake-quantized with
+        # the current abs-max — after a post-forward weight update the
+        # payload would not reproduce the served numerics
+        paddle.seed(2)
+        net = MLP()
+        qat = QAT()
+        qat.quantize(net)
+        net.train()
+        net(paddle.to_tensor(np.random.RandomState(0)
+                             .randn(4, 8).astype(np.float32)))
+        # simulate an optimizer step AFTER the last training forward
+        w = net.fc1.inner.weight
+        w.set_value(np.asarray(w.numpy()) * 1.7)
+        prefix = str(tmp_path / "qmodel2")
+        qat.save_quantized_model(
+            net, prefix, example_inputs=[np.zeros((4, 8), np.float32)])
+        pred = load_quantized_predictor(prefix)
+        rec = pred.quant_params["fc1"]
+        qmax = 2 ** (rec["bits"] - 1) - 1
+        dq = rec["int8_weight"].astype(np.float32) * \
+            (max(rec["weight_scale"], 1e-8) / qmax)
+        # dequantized payload must equal the fake-quantized weight the
+        # export trace baked in (i.e. current abs-max scale, not stale)
+        wq = np.asarray(net.fc1.inner.weight.numpy())
+        scale = np.max(np.abs(wq))
+        step = max(scale, 1e-8) / qmax
+        expect = np.clip(np.round(wq / step), -qmax, qmax) * step
+        np.testing.assert_allclose(dq, expect, rtol=1e-6, atol=1e-7)
+
     def test_conv_qat_smoke(self, tmp_path):
         net = ConvNet()
         QAT().quantize(net)
