@@ -1309,6 +1309,7 @@ def ctc_align(ids, input_length, blank=0, merge_repeated=True, name=None):
     then drop blanks; output packed left, zero-padded, plus new lens."""
     def f(v, ln):
         B, T = v.shape
+        ln = ln.reshape(-1)  # accept [B] or the paddle-standard [B,1]
         t = jnp.arange(T)[None, :]
         valid = t < ln[:, None]
         if merge_repeated:
@@ -1374,15 +1375,17 @@ def spp(x, pyramid_height=3, pool_type="max", name=None):
 
 
 def _adaptive_pool2d_impl(v, bins, pool_type):
+    # floor-start / ceil-end bins — the same convention as
+    # adaptive_avg_pool2d above and the reference spp_op.h
+    # (kernel = ceil(dim/bins)), so non-divisible sizes agree
     B, C, H, W = v.shape
-    hs = [int(np.floor(i * H / bins)) for i in range(bins)] + [H]
-    ws = [int(np.floor(i * W / bins)) for i in range(bins)] + [W]
     rows = []
     for i in range(bins):
+        h0, h1 = (i * H) // bins, -(-((i + 1) * H) // bins)
         cols = []
         for j in range(bins):
-            cell = v[:, :, hs[i]:max(hs[i + 1], hs[i] + 1),
-                     ws[j]:max(ws[j + 1], ws[j] + 1)]
+            w0, w1 = (j * W) // bins, -(-((j + 1) * W) // bins)
+            cell = v[:, :, h0:h1, w0:w1]
             red = cell.max((2, 3)) if pool_type == "max" else cell.mean((2, 3))
             cols.append(red)
         rows.append(jnp.stack(cols, -1))

@@ -127,6 +127,19 @@ class Optimizer:
 
     def minimize(self, loss, startup_program=None, parameters=None,
                  no_grad_set=None):
+        from ..static.program import Variable, collect_params
+
+        if isinstance(loss, Variable):
+            # static-graph capture: register the train objective on the
+            # current main Program; Executor.run performs the jitted
+            # value_and_grad + update (static/program.py train_step)
+            from ..static import default_main_program
+
+            prog = default_main_program()
+            prog._train = (loss, self)
+            if not self._parameter_list:
+                self._parameter_list = collect_params([loss])
+            return None, []
         loss.backward()
         self.step()
         return None, [(p, p.grad) for p in (self._parameter_list or [])]

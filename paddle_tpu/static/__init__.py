@@ -38,23 +38,30 @@ def in_static_mode() -> bool:
 
 
 class Program:
-    """A deferred computation: body callables appended under program_guard.
-    Minimal emulation of fluid framework.py Program:4094."""
+    """A captured computation (fluid framework.py Program:4094): ops on
+    static.data() Variables record into an expression DAG (see
+    program.py); `_train` holds the (loss, optimizer) a `minimize` under
+    this program registered; Executor.run evaluates under jax.jit."""
 
     def __init__(self):
-        self._builders = []  # callables executed by Executor.run
+        self._builders = []  # legacy: callables executed by Executor.run
+        self._train = None   # (loss Variable, Optimizer) from minimize
+        self._jit_cache = {}
         self.random_seed = 0
 
     def clone(self, for_test=False):
         p = Program()
         p._builders = list(self._builders)
+        if not for_test:
+            p._train = self._train
         return p
 
     def global_block(self):
         return self
 
     def __repr__(self):
-        return f"Program(num_builders={len(self._builders)})"
+        return (f"Program(train={self._train is not None}, "
+                f"num_builders={len(self._builders)})")
 
 
 _default_main = Program()
@@ -83,34 +90,50 @@ def program_guard(main_program, startup_program=None):
 
 
 def data(name, shape, dtype="float32", lod_level=0):
-    raise NotImplementedError(
-        "Static placeholder graphs are not part of the TPU-native design: "
-        "wrap your computation in a function and use "
-        "paddle_tpu.jit.to_static / Executor.run(fn, feed=...) instead "
-        "(SURVEY.md §7: tracing is the execution model).")
+    """Declare a feed placeholder (fluid.data / framework.py Variable:938):
+    returns a symbolic Variable; any op applied to it is captured into the
+    current Program's expression DAG instead of executing (program.py)."""
+    from .program import Variable
+
+    return Variable(name=name, shape=shape, dtype=dtype)
 
 
 class Executor:
-    """Minimal Executor parity: runs a python callable over feeds, jitted.
-    Reference: fluid/executor.py Executor:475/run:916."""
+    """Executor parity (fluid/executor.py Executor:475 / run:916): runs a
+    captured Program (fetch evaluation and minimize-training under
+    jax.jit), or a plain python callable over feeds."""
 
     def __init__(self, place=None):
         self.place = place
         self._cache = {}
 
     def run(self, program=None, feed=None, fetch_list=None, fn=None, **kw):
-        if fn is None and callable(program):
+        from .program import evaluate, train_step
+
+        if fn is None and callable(program) and not isinstance(
+                program, (Program, CompiledProgram)):
             fn = program
-        if fn is None:
-            raise NotImplementedError(
-                "Executor.run requires a callable (the traced-step model); "
-                "ProgramDesc interpretation does not exist on TPU")
+        if fn is not None:
+            feed = feed or {}
+            out = fn(**{k: (v if isinstance(v, Tensor) else Tensor(v))
+                        for k, v in feed.items()})
+            if fetch_list:
+                return [out[k] if isinstance(out, dict) else out
+                        for k in fetch_list]
+            return out
+        prog = program if program is not None else default_main_program()
+        if isinstance(prog, CompiledProgram):
+            prog = prog.program
+        if not isinstance(prog, Program):
+            raise TypeError(f"cannot run {type(prog).__name__}")
         feed = feed or {}
-        out = fn(**{k: (v if isinstance(v, Tensor) else Tensor(v))
-                    for k, v in feed.items()})
-        if fetch_list:
-            return [out[k] if isinstance(out, dict) else out for k in fetch_list]
-        return out
+        if prog._train is not None:
+            loss_var, opt = prog._train
+            return train_step(loss_var, opt, feed, fetch_list,
+                              prog._jit_cache)
+        if not fetch_list:
+            return []  # e.g. exe.run(startup_program): params are eager
+        return evaluate(list(fetch_list), feed, jit_cache=prog._jit_cache)
 
 
 @contextlib.contextmanager

@@ -1,0 +1,291 @@
+"""Program capture — the fluid static-graph workflow on a tracing core.
+
+Reference parity: python/paddle/fluid/framework.py (Program:4094,
+Variable:938, `fluid.data`) + executor.py (Executor.run:916).
+
+TPU-native design: the reference builds an op-desc graph that a C++
+interpreter walks.  Here `static.data()` returns a symbolic
+:class:`Variable`, and the ONE eager dispatch point (`tensor.apply`)
+defers any op touching a Variable into an expression DAG instead of
+executing it.  `Executor.run(program, feed, fetch_list)` evaluates the
+DAG under `jax.jit` — so a classic
+``program_guard -> data -> layers -> minimize -> run`` fluid script
+compiles into exactly the same XLA program a `to_static` rewrite would
+produce.  Real `nn.Layer` parameters stay eager Tensors: trainable ones
+become differentiable jit inputs, everything else is baked constant.
+
+Deliberate limits (documented divergence, README "static graph" section):
+multi-output deferred ops and data-dependent python control flow inside a
+program_guard block are not capturable — use `to_static` for those.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..tensor import Tensor, register_deferred_hook, unwrap
+
+__all__ = ["Variable", "evaluate", "collect_params"]
+
+
+class Variable:
+    """A node in the captured expression DAG: a feed leaf (`fn is None`)
+    or a deferred op application."""
+
+    def __init__(self, name=None, shape=None, dtype="float32", fn=None,
+                 args=None, kwargs=None):
+        self.name = name
+        self._shape = list(shape) if shape is not None else None
+        self._dtype = dtype
+        self._fn = fn
+        self._args = args or ()
+        self._kwargs = kwargs or {}
+        self.stop_gradient = False
+
+    # -- graph structure ---------------------------------------------------
+    def leaves(self, acc=None, seen=None):
+        acc = acc if acc is not None else []
+        seen = seen if seen is not None else set()
+        if id(self) in seen:
+            return acc
+        seen.add(id(self))
+        if self._fn is None:
+            acc.append(self)
+        for a in self._args:
+            if isinstance(a, Variable):
+                a.leaves(acc, seen)
+        return acc
+
+    def tensors(self, acc=None, seen=None):
+        """Eager Tensor inputs captured in the DAG (layer parameters)."""
+        acc = acc if acc is not None else []
+        seen = seen if seen is not None else set()
+        if id(self) in seen:
+            return acc
+        seen.add(id(self))
+        for a in self._args:
+            if isinstance(a, Variable):
+                a.tensors(acc, seen)
+            elif isinstance(a, Tensor) and not any(a is t for t in acc):
+                acc.append(a)
+        return acc
+
+    # -- Tensor-like surface ----------------------------------------------
+    @property
+    def shape(self):
+        if self._shape is None:
+            self._shape = list(self._abstract().shape)
+        return self._shape
+
+    @property
+    def dtype(self):
+        if self._fn is not None and self._dtype is None:
+            self._dtype = str(self._abstract().dtype)
+        return self._dtype
+
+    def _abstract(self):
+        """Shape/dtype inference by jax.eval_shape over the DAG (None
+        feed dims evaluated as 1)."""
+        def run(v, memo):
+            if id(v) in memo:
+                return memo[id(v)]
+            if v._fn is None:
+                out = jax.ShapeDtypeStruct(
+                    tuple(1 if (d is None or d == -1) else int(d)
+                          for d in (v._shape or ())), jnp.dtype(v._dtype))
+            else:
+                args = [run(a, memo) if isinstance(a, Variable)
+                        else unwrap(a) if isinstance(a, Tensor) else a
+                        for a in v._args]
+                out = jax.eval_shape(
+                    lambda *xs: v._fn(*xs, **v._kwargs), *args)
+            memo[id(v)] = out
+            return out
+
+        return run(self, {})
+
+    def __repr__(self):
+        if self._fn is None:
+            return f"Variable(name={self.name!r}, shape={self._shape})"
+        return f"Variable(op={getattr(self._fn, '__name__', self._fn)})"
+
+    # arithmetic routes back through tensor_ops -> apply -> deferred
+    def _op(self, name, *others):
+        from .. import tensor_ops as T
+
+        return getattr(T, name)(self, *others)
+
+    def __add__(self, o):
+        return self._op("add", o)
+
+    def __radd__(self, o):
+        return self._op("add", o)
+
+    def __sub__(self, o):
+        return self._op("subtract", o)
+
+    def __rsub__(self, o):
+        from .. import tensor_ops as T
+
+        return T.subtract(o, self)
+
+    def __mul__(self, o):
+        return self._op("multiply", o)
+
+    def __rmul__(self, o):
+        return self._op("multiply", o)
+
+    def __truediv__(self, o):
+        return self._op("divide", o)
+
+    def __pow__(self, o):
+        return self._op("pow", o)
+
+    def __matmul__(self, o):
+        return self._op("matmul", o)
+
+    def __neg__(self):
+        return self._op("scale", -1.0)
+
+    def __getattr__(self, item):
+        # tensor methods (v.mean(), v.reshape(...)) resolve to the
+        # tensor_ops function of the same name, keeping ONE op surface
+        from .. import tensor_ops as T
+
+        f = getattr(T, item, None)
+        if f is None or item.startswith("_"):
+            raise AttributeError(item)
+
+        def method(*a, **k):
+            return f(self, *a, **k)
+
+        return method
+
+
+# -- apply() hook ----------------------------------------------------------
+
+def _is_deferred(args, kwargs):
+    return any(isinstance(a, Variable) for a in args)
+
+
+def _build(fn, args, kwargs, multi):
+    if multi:
+        raise NotImplementedError(
+            "multi-output ops cannot be captured into a static Program; "
+            "wrap this computation with paddle.jit.to_static instead "
+            "(README: static-graph compatibility)")
+    return Variable(fn=fn, args=args, kwargs=kwargs)
+
+
+register_deferred_hook(_is_deferred, _build)
+
+
+# -- evaluation ------------------------------------------------------------
+
+def collect_params(fetch_vars):
+    """Trainable eager Tensors captured by the DAG (stop_gradient False)."""
+    params = []
+    for v in fetch_vars:
+        for t in v.tensors():
+            if not t.stop_gradient and not any(t is p for p in params):
+                params.append(t)
+    return params
+
+
+def _eval_fn(fetch_vars, leaf_names, params):
+    """A pure function (feed_values, param_values) -> fetch values, ready
+    for jax.jit / jax.grad."""
+    pid = {id(p): i for i, p in enumerate(params)}
+
+    def f(feed_vals, param_vals):
+        memo = {}
+
+        def run(v):
+            if id(v) in memo:
+                return memo[id(v)]
+            if v._fn is None:
+                out = feed_vals[leaf_names.index(v.name)]
+            else:
+                args = [run(a) if isinstance(a, Variable)
+                        else (param_vals[pid[id(a)]] if id(a) in pid
+                              else unwrap(a))
+                        for a in v._args]
+                out = v._fn(*args, **v._kwargs)
+            memo[id(v)] = out
+            return out
+
+        return [run(v) for v in fetch_vars]
+
+    return f
+
+
+def evaluate(fetch_vars, feed, params=None, jit_cache=None):
+    """Evaluate DAG nodes under jax.jit.  feed: {name: array}."""
+    fetch_vars = [v for v in fetch_vars]
+    leaves = []
+    for v in fetch_vars:
+        for leaf in v.leaves():
+            if leaf.name not in [x.name for x in leaves]:
+                leaves.append(leaf)
+    leaf_names = [x.name for x in leaves]
+    missing = [n for n in leaf_names if n not in (feed or {})]
+    if missing:
+        raise ValueError(f"feed is missing static.data inputs: {missing}")
+    params = params if params is not None else collect_params(fetch_vars)
+    feed_vals = [jnp.asarray(unwrap(feed[n])) for n in leaf_names]
+    param_vals = [unwrap(p) for p in params]
+    f = _eval_fn(fetch_vars, leaf_names, params)
+    key = (tuple(id(v) for v in fetch_vars),
+           tuple((v.shape, str(v.dtype)) for v in feed_vals))
+    if jit_cache is not None:
+        jf = jit_cache.get(key)
+        if jf is None:
+            jf = jit_cache[key] = jax.jit(f)
+    else:
+        jf = jax.jit(f)
+    outs = jf(feed_vals, param_vals)
+    return [np.asarray(o) for o in outs]
+
+
+def train_step(loss_var, optimizer, feed, fetch_list, jit_cache=None):
+    """One captured-program training step: value_and_grad of the loss wrt
+    the DAG's trainable parameters in the SAME jitted forward that
+    evaluates fetch_list (so fetches are pre-update values, like the
+    reference Executor), then the optimizer's eager update."""
+    fetch_list = list(fetch_list or [loss_var])
+    all_vars = [loss_var] + fetch_list
+    params = collect_params(all_vars)
+    leaves = []
+    for v in all_vars:
+        for leaf in v.leaves():
+            if leaf.name not in [x.name for x in leaves]:
+                leaves.append(leaf)
+    leaf_names = [x.name for x in leaves]
+    missing = [n for n in leaf_names if n not in (feed or {})]
+    if missing:
+        raise ValueError(f"feed is missing static.data inputs: {missing}")
+    feed_vals = [jnp.asarray(unwrap(feed[n])) for n in leaf_names]
+    f = _eval_fn(all_vars, leaf_names, params)
+
+    def loss_of(param_vals, feed_vals):
+        outs = f(feed_vals, param_vals)
+        return outs[0].reshape(()), outs[1:]
+
+    key = ("train", tuple(id(v) for v in all_vars),
+           tuple((v.shape, str(v.dtype)) for v in feed_vals))
+    if jit_cache is not None:
+        jf = jit_cache.get(key)
+        if jf is None:
+            jf = jit_cache[key] = jax.jit(
+                jax.value_and_grad(loss_of, has_aux=True))
+    else:
+        jf = jax.jit(jax.value_and_grad(loss_of, has_aux=True))
+    (loss, fetches), grads = jf([unwrap(p) for p in params], feed_vals)
+    del loss
+    for p, g in zip(params, grads):
+        p.grad = Tensor(g)
+    optimizer.step()
+    optimizer.clear_grad()
+    return [np.asarray(o) for o in fetches]

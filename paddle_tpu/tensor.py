@@ -318,6 +318,17 @@ def _maybe_check_nan_inf(fn, out):
                     f"(FLAGS_check_nan_inf is set); shape={arr.shape}")
 
 
+# program-capture hook (paddle.static): set by static/__init__ to a
+# (is_deferred(args, kwargs), build(fn, args, kwargs, multi)) pair so ops
+# over static Variables record into the expression DAG instead of running
+_deferred_hook = None
+
+
+def register_deferred_hook(is_deferred, build):
+    global _deferred_hook
+    _deferred_hook = (is_deferred, build)
+
+
 def apply(fn, *args, _multi_out: bool = False, **kwargs):
     """Run pure jax function `fn` over (possibly Tensor) args.
 
@@ -325,6 +336,8 @@ def apply(fn, *args, _multi_out: bool = False, **kwargs):
     When the tape is live and any input requires grad, use jax.vjp so the
     backward closure is captured (one forward pass total).
     """
+    if _deferred_hook is not None and _deferred_hook[0](args, kwargs):
+        return _deferred_hook[1](fn, args, kwargs, _multi_out)
     jvals = [unwrap(a) for a in args]
     tensor_args = [a for a in args if isinstance(a, Tensor)]
     any_tracer = any(_is_tracer(v) for v in jvals)

@@ -789,6 +789,21 @@ def box_decoder_and_assign(prior_box, prior_box_var, target_box,
 # op-registry tail (COVERAGE.md round-4)
 # --------------------------------------------------------------------------
 
+def _pairwise_iou_np(a, b):
+    """[N,4] x [M,4] xyxy -> [N,M] IoU, vectorized numpy (host-side
+    assignment ops share this instead of re-deriving the formula)."""
+    a = np.asarray(a, np.float64).reshape(-1, 4)
+    b = np.asarray(b, np.float64).reshape(-1, 4)
+    ix = np.maximum(0.0, np.minimum(a[:, None, 2], b[None, :, 2])
+                    - np.maximum(a[:, None, 0], b[None, :, 0]))
+    iy = np.maximum(0.0, np.minimum(a[:, None, 3], b[None, :, 3])
+                    - np.maximum(a[:, None, 1], b[None, :, 1]))
+    inter = ix * iy
+    area_a = (a[:, 2] - a[:, 0]) * (a[:, 3] - a[:, 1])
+    area_b = (b[:, 2] - b[:, 0]) * (b[:, 3] - b[:, 1])
+    ua = area_a[:, None] + area_b[None, :] - inter
+    return np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0.0)
+
 def affine_channel(x, scale, bias, data_layout="NCHW"):
     """Per-channel x*scale+bias (operators/affine_channel_op.cc)."""
     def f(v, s, b):
@@ -1049,16 +1064,7 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
     an = np.asarray(unwrap(anchors), np.float64).reshape(-1, 4)
     gt = np.asarray(unwrap(gt_boxes), np.float64).reshape(-1, 4)
     n = len(an)
-    iou = np.zeros((n, max(len(gt), 1)))
-    for j, g in enumerate(gt):
-        ix = np.maximum(0, np.minimum(an[:, 2], g[2])
-                        - np.maximum(an[:, 0], g[0]))
-        iy = np.maximum(0, np.minimum(an[:, 3], g[3])
-                        - np.maximum(an[:, 1], g[1]))
-        inter = ix * iy
-        ua = ((an[:, 2] - an[:, 0]) * (an[:, 3] - an[:, 1])
-              + (g[2] - g[0]) * (g[3] - g[1]) - inter)
-        iou[:, j] = np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0)
+    iou = _pairwise_iou_np(an, gt) if len(gt) else np.zeros((n, 1))
     best = iou.max(1) if len(gt) else np.zeros(n)
     argbest = iou.argmax(1) if len(gt) else np.zeros(n, int)
     label = -np.ones(n, np.int64)
@@ -1115,16 +1121,7 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
     gtb = np.asarray(unwrap(gt_boxes), np.float64).reshape(-1, 4)
     rois = np.concatenate([rois, gtb], 0)  # gt boxes join the pool
     n = len(rois)
-    iou = np.zeros((n, max(len(gtb), 1)))
-    for j, g in enumerate(gtb):
-        ix = np.maximum(0, np.minimum(rois[:, 2], g[2])
-                        - np.maximum(rois[:, 0], g[0]))
-        iy = np.maximum(0, np.minimum(rois[:, 3], g[3])
-                        - np.maximum(rois[:, 1], g[1]))
-        inter = ix * iy
-        ua = ((rois[:, 2] - rois[:, 0]) * (rois[:, 3] - rois[:, 1])
-              + (g[2] - g[0]) * (g[3] - g[1]) - inter)
-        iou[:, j] = np.where(ua > 0, inter / np.maximum(ua, 1e-12), 0)
+    iou = _pairwise_iou_np(rois, gtb) if len(gtb) else np.zeros((n, 1))
     best = iou.max(1) if len(gtb) else np.zeros(n)
     arg = iou.argmax(1) if len(gtb) else np.zeros(n, int)
     fg = np.where(best >= fg_thresh)[0]

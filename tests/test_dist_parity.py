@@ -1,0 +1,148 @@
+"""TestDistBase analog — REAL multi-process training parity.
+
+Reference contract: fluid/tests/unittests/test_dist_base.py:652,765-831 —
+spawn separate trainer processes, train the same model data-parallel, and
+assert per-step losses match a single-process run within delta.  This is
+the only test that exercises init_parallel_env →
+jax.distributed.initialize → cross-process eager collectives end to end
+(distributed/parallel.py:39-44); the 8-virtual-device mesh tests cannot,
+because they live in one process.
+"""
+import json
+import os
+import socket
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TRAINER = textwrap.dedent("""
+    import json
+    import os
+    import sys
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import paddle_tpu as paddle
+    import paddle_tpu.distributed as dist
+
+    env = dist.init_parallel_env()      # -> jax.distributed.initialize
+    rank, world = env.rank, env.world_size
+    assert jax.process_count() == world, (jax.process_count(), world)
+    assert jax.device_count() == world  # one cpu device per process
+
+    paddle.seed(0)                      # identical init on every rank
+    rs = np.random.RandomState(42)
+    X = rs.randn(32, 8).astype(np.float32)
+    W = rs.randn(8, 1).astype(np.float32)
+    Y = X @ W + 0.1 * rs.randn(32, 1).astype(np.float32)
+
+    model = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    losses = []
+    for step in range(5):
+        xb, yb = X[rank::world], Y[rank::world]
+        out = model(paddle.to_tensor(xb))
+        loss = ((out - paddle.to_tensor(yb)) ** 2).mean()
+        loss.backward()
+        for p in model.parameters():    # DP grad sync (Reducer analog)
+            if p.grad is not None:
+                dist.all_reduce(p.grad)
+                p.grad.set_value(np.asarray(p.grad.numpy()) / world)
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.numpy())))
+    print("LOSSES_RANK%d " % rank + json.dumps(losses), flush=True)
+""")
+
+
+def _single_process_reference():
+    """The same 5 steps on the full batch in-process."""
+    import jax
+
+    import paddle_tpu as paddle
+
+    paddle.seed(0)
+    rs = np.random.RandomState(42)
+    X = rs.randn(32, 8).astype(np.float32)
+    W = rs.randn(8, 1).astype(np.float32)
+    Y = X @ W + 0.1 * rs.randn(32, 1).astype(np.float32)
+    model = paddle.nn.Linear(8, 1)
+    opt = paddle.optimizer.SGD(learning_rate=0.1,
+                               parameters=model.parameters())
+    losses = []
+    for step in range(5):
+        out = model(paddle.to_tensor(X))
+        loss = ((out - paddle.to_tensor(Y)) ** 2).mean()
+        loss.backward()
+        opt.step()
+        opt.clear_grad()
+        losses.append(float(np.asarray(loss.numpy())))
+    return losses
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    p = s.getsockname()[1]
+    s.close()
+    return p
+
+
+def test_two_process_dp_loss_parity(tmp_path):
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER)
+    port = _free_port()
+    eps = [f"127.0.0.1:{port}", f"127.0.0.1:{port + 1}"]
+    procs = []
+    for rank in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+        env.pop("XLA_FLAGS", None)             # exactly 1 device/process
+        env.update({
+            "JAX_PLATFORMS": "cpu",
+            "PYTHONPATH": REPO,
+            "PADDLE_TRAINER_ID": str(rank),
+            "PADDLE_TRAINERS_NUM": "2",
+            "PADDLE_TRAINER_ENDPOINTS": ",".join(eps),
+            "PADDLE_CURRENT_ENDPOINT": eps[rank],
+            "PADDLE_MASTER": eps[0],
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            pytest.fail("trainer process hung (coordination service?)")
+        assert p.returncode == 0, f"trainer failed:\n{err[-2000:]}"
+        outs.append(out)
+
+    per_rank = {}
+    for out in outs:
+        for line in out.splitlines():
+            if line.startswith("LOSSES_RANK"):
+                rank = int(line[len("LOSSES_RANK")])
+                per_rank[rank] = json.loads(line.split(" ", 1)[1])
+    assert set(per_rank) == {0, 1}, f"missing rank output: {outs}"
+
+    ref = _single_process_reference()
+    # full-batch MSE == mean of the two stride-shard MSEs (equal shards),
+    # and averaged grads make the updates identical -> per-step parity
+    for step in range(5):
+        dist_loss = 0.5 * (per_rank[0][step] + per_rank[1][step])
+        assert abs(dist_loss - ref[step]) < 1e-4, (
+            step, dist_loss, ref[step], per_rank)
+    # training actually progressed
+    assert ref[-1] < ref[0]

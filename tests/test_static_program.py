@@ -1,0 +1,110 @@
+"""Static-graph program capture (fluid framework.py Program:4094 +
+executor.py run:916): the classic program_guard -> data -> layers ->
+minimize -> Executor.run workflow must train, on the tracing core."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu import static
+
+
+class TestProgramCapture:
+    def test_data_returns_symbolic_variable(self):
+        x = static.data("x", [None, 4], "float32")
+        assert x.name == "x"
+        y = x * 2.0 + 1.0
+        from paddle_tpu.static.program import Variable
+
+        assert isinstance(y, Variable)
+
+    def test_fetch_evaluation(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 3], "float32")
+            y = (x * 2.0).sum()
+        exe = static.Executor()
+        out, = exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+                       fetch_list=[y])
+        assert float(out) == 12.0
+
+    def test_layer_params_are_captured(self):
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 4], "float32")
+            lin = paddle.nn.Linear(4, 2)
+            out = lin(x)
+        exe = static.Executor()
+        r, = exe.run(main, feed={"x": np.ones((3, 4), np.float32)},
+                     fetch_list=[out])
+        expect = np.asarray(lin(paddle.to_tensor(
+            np.ones((3, 4), np.float32))).numpy())
+        np.testing.assert_allclose(r, expect, rtol=1e-5)
+
+    def test_classic_fluid_training_loop(self):
+        """The reference book pattern (tests/book/test_fit_a_line.py):
+        program_guard + data + minimize + Executor.run loop converges."""
+        paddle.seed(0)
+        rs = np.random.RandomState(0)
+        X = rs.randn(64, 4).astype(np.float32)
+        W = np.array([[1.0], [2.0], [-1.0], [0.5]], np.float32)
+        Y = X @ W
+
+        main = static.Program()
+        startup = static.Program()
+        with static.program_guard(main, startup):
+            x = static.data("x", [None, 4], "float32")
+            y = static.data("y", [None, 1], "float32")
+            lin = paddle.nn.Linear(4, 1)
+            pred = lin(x)
+            cost = ((pred - y) ** 2).mean()
+            opt = paddle.optimizer.SGD(learning_rate=0.1)
+            opt.minimize(cost)
+
+        exe = static.Executor()
+        exe.run(startup)
+        losses = []
+        for step in range(60):
+            loss, = exe.run(main, feed={"x": X, "y": Y},
+                            fetch_list=[cost])
+            losses.append(float(loss))
+        assert losses[-1] < 1e-3, losses[-5:]
+        assert losses[-1] < losses[0] * 0.01
+        # learned weights approach the generator
+        w = np.asarray(lin.weight.numpy()).reshape(-1)
+        np.testing.assert_allclose(w, W.reshape(-1), atol=0.05)
+
+    def test_eval_clone_for_test(self):
+        paddle.seed(0)
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            lin = paddle.nn.Linear(2, 1)
+            pred = lin(x)
+            cost = (pred ** 2).mean()
+            paddle.optimizer.SGD(learning_rate=0.1).minimize(cost)
+        test_prog = main.clone(for_test=True)
+        exe = static.Executor()
+        # clone(for_test) must NOT train: params unchanged after run
+        before = np.asarray(lin.weight.numpy()).copy()
+        exe.run(test_prog, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[cost])
+        np.testing.assert_allclose(np.asarray(lin.weight.numpy()), before)
+        # the train program DOES update
+        exe.run(main, feed={"x": np.ones((2, 2), np.float32)},
+                fetch_list=[cost])
+        assert not np.allclose(np.asarray(lin.weight.numpy()), before)
+
+    def test_missing_feed_is_loud(self):
+        main = static.Program()
+        with static.program_guard(main):
+            x = static.data("x", [None, 2], "float32")
+            out = x.sum()
+        with pytest.raises(ValueError, match="missing"):
+            static.Executor().run(main, feed={}, fetch_list=[out])
+
+    def test_shape_inference(self):
+        x = static.data("x", [8, 4], "float32")
+        lin = paddle.nn.Linear(4, 3)
+        out = lin(x)
+        assert out.shape == [8, 3]

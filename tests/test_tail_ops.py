@@ -164,6 +164,10 @@ class TestFunctionalTail:
         np.testing.assert_allclose(np.asarray(out.numpy())[0, :3],
                                    [1, 2, 3])
         assert int(np.asarray(lens.numpy())[0]) == 3
+        # the paddle-standard [B,1] length layout must work too
+        out2, lens2 = F.ctc_align(T(ids), T(np.array([[6]])), blank=0)
+        np.testing.assert_allclose(np.asarray(out2.numpy()),
+                                   np.asarray(out.numpy()))
 
     def test_center_loss_updates_centers(self):
         x = np.array([[1.0, 1.0]], np.float32)
@@ -184,6 +188,15 @@ class TestFunctionalTail:
         x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
         out = np.asarray(F.spp(T(x), pyramid_height=2).numpy())
         assert out.shape == (2, 3 * (1 + 4))
+
+    def test_spp_non_divisible_matches_ceil_kernel(self):
+        # reference spp_op.h: kernel=ceil(H/bins) -> bin (0,0) of a 5x5
+        # covers rows/cols [0:3] (floor-start/ceil-end convention)
+        x = np.arange(25, dtype=np.float32).reshape(1, 1, 5, 5)
+        out = np.asarray(F.spp(T(x), pyramid_height=2).numpy())[0]
+        # level 0: global max 24; level 1 bins: [0:3,0:3]->12,
+        # [0:3,2:5]->14, [2:5,0:3]->22, [2:5,2:5]->24
+        np.testing.assert_allclose(out, [24, 12, 14, 22, 24])
 
     def test_max_unpool2d_roundtrip(self):
         x = np.array([[[[5.0, 6.0], [7.0, 8.0]]]], np.float32)
