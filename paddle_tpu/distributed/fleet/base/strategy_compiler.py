@@ -85,8 +85,10 @@ class StrategyCompiler:
         ctx.pipeline_program = program
         # microbatching happens INSIDE the pipe (fill-drain over M), so
         # k_steps stays 1 — accumulate_steps is not an outer grad-merge here
+        schedule = cfg.get("schedule_mode", "F-then-B")
         ctx.loss_fn = pipeline_loss_fn(
-            program, ctx.mesh, M, axis_name=ctx.pipeline_axis)
+            program, ctx.mesh, M, axis_name=ctx.pipeline_axis,
+            schedule=schedule)
 
     # ------------------------------------------------------------------
     def build_train_step(self, ctx: TrainStepContext, params,
@@ -156,18 +158,22 @@ class StrategyCompiler:
             return state
 
         # -- fp16_allreduce: explicit bf16 psum over the dp axis ----------
+        # dp x mp meshes are supported (round-3 next-step #10): shard_map
+        # is MANUAL over the dp axis only (axis_names={dp}), so the bf16
+        # psum rides dp while tensor-parallel axes stay GSPMD-auto and the
+        # model's own mp collectives/shardings compose unchanged.
         comm_dtype = ctx.grad_comm_dtype
         fp16_sm = (
             comm_dtype is not None and mesh is not None
+            and batch_axis in mesh.shape
             and ctx.pipeline_program is None and ctx.pipeline_degree == 1
-            and stage < 2
-            and all(mesh.shape[a] == 1 for a in mesh.axis_names
-                    if a != batch_axis))
+            and stage < 2)
         if comm_dtype is not None and not fp16_sm:
             warnings.warn(
-                "fp16_allreduce only takes effect for pure data-parallel "
-                "meshes with ZeRO stage < 2 (the explicit bf16 psum path); "
-                "flag ignored for this configuration")
+                "fp16_allreduce only takes effect on meshes with a "
+                f"'{batch_axis}' axis, without a pipeline program, and "
+                "with ZeRO stage < 2 (the explicit bf16 psum path); flag "
+                "ignored for this configuration")
 
         k = ctx.k_steps
 
@@ -178,6 +184,18 @@ class StrategyCompiler:
             # reduction loss should not enable fp16_allreduce).
             dp_size = mesh.shape[batch_axis]
             p_repl = jax.tree.map(lambda _: P(), params)
+            # dp x mp: manual over dp only, mp stays GSPMD-auto so TP
+            # shardings compose.  XLA's CPU AllReducePromotion pass
+            # CHECK-fails cloning a bf16 all-reduce emitted under
+            # partial-manual lowering (and would promote the wire to f32
+            # anyway), so the half-precision wire is TPU/GPU-only there;
+            # pure-dp keeps the full-manual bf16 path on every backend.
+            partial_manual = any(mesh.shape[a] > 1
+                                 for a in mesh.axis_names
+                                 if a != batch_axis)
+            wire_dtype = comm_dtype
+            if partial_manual and jax.default_backend() == "cpu":
+                wire_dtype = None
 
             def loss_grads(params, batch, scale):
                 b_spec = jax.tree.map(lambda _: P(batch_axis), batch)
@@ -199,15 +217,22 @@ class StrategyCompiler:
                     loss, grads = f(p, b)
                     # the wire format: bf16 across the ICI, halving
                     # collective bytes (fp16_allreduce_optimizer.py parity)
-                    grads = jax.tree.map(
-                        lambda g: (jax.lax.psum(
-                            g.astype(comm_dtype), batch_axis)
-                            .astype(g.dtype) / dp_size), grads)
+                    if wire_dtype is not None:
+                        grads = jax.tree.map(
+                            lambda g: (jax.lax.psum(
+                                g.astype(wire_dtype), batch_axis)
+                                .astype(g.dtype) / dp_size), grads)
+                    else:
+                        grads = jax.tree.map(
+                            lambda g: jax.lax.psum(g, batch_axis) / dp_size,
+                            grads)
                     return jax.lax.pmean(loss, batch_axis), grads
 
-                loss, grads = shard_map(
-                    local, mesh=mesh, in_specs=(p_repl, b_spec),
-                    out_specs=(P(), g_spec), check_vma=False)(params, batch)
+                sm_kw = dict(mesh=mesh, in_specs=(p_repl, b_spec),
+                             out_specs=(P(), g_spec), check_vma=False)
+                if partial_manual:
+                    sm_kw["axis_names"] = frozenset({batch_axis})
+                loss, grads = shard_map(local, **sm_kw)(params, batch)
                 return (loss / scale if dls else loss), grads
         else:
             def vg(params, batch, scale):

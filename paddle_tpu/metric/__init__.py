@@ -177,15 +177,25 @@ class PrecisionRecall(Metric):
         p = np.asarray(unwrap(preds))
         if p.ndim == 2:
             p = p.argmax(-1)
-        p = p.ravel()
-        y = np.asarray(unwrap(labels)).ravel()
+        p = p.ravel().astype(np.int64)
+        y = np.asarray(unwrap(labels)).ravel().astype(np.int64)
         C = self.num_classes
-        # one O(N) confusion-matrix pass instead of 3 scans per class
-        conf = np.bincount(y * C + p, minlength=C * C).reshape(C, C)
+        # one O(N) confusion-matrix pass instead of 3 scans per class;
+        # ids outside [0, C) don't alias into the matrix: an out-of-range
+        # prediction still counts as FN for its (valid) label class, and
+        # an out-of-range label as FP for its (valid) prediction class
+        vp = (p >= 0) & (p < C)
+        vy = (y >= 0) & (y < C)
+        both = vp & vy
+        conf = np.bincount(y[both] * C + p[both],
+                           minlength=C * C).reshape(C, C)
         tp = np.diag(conf)
         self._tp += tp
         self._fp += conf.sum(0) - tp   # predicted c, label != c
         self._fn += conf.sum(1) - tp   # label c, predicted != c
+        if not both.all():
+            self._fn += np.bincount(y[vy & ~vp], minlength=C)
+            self._fp += np.bincount(p[vp & ~vy], minlength=C)
 
     @staticmethod
     def _prf(tp, fp, fn):

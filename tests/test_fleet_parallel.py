@@ -120,6 +120,33 @@ class TestPipelineThroughFleet:
         with pytest.raises(ValueError, match="PipelineProgram"):
             _build(loss_fn, params, strategy, mesh)
 
+    def test_1f1b_schedule_mode_matches_gpipe_loss(self):
+        """schedule_mode='1F1B' routes through the interleaved pipeline
+        (round-3 next-step #9); with the gpt_hybrid per-device stage
+        stack it degenerates to v=1 — same numerics as F-then-B (the
+        chunked-speedup case is covered by TestInterleavedPipeline in
+        test_parallel_transforms.py)."""
+        cfg, mesh = self._cfg_mesh()
+        M = 2
+        ids = jnp.zeros((2 * M * 2, 16), jnp.int32)
+        losses = {}
+        for mode in ("F-then-B", "1F1B"):
+            strategy = DistributedStrategy()
+            strategy.pipeline = True
+            strategy.pipeline_configs = {"accumulate_steps": M,
+                                         "pp_degree": 2,
+                                         "schedule_mode": mode}
+            program = gpt_hybrid.pipeline_program(cfg, mesh)
+            params = gpt_hybrid.init_params(cfg, pp=2, seed=0)
+            dopt, step, init_state, (p_sh, _, _) = _build(
+                program, params, strategy, mesh)
+            params = jax.device_put(params, p_sh)
+            _, _, loss = step(params, init_state(params), ids)
+            losses[mode] = float(loss)
+        assert np.isfinite(losses["1F1B"])
+        np.testing.assert_allclose(losses["1F1B"], losses["F-then-B"],
+                                   rtol=1e-5)
+
 
 class TestTensorParallelThroughFleet:
     """Parameter.dist_spec annotations must reach the built step (round-1
@@ -348,9 +375,72 @@ class TestFP16AllReduce:
         assert np.isfinite(float(loss))
 
     def test_warns_when_not_applicable(self):
+        # widened to dp x mp (round-3 next-step #10): the remaining
+        # exclusions are ZeRO stage >= 2 (grads are reduce-scattered to
+        # owners, not all-reduced) and pipeline programs
         loss_fn, params, batch = _toy()
-        mesh = build_mesh({"dp": 4, "mp": 2})
+        mesh = build_mesh({"dp": 8})
         strategy = DistributedStrategy()
         strategy.fp16_allreduce = True
+        strategy.sharding = True
+        strategy.sharding_configs = {"stage": 2}
         with pytest.warns(UserWarning, match="fp16_allreduce"):
             _build(loss_fn, params, strategy, mesh)
+
+    def test_dp_mp_mesh_bf16_comms_with_tp_model(self):
+        """fp16_allreduce on a dp x mp mesh: bf16 all-reduce rides dp
+        while the TP model's mp collectives stay intact, and the loss
+        matches the fp32-comms build within bf16 tolerance."""
+        from paddle_tpu.distributed.meta_parallel import (
+            ColumnParallelLinear, RowParallelLinear, dist_specs)
+        from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+        import paddle_tpu.nn as nn
+
+        mesh = build_mesh({"dp": 4, "mp": 2})
+        with mesh_guard(mesh):
+            paddle.seed(0)
+            d = 16
+
+            class Net(nn.Layer):
+                def __init__(self):
+                    super().__init__()
+                    self.col = ColumnParallelLinear(d, 4 * d,
+                                                    gather_output=False)
+                    self.row = RowParallelLinear(4 * d, d,
+                                                 input_is_parallel=True)
+
+                def forward(self, x):
+                    return self.row(self.col(x))
+
+            net = Net()
+            params, buffers = state_pytrees(net)
+            specs = dist_specs(net)
+
+            def loss_fn(p, batch):
+                out, _ = functional_call(
+                    net, p, (paddle.Tensor(batch),), buffers=buffers)
+                return (out.value ** 2).mean()
+
+            rs = np.random.RandomState(0)
+            batch = jnp.asarray(rs.randn(8, d), jnp.float32)
+            losses = {}
+            for fp16 in (False, True):
+                strategy = DistributedStrategy()
+                strategy.fp16_allreduce = fp16
+                dopt, step, init_state, _ = _build(
+                    loss_fn, params, strategy, mesh, param_specs=specs)
+                if fp16:
+                    assert "fp16_allreduce" in dopt.applied_meta_list
+                    hlo = step.lower(params, init_state(params),
+                                     batch).compile().as_text()
+                    # dp grad combine + mp TP collectives both present
+                    assert "all-reduce" in hlo
+                    if jax.default_backend() != "cpu":
+                        # the bf16 wire is TPU/GPU-only: XLA CPU's
+                        # AllReducePromotion CHECK-fails under the
+                        # partial-manual lowering (strategy_compiler.py)
+                        assert "bf16" in hlo
+                _, _, loss = step(params, init_state(params), batch)
+                losses[fp16] = float(loss)
+            np.testing.assert_allclose(losses[True], losses[False],
+                                       rtol=2e-2)

@@ -305,6 +305,15 @@ class TestMetricTail:
         mp, mr, mf, up, ur, uf = pr.accumulate()
         assert abs(up - 0.75) < 1e-9 and abs(ur - 0.75) < 1e-9
 
+    def test_precision_recall_float_and_out_of_range(self):
+        pr = paddle.metric.PrecisionRecall(3)
+        # float labels must not crash; out-of-range prediction counts as
+        # FN for its label class, not as an aliased confusion cell
+        pr.update(np.array([0, 5, 1]), np.array([0.0, 1.0, 1.0]))
+        assert pr._tp.tolist() == [1, 1, 0]
+        assert pr._fn.tolist() == [0, 1, 0]
+        assert pr._fp.tolist() == [0, 0, 0]
+
     def test_detection_map_half(self):
         dm = paddle.metric.DetectionMAP()
         dm.update(np.array([[0, 0, 10, 10], [50, 50, 60, 60]]),
