@@ -92,6 +92,10 @@ def interleave_chunk_view(stage_stack, n_devices):
     reshape) — the chunk assignment costs a reshape, not a gather."""
     def f(l):
         L = l.shape[0]
+        if L % n_devices:
+            raise ValueError(
+                f"interleaved schedule needs a stage-stack depth divisible "
+                f"by the pp extent (got {L} stages on pp={n_devices})")
         v = L // n_devices
         return l.reshape((v, n_devices) + l.shape[1:])
 
@@ -145,6 +149,15 @@ def spmd_pipeline_interleaved(stage_fn, chunk_params, microbatches, *,
             f"interleaved schedule needs microbatches divisible by pp "
             f"({M} % {S})")
     fn = jax.checkpoint(stage_fn) if remat else stage_fn
+
+    p_one = jax.tree.map(lambda l: l[0], p_local)
+    out_sd = jax.eval_shape(stage_fn, p_one, microbatches[0])
+    if (out_sd.shape, out_sd.dtype) != (microbatches[0].shape,
+                                        microbatches[0].dtype):
+        raise ValueError(
+            f"pipeline stages must preserve activation shape/dtype; got "
+            f"{microbatches[0].shape}/{microbatches[0].dtype} -> "
+            f"{out_sd.shape}/{out_sd.dtype}")
 
     ring = [(i, (i + 1) % S) for i in range(S)]
     T = v * M + S - 1

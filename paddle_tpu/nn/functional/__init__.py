@@ -409,14 +409,18 @@ def _pool(x, kernel, stride, padding, nsp, data_format, op, ceil_mode=False,
                     pads_resolved[d] = (pads_resolved[d][0],
                                         pads_resolved[d][1] + stride[i] - rem)
         if op == "max":
-            init = -jnp.inf if jnp.issubdtype(v.dtype, jnp.floating) else \
-                jnp.iinfo(v.dtype).min
+            # init must carry the operand dtype as a CONCRETE numpy scalar:
+            # a python -inf becomes f64 under x64 (CPU) and poisons the
+            # graph, while a jax array init breaks reduce_window transpose
+            init = (np.dtype(v.dtype).type(-np.inf)
+                    if jnp.issubdtype(v.dtype, jnp.floating)
+                    else np.dtype(v.dtype).type(jnp.iinfo(v.dtype).min))
             return jax.lax.reduce_window(v, init, jax.lax.max, window, strides,
                                          pads_resolved)
         # avg
         ones = jnp.ones_like(v)
-        s = jax.lax.reduce_window(v, 0.0, jax.lax.add, window, strides,
-                                  pads_resolved)
+        s = jax.lax.reduce_window(v, np.dtype(v.dtype).type(0), jax.lax.add,
+                                  window, strides, pads_resolved)
         if count_include_pad:
             denom = float(np.prod(kernel))
             return s / denom
@@ -505,7 +509,8 @@ def adaptive_max_pool2d(x, output_size, return_mask=False, name=None):
         H, W = v.shape[2], v.shape[3]
         oh, ow = out_hw
         kh, kw = H // oh, W // ow
-        return jax.lax.reduce_window(v, -jnp.inf, jax.lax.max,
+        return jax.lax.reduce_window(v, np.dtype(v.dtype).type(-np.inf),
+                                     jax.lax.max,
                                      (1, 1, kh, kw), (1, 1, kh, kw), "VALID")
     return apply(f, x)
 

@@ -32,9 +32,13 @@ class _BatchNormBase(Layer):
             default_initializer=I.Constant(1.0))
         self.bias = self.create_parameter([num_features], attr=bias_attr,
                                           is_bias=True)
-        self.register_buffer("_mean", Tensor(jnp.zeros([num_features])),
+        self.register_buffer("_mean",
+                             Tensor(jnp.zeros([num_features],
+                                              jnp.float32)),
                              persistable=True)
-        self.register_buffer("_variance", Tensor(jnp.ones([num_features])),
+        self.register_buffer("_variance",
+                             Tensor(jnp.ones([num_features],
+                                             jnp.float32)),
                              persistable=True)
 
     def forward(self, x):

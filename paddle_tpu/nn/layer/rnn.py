@@ -72,7 +72,10 @@ class _RNNCellBase(Layer):
             default_initializer=init)
 
     def get_initial_states(self, batch_size, dtype="float32"):
-        z = Tensor(jnp.zeros([batch_size, self.hidden_size]))
+        from ...framework.dtype import convert_dtype
+
+        z = Tensor(jnp.zeros([batch_size, self.hidden_size],
+                             convert_dtype(dtype) or jnp.float32))
         return z
 
 

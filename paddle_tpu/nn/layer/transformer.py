@@ -66,8 +66,13 @@ class MultiHeadAttention(Layer):
             b = key.shape[0]
             import jax.numpy as jnp
 
-            k = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim]))
-            v = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim]))
+            # pin to the projection dtype: an f32 empty cache would
+            # silently upcast every decode step's k/v under bf16
+            cdt = self.k_proj.weight.value.dtype
+            k = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim],
+                                 cdt))
+            v = Tensor(jnp.zeros([b, 0, self.num_heads, self.head_dim],
+                                 cdt))
             return self.Cache(k, v)
         return self.Cache(key, value)
 

@@ -61,10 +61,40 @@ class BottleneckBlock(nn.Layer):
         return self.relu(out + identity)
 
 
+def _s2d_stem_conv(x, weight):
+    """The 7x7/s2/p3 stem computed as space-to-depth + 4x4/s1 conv — the
+    standard TPU ResNet stem optimization (MLPerf): the original conv has
+    3 input channels, filling 3/128 of an MXU lane; the transformed conv
+    has 12.  EXACT: y = conv(x, W, s2, p3) == conv(s2d2(x), W', s1,
+    pad((2,1),(2,1))) where W'[(i*2+j)*C+c, a, b] = Wpad[c, 2a+i-1,
+    2b+j-1] — a pure reshape/transpose of the left-padded kernel, so the
+    checkpoint keeps the reference [O,3,7,7] layout."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...tensor import apply
+
+    def f(v, w):
+        B, C, H, W_ = v.shape
+        O = w.shape[0]
+        xs = v.reshape(B, C, H // 2, 2, W_ // 2, 2) \
+            .transpose(0, 3, 5, 1, 2, 4).reshape(B, 4 * C, H // 2, W_ // 2)
+        w8 = jnp.pad(w.astype(v.dtype),
+                     ((0, 0), (0, 0), (1, 0), (1, 0)))         # kh+1,kw+1
+        wp = w8.reshape(O, C, 4, 2, 4, 2) \
+            .transpose(0, 3, 5, 1, 2, 4).reshape(O, 4 * C, 4, 4)
+        return jax.lax.conv_general_dilated(
+            xs, wp, (1, 1), [(2, 1), (2, 1)],
+            dimension_numbers=("NCHW", "OIHW", "NCHW"))
+
+    return apply(f, x, weight)
+
+
 class ResNet(nn.Layer):
     def __init__(self, block, depth=50, width=64, num_classes=1000,
-                 with_pool=True, groups=1):
+                 with_pool=True, groups=1, s2d_stem=False):
         super().__init__()
+        self._s2d_stem = s2d_stem
         layer_cfg = {18: [2, 2, 2, 2], 34: [3, 4, 6, 3], 50: [3, 4, 6, 3],
                      101: [3, 4, 23, 3], 152: [3, 8, 36, 3]}
         layers = layer_cfg[depth]
@@ -109,7 +139,11 @@ class ResNet(nn.Layer):
         return nn.Sequential(*layers)
 
     def forward(self, x):
-        x = self.relu(self.bn1(self.conv1(x)))
+        if self._s2d_stem:
+            x = _s2d_stem_conv(x, self.conv1.weight)
+        else:
+            x = self.conv1(x)
+        x = self.relu(self.bn1(x))
         x = self.maxpool(x)
         x = self.layer1(x)
         x = self.layer2(x)

@@ -225,3 +225,35 @@ class TestHybridGPT:
             params, state, loss = step(params, state, ids)
             l0 = float(loss) if l0 is None else l0
         assert float(loss) < l0
+
+
+class TestS2DStem:
+    def test_s2d_stem_matches_standard_resnet(self):
+        # exact rewrite (vision/models/resnet.py _s2d_stem_conv): same
+        # checkpoint, same outputs
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        a = resnet18(num_classes=7)
+        b = resnet18(num_classes=7, s2d_stem=True)
+        b.set_state_dict(a.state_dict())
+        a.eval()
+        b.eval()
+        x = paddle.to_tensor(
+            np.random.RandomState(0).randn(2, 3, 64, 64).astype(np.float32))
+        np.testing.assert_allclose(np.asarray(a(x).numpy()),
+                                   np.asarray(b(x).numpy()),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_norm_buffers_are_f32_under_x64(self):
+        # BN running stats created without an explicit dtype became f64
+        # whenever x64 is enabled (CPU policy) and poisoned every
+        # downstream conv to f64 — the round-3 f64-poisoning bug class
+        import paddle_tpu as paddle
+
+        bn = paddle.nn.BatchNorm2D(4)
+        assert str(bn._mean.dtype).endswith("float32")
+        assert str(bn._variance.dtype).endswith("float32")
