@@ -71,12 +71,17 @@ class _RNNCellBase(Layer):
             [gate_mult * hidden_size], attr=bias_hh_attr, is_bias=True,
             default_initializer=init)
 
-    def get_initial_states(self, batch_size, dtype="float32"):
+    def get_initial_states(self, batch_size, dtype=None):
         from ...framework.dtype import convert_dtype
 
-        z = Tensor(jnp.zeros([batch_size, self.hidden_size],
-                             convert_dtype(dtype) or jnp.float32))
-        return z
+        # default to the cell's parameter dtype: an f32 initial state
+        # would silently upcast every gate matmul under bf16 (same
+        # failure mode as the attention decode cache)
+        if dtype is None:
+            dt = self.weight_hh.value.dtype
+        else:
+            dt = convert_dtype(dtype) or jnp.float32
+        return Tensor(jnp.zeros([batch_size, self.hidden_size], dt))
 
 
 class LSTMCell(_RNNCellBase):
