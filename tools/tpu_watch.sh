@@ -1,0 +1,28 @@
+#!/bin/bash
+# Watch for the axon TPU tunnel to return; when it does, run the full
+# bench and append the TPU-platform lines to BENCH_session_r04.jsonl
+# (round-3 verdict #1: record TPU evidence whenever the chip is
+# reachable — the tunnel has multi-hour transient outages).
+cd /root/repo
+LOG=/tmp/tpu_watch.log
+for i in $(seq 1 60); do
+  probe=$(timeout 150 python bench.py --probe 2>/dev/null | tail -1)
+  if echo "$probe" | grep -q '"ok": true' && ! echo "$probe" | grep -q '"platform": "cpu"'; then
+    echo "$(date -u +%FT%TZ) TPU up; running full bench" >> "$LOG"
+    timeout 5400 python bench.py > /tmp/bench_r4_run2.jsonl 2>>"$LOG"
+    if grep -q '"platform": "TPU' /tmp/bench_r4_run2.jsonl; then
+      {
+        echo "{\"metric\": \"session_note\", \"value\": 1.0, \"unit\": \"note\", \"vs_baseline\": 0.0, \"note\": \"second session run $(date -u +%FT%TZ) after tunnel recovery; includes s2d-stem/batch-128 resnet and the bert headline\"}"
+        cat /tmp/bench_r4_run2.jsonl
+      } >> BENCH_session_r04.jsonl
+      git add BENCH_session_r04.jsonl
+      git commit -q -m "Record second TPU bench session (tunnel recovery): bert headline + s2d-stem resnet numbers"
+      echo "$(date -u +%FT%TZ) SUCCESS committed" >> "$LOG"
+      exit 0
+    fi
+    echo "$(date -u +%FT%TZ) bench ran but no TPU lines; will retry" >> "$LOG"
+  else
+    echo "$(date -u +%FT%TZ) probe down" >> "$LOG"
+  fi
+  sleep 420
+done
