@@ -36,7 +36,7 @@ CONFIG_TIMEOUT_TPU_S = 900
 CONFIG_TIMEOUT_CPU_S = 900   # gpt13b's exact-1.3B CPU grad compile ≈ 382s
                              # alone (measured r04); leave headroom
 
-CONFIGS = ("mnist", "kernels", "resnet50", "ernie", "gpt13b",
+CONFIGS = ("mnist", "kernels", "longseq", "resnet50", "ernie", "gpt13b",
            "bert")  # bert last = headline
 
 
@@ -733,6 +733,21 @@ def body_gpt13b(on_tpu):
     }
 
 
+def _naive_causal_attention(q, k, v):
+    """The O(S^2)-memory XLA reference attention shared by the kernels
+    and longseq configs (single source for masking/scaling)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    S, D = q.shape[1], q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+    logits = logits / np.sqrt(D)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    p = jax.nn.softmax(jnp.where(mask, logits, -1e30), -1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
+
+
 def body_kernels(on_tpu):
     """Validate Pallas flash-attention (fwd + bwd) and fused layer_norm
     numerics against the plain-XLA path on the REAL device (VERDICT round-1
@@ -750,12 +765,7 @@ def body_kernels(on_tpu):
     k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
     v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
 
-    def ref_attn(q, k, v):
-        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(D)
-        mask = jnp.tril(jnp.ones((S, S), bool))
-        logits = jnp.where(mask, logits, -1e30)
-        p = jax.nn.softmax(logits, -1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    ref_attn = _naive_causal_attention
 
     def loss_fa(q, k, v):
         return (flash_attention(q, k, v, causal=True) ** 2).mean()
@@ -793,13 +803,74 @@ def body_kernels(on_tpu):
     }
 
 
+def body_longseq(on_tpu):
+    """Long-context evidence (SURVEY section 5: long-context is a
+    first-class NEW capability vs the reference): causal flash attention
+    fwd+bwd at long sequence on one chip, vs the naive O(S^2)-memory XLA
+    path.  The multichip ring/Ulysses path is exercised by
+    dryrun_multichip and tests/test_ring_attention.py."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from paddle_tpu.ops.pallas.flash_attention import flash_attention
+
+    if on_tpu:
+        B, S, H, D = 1, 4096, 16, 64
+        reps = 3
+    else:
+        B, S, H, D = 1, 256, 2, 32
+        reps = 1
+    rs = np.random.RandomState(0)
+    q = jnp.asarray(rs.randn(B, S, H, D) * 0.1, jnp.bfloat16)
+    k = jnp.asarray(rs.randn(B, S, H, D) * 0.1, jnp.bfloat16)
+    v = jnp.asarray(rs.randn(B, S, H, D) * 0.1, jnp.bfloat16)
+
+    def loss_flash(q, k, v):
+        return (flash_attention(q, k, v, causal=True)
+                .astype(jnp.float32) ** 2).mean()
+
+    def loss_ref(q, k, v):
+        out = _naive_causal_attention(q, k, v)
+        return (out.astype(jnp.float32) ** 2).mean()
+
+    def timed(loss):
+        g = jax.jit(jax.grad(loss, argnums=(0, 1, 2)))
+        out = g(q, k, v)
+        jax.block_until_ready(out)
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(g(q, k, v))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_flash = timed(loss_flash)
+    t_ref = timed(loss_ref)
+    # causal attention fwd+bwd ~ 2.5 * 2 * 2*S^2*D per head-batch halved
+    # by causality: 0.5 * 3.5 * 4 * B*H*S^2*D
+    flops = 0.5 * 3.5 * 4.0 * B * H * S * S * D
+    achieved = flops / t_flash
+    return {
+        "metric": ("longseq_flash_attn_speedup_vs_xla" if on_tpu
+                   else "longseq_smoke_cpu"),
+        "value": round(t_ref / t_flash, 3),
+        "unit": "x",
+        "vs_baseline": round(t_ref / t_flash, 3),
+        "seq_len": S,
+        "flash_ms": round(t_flash * 1e3, 2),
+        "xla_ms": round(t_ref * 1e3, 2),
+        "flash_attn_tflops": round(achieved / 1e12, 1),
+    }
+
+
 def body_config(name):
     import jax
 
     on_tpu = jax.default_backend() not in ("cpu",)
     body = {"bert": body_bert, "ernie": body_ernie, "resnet50": body_resnet50,
             "gpt13b": body_gpt13b, "kernels": body_kernels,
-            "mnist": body_mnist}[name]
+            "mnist": body_mnist, "longseq": body_longseq}[name]
     r = body(on_tpu)
     r["platform"] = jax.devices()[0].device_kind if on_tpu else "cpu"
     print(json.dumps(r), flush=True)

@@ -266,26 +266,34 @@ def edit_distance(hyps, hyp_lens, refs, ref_lens, normalized=True):
 
 
 class ChunkEvaluator(Metric):
-    """Chunking F1 for IOB tagging (operators/metrics/chunk_eval_op.h
-    re-designed host-side): update with padded tag ids + lens,
-    accumulate (precision, recall, f1).
+    """Chunking F1 for IOB / IOE / IOBES / plain tagging
+    (operators/metrics/chunk_eval_op.h re-designed host-side): update
+    with padded tag ids + lens, accumulate (precision, recall, f1).
 
-    Numeric tag scheme (the reference's): for ``num_chunk_types`` n,
-    tag 2k = B-type-k, tag 2k+1 = I-type-k, and any tag >= 2n (typically
-    2n itself) is Outside.  Pass num_chunk_types (or a label_list whose
-    length is 2n+1); without either, every tag is treated as B/I."""
+    Numeric tag scheme (the reference's): tag = chunk_type * num_tags +
+    tag_role with num_tags = 2 for IOB (roles B,I) and IOE (roles I,E),
+    4 for IOBES (roles B,I,E,S), 1 for plain; any tag >=
+    num_tags * num_chunk_types (typically the next id) is Outside.
+    Pass num_chunk_types (or a label_list of length
+    num_tags*num_chunk_types + 1); without either, every tag is a
+    chunk tag."""
+
+    _ROLES = {"IOB": "BI", "IOE": "IE", "IOBES": "BIES", "PLAIN": "S"}
 
     def __init__(self, label_list=None, scheme="IOB", name="chunk",
                  num_chunk_types=None, excluded_chunk_types=()):
-        if scheme.upper() != "IOB":
-            raise NotImplementedError(
-                f"chunk scheme {scheme!r}: only IOB is implemented "
-                "(reference also supports IOE/IOBES/plain)")
+        scheme = scheme.upper()
+        if scheme == "IO":
+            scheme = "PLAIN"
+        if scheme not in self._ROLES:
+            raise ValueError(
+                f"chunk scheme {scheme!r}: one of IOB/IOE/IOBES/plain")
         self._name = name
         self.label_list = label_list
         self.scheme = scheme
+        self._ntags = len(self._ROLES[scheme])
         if num_chunk_types is None and label_list is not None:
-            num_chunk_types = (len(label_list) - 1) // 2
+            num_chunk_types = (len(label_list) - 1) // self._ntags
         self.num_chunk_types = num_chunk_types
         self.excluded = set(excluded_chunk_types)
         self.reset()
@@ -293,12 +301,17 @@ class ChunkEvaluator(Metric):
     def reset(self):
         self._correct = self._infer = self._label = 0
 
-    def _is_outside(self, t):
-        return t < 0 or (self.num_chunk_types is not None
-                         and t >= 2 * self.num_chunk_types)
+    def _decode(self, t):
+        """tag id -> (chunk_type, role) or None for Outside."""
+        if t < 0 or (self.num_chunk_types is not None
+                     and t >= self._ntags * self.num_chunk_types):
+            return None
+        return t // self._ntags, self._ROLES[self.scheme][t % self._ntags]
 
     def _chunks(self, tags):
-        """(type, start, end) chunks from a numeric IOB tag sequence."""
+        """(type, start, end) chunks, conlleval-style begin/end rules:
+        B/S (and a role that does not continue the open chunk) begin;
+        E/S end; I continues."""
         chunks, start, ctype = [], None, None
 
         def flush(end):
@@ -308,17 +321,18 @@ class ChunkEvaluator(Metric):
             start = ctype = None
 
         for i, t in enumerate(tags):
-            t = int(t)
-            if self._is_outside(t):
+            d = self._decode(int(t))
+            if d is None:
                 flush(i)
-            elif t % 2 == 0:            # B-
+                continue
+            ty, role = d
+            continues = (start is not None and ty == ctype
+                         and role in ("I", "E"))
+            if not continues:
                 flush(i)
-                start, ctype = i, t // 2
-            elif start is not None and t // 2 == ctype:
-                continue                # I- of same type
-            else:                       # dangling I-: starts a chunk
-                flush(i)
-                start, ctype = i, t // 2
+                start, ctype = i, ty
+            if role in ("E", "S"):
+                flush(i + 1)
         flush(len(tags))
         return set(chunks)
 

@@ -276,8 +276,26 @@ class TestMetricTail:
         p, r, f1 = ce.accumulate()
         assert (p, r, f1) == (1.0, 1.0, 1.0)
         assert ce._label == 2  # two chunks, not an O-phantom third
-        with pytest.raises(NotImplementedError):
-            paddle.metric.ChunkEvaluator(scheme="IOBES")
+        with pytest.raises(ValueError):
+            paddle.metric.ChunkEvaluator(scheme="BILOU")
+
+    def test_chunk_evaluator_ioe_and_iobes(self):
+        # IOE (roles I,E): chunk [I I E] of type 0 = tags [0, 0, 1]
+        ce = paddle.metric.ChunkEvaluator(scheme="IOE", num_chunk_types=1)
+        seq = np.array([[0, 0, 1, 2]])     # I I E O -> one chunk [0,3)
+        ce.update(seq, seq, np.array([4]))
+        assert ce._label == 1 and ce._correct == 1
+        # IOBES (roles B,I,E,S): B I E then S then O
+        ce2 = paddle.metric.ChunkEvaluator(scheme="IOBES",
+                                           num_chunk_types=2)
+        # type0: B=0 I=1 E=2 S=3; type1: B=4 I=5 E=6 S=7; O=8
+        seq2 = np.array([[0, 1, 2, 3, 8, 4, 6]])
+        ce2.update(seq2, seq2, np.array([7]))
+        # chunks: [0,3) type0; [3,4) S type0; [5,6) B-type1 cut by E;
+        # conlleval: B then E of same type = one chunk [5,7)
+        p, r, f1 = ce2.accumulate()
+        assert (p, r, f1) == (1.0, 1.0, 1.0)
+        assert ce2._label == 3
 
     def test_bpr_loss_column_label(self):
         logits = np.array([[2.0, 1.0, 0.0]], np.float32)

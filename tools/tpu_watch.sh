@@ -11,14 +11,16 @@ for i in $(seq 1 60); do
     echo "$(date -u +%FT%TZ) TPU up; running full bench" >> "$LOG"
     timeout 5400 python bench.py > /tmp/bench_r4_run2.jsonl 2>>"$LOG"
     if grep -q '"platform": "TPU' /tmp/bench_r4_run2.jsonl; then
+      ntpu=$(grep -c '"platform": "TPU' /tmp/bench_r4_run2.jsonl)
+      bert=$(grep -q 'bert_base_samples_per_sec_per_chip' /tmp/bench_r4_run2.jsonl && echo yes || echo no)
       {
-        echo "{\"metric\": \"session_note\", \"value\": 1.0, \"unit\": \"note\", \"vs_baseline\": 0.0, \"note\": \"second session run $(date -u +%FT%TZ) after tunnel recovery; includes s2d-stem/batch-128 resnet and the bert headline\"}"
+        echo "{\"metric\": \"session_note\", \"value\": 1.0, \"unit\": \"note\", \"vs_baseline\": 0.0, \"note\": \"second session run $(date -u +%FT%TZ) after tunnel recovery; tpu_lines=$ntpu bert_on_tpu=$bert\"}"
         cat /tmp/bench_r4_run2.jsonl
       } >> BENCH_session_r04.jsonl
-      git add BENCH_session_r04.jsonl
-      git commit -q -m "Record second TPU bench session (tunnel recovery): bert headline + s2d-stem resnet numbers"
-      echo "$(date -u +%FT%TZ) SUCCESS committed" >> "$LOG"
-      exit 0
+      git commit -q -m "Record second TPU bench session (tunnel recovery)" -- BENCH_session_r04.jsonl
+      echo "$(date -u +%FT%TZ) SUCCESS committed (tpu_lines=$ntpu bert=$bert)" >> "$LOG"
+      if [ "$bert" = yes ]; then exit 0; fi
+      echo "$(date -u +%FT%TZ) bert still missing; continuing watch" >> "$LOG"
     fi
     echo "$(date -u +%FT%TZ) bench ran but no TPU lines; will retry" >> "$LOG"
   else
