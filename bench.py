@@ -847,8 +847,8 @@ def body_longseq(on_tpu):
 
     t_flash = timed(loss_flash)
     t_ref = timed(loss_ref)
-    # causal attention fwd+bwd ~ 2.5 * 2 * 2*S^2*D per head-batch halved
-    # by causality: 0.5 * 3.5 * 4 * B*H*S^2*D
+    # fwd = 2 matmuls = 4*S^2*D FLOPs per head-batch; bwd = 2.5x fwd
+    # (5 matmuls); total 3.5 * 4 * S^2 * D, halved by causal masking
     flops = 0.5 * 3.5 * 4.0 * B * H * S * S * D
     achieved = flops / t_flash
     return {

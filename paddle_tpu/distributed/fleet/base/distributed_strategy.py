@@ -95,7 +95,9 @@ _CONFIG_DEFAULTS = {
     "pipeline_configs": {
         "micro_batch_size": 1,
         "accumulate_steps": 1,
-        "schedule_mode": "F-then-B",  # reference GPipe schedule (A.2)
+        "schedule_mode": "F-then-B",  # reference GPipe schedule (A.2);
+                                      # "1F1B" = interleaved virtual stages
+        "virtual_pipeline_degree": None,  # chunks per device under 1F1B
         "p2p_cache_shape": True,
         "pp_degree": 1,               # TPU extension: pp mesh-axis size;
                                       # >1 routes a PipelineProgram through

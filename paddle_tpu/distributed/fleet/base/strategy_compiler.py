@@ -88,7 +88,8 @@ class StrategyCompiler:
         schedule = cfg.get("schedule_mode", "F-then-B")
         ctx.loss_fn = pipeline_loss_fn(
             program, ctx.mesh, M, axis_name=ctx.pipeline_axis,
-            schedule=schedule)
+            schedule=schedule,
+            virtual_chunks=cfg.get("virtual_pipeline_degree"))
 
     # ------------------------------------------------------------------
     def build_train_step(self, ctx: TrainStepContext, params,

@@ -266,19 +266,46 @@ class PipelineProgram:
 
 
 def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
-                     *, axis_name="pp", remat=True, schedule="F-then-B"):
+                     *, axis_name="pp", remat=True, schedule="F-then-B",
+                     virtual_chunks=None):
     """(params, batch) -> scalar loss running `program` as a pipeline over
     mesh axis `axis_name`.  schedule: "F-then-B" (GPipe fill-drain, the
-    reference default) or "1F1B" (interleaved virtual stages; the stage
-    stack's leading dim must be a multiple of the pp extent — each entry
-    becomes one CHUNK, v = stages/pp per device).  The loss is pmean'd
-    over every mesh axis so value and gradients are exact."""
+    reference default) or "1F1B" (interleaved virtual stages).
+
+    For "1F1B", `virtual_chunks` = v splits each device's stage into v
+    chunks (Megatron's virtual_pipeline_degree): the program's stage
+    stack [S, Lp, ...] (device-major depth order, `stage` scanning the
+    per-device axis) is VIEWED as [v, S, Lp/v, ...] — a pure reshape, so
+    chunk (c, d) holds depth blocks [(c*S+d)*Lp/v, ...) with no data
+    movement — and `stage` now scans Lp/v blocks per chunk.  v=1 (the
+    default) degenerates to GPipe numerics with the 1F1B wiring.  The
+    loss is pmean'd over every mesh axis so value and gradients are
+    exact."""
     all_axes = tuple(mesh.axis_names)
     S = mesh.shape[axis_name]
     # validate like pipeline_schedule_ticks: a typo'd schedule must not
     # silently train as GPipe
     pipeline_schedule_ticks(schedule, S, 1, 1)
     interleaved = schedule in ("1F1B", "interleaved")
+    v = int(virtual_chunks or 1)
+    if v > 1 and not interleaved:
+        raise ValueError("virtual_chunks > 1 requires schedule='1F1B'")
+
+    def to_chunk_view(tree_):
+        def f(l):
+            if l.shape[0] != S:
+                raise ValueError(
+                    f"stage stack leading dim {l.shape[0]} != pp extent "
+                    f"{S}")
+            if v > 1:
+                if l.ndim < 2 or l.shape[1] % v:
+                    raise ValueError(
+                        f"virtual_chunks={v} needs a per-device stage "
+                        f"axis divisible by v; got {l.shape}")
+                return l.reshape((v, S, l.shape[1] // v) + l.shape[2:])
+            return l.reshape((1,) + l.shape)
+
+        return jax.tree.map(f, tree_)
 
     def inner(params, micro):
         act = program.embed(params, micro)
@@ -297,12 +324,11 @@ def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
 
     specs = program.param_specs()
     if interleaved:
-        # the [L,...] -> [v,S,...] chunk view shifts the pp axis to
-        # position 1 in the stage subtree's specs.  NOTE: if parameters
-        # are STORED with the contiguous [L] P('pp') placement, GSPMD
-        # inserts one resharding of the stage stack per step (identity
-        # when v == 1); store the stack as [v,S,...] P(None,'pp') to make
-        # chunk assignment fully free.
+        # the chunk view shifts the pp axis to position 1 in the stage
+        # subtree's specs.  NOTE: with v > 1 and parameters STORED in the
+        # [S, Lp] P('pp') placement, GSPMD reshards the stage stack once
+        # per step (identity when v == 1); store the stack pre-viewed as
+        # [v, S, Lp/v] P(None,'pp') to make chunk assignment fully free.
         specs = dict(specs)
         specs[program.stage_key] = jax.tree.map(
             lambda s: P(None, *s), specs[program.stage_key],
@@ -312,8 +338,8 @@ def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
         micro = program.to_microbatches(batch, n_microbatches)
         if interleaved:
             params = dict(params)
-            params[program.stage_key] = interleave_chunk_view(
-                params[program.stage_key], S)
+            params[program.stage_key] = to_chunk_view(
+                params[program.stage_key])
         f = shard_map(inner, mesh=mesh,
                       in_specs=(specs, program.data_spec()),
                       out_specs=P(), check_vma=False)

@@ -278,16 +278,17 @@ class ChunkEvaluator(Metric):
     num_tags*num_chunk_types + 1); without either, every tag is a
     chunk tag."""
 
-    _ROLES = {"IOB": "BI", "IOE": "IE", "IOBES": "BIES", "PLAIN": "S"}
+    # role alphabets: IO = bare per-type Inside tags (maximal same-type
+    # runs form one chunk); PLAIN = every tagged token its own chunk
+    _ROLES = {"IOB": "BI", "IOE": "IE", "IOBES": "BIES", "IO": "I",
+              "PLAIN": "S"}
 
     def __init__(self, label_list=None, scheme="IOB", name="chunk",
                  num_chunk_types=None, excluded_chunk_types=()):
         scheme = scheme.upper()
-        if scheme == "IO":
-            scheme = "PLAIN"
         if scheme not in self._ROLES:
             raise ValueError(
-                f"chunk scheme {scheme!r}: one of IOB/IOE/IOBES/plain")
+                f"chunk scheme {scheme!r}: one of IOB/IOE/IOBES/IO/plain")
         self._name = name
         self.label_list = label_list
         self.scheme = scheme

@@ -279,6 +279,17 @@ class TestMetricTail:
         with pytest.raises(ValueError):
             paddle.metric.ChunkEvaluator(scheme="BILOU")
 
+    def test_chunk_evaluator_io_runs(self):
+        # IO: maximal same-type runs are ONE chunk (not per-token)
+        ce = paddle.metric.ChunkEvaluator(scheme="IO", num_chunk_types=2)
+        lab = np.array([[0, 0, 2, 1, 1]])   # run of type0, O, run of type1
+        pred = np.array([[0, 2, 2, 1, 1]])  # boundary error on the first
+        ce.update(pred, lab, np.array([5]))
+        p, r, f1 = ce.accumulate()
+        assert ce._label == 2 and ce._infer == 2
+        assert ce._correct == 1  # only the type-1 run matches exactly
+        assert (p, r) == (0.5, 0.5)
+
     def test_chunk_evaluator_ioe_and_iobes(self):
         # IOE (roles I,E): chunk [I I E] of type 0 = tags [0, 0, 1]
         ce = paddle.metric.ChunkEvaluator(scheme="IOE", num_chunk_types=1)

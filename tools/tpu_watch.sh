@@ -21,8 +21,9 @@ for i in $(seq 1 60); do
       echo "$(date -u +%FT%TZ) SUCCESS committed (tpu_lines=$ntpu bert=$bert)" >> "$LOG"
       if [ "$bert" = yes ]; then exit 0; fi
       echo "$(date -u +%FT%TZ) bert still missing; continuing watch" >> "$LOG"
+    else
+      echo "$(date -u +%FT%TZ) bench ran but no TPU lines; will retry" >> "$LOG"
     fi
-    echo "$(date -u +%FT%TZ) bench ran but no TPU lines; will retry" >> "$LOG"
   else
     echo "$(date -u +%FT%TZ) probe down" >> "$LOG"
   fi
