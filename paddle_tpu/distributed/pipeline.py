@@ -89,7 +89,12 @@ def interleave_chunk_view(stage_stack, n_devices):
     """Depth-ordered stage stack [L, ...] -> [v, S, ...] VIEW whose axis 1
     sharded over the pp axis hands device d exactly its interleaved
     chunks (virtual stage g = c*S + d splits as [c][d] under row-major
-    reshape) — the chunk assignment costs a reshape, not a gather."""
+    reshape) — the chunk assignment costs a reshape, not a gather.
+
+    This is the DIRECT-use form (stage_fn consumes one depth entry);
+    `pipeline_loss_fn` applies the equivalent view to a PipelineProgram's
+    [S, Lp, ...] device-major stack internally (see its virtual_chunks
+    docstring) — do not combine the two."""
     def f(l):
         L = l.shape[0]
         if L % n_devices:
@@ -287,7 +292,10 @@ def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
     # silently train as GPipe
     pipeline_schedule_ticks(schedule, S, 1, 1)
     interleaved = schedule in ("1F1B", "interleaved")
-    v = int(virtual_chunks or 1)
+    v = 1 if virtual_chunks is None else virtual_chunks
+    if not isinstance(v, int) or v < 1:
+        raise ValueError(
+            f"virtual_chunks must be a positive int, got {v!r}")
     if v > 1 and not interleaved:
         raise ValueError("virtual_chunks > 1 requires schedule='1F1B'")
 

@@ -170,11 +170,13 @@ class TestPipelineThroughFleet:
             dopt, step, init_state, (p_sh, _, _) = _build(
                 program, params, strategy, mesh)
             params = jax.device_put(params, p_sh)
+            state = init_state(params)
             if vdeg:
-                hlo = step.lower(params, init_state(params),
-                                 ids).compile().as_text()
-                assert "collective-permute" in hlo
-            _, _, loss = step(params, init_state(params), ids)
+                compiled = step.lower(params, state, ids).compile()
+                assert "collective-permute" in compiled.as_text()
+                _, _, loss = compiled(params, state, ids)  # one compile
+            else:
+                _, _, loss = step(params, state, ids)
             losses[mode] = float(loss)
         np.testing.assert_allclose(losses["1F1B"], losses["F-then-B"],
                                    rtol=1e-4)

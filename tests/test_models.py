@@ -257,3 +257,24 @@ class TestS2DStem:
         bn = paddle.nn.BatchNorm2D(4)
         assert str(bn._mean.dtype).endswith("float32")
         assert str(bn._variance.dtype).endswith("float32")
+
+    def test_s2d_resnet_exports_and_serves(self, tmp_path):
+        # the weight-transform inside forward must trace into the AOT
+        # export (StableHLO) and serve identically
+        import numpy as np
+
+        import paddle_tpu as paddle
+        from paddle_tpu.inference import (load_inference_model,
+                                          save_inference_model)
+        from paddle_tpu.vision.models import resnet18
+
+        paddle.seed(0)
+        net = resnet18(num_classes=4, s2d_stem=True)
+        net.eval()
+        x = np.random.RandomState(0).randn(1, 3, 32, 32).astype(np.float32)
+        prefix = str(tmp_path / "s2drn")
+        save_inference_model(prefix, net, example_inputs=[x])
+        pred = load_inference_model(prefix)
+        out, = pred.run([x])
+        expect = np.asarray(net(paddle.to_tensor(x)).numpy())
+        np.testing.assert_allclose(out, expect, rtol=1e-4, atol=1e-5)

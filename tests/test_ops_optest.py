@@ -379,3 +379,78 @@ class TestImageOps(OpTest):
         ref = np.asarray(F.temporal_shift(
             paddle.to_tensor(np.moveaxis(x, -1, 1).copy()), 2).numpy())
         np.testing.assert_allclose(out, np.moveaxis(ref, 1, -1), rtol=1e-6)
+
+
+class TestRound4TailOpGrads(OpTest):
+    """Analytic-vs-numeric gradient checks (the reference OpTest
+    check_grad contract) for the round-4 registry-tail ops that
+    differentiate."""
+
+    def test_row_conv_grad(self):
+        x = rs().randn(1, 4, 3).astype("f") * 0.5
+        w = rs().randn(2, 3).astype("f") * 0.5
+        self.check_grad(lambda a, b: F.row_conv(a, b), [x, w])
+
+    def test_conv_shift_grad(self):
+        a = rs().randn(2, 5).astype("f") * 0.5
+        b = rs().randn(2, 3).astype("f") * 0.5
+        self.check_grad(lambda x, y: F.conv_shift(x, y), [a, b])
+
+    def test_bilinear_grad(self):
+        a = rs().randn(2, 3).astype("f") * 0.5
+        b = rs().randn(2, 4).astype("f") * 0.5
+        w = rs().randn(2, 3, 4).astype("f") * 0.5
+        self.check_grad(lambda x, y, w_: F.bilinear(x, y, w_), [a, b, w])
+
+    def test_sequence_conv_grad(self):
+        from paddle_tpu.text import sequence as sq
+
+        x = rs().randn(1, 4, 2).astype("f") * 0.5
+        ln = np.array([3])
+        w = rs().randn(6, 3).astype("f") * 0.5
+        self.check_grad(
+            lambda a, b: sq.sequence_conv(a, paddle.to_tensor(ln), b, 3),
+            [x, w])
+
+    def test_sequence_pool_grads(self):
+        from paddle_tpu.text import sequence as sq
+
+        x = rs().randn(2, 4).astype("f")
+        ln = np.array([3, 2])
+        for pt in ("SUM", "AVERAGE", "SQRT", "MAX", "LAST"):
+            self.check_grad(
+                lambda a, pt=pt: sq.sequence_pool(
+                    a, paddle.to_tensor(ln), pt), [x])
+
+    def test_deform_conv2d_grads(self):
+        from paddle_tpu.vision import ops as V
+
+        x = rs().randn(1, 2, 4, 4).astype("f") * 0.5
+        off = rs().randn(1, 18, 4, 4).astype("f") * 0.3
+        w = rs().randn(2, 2, 3, 3).astype("f") * 0.5
+        self.check_grad(
+            lambda a, o, w_: V.deform_conv2d(a, o, w_, padding=1),
+            [x, off, w], max_relative_error=2e-2)  # bilinear kinks
+
+    def test_linear_chain_crf_grad(self):
+        from paddle_tpu.text import linear_chain_crf
+
+        em = rs().randn(2, 3, 3).astype("f") * 0.5
+        tr = rs().randn(5, 3).astype("f") * 0.5
+        lab = np.array([[0, 1, 2], [2, 0, 0]])
+        ln = np.array([3, 2])
+        self.check_grad(
+            lambda e, t: linear_chain_crf(
+                e, t, paddle.to_tensor(lab), paddle.to_tensor(ln)),
+            [em, tr])
+
+    def test_addmm_segment_grads(self):
+        a = rs().randn(2, 2).astype("f")
+        b = rs().randn(2, 2).astype("f")
+        c = rs().randn(2, 2).astype("f")
+        self.check_grad(lambda i, x, y: paddle.addmm(i, x, y, beta=2.0,
+                                                     alpha=0.5), [a, b, c])
+        d = rs().randn(3, 2).astype("f")
+        ids = np.array([0, 0, 1])
+        self.check_grad(
+            lambda v: paddle.segment_sum(v, paddle.to_tensor(ids)), [d])
