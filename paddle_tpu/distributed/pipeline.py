@@ -292,10 +292,19 @@ def pipeline_loss_fn(program: PipelineProgram, mesh, n_microbatches: int,
     # silently train as GPipe
     pipeline_schedule_ticks(schedule, S, 1, 1)
     interleaved = schedule in ("1F1B", "interleaved")
-    v = 1 if virtual_chunks is None else virtual_chunks
-    if not isinstance(v, int) or v < 1:
-        raise ValueError(
-            f"virtual_chunks must be a positive int, got {v!r}")
+    if virtual_chunks is None:
+        v = 1
+    else:
+        try:
+            v = int(virtual_chunks)
+        except (TypeError, ValueError):
+            raise ValueError(
+                f"virtual_chunks must be a positive integer, got "
+                f"{virtual_chunks!r}") from None
+        if v != virtual_chunks or v < 1:  # rejects 2.5, 0, -2; takes 2.0
+            raise ValueError(
+                f"virtual_chunks must be a positive integer, got "
+                f"{virtual_chunks!r}")
     if v > 1 and not interleaved:
         raise ValueError("virtual_chunks > 1 requires schedule='1F1B'")
 

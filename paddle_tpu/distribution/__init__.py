@@ -19,7 +19,7 @@ from ..framework import random as _random
 from ..tensor import Tensor, unwrap
 
 __all__ = ["Distribution", "Normal", "Uniform", "Categorical",
-           "kl_divergence"]
+           "MultivariateNormalDiag", "kl_divergence"]
 
 
 def _val(x):
@@ -145,8 +145,68 @@ class Categorical(Distribution):
         return Tensor(jnp.exp(unwrap(self.log_prob(value))))
 
 
+class MultivariateNormalDiag(Distribution):
+    """Multivariate normal with diagonal covariance
+    (fluid/layers/distributions.py:531): loc [..., K], scale [..., K]
+    holding the diagonal standard deviations (the reference stores a
+    diagonal MATRIX; a vector is the TPU-native form — pass either)."""
+
+    def __init__(self, loc, scale):
+        loc = _val(loc)
+        s = _val(scale)
+        # the reference passes scale as a [K,K] DIAGONAL matrix; a vector
+        # of standard deviations is the TPU-native form.  Matrix form is
+        # recognized only when scale has exactly one more axis than loc
+        # and square trailing dims (batched vector scales keep their
+        # shape — give loc the same ndim for those).
+        if s.ndim == loc.ndim + 1 and s.ndim >= 2 \
+                and s.shape[-1] == s.shape[-2]:
+            if not isinstance(s, jax.core.Tracer):
+                off = np.asarray(s) * (1 - np.eye(s.shape[-1]))
+                if np.abs(off).max() > 0:
+                    raise ValueError(
+                        "MultivariateNormalDiag requires a DIAGONAL "
+                        "scale matrix (off-diagonal entries present); "
+                        "use a full-covariance distribution instead")
+            s = jnp.diagonal(s, axis1=-2, axis2=-1)
+        # broadcast once so the event size K is well-defined for scalar
+        # or broadcast loc
+        shape = jnp.broadcast_shapes(jnp.shape(loc), jnp.shape(s))
+        if not shape:
+            raise ValueError("MultivariateNormalDiag needs an event axis "
+                             "(loc/scale with at least one dimension)")
+        self.loc = jnp.broadcast_to(loc, shape)
+        self.scale = jnp.broadcast_to(s, shape)
+
+    def sample(self, shape=()):
+        key = _random.split_key()
+        shp = tuple(shape) + self.loc.shape
+        eps = jax.random.normal(key, shp, self.loc.dtype)
+        return Tensor(self.loc + eps * self.scale)
+
+    def entropy(self):
+        K = self.loc.shape[-1]
+        return Tensor(0.5 * (K * (1.0 + math.log(2 * math.pi))
+                             + 2.0 * jnp.log(self.scale).sum(-1)))
+
+    def log_prob(self, value):
+        v = _val(value)
+        z = (v - self.loc) / self.scale
+        K = self.loc.shape[-1]
+        return Tensor(-0.5 * (z ** 2).sum(-1)
+                      - jnp.log(self.scale).sum(-1)
+                      - 0.5 * K * math.log(2 * math.pi))
+
+
 def kl_divergence(p: Distribution, q: Distribution):
     """KL(p || q) for matching families (reference: distributions kl_divergence)."""
+    if isinstance(p, MultivariateNormalDiag) and \
+            isinstance(q, MultivariateNormalDiag):
+        # reference distributions.py:579 diag-gaussian closed form
+        var_ratio = (p.scale / q.scale) ** 2
+        t1 = ((p.loc - q.loc) / q.scale) ** 2
+        return Tensor(0.5 * (var_ratio + t1 - 1.0
+                             - jnp.log(var_ratio)).sum(-1))
     if isinstance(p, Normal) and isinstance(q, Normal):
         var_ratio = (p.scale / q.scale) ** 2
         t1 = ((p.loc - q.loc) / q.scale) ** 2

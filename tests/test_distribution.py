@@ -1,0 +1,106 @@
+"""paddle.distribution parity tests (reference:
+fluid/layers/distributions.py Normal:260 / Uniform:115 / Categorical:425
+/ MultivariateNormalDiag:531)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.distribution import (Categorical, MultivariateNormalDiag,
+                                     Normal, Uniform, kl_divergence)
+
+
+class TestNormal:
+    def test_log_prob_and_entropy(self):
+        d = Normal(0.0, 2.0)
+        lp = float(np.asarray(d.log_prob(
+            paddle.to_tensor(np.float32(0.0))).numpy()))
+        assert abs(lp - (-np.log(2.0) - 0.5 * np.log(2 * np.pi))) < 1e-5
+        ent = float(np.asarray(d.entropy().numpy()))
+        assert abs(ent - (0.5 + 0.5 * np.log(2 * np.pi) + np.log(2.0))) < 1e-5
+
+    def test_kl_zero_for_same(self):
+        d = Normal(1.0, 3.0)
+        assert abs(float(np.asarray(
+            kl_divergence(d, Normal(1.0, 3.0)).numpy()))) < 1e-7
+
+    def test_sampling_moments(self):
+        paddle.seed(0)
+        d = Normal(2.0, 0.5)
+        s = np.asarray(d.sample((4000,)).numpy())
+        assert abs(s.mean() - 2.0) < 0.05
+        assert abs(s.std() - 0.5) < 0.05
+
+
+class TestUniform:
+    def test_lp_inside_outside(self):
+        d = Uniform(0.0, 4.0)
+        inside = float(np.asarray(d.log_prob(
+            paddle.to_tensor(np.float32(1.0))).numpy()))
+        assert abs(inside + np.log(4.0)) < 1e-6
+
+
+class TestCategorical:
+    def test_kl_and_entropy(self):
+        p = Categorical(paddle.to_tensor(np.log(
+            np.array([0.5, 0.5], np.float32))))
+        q = Categorical(paddle.to_tensor(np.log(
+            np.array([0.9, 0.1], np.float32))))
+        kl = float(np.asarray(kl_divergence(p, q).numpy()))
+        expect = 0.5 * np.log(0.5 / 0.9) + 0.5 * np.log(0.5 / 0.1)
+        assert abs(kl - expect) < 1e-5
+
+
+class TestMultivariateNormalDiag:
+    def test_closed_forms(self):
+        p = MultivariateNormalDiag(np.zeros(3, np.float32),
+                                   np.ones(3, np.float32))
+        q = MultivariateNormalDiag(np.ones(3, np.float32),
+                                   2 * np.ones(3, np.float32))
+        lp = float(np.asarray(p.log_prob(np.zeros(3, np.float32)).numpy()))
+        assert abs(lp + 1.5 * np.log(2 * np.pi)) < 1e-5
+        ent = float(np.asarray(p.entropy().numpy()))
+        assert abs(ent - 1.5 * (1 + np.log(2 * np.pi))) < 1e-5
+        kl = float(np.asarray(kl_divergence(p, q).numpy()))
+        expect = 3 * 0.5 * (0.25 + 0.25 - 1 - np.log(0.25))
+        assert abs(kl - expect) < 1e-5
+
+    def test_diag_matrix_input_accepted(self):
+        # the reference stores a diagonal MATRIX; both forms must agree
+        s = np.diag([1.0, 2.0, 3.0]).astype(np.float32)
+        a = MultivariateNormalDiag(np.zeros(3, np.float32), s)
+        b = MultivariateNormalDiag(np.zeros(3, np.float32),
+                                   np.array([1, 2, 3], np.float32))
+        np.testing.assert_allclose(np.asarray(a.entropy().numpy()),
+                                   np.asarray(b.entropy().numpy()))
+
+    def test_sampling_moments(self):
+        paddle.seed(1)
+        d = MultivariateNormalDiag(np.array([1.0, -1.0], np.float32),
+                                   np.array([0.5, 2.0], np.float32))
+        s = np.asarray(d.sample((4000,)).numpy())
+        assert np.abs(s.mean(0) - [1.0, -1.0]).max() < 0.1
+        assert np.abs(s.std(0) - [0.5, 2.0]).max() < 0.15
+
+    def test_broadcast_loc_and_scalar_rejection(self):
+        # broadcast loc [1] against scale [3]: K must be 3, so log_prob
+        # at the mean is -1.5*log(2*pi), not the K=1 value
+        d = MultivariateNormalDiag(np.zeros(1, np.float32),
+                                   np.ones(3, np.float32))
+        lp = float(np.asarray(d.log_prob(np.zeros(3, np.float32)).numpy()))
+        assert abs(lp + 1.5 * np.log(2 * np.pi)) < 1e-5
+        with pytest.raises(ValueError, match="event axis"):
+            MultivariateNormalDiag(0.0, 1.0)
+
+    def test_non_diagonal_matrix_rejected(self):
+        m = np.array([[1.0, 0.5], [0.0, 2.0]], np.float32)
+        with pytest.raises(ValueError, match="DIAGONAL"):
+            MultivariateNormalDiag(np.zeros(2, np.float32), m)
+
+    def test_batched_vector_scale_not_misread_as_matrix(self):
+        # loc [B,K] + scale [B,K] with B==K must stay a batch of vectors
+        loc = np.zeros((3, 3), np.float32)
+        sc = np.array([[1, 1, 1], [2, 2, 2], [3, 3, 3]], np.float32)
+        d = MultivariateNormalDiag(loc, sc)
+        ent = np.asarray(d.entropy().numpy())
+        assert ent.shape == (3,)
+        assert ent[1] > ent[0] and ent[2] > ent[1]

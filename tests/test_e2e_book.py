@@ -1,0 +1,131 @@
+"""Reference "book" e2e contracts beyond MNIST
+(fluid/tests/book/: test_word2vec, test_understand_sentiment,
+test_label_semantic_roles): small models must TRAIN — loss drops and the
+task is learned — through the public API on synthetic data.  Kept small:
+each case trains in seconds on the CPU mesh."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+
+
+class TestWord2Vec:
+    """N-gram LM (book/test_word2vec.py): embeddings + 2-layer MLP over
+    concatenated context embeddings, next-word softmax."""
+
+    def test_ngram_lm_learns_deterministic_sequence(self):
+        paddle.seed(0)
+        V, E, CTX = 20, 16, 4
+        # deterministic cyclic corpus: next token fully predictable
+        corpus = np.arange(200) % V
+        X = np.stack([corpus[i:i + CTX] for i in range(len(corpus) - CTX)])
+        Y = corpus[CTX:].copy()
+
+        class NGram(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(V, E)
+                self.fc1 = nn.Linear(CTX * E, 64)
+                self.fc2 = nn.Linear(64, V)
+
+            def forward(self, ids):
+                e = self.emb(ids)
+                e = paddle.reshape(e, [ids.shape[0], -1])
+                return self.fc2(paddle.nn.functional.relu(self.fc1(e)))
+
+        net = NGram()
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=net.parameters())
+        first = last = None
+        for epoch in range(12):
+            logits = net(paddle.to_tensor(X.astype(np.int64)))
+            loss = paddle.nn.functional.cross_entropy(
+                logits, paddle.to_tensor(Y.astype(np.int64))).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            v = float(np.asarray(loss.numpy()))
+            first = v if first is None else first
+            last = v
+        assert last < first * 0.2, (first, last)
+        pred = np.asarray(net(paddle.to_tensor(
+            X[:50].astype(np.int64))).numpy()).argmax(-1)
+        assert (pred == Y[:50]).mean() > 0.9
+
+
+class TestUnderstandSentiment:
+    """LSTM classifier (book/test_understand_sentiment.py) on the
+    synthetic Imdb dataset (token distributions differ per class)."""
+
+    def test_lstm_classifier_learns(self):
+        from paddle_tpu.text import Imdb
+
+        paddle.seed(0)
+        ds = Imdb(mode="train", seq_len=32, vocab_size=200)
+        X = np.stack([ds[i][0] for i in range(256)]).astype(np.int64)
+        Y = np.array([ds[i][1] for i in range(256)]).astype(np.int64)
+
+        class Net(nn.Layer):
+            def __init__(self):
+                super().__init__()
+                self.emb = nn.Embedding(200, 32)
+                self.lstm = nn.LSTM(32, 32)
+                self.fc = nn.Linear(32, 2)
+
+            def forward(self, ids):
+                e = self.emb(ids)
+                out, (h, c) = self.lstm(e)
+                return self.fc(h[-1] if h.ndim == 3 else h)
+
+        net = Net()
+        opt = paddle.optimizer.Adam(learning_rate=5e-3,
+                                    parameters=net.parameters())
+        for step in range(15):
+            logits = net(paddle.to_tensor(X))
+            loss = paddle.nn.functional.cross_entropy(
+                logits, paddle.to_tensor(Y)).mean()
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        acc = (np.asarray(net(paddle.to_tensor(X)).numpy()).argmax(-1)
+               == Y).mean()
+        assert acc > 0.85, acc
+
+
+class TestLabelSemanticRoles:
+    """CRF sequence tagging (book/test_label_semantic_roles.py):
+    emissions from a Linear + linear_chain_crf loss + ViterbiDecoder."""
+
+    def test_crf_tagger_learns(self):
+        from paddle_tpu.text import ViterbiDecoder, linear_chain_crf
+
+        paddle.seed(0)
+        rs = np.random.RandomState(0)
+        K, D, T, N = 3, 8, 6, 160
+        Wt = rs.randn(D, K).astype(np.float32)
+        feats = rs.randn(N, T, D).astype(np.float32)
+        tags = (feats @ Wt).argmax(-1)
+        lens = np.full((N,), T, np.int64)
+
+        lin = nn.Linear(D, K)
+        trans = paddle.to_tensor(np.zeros((K + 2, K), np.float32))
+        trans.stop_gradient = False
+        opt = paddle.optimizer.Adam(
+            learning_rate=0.1, parameters=list(lin.parameters()) + [trans])
+        for step in range(60):
+            em = lin(paddle.to_tensor(feats))
+            ll = linear_chain_crf(em, trans, paddle.to_tensor(tags),
+                                  paddle.to_tensor(lens))
+            loss = -(ll.mean())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+        assert float(np.asarray(loss.numpy())) < 2.0
+        vit = ViterbiDecoder(
+            paddle.to_tensor(np.asarray(trans.numpy())[2:]),
+            include_bos_eos_tag=False)
+        _, paths = vit(lin(paddle.to_tensor(feats[:16])),
+                       paddle.to_tensor(lens[:16]))
+        acc = (np.asarray(paths.numpy()) == tags[:16]).mean()
+        assert acc > 0.9, acc
