@@ -106,3 +106,108 @@ enable_static = static.enable_static
 in_dynamic_mode = lambda: not static.in_static_mode()  # noqa: E731
 
 __version__ = "0.1.0"
+
+
+# -- fluid-era creation/compat surface (python/paddle/__init__.py aliases) --
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    """A trainable Tensor outside any Layer (fluid layer_helper-created
+    parameter).  ParamAttr resolution (initializer/trainable/name) is the
+    same as Layer.create_parameter (nn/layer_base.py:160): zeros for
+    bias-like, Xavier-uniform otherwise, unless attr or
+    default_initializer says otherwise."""
+    from .framework.dtype import convert_dtype
+    from .nn import initializer as _init
+    from .nn.layer_base import ParamAttr, Parameter, _unique_name
+
+    attr = ParamAttr._to_attr(attr)
+    if attr is False:
+        return None
+    init = attr.initializer or default_initializer or (
+        _init.Constant(0.0) if is_bias else _init.XavierUniform())
+    value = init(tuple(int(d) for d in shape),
+                 convert_dtype(dtype) or "float32")
+    p = Parameter(value, name=name or attr.name or _unique_name("param"),
+                  trainable=attr.trainable)
+    p.optimize_attr["learning_rate"] = attr.learning_rate
+    p.regularizer = attr.regularizer
+    p.need_clip = attr.need_clip
+    return p
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False,
+                      force_cpu=False, name=None):
+    """A non-trainable filled Tensor (fluid create_global_var)."""
+    t = full(shape, value, dtype=dtype)
+    t.stop_gradient = True
+    if name:
+        t.name = name
+    return t
+
+
+class LoDTensor(Tensor):
+    """Compat shim: LoD (level-of-detail) tensors do not exist on TPU —
+    variable-length batches are padded arrays + seq_len (COVERAGE.md,
+    paddle_tpu.text.sequence).  Keeps the fluid construction patterns
+    working — `LoDTensor()` + `.set(array, place)` and
+    `LoDTensor(array)`; lod() is always empty."""
+
+    def __init__(self, value=None, *args, **kwargs):
+        import numpy as _np
+
+        if value is None:
+            value = _np.zeros((0,), _np.float32)
+        super().__init__(value, *args, **kwargs)
+
+    def set(self, array, place=None):
+        import jax.numpy as _jnp
+
+        self._value = _jnp.asarray(array)
+
+    def lod(self):
+        return []
+
+    def recursive_sequence_lengths(self):
+        return []
+
+    def set_lod(self, lod):
+        raise NotImplementedError(
+            "LoD metadata is not representable on TPU; keep sequences "
+            "padded with explicit seq_len (paddle_tpu.text.sequence)")
+
+
+class LoDTensorArray(list):
+    """Compat shim for the vector<LoDTensor> container (array ops live in
+    paddle_tpu.static.nn TensorArray)."""
+
+
+def get_cuda_rng_state():
+    """RNG state for checkpoint round-trips.  There is no CUDA here: the
+    framework RNG is a (seed, counter) chain (framework/random.py) and
+    that pair is the state."""
+    from .framework import random as _r
+
+    return [("paddle_tpu", _r._state.seed_value, _r._state.counter)]
+
+
+def set_cuda_rng_state(state):
+    from .framework import random as _r
+
+    if state and isinstance(state[0], tuple) and state[0][0] == "paddle_tpu":
+        _, s, c = state[0]
+        seed(int(s))
+        _r._state.counter = int(c)
+    else:
+        raise ValueError("unrecognized rng state (expected the value from "
+                         "paddle_tpu.get_cuda_rng_state())")
+
+
+def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
+    """fluid fill_constant alias of paddle.full (fill_constant_op.cc);
+    out= fills the given variable in place (the fluid idiom discards the
+    return value)."""
+    t = full(shape, value, dtype=dtype)
+    if out is not None:
+        out.set_value(t)
+        return out
+    return t

@@ -1208,3 +1208,114 @@ def _install_methods():
 
 
 _install_methods()
+
+
+# ---------------------------------------------------------------------------
+# fluid-era top-level aliases (python/paddle/__init__.py #DEFINE_ALIAS
+# block): same lowerings under the legacy names
+# ---------------------------------------------------------------------------
+def _fluid_axis_align(x, y, axis):
+    """fluid's elementwise axis semantics (elementwise_op_function.h):
+    y's dims align to x's starting at `axis` (counted from the left), so
+    trailing singleton axes are appended to y before broadcasting."""
+    if axis == -1:
+        return y
+    xv, yv = unwrap(x), unwrap(y)
+    pad = xv.ndim - int(axis) - yv.ndim
+    if pad < 0:
+        raise ValueError(
+            f"elementwise axis={axis} incompatible with ranks "
+            f"{xv.ndim} vs {yv.ndim}")
+    if pad == 0:
+        return y
+    return apply(lambda v: v.reshape(v.shape + (1,) * pad), y)
+
+
+def elementwise_add(x, y, axis=-1, name=None):
+    return add(x, _fluid_axis_align(x, y, axis))
+
+
+def elementwise_sub(x, y, axis=-1, name=None):
+    return subtract(x, _fluid_axis_align(x, y, axis))
+
+
+def elementwise_mul(x, y, axis=-1, name=None):
+    return multiply(x, _fluid_axis_align(x, y, axis))
+
+
+def elementwise_div(x, y, axis=-1, name=None):
+    return divide(x, _fluid_axis_align(x, y, axis))
+
+
+def elementwise_floordiv(x, y, axis=-1, name=None):
+    return floor_divide(x, _fluid_axis_align(x, y, axis))
+
+
+def elementwise_mod(x, y, axis=-1, name=None):
+    return mod(x, _fluid_axis_align(x, y, axis))
+
+
+def elementwise_pow(x, y, axis=-1, name=None):
+    return pow(x, _fluid_axis_align(x, y, axis))
+
+
+def floor_mod(x, y, name=None):
+    return mod(x, y)
+
+
+def reduce_sum(x, dim=None, keep_dim=False, name=None):
+    return sum(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_mean(x, dim=None, keep_dim=False, name=None):
+    return mean(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_max(x, dim=None, keep_dim=False, name=None):
+    return max(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_min(x, dim=None, keep_dim=False, name=None):
+    return min(x, axis=dim, keepdim=keep_dim)
+
+
+def reduce_prod(x, dim=None, keep_dim=False, name=None):
+    return prod(x, axis=dim, keepdim=keep_dim)
+
+
+def broadcast_shape(x_shape, y_shape):
+    """Shape of broadcasting x_shape against y_shape
+    (paddle.broadcast_shape)."""
+    return list(jnp.broadcast_shapes(tuple(x_shape), tuple(y_shape)))
+
+
+def has_inf(x, name=None):
+    return apply(lambda v: jnp.isinf(v).any(), x)
+
+
+def has_nan(x, name=None):
+    return apply(lambda v: jnp.isnan(v).any(), x)
+
+
+def set_printoptions(precision=None, threshold=None, edgeitems=None,
+                     sci_mode=None, linewidth=None):
+    """Tensor repr formatting (paddle.set_printoptions): Tensor printing
+    routes through numpy, so this forwards to numpy's printoptions."""
+    kw = {}
+    if precision is not None:
+        kw["precision"] = precision
+    if threshold is not None:
+        kw["threshold"] = threshold
+    if edgeitems is not None:
+        kw["edgeitems"] = edgeitems
+    if linewidth is not None:
+        kw["linewidth"] = linewidth
+    if sci_mode is not None:
+        kw["suppress"] = not sci_mode
+    np.set_printoptions(**kw)
+
+
+def get_tensor_from_selected_rows(x, name=None):
+    """SelectedRows do not exist on TPU (gradients are dense pytree
+    arrays — COVERAGE.md); the contained tensor IS the input."""
+    return x if isinstance(x, Tensor) else Tensor(unwrap(x))
