@@ -211,3 +211,8 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
         out.set_value(t)
         return out
     return t
+
+
+# tensor-array ops at top level (python/paddle/tensor/__init__.py aliases)
+from .static.nn import (  # noqa: E402,F401
+    array_length, array_read, array_write, create_array)

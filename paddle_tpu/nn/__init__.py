@@ -121,3 +121,211 @@ from .layer.transformer import (  # noqa: F401
     TransformerEncoderLayer,
 )
 from .clip import ClipGradByGlobalNorm, ClipGradByNorm, ClipGradByValue  # noqa: F401
+
+
+# --------------------------------------------------------------------------
+# reference paddle.nn surface completion (round-4)
+# --------------------------------------------------------------------------
+from .layer import conv, loss, rnn as _rnn_mod  # noqa: F401,E402
+rnn = _rnn_mod
+from .layer.rnn import _RNNCellBase as RNNCellBase  # noqa: F401,E402
+from ..static.nn import cond, while_loop  # noqa: F401,E402
+
+
+def Input(shape=None, dtype="float32", name=None):
+    """paddle.nn.Input -> an InputSpec for to_static signatures (the
+    static-graph placeholder form is paddle.static.data)."""
+    from ..jit import InputSpec
+
+    return InputSpec(shape=shape, dtype=dtype, name=name)
+
+
+def crf_decoding(*args, **kwargs):
+    from . import functional as _F
+
+    return _F.crf_decoding(*args, **kwargs)
+
+
+def ctc_greedy_decoder(*args, **kwargs):
+    from . import functional as _F
+
+    return _F.ctc_greedy_decoder(*args, **kwargs)
+
+
+class AdaptiveAvgPool3D(Layer):
+    def __init__(self, output_size, data_format="NCDHW", name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        from . import functional as _F
+
+        return _F.adaptive_avg_pool3d(x, self._os)
+
+
+class AdaptiveMaxPool1D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        from . import functional as _F
+
+        return _F.adaptive_max_pool1d(x, self._os)
+
+
+class AdaptiveMaxPool3D(Layer):
+    def __init__(self, output_size, return_mask=False, name=None):
+        super().__init__()
+        self._os = output_size
+
+    def forward(self, x):
+        from . import functional as _F
+
+        return _F.adaptive_max_pool3d(x, self._os)
+
+
+class PairwiseDistance(Layer):
+    """||x - y||_p along the last axis (nn/layer/distance.py)."""
+
+    def __init__(self, p=2.0, epsilon=1e-6, keepdim=False, name=None):
+        super().__init__()
+        self.p, self.eps, self.keepdim = p, epsilon, keepdim
+
+    def forward(self, x, y):
+        from .. import tensor_ops as T
+
+        d = T.add(T.subtract(x, y), T.full([1], self.eps, "float32"))
+        return T.norm(d, p=self.p, axis=-1, keepdim=self.keepdim)
+
+
+class Decoder:
+    """Seq2seq decoder contract (paddle.nn.decode.Decoder):
+    initialize() -> (inputs, states, finished); step() -> (outputs,
+    states, next_inputs, finished)."""
+
+    def initialize(self, inits):
+        raise NotImplementedError("subclass Decoder and implement "
+                                  "initialize()")
+
+    def step(self, time, inputs, states, **kwargs):
+        raise NotImplementedError("subclass Decoder and implement step()")
+
+    def finalize(self, outputs, final_states, sequence_lengths):
+        return outputs, final_states
+
+
+class BeamSearchDecoder(Decoder):
+    """Beam-search decoding over an RNN cell
+    (paddle.nn.BeamSearchDecoder re-designed on text.beam_search_step):
+    embedding_fn maps token ids to cell inputs, output_fn maps cell
+    outputs to vocab logits."""
+
+    def __init__(self, cell, start_token, end_token, beam_size,
+                 embedding_fn=None, output_fn=None):
+        self.cell = cell
+        self.start, self.end = int(start_token), int(end_token)
+        self.beam_size = int(beam_size)
+        self.embedding_fn = embedding_fn or (lambda ids: ids)
+        self.output_fn = output_fn or (lambda x: x)
+
+
+def dynamic_decode(decoder, inits=None, max_step_num=32, **kwargs):
+    """Run a BeamSearchDecoder to completion (paddle.nn.dynamic_decode):
+    returns (token ids [B, beam, T], final scores [B, beam]).  Eager
+    host loop — the jit form is a user-side lax.scan over
+    text.beam_search_step."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    from ..tensor import Tensor, unwrap
+    from ..text import beam_search_decode, beam_search_step
+
+    cell, W = decoder.cell, decoder.beam_size
+    state0 = inits
+    if state0 is None:
+        raise ValueError("dynamic_decode needs the encoder final state "
+                         "as `inits`")
+    B = unwrap(state0[0] if isinstance(state0, (tuple, list))
+               else state0).shape[0]
+
+    def tile(s):
+        if isinstance(s, (tuple, list)):
+            return type(s)(tile(x) for x in s)
+        v = unwrap(s)
+        return Tensor(jnp.repeat(v, W, axis=0))
+
+    states = tile(state0)
+    ids = Tensor(jnp.full((B * W,), decoder.start, jnp.int32))
+    scores = jnp.where(jnp.arange(W)[None, :] == 0, 0.0, -1e9)
+    scores = Tensor(jnp.broadcast_to(scores, (B, W)).astype(jnp.float32))
+    fin = Tensor(jnp.zeros((B, W), bool))
+    step_ids, step_parents = [], []
+    for t in range(max_step_num):
+        out, states = cell(decoder.embedding_fn(ids), states)
+        logits = decoder.output_fn(out)
+        V = unwrap(logits).shape[-1]
+        logp = jax.nn.log_softmax(unwrap(logits), -1)
+        sel_ids, parents, scores = beam_search_step(
+            Tensor(logp.reshape(B, W, V)), scores, W,
+            end_token=decoder.end, finished=fin)
+        step_ids.append(unwrap(sel_ids))
+        step_parents.append(unwrap(parents))
+        # reorder states along the beam axis by parent
+        flat_parent = (jnp.arange(B)[:, None] * W
+                       + unwrap(parents)).reshape(-1)
+
+        def reorder(s):
+            if isinstance(s, (tuple, list)):
+                return type(s)(reorder(x) for x in s)
+            return Tensor(unwrap(s)[flat_parent])
+
+        states = reorder(states)
+        ids = Tensor(unwrap(sel_ids).reshape(-1).astype(jnp.int32))
+        fin = Tensor(unwrap(fin)[
+            jnp.arange(B)[:, None], unwrap(parents)]
+            | (unwrap(sel_ids) == decoder.end))
+        if bool(np.asarray(unwrap(fin)).all()):
+            break
+    seqs, final_scores = beam_search_decode(
+        Tensor(jnp.stack(step_ids)), Tensor(jnp.stack(step_parents)),
+        scores)
+    return seqs, final_scores
+
+
+class _FluidEraStub:
+    _msg = ""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(self._msg)
+
+
+class DynamicRNN(_FluidEraStub):
+    _msg = ("DynamicRNN is a fluid LoD program builder; on TPU write the "
+            "recurrence with nn.LSTM/GRU or lax.scan over padded "
+            "sequences (COVERAGE.md, text.sequence)")
+
+
+class StaticRNN(_FluidEraStub):
+    _msg = ("StaticRNN is a fluid program builder; on TPU write the "
+            "recurrence with nn.LSTM/GRU or lax.scan (COVERAGE.md)")
+
+
+class HSigmoidLoss(_FluidEraStub):
+    _msg = ("hierarchical sigmoid needs a host-side Huffman tree; use "
+            "full softmax cross_entropy (COVERAGE.md non-goal)")
+
+
+class NCELoss(_FluidEraStub):
+    _msg = ("NCE needs a host-side sampling table; use sampled softmax "
+            "composed from multinomial + cross_entropy (COVERAGE.md "
+            "non-goal)")
+
+
+class TreeConv(_FluidEraStub):
+    _msg = ("TreeConv is a PS-era recommender op (COVERAGE.md non-goal)")
+
+
+from ..vision import ops as vision  # noqa: F401,E402  (paddle.nn.vision)

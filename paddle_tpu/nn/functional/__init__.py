@@ -1421,3 +1421,581 @@ def max_unpool2d(x, indices, kernel_size, stride=None, padding=0,
             idx.reshape(B, C, -1)].set(v.reshape(B, C, -1), mode="drop")
         return out.reshape(B, C, oh, ow)
     return apply(f, x, indices)
+
+
+# --------------------------------------------------------------------------
+# reference paddle.nn.functional surface completion (round-4): re-exports
+# of ops that live in their subsystem modules, fluid-era aliases, and the
+# remaining small lowerings.  Documented non-goals raise with a pointer
+# to COVERAGE.md (no bare NotImplementedError).
+# --------------------------------------------------------------------------
+
+def _non_goal(name, why):
+    def stub(*args, **kwargs):
+        raise NotImplementedError(
+            f"{name} is a documented non-goal on TPU ({why}); see "
+            "COVERAGE.md for the disposition and the supported "
+            "alternative")
+    stub.__name__ = name
+    return stub
+
+
+def _lod_absorbed(name):
+    return _non_goal(
+        name, "LoD tensors are replaced by dense padding + seq_len; use "
+        "paddle_tpu.text.sequence")
+
+
+# -- detection / vision (implementations: paddle_tpu.vision.ops) ----------
+def __getattr__(name):  # module-level PEP 562 fallback
+    _vision_ops = (
+        "affine_channel anchor_generator bipartite_match box_clip "
+        "box_coder box_decoder_and_assign collect_fpn_proposals "
+        "density_prior_box distribute_fpn_proposals generate_proposals "
+        "generate_proposal_labels multiclass_nms prior_box prroi_pool "
+        "psroi_pool retinanet_detection_output "
+        "rpn_target_assign roi_align roi_pool polygon_box_transform "
+        "target_assign space_to_depth yolo_box random_crop".split())
+    if name in _vision_ops:
+        from ...vision import ops as _V
+
+        return getattr(_V, name)
+    if name in ("sequence_concat", "sequence_conv", "sequence_enumerate",
+                "sequence_expand", "sequence_expand_as", "sequence_pad",
+                "sequence_pool", "sequence_reshape", "sequence_reverse",
+                "sequence_scatter", "sequence_slice", "sequence_softmax",
+                "sequence_unpad", "sequence_mask"):
+        from ...text import sequence as _sq
+
+        return getattr(_sq, name)
+    if name in ("array_read", "array_write", "array_length",
+                "create_array", "tensor_array_to_tensor"):
+        from ...static import nn as _snn
+
+        return getattr(_snn, name)
+    if name == "linear_chain_crf":
+        from ...text import linear_chain_crf as _f
+
+        return _f
+    if name == "diag_embed":
+        from ...creation import diag as _f
+
+        return _f
+    if name == "erf":
+        from ... import tensor_ops as _T
+
+        return _T.erf
+    if name == "shuffle_channel":
+        from ...vision.ops import channel_shuffle as _f
+
+        return _f
+    if name == "retinanet_target_assign":
+        from ...vision.ops import rpn_target_assign as _f
+
+        return _f
+    if name == "random_crop":
+        from ...vision.ops import random_crop as _f
+
+        return _f
+    raise AttributeError(name)
+
+
+def deformable_conv(x, offset, mask, num_filters=None, filter_size=None,
+                    weight=None, stride=1, padding=0, dilation=1,
+                    groups=1, deformable_groups=1, im2col_step=1,
+                    bias=None, name=None):
+    """fluid.layers.deformable_conv signature over vision.ops.deform_conv2d
+    (v1 when mask is None, v2 otherwise)."""
+    from ...vision.ops import deform_conv2d
+
+    return deform_conv2d(x, offset, weight, mask=mask, stride=stride,
+                         padding=padding, dilation=dilation,
+                         groups=groups,
+                         deformable_groups=deformable_groups, bias=bias)
+
+
+def deformable_roi_pooling(input, rois, trans=None, no_trans=True,
+                           spatial_scale=1.0, group_size=(1, 1),
+                           pooled_height=7, pooled_width=7, part_size=None,
+                           sample_per_part=4, trans_std=0.1, name=None):
+    """Position-sensitive RoI pooling; the learned-offset (trans) variant
+    is not implemented — with no_trans it IS psroi_pool (COVERAGE.md)."""
+    if not no_trans and trans is not None:
+        raise NotImplementedError(
+            "deformable_roi_pooling with learned offsets is not "
+            "implemented; the no_trans form is vision.ops.psroi_pool "
+            "(COVERAGE.md)")
+    from ...vision.ops import psroi_pool
+
+    return psroi_pool(input, rois, output_size=(pooled_height,
+                                                pooled_width),
+                      spatial_scale=spatial_scale)
+
+
+def yolov3_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                ignore_thresh, downsample_ratio, gt_score=None,
+                use_label_smooth=True, name=None):
+    from ...vision.ops import yolo_loss
+
+    return yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
+                     ignore_thresh, downsample_ratio, gt_score,
+                     use_label_smooth)
+
+
+def detection_output(loc, scores, prior_box, prior_box_var,
+                     background_label=0, nms_threshold=0.3, nms_top_k=400,
+                     keep_top_k=200, score_threshold=0.01, nms_eta=1.0):
+    """SSD post-processing (detection_output_op.cc): decode loc deltas
+    against priors, then per-class NMS via multiclass_nms."""
+    import numpy as _np
+
+    from ...vision.ops import box_coder, multiclass_nms
+
+    decoded = box_coder(prior_box, prior_box_var, loc,
+                        code_type="decode_center_size", box_normalized=True)
+    sv = _np.asarray(unwrap(scores))
+    dv = _np.asarray(unwrap(decoded))
+    if sv.ndim == 2:   # fluid layout [num_priors, C] -> class-major [C, N]
+        return multiclass_nms(dv, sv.T,
+                              score_threshold=score_threshold,
+                              nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                              nms_threshold=nms_threshold,
+                              background_label=background_label)
+    # batched [N, Np, C]: per-image results as a list (the reference
+    # returns a LoD batch; a python list is the dense analog)
+    return [multiclass_nms(dv[i] if dv.ndim == 3 else dv, sv[i].T,
+                           score_threshold=score_threshold,
+                           nms_top_k=nms_top_k, keep_top_k=keep_top_k,
+                           nms_threshold=nms_threshold,
+                           background_label=background_label)
+            for i in range(sv.shape[0])]
+
+
+# -- sequence step helpers -------------------------------------------------
+def sequence_first_step(x, seq_len=None):
+    from ...text.sequence import sequence_pool
+
+    if seq_len is None:
+        import jax.numpy as _jnp
+
+        seq_len = Tensor(_jnp.full((unwrap(x).shape[0],),
+                                   unwrap(x).shape[1], _jnp.int32))
+    return sequence_pool(x, seq_len, "FIRST")
+
+
+def sequence_last_step(x, seq_len=None):
+    from ...text.sequence import sequence_pool
+
+    if seq_len is None:
+        import jax.numpy as _jnp
+
+        seq_len = Tensor(_jnp.full((unwrap(x).shape[0],),
+                                   unwrap(x).shape[1], _jnp.int32))
+    return sequence_pool(x, seq_len, "LAST")
+
+
+# -- pooling / resize aliases ---------------------------------------------
+def _spatial_shape(v, data_format):
+    return (list(v.shape[1:-1]) if data_format.endswith("C")
+            else list(v.shape[2:]))
+
+
+def pool2d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCHW", name=None):
+    """fluid.layers.pool2d facade over max/avg_pool2d."""
+    if global_pooling:
+        pool_size = _spatial_shape(unwrap(input), data_format)
+        pool_padding = 0
+    if pool_type == "max":
+        return max_pool2d(input, pool_size, pool_stride, pool_padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    return avg_pool2d(input, pool_size, pool_stride, pool_padding,
+                      ceil_mode=ceil_mode, exclusive=exclusive,
+                      data_format=data_format)
+
+
+def pool3d(input, pool_size=-1, pool_type="max", pool_stride=1,
+           pool_padding=0, global_pooling=False, ceil_mode=False,
+           exclusive=True, data_format="NCDHW", name=None):
+    if global_pooling:
+        pool_size = _spatial_shape(unwrap(input), data_format)
+        pool_padding = 0
+    if pool_type == "max":
+        return max_pool3d(input, pool_size, pool_stride, pool_padding,
+                          ceil_mode=ceil_mode, data_format=data_format)
+    return avg_pool3d(input, pool_size, pool_stride, pool_padding,
+                      ceil_mode=ceil_mode, exclusive=exclusive,
+                      data_format=data_format)
+
+
+def adaptive_avg_pool3d(x, output_size, data_format="NCDHW", name=None):
+    """Adaptive 3D average pool (floor-start/ceil-end bins like the 2D
+    form); one reduce_window when the size divides evenly."""
+    os_ = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(v):
+        B, C, D, H, W = v.shape
+        if D % os_[0] == 0 and H % os_[1] == 0 and W % os_[2] == 0:
+            k = (1, 1, D // os_[0], H // os_[1], W // os_[2])
+            s = jax.lax.reduce_window(v, np.dtype(v.dtype).type(0),
+                                      jax.lax.add, k, k, "VALID")
+            return s / (k[2] * k[3] * k[4])
+        out = jnp.zeros((B, C) + os_, v.dtype)
+        for i in range(os_[0]):
+            d0, d1 = (i * D) // os_[0], -(-((i + 1) * D) // os_[0])
+            for j in range(os_[1]):
+                h0, h1 = (j * H) // os_[1], -(-((j + 1) * H) // os_[1])
+                for k in range(os_[2]):
+                    w0, w1 = (k * W) // os_[2], -(-((k + 1) * W) // os_[2])
+                    out = out.at[:, :, i, j, k].set(
+                        v[:, :, d0:d1, h0:h1, w0:w1].mean((2, 3, 4)))
+        return out
+
+    return apply(f, x)
+
+
+def adaptive_max_pool1d(x, output_size, return_mask=False, name=None):
+    def f(v):
+        B, C, L = v.shape
+        outs = []
+        for i in range(output_size):
+            l0, l1 = (i * L) // output_size, -(-((i + 1) * L) // output_size)
+            outs.append(v[:, :, l0:l1].max(-1))
+        return jnp.stack(outs, -1)
+
+    return apply(f, x)
+
+
+def adaptive_max_pool3d(x, output_size, return_mask=False, name=None):
+    os_ = (output_size,) * 3 if isinstance(output_size, int) \
+        else tuple(output_size)
+
+    def f(v):
+        B, C, D, H, W = v.shape
+        if D % os_[0] == 0 and H % os_[1] == 0 and W % os_[2] == 0:
+            k = (1, 1, D // os_[0], H // os_[1], W // os_[2])
+            return jax.lax.reduce_window(
+                v, np.dtype(v.dtype).type(-np.inf), jax.lax.max, k, k,
+                "VALID")
+        out = jnp.zeros((B, C) + os_, v.dtype)
+        for i in range(os_[0]):
+            d0, d1 = (i * D) // os_[0], -(-((i + 1) * D) // os_[0])
+            for j in range(os_[1]):
+                h0, h1 = (j * H) // os_[1], -(-((j + 1) * H) // os_[1])
+                for k in range(os_[2]):
+                    w0, w1 = (k * W) // os_[2], -(-((k + 1) * W) // os_[2])
+                    out = out.at[:, :, i, j, k].set(
+                        v[:, :, d0:d1, h0:h1, w0:w1].max((2, 3, 4)))
+        return out
+
+    return apply(f, x)
+
+
+def image_resize(input, out_shape=None, scale=None, resample="BILINEAR",
+                 align_corners=True, align_mode=1, data_format="NCHW",
+                 name=None):
+    mode = {"BILINEAR": "bilinear", "NEAREST": "nearest",
+            "TRILINEAR": "trilinear", "BICUBIC": "bicubic"}[resample]
+    return interpolate(input, size=out_shape, scale_factor=scale,
+                       mode=mode, align_corners=align_corners)
+
+
+def image_resize_short(input, out_short_len, resample="BILINEAR"):
+    v = unwrap(input)
+    H, W = v.shape[2], v.shape[3]
+    short = min(H, W)
+    ratio = out_short_len / short
+    return image_resize(input,
+                        [int(round(H * ratio)), int(round(W * ratio))],
+                        resample=resample)
+
+
+def resize_bilinear(input, out_shape=None, scale=None, name=None,
+                    align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, "BILINEAR", align_corners)
+
+
+def resize_nearest(input, out_shape=None, scale=None, name=None,
+                   align_corners=True):
+    return image_resize(input, out_shape, scale, "NEAREST", align_corners)
+
+
+def resize_trilinear(input, out_shape=None, scale=None, name=None,
+                     align_corners=True, align_mode=1):
+    return image_resize(input, out_shape, scale, "TRILINEAR", align_corners)
+
+
+# -- misc fluid layers -----------------------------------------------------
+def fc(input, size, num_flatten_dims=1, weight=None, bias=None, name=None):
+    """fluid.layers.fc: flatten trailing dims then linear; weight/bias
+    must be provided (create_parameter) — the layer form is nn.Linear."""
+    v = unwrap(input)
+    lead = v.shape[:num_flatten_dims]
+    from ... import tensor_ops as T
+
+    flat = T.reshape(input, list(lead) + [-1])
+    if weight is None:
+        raise ValueError("functional fc needs an explicit weight "
+                         "(paddle.create_parameter); use nn.Linear for "
+                         "the parameterized layer form")
+    return linear(flat, weight, bias)
+
+
+def bilinear_tensor_product(x, y, weight, bias=None, name=None):
+    return bilinear(x, y, weight, bias)
+
+
+def smooth_l1(x, y, inside_weight=None, outside_weight=None, sigma=None):
+    """fluid smooth_l1 (sum form, optional elementwise weights):
+    inside_weight scales the diff BEFORE the Huber switch, outside_weight
+    scales the loss after it (smooth_l1_loss_op.cc)."""
+    has_iw = inside_weight is not None
+    has_ow = outside_weight is not None
+
+    def f(a, b, *w):
+        iw = w[0] if has_iw else jnp.ones_like(a)
+        ow = w[-1] if has_ow else jnp.ones_like(a)
+        d = (a - b) * iw
+        s2 = (sigma or 1.0) ** 2
+        loss = jnp.where(jnp.abs(d) < 1.0 / s2,
+                         0.5 * s2 * d * d, jnp.abs(d) - 0.5 / s2)
+        return (loss * ow).sum(axis=tuple(range(1, a.ndim)),
+                               keepdims=False)[..., None]
+
+    args = [x, y] + [a for a in (inside_weight, outside_weight)
+                     if a is not None]
+    return apply(f, *args)
+
+
+def soft_relu(x, threshold=40.0, name=None):
+    """log(1 + exp(clip(x, -t, t))) (activation_op.h SoftRelu)."""
+    return apply(lambda v: jnp.log1p(jnp.exp(jnp.clip(v, -threshold,
+                                                      threshold))), x)
+
+
+def dice_loss(input, label, epsilon=1e-5, name=None):
+    """1 - 2|X∩Y|/(|X|+|Y|) over the trailing class axis (dice_loss in
+    fluid/layers/nn.py)."""
+    def f(p, y):
+        yf = jax.nn.one_hot(y.squeeze(-1), p.shape[-1], dtype=p.dtype) \
+            if y.shape[-1] == 1 and y.dtype in (jnp.int32, jnp.int64) \
+            else y.astype(p.dtype)
+        reduce_dims = tuple(range(1, p.ndim))
+        inter = (p * yf).sum(reduce_dims)
+        union = p.sum(reduce_dims) + yf.sum(reduce_dims)
+        return (1.0 - (2.0 * inter + epsilon) / (union + epsilon)).mean()
+
+    return apply(f, input, label)
+
+
+def npair_loss(anchor, positive, labels, l2_reg=0.002):
+    """N-pair metric loss (fluid/layers/loss.py npair_loss)."""
+    def f(a, p, y):
+        B = a.shape[0]
+        logits = a @ p.T
+        tgt = (y[:, None] == y[None, :]).astype(logits.dtype)
+        tgt = tgt / tgt.sum(-1, keepdims=True)
+        logp = jax.nn.log_softmax(logits, -1)
+        xe = -(tgt * logp).sum(-1).mean()
+        reg = (a * a).sum() / B + (p * p).sum() / B
+        return xe + l2_reg * reg * 0.25
+
+    return apply(f, anchor, positive, labels)
+
+
+def fsp_matrix(x, y):
+    """Flow-of-solution-procedure matrix (fsp_op.cc): [B, Cx, Cy] =
+    x·y over the spatial map, normalized by H*W."""
+    return apply(lambda a, b: jnp.einsum("bchw,bdhw->bcd", a, b)
+                 / (a.shape[2] * a.shape[3]), x, y)
+
+
+def warpctc(input, label, input_length=None, label_length=None,
+            blank=0, norm_by_times=False):
+    return ctc_loss(input, label, input_length, label_length, blank=blank)
+
+
+def ctc_greedy_decoder(input, blank, input_length=None, name=None):
+    """Greedy CTC decode (ctc_align over the argmax path)."""
+    from ... import tensor_ops as T
+
+    ids = T.argmax(input, axis=-1)
+    if input_length is None:
+        import jax.numpy as _jnp
+
+        v = unwrap(ids)
+        input_length = Tensor(_jnp.full((v.shape[0],), v.shape[1],
+                                        _jnp.int32))
+    return ctc_align(ids, input_length, blank=blank)
+
+
+def crf_decoding(input, transition, seq_len=None, label=None, name=None):
+    """Viterbi decode with the CRF's [K+2, K] transition layout
+    (crf_decoding_op.cc): returns the best path ids."""
+    import jax.numpy as _jnp
+
+    from ...text import ViterbiDecoder
+
+    tr = unwrap(transition)
+    dec = ViterbiDecoder(Tensor(tr[2:]), include_bos_eos_tag=False)
+    v = unwrap(input)
+    if seq_len is None:
+        seq_len = Tensor(_jnp.full((v.shape[0],), v.shape[1], _jnp.int32))
+    _, paths = dec(input, seq_len)
+    return paths
+
+
+def data_norm(input, epsilon=1e-5, **kwargs):
+    """data_norm_op.cc: normalize by accumulated batch statistics — the
+    stateless form normalizes with the batch's own moments."""
+    def f(v):
+        mu = v.mean(0, keepdims=True)
+        var = v.var(0, keepdims=True)
+        return (v - mu) * jax.lax.rsqrt(var + epsilon)
+
+    return apply(f, input)
+
+
+_step_counters = {}
+
+
+def autoincreased_step_counter(counter_name=None, begin=1, step=1):
+    """Per-name step counter (fluid layers.autoincreased_step_counter):
+    python-int state keyed by counter_name, returned as an int64 Tensor
+    (the reference op's dtype)."""
+    import jax.numpy as _jnp
+
+    key = counter_name or "@STEP_COUNTER@"
+    if key not in _step_counters:
+        _step_counters[key] = begin
+    else:
+        _step_counters[key] += step
+    dt = _jnp.int64 if jax.config.jax_enable_x64 else _jnp.int32
+    return Tensor(_jnp.asarray(_step_counters[key], dt))
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Functional spectral normalization (spectral_norm_op.cc): a few
+    power iterations estimate sigma_max; returns weight / sigma."""
+    def f(w):
+        wm = jnp.moveaxis(w, dim, 0).reshape(w.shape[dim], -1)
+        u = jnp.ones((wm.shape[0],), w.dtype)
+        v = None
+        for _ in range(builtins_max(power_iters, 1)):
+            v = wm.T @ u
+            v = v / (jnp.linalg.norm(v) + eps)
+            u = wm @ v
+            u = u / (jnp.linalg.norm(u) + eps)
+        sigma = u @ wm @ v
+        return w / sigma
+
+    import builtins as _b
+    builtins_max = _b.max
+    return apply(f, weight)
+
+
+# -- documented non-goals / LoD-era stubs ---------------------------------
+nce = _non_goal("nce", "host-side negative-sampling table")
+hsigmoid_loss = _non_goal("hsigmoid_loss", "host-side Huffman tree")
+hash = _non_goal("hash", "PS-era recommender op")  # noqa: A001
+filter_by_instag = _non_goal("filter_by_instag", "PS-era recommender op")
+continuous_value_model = _non_goal("continuous_value_model",
+                                   "PS-era recommender op")
+teacher_student_sigmoid_loss = _non_goal("teacher_student_sigmoid_loss",
+                                         "PS-era recommender op")
+similarity_focus = _non_goal("similarity_focus", "PS-era recommender op")
+multi_box_head = _non_goal(
+    "multi_box_head", "SSD graph-builder helper; compose prior_box + "
+    "conv heads directly")
+roi_perspective_transform = _non_goal(
+    "roi_perspective_transform", "OCR-specific; compose grid_sample + "
+    "roi_align")
+generate_mask_labels = _non_goal("generate_mask_labels",
+                                 "Mask-RCNN host-side label carving")
+im2sequence = _lod_absorbed("im2sequence")
+lod_append = _lod_absorbed("lod_append")
+lod_reset = _lod_absorbed("lod_reset")
+reorder_lod_tensor_by_rank = _lod_absorbed("reorder_lod_tensor_by_rank")
+dynamic_gru = _lod_absorbed("dynamic_gru")
+dynamic_lstm = _lod_absorbed("dynamic_lstm")
+dynamic_lstmp = _lod_absorbed("dynamic_lstmp")
+merge_selected_rows = _non_goal("merge_selected_rows",
+                                "SelectedRows do not exist (dense grads)")
+
+
+def gru_unit(input, hidden, weight=None, bias=None, **kwargs):
+    raise NotImplementedError(
+        "gru_unit's fused fluid contract is absorbed by nn.GRUCell "
+        "(COVERAGE.md: lax.scan is the recurrence primitive)")
+
+
+def lstm_unit(x_t, hidden_t_prev, cell_t_prev, **kwargs):
+    raise NotImplementedError(
+        "lstm_unit's fused fluid contract is absorbed by nn.LSTMCell "
+        "(COVERAGE.md: lax.scan is the recurrence primitive)")
+
+
+def lstm(input, init_h, init_c, max_len=None, hidden_size=None,
+         num_layers=1, **kwargs):
+    raise NotImplementedError(
+        "fluid.layers.lstm (cudnn contract) is absorbed by nn.LSTM "
+        "(COVERAGE.md)")
+
+
+def rnn(cell, inputs, initial_states=None, sequence_length=None,
+        time_major=False, is_reverse=False, **kwargs):
+    """Run an RNN cell over time (paddle.nn.functional.rnn > fluid
+    rnn): host-level loop over the cell, batch-major by default."""
+    from ... import tensor_ops as T
+
+    x = inputs
+    if time_major:
+        x = T.transpose(x, [1, 0, 2])
+    B = unwrap(x).shape[0]
+    Tlen = unwrap(x).shape[1]
+    state = cell.get_initial_states(B) if initial_states is None \
+        else initial_states
+    outs = []
+    ts = range(Tlen - 1, -1, -1) if is_reverse else range(Tlen)
+    for t in ts:
+        out, state = cell(x[:, t], state)
+        outs.append(out)
+    if is_reverse:
+        outs = outs[::-1]
+    y = T.stack(outs, axis=1)
+    if time_major:
+        y = T.transpose(y, [1, 0, 2])
+    return y, state
+
+
+def birnn(cell_fw, cell_bw, inputs, initial_states=None,
+          sequence_length=None, time_major=False, **kwargs):
+    """Bidirectional rnn(): concat of forward and reversed-backward
+    passes."""
+    from ... import tensor_ops as T
+
+    fw, st_f = rnn(cell_fw, inputs, time_major=time_major)
+    bw, st_b = rnn(cell_bw, inputs, time_major=time_major,
+                   is_reverse=True)
+    return T.concat([fw, bw], axis=-1), (st_f, st_b)
+
+
+def pad2d(input, paddings=0, mode="constant", pad_value=0.0,
+          data_format="NCHW", name=None):
+    """fluid pad2d over F.pad ([top, bottom, left, right] order)."""
+    p = [paddings] * 4 if isinstance(paddings, int) else list(paddings)
+    # fluid order t,b,l,r -> pad() 2d order l,r,t,b
+    return pad(input, [p[2], p[3], p[0], p[1]], mode=mode,
+               value=pad_value, data_format=data_format)
+
+
+def pad_constant_like(x, y, pad_value=0.0, name=None):
+    """Pad y up to x's shape with trailing constant padding
+    (pad_constant_like_op.cc)."""
+    def f(a, b):
+        pads = [(0, int(sa) - int(sb)) for sa, sb in zip(a.shape, b.shape)]
+        return jnp.pad(b, pads, constant_values=pad_value)
+
+    return apply(f, x, y)

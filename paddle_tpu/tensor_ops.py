@@ -535,15 +535,18 @@ def pad(x, pad, mode="constant", value=0.0, data_format="NCHW", name=None):
             # paddle flat format: [d0_l, d0_r, d1_l, d1_r, ...] over ALL dims
             width = [(p[2 * i], p[2 * i + 1]) for i in range(v.ndim)]
         else:
-            # partial spec applies to trailing spatial dims, like F.pad
+            # partial spec applies to the spatial dims with pairs running
+            # from the LAST dim backwards (paddle F.pad: 2D
+            # [left, right, top, bottom] -> pair 0 pads W, pair 1 pads H)
             nsp = len(p) // 2
-            width = [(0, 0)] * (v.ndim - nsp)
-            # paddle F.pad lists from last dim backwards in pairs
-            if data_format.endswith("C"):  # NHWC/NLC/NDHWC: spatial before channel
-                width = [(0, 0)] + [(p[2 * i], p[2 * i + 1]) for i in range(nsp)] + [(0, 0)]
-                width = [(0, 0)] * (v.ndim - len(width)) + width
-            else:
-                width += [(p[2 * i], p[2 * i + 1]) for i in range(nsp)]
+            pairs = [(p[2 * i], p[2 * i + 1]) for i in range(nsp)]
+            if data_format.endswith("C"):  # NHWC/NLC/NDHWC
+                sp_dims = list(range(1, 1 + nsp))
+            else:                          # NCHW/NCL/NCDHW
+                sp_dims = list(range(v.ndim - nsp, v.ndim))
+            width = [(0, 0)] * v.ndim
+            for i, d in enumerate(reversed(sp_dims)):
+                width[d] = pairs[i]
         jmode = {"constant": "constant", "reflect": "reflect",
                  "replicate": "edge", "circular": "wrap"}[mode]
         kw = {"constant_values": value} if jmode == "constant" else {}

@@ -223,7 +223,8 @@ def gather_tree(ids, parents):
             beam2 = par[t, b, beam]
             return beam2, tok
 
-        last = jnp.broadcast_to(jnp.arange(W)[None, :], (B, W))
+        last = jnp.broadcast_to(jnp.arange(W)[None, :],
+                                (B, W)).astype(par.dtype)
         _, toks = jax.lax.scan(step, last, jnp.arange(T - 1, -1, -1))
         return toks[::-1]  # scanned back-to-front
 

@@ -1300,3 +1300,32 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         return (loss_xy + loss_wh + loss_cls + loss_obj) / B
 
     return apply(f, x, gt_box, gt_label)
+
+
+def random_crop(x, shape, seed=None):
+    """Random spatial crop (random_crop_op.cc): crop the trailing dims of
+    x to `shape` at a uniformly random offset.  Offsets come from the
+    framework RNG chain (paddle.seed reproduces them) unless `seed` pins
+    a local key."""
+    import jax
+
+    from ..framework import random as _random
+
+    def f(v):
+        tgt = list(shape)
+        nlead = v.ndim - len(tgt)
+        if seed is not None:
+            keys = list(jax.random.split(jax.random.PRNGKey(int(seed)),
+                                         len(tgt)))
+        else:
+            k = _random.split_key(len(tgt))
+            keys = list(k) if isinstance(k, (list, tuple)) else [k]
+        out = v
+        for d, t in enumerate(tgt):
+            limit = out.shape[nlead + d] - t
+            off = jax.random.randint(keys[d], (), 0,
+                                     limit + 1) if limit > 0 else 0
+            out = jax.lax.dynamic_slice_in_dim(out, off, t, nlead + d)
+        return out
+
+    return apply(f, x)

@@ -11,15 +11,35 @@ import paddle_tpu as paddle
 REF_INIT = "/root/reference/python/paddle/__init__.py"
 
 
-@pytest.mark.skipif(not os.path.exists(REF_INIT),
-                    reason="reference tree not present")
-def test_reference_top_level_names_all_present():
-    src = open(REF_INIT).read()
+REF_ROOT = "/root/reference/python/paddle"
+
+
+def _ref_names(path):
+    src = open(path).read()
     names = set(re.findall(
         r"from\s+[\w.]+\s+import\s+(\w+)\s+#DEFINE_ALIAS", src))
-    names |= set(re.findall(r"^\s+'(\w+)',", src, re.M))
-    missing = sorted(n for n in names if not hasattr(paddle, n))
-    assert not missing, f"missing top-level names: {missing}"
+    names |= set(re.findall(r"^\s+'([\w.]+)',", src, re.M))
+    return names
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INIT),
+                    reason="reference tree not present")
+@pytest.mark.parametrize("mod,rel", [
+    ("", "__init__.py"),
+    ("nn", "nn/__init__.py"),
+    ("nn.functional", "nn/functional/__init__.py"),
+    ("tensor", "tensor/__init__.py"),
+])
+def test_reference_api_surface_all_present(mod, rel):
+    names = _ref_names(os.path.join(REF_ROOT, rel))
+    obj = paddle
+    for part in (mod.split(".") if mod else []):
+        obj = getattr(obj, part)
+    missing = sorted(
+        n for n in names
+        if not hasattr(obj, n.split(".")[-1])
+        and not hasattr(paddle, n.split(".")[-1]))
+    assert not missing, f"paddle.{mod} missing: {missing}"
 
 
 def test_legacy_aliases_behave():
@@ -65,3 +85,50 @@ def test_fluid_axis_broadcast_and_param_attr():
     t = paddle.LoDTensor()
     t.set(np.ones((2, 2), np.float32))
     assert np.asarray(t.numpy()).shape == (2, 2)
+
+
+def test_pad_conventions_and_pool_facades():
+    import numpy as np
+
+    F = paddle.nn.functional
+    x = paddle.to_tensor(np.zeros((1, 1, 2, 3), np.float32))
+    # paddle F.pad 2D partial spec: [left, right, top, bottom] -> W then H
+    out = np.asarray(F.pad(x, [1, 1, 0, 0]).numpy())
+    assert out.shape == (1, 1, 2, 5), out.shape
+    # fluid pad2d: [top, bottom, left, right]
+    out2 = np.asarray(F.pad2d(x, [1, 1, 0, 0]).numpy())
+    assert out2.shape == (1, 1, 4, 3), out2.shape
+    # pool2d facade honors NHWC global pooling
+    xh = paddle.to_tensor(np.arange(24, dtype=np.float32).reshape(1, 2, 3, 4))
+    g = np.asarray(F.pool2d(xh, global_pooling=True, pool_type="max",
+                            data_format="NHWC").numpy())
+    assert g.shape == (1, 1, 1, 4)
+    np.testing.assert_allclose(g[0, 0, 0], xh.numpy()[0].max((0, 1)))
+
+
+def test_dynamic_decode_beam_search():
+    import numpy as np
+
+    import paddle_tpu.nn as nn
+
+    # a "cell" that deterministically prefers token (state+1) mod V
+    V = 5
+
+    class ToyCell:
+        def __call__(self, ids, state):
+            import jax.numpy as jnp
+
+            from paddle_tpu.tensor import Tensor, unwrap
+
+            s = unwrap(state)
+            logits = jnp.eye(V)[(s + 1) % V] * 10.0
+            return Tensor(logits), Tensor((s + 1) % V)
+
+    dec = nn.BeamSearchDecoder(ToyCell(), start_token=0, end_token=V - 1,
+                               beam_size=2)
+    seqs, scores = nn.dynamic_decode(
+        dec, inits=paddle.to_tensor(np.zeros(2, np.int64)),
+        max_step_num=8)
+    s = np.asarray(seqs.numpy())
+    # best beam follows 1,2,3,4(end)
+    assert s.shape[0] == 2 and list(s[0, 0, :4]) == [1, 2, 3, 4]
