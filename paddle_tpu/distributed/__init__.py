@@ -55,3 +55,29 @@ from .meta_parallel import (  # noqa: F401
     shard_constraint,
     split,
 )
+
+
+class InMemoryDataset:
+    """PS-era dataset feeder (distributed/fleet/dataset.py): the
+    brpc/PS data path is a documented non-goal (COVERAGE.md).  This shim
+    holds filenames + a parse function and exposes the subset of the API
+    a data-reading script touches; feed models with paddle.io.DataLoader."""
+
+    def __init__(self, *a, **k):
+        self._files = []
+        self.proto_desc = None
+
+    def set_filelist(self, files):
+        self._files = list(files)
+
+    def get_filelist(self):
+        return list(self._files)
+
+    def load_into_memory(self):
+        raise NotImplementedError(
+            "InMemoryDataset's PS ingestion pipeline is a documented "
+            "non-goal (COVERAGE.md); use paddle.io.DataLoader")
+
+
+class QueueDataset(InMemoryDataset):
+    pass

@@ -25,10 +25,29 @@ from .base import (  # noqa: F401
     RoleMakerBase,
     StrategyCompiler,
     UserDefinedRoleMaker,
+    UtilBase,
     fleet,
 )
 
+
+class MultiSlotDataGenerator:
+    """PS-era slot data feeder (fleet/data_generator): the PS training
+    stack is a documented non-goal (COVERAGE.md); feed data with
+    paddle.io.DataLoader instead."""
+
+    def __init__(self, *a, **k):
+        raise NotImplementedError(
+            f"{type(self).__name__} is a PS-era slot data feeder; the PS "
+            "training stack is a documented non-goal (COVERAGE.md) — "
+            "feed data with paddle.io.DataLoader instead")
+
+
+class MultiSlotStringDataGenerator(MultiSlotDataGenerator):
+    pass
+
 __all__ = ["DistributedStrategy", "Fleet", "fleet", "init",
+           "UtilBase", "MultiSlotDataGenerator",
+           "MultiSlotStringDataGenerator",
            "distributed_optimizer", "distributed_model",
            "PaddleCloudRoleMaker", "UserDefinedRoleMaker",
            "is_first_worker", "worker_index", "worker_num", "is_worker",

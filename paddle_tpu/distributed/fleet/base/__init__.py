@@ -1,5 +1,6 @@
 from .distributed_strategy import DistributedStrategy  # noqa: F401
-from .fleet_base import DistributedOptimizer, Fleet, fleet  # noqa: F401
+from .fleet_base import (  # noqa: F401
+    DistributedOptimizer, Fleet, UtilBase, fleet)
 from .role_maker import (  # noqa: F401
     PaddleCloudRoleMaker,
     Role,

@@ -122,6 +122,14 @@ class Fleet:
     def is_initialized(self):
         return self._initialized
 
+    @property
+    def util(self):
+        """fleet.util (reference fleet_base.py util property backed by
+        util_factory.UtilBase)."""
+        if getattr(self, "_util", None) is None:
+            self._util = UtilBase()
+        return self._util
+
     def _ensure_init(self):
         if not self._initialized:
             self.init()
@@ -215,3 +223,64 @@ class Fleet:
 
 
 fleet = Fleet()
+
+
+class UtilBase:
+    """fleet.util (fleet/base/util_factory.py UtilBase): small cross-rank
+    utilities over the TPU collective backend — all_reduce/all_gather/
+    barrier on host values, deterministic file sharding, rank-gated
+    printing."""
+
+    def all_reduce(self, input, mode="sum", comm_world="worker"):
+        import numpy as np
+
+        from ....tensor import Tensor
+        from ... import collective
+
+        t = Tensor(np.asarray(input))
+        op = {"sum": collective.ReduceOp.SUM,
+              "max": collective.ReduceOp.MAX,
+              "min": collective.ReduceOp.MIN}[mode]
+        collective.all_reduce(t, op=op)
+        return np.asarray(t.numpy())
+
+    def all_gather(self, input, comm_world="worker"):
+        import numpy as np
+
+        from ....tensor import Tensor
+        from ... import collective
+
+        gathered = []
+        collective.all_gather(gathered, Tensor(np.asarray(input)))
+        return [np.asarray(g.numpy()) for g in gathered]
+
+    def barrier(self, comm_world="worker"):
+        from ... import collective
+
+        collective.barrier()
+
+    def get_file_shard(self, files):
+        """Deterministic contiguous split of `files` across trainers
+        (util_factory.py:206: blocks of size n+1 for the first `remain`
+        trainers, n for the rest)."""
+        from ...env import ParallelEnv
+
+        env = ParallelEnv()
+        trainer_id, trainers = env.rank, env.world_size
+        if not isinstance(files, list):
+            raise TypeError("files should be a list of file names")
+        begin, eof = 0, len(files)
+        blocks = []
+        n = eof // trainers
+        remain = eof % trainers
+        for i in range(trainers):
+            length = n + 1 if i < remain else n
+            blocks.append(files[begin:begin + length])
+            begin += length
+        return blocks[trainer_id]
+
+    def print_on_rank(self, message, rank_id):
+        from ...env import ParallelEnv
+
+        if ParallelEnv().rank == rank_id:
+            print(message)

@@ -29,6 +29,10 @@ def _ref_names(path):
     ("nn", "nn/__init__.py"),
     ("nn.functional", "nn/functional/__init__.py"),
     ("tensor", "tensor/__init__.py"),
+    ("distributed", "distributed/__init__.py"),
+    ("distributed.fleet", "distributed/fleet/__init__.py"),
+    ("optimizer", "optimizer/__init__.py"),
+    ("io", "io/__init__.py"),
 ])
 def test_reference_api_surface_all_present(mod, rel):
     names = _ref_names(os.path.join(REF_ROOT, rel))

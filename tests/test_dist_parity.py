@@ -96,9 +96,7 @@ def _free_port():
     return p
 
 
-def test_two_process_dp_loss_parity(tmp_path):
-    script = tmp_path / "trainer.py"
-    script.write_text(TRAINER)
+def _launch_trainers(script):
     port = _free_port()
     eps = [f"127.0.0.1:{port}", f"127.0.0.1:{port + 1}"]
     procs = []
@@ -118,16 +116,34 @@ def test_two_process_dp_loss_parity(tmp_path):
         procs.append(subprocess.Popen(
             [sys.executable, str(script)], env=env,
             stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
-    outs = []
+    outs, ok, err_tail = [], True, ""
     for p in procs:
         try:
             out, err = p.communicate(timeout=240)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
-            pytest.fail("trainer process hung (coordination service?)")
-        assert p.returncode == 0, f"trainer failed:\n{err[-2000:]}"
+            return None, "trainer process hung (coordination service?)"
+        if p.returncode != 0:
+            ok, err_tail = False, err[-2000:]
         outs.append(out)
+    return (outs, "") if ok else (None, err_tail)
+
+
+def test_two_process_dp_loss_parity(tmp_path):
+    script = tmp_path / "trainer.py"
+    script.write_text(TRAINER)
+    # one retry with fresh ports, gated on the port-race signature only:
+    # under a loaded machine the freed probe port can be re-taken before
+    # the coordination service binds it (deterministic trainer bugs must
+    # fail immediately)
+    outs, err = _launch_trainers(script)
+    port_race = any(sig in err for sig in (
+        "hung", "Failed to bind", "address already in use",
+        "UNAVAILABLE", "DEADLINE_EXCEEDED"))
+    if outs is None and port_race:
+        outs, err = _launch_trainers(script)
+    assert outs is not None, f"trainers failed:\n{err}"
 
     per_rank = {}
     for out in outs:
