@@ -231,6 +231,48 @@ def create_predictor(config):
     return Predictor(config)
 
 
+
+
+def symbolic_input_specs(manifest_shapes, dtypes):
+    """ShapeDtypeStructs for export: dims marked -1 become symbolic
+    (jax.export) so the served artifact accepts any size there; returns
+    None when every dim is concrete."""
+    if not any(d < 0 for shp in manifest_shapes for d in shp):
+        return None
+    scope = jax.export.SymbolicScope()
+    specs = []
+    for i, (shp, dt) in enumerate(zip(manifest_shapes, dtypes)):
+        dims = ",".join(f"d{i}_{j}" if d < 0 else str(d)
+                        for j, d in enumerate(shp))
+        shape = jax.export.symbolic_shape(dims, scope=scope)
+        specs.append(jax.ShapeDtypeStruct(shape, np.dtype(dt)))
+    return specs
+
+
+def write_export_artifacts(path_prefix, exported, input_names,
+                           manifest_shapes, dtypes, aot_params=None):
+    """Serialize a jax.export.Exported + manifest (+ AOT param payload)
+    in the layout Predictor._load reads — the ONE writer both
+    inference.save_inference_model and static.save_inference_model use."""
+    os.makedirs(os.path.dirname(path_prefix) or ".", exist_ok=True)
+    with open(path_prefix + ".pdexport", "wb") as f:
+        f.write(exported.serialize())
+    if aot_params is not None:
+        with open(path_prefix + ".pdaotparams", "wb") as f:
+            pickle.dump(aot_params, f)
+    manifest = {
+        "input_names": list(input_names),
+        "output_names": [f"out{i}"
+                         for i in range(len(exported.out_avals))],
+        "input_specs": [{"shape": list(shp), "dtype": str(np.dtype(dt))}
+                        for shp, dt in zip(manifest_shapes, dtypes)],
+        "format": "jax.export/stablehlo",
+    }
+    with open(path_prefix + ".pdmodel.json", "w") as f:
+        json.dump(manifest, f, indent=2)
+    return path_prefix
+
+
 def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
                          input_spec=None, example_inputs=None):
     """Export a Layer for serving.
@@ -276,16 +318,9 @@ def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
         if input_spec is not None:
             manifest_shapes = [[-1 if (d is None or d < 0) else int(d)
                                 for d in s.shape] for s in input_spec]
-            if any(d < 0 for shp in manifest_shapes for d in shp):
-                scope = jax.export.SymbolicScope()
-                sym_in_specs = []
-                for i, s in enumerate(input_spec):
-                    dims = ",".join(
-                        f"d{i}_{j}" if (d is None or d < 0) else str(d)
-                        for j, d in enumerate(s.shape))
-                    shape = jax.export.symbolic_shape(dims, scope=scope)
-                    sym_in_specs.append(jax.ShapeDtypeStruct(
-                        shape, np.dtype(convert_dtype(s.dtype))))
+            sym_in_specs = symbolic_input_specs(
+                manifest_shapes,
+                [convert_dtype(s.dtype) for s in input_spec])
         if example_inputs is None and input_spec is not None:
             example_inputs = [
                 np.zeros([d if d and d > 0 else 1 for d in s.shape],
@@ -349,21 +384,11 @@ def save_inference_model(path_prefix, layer_or_feed, fetch_vars=None,
                     "example_inputs to export a fixed-shape artifact."
                 ) from e
             raise
-        with open(path_prefix + ".pdexport", "wb") as f:
-            f.write(exported.serialize())
-        manifest = {
-            "input_names": [f"x{i}" for i in range(len(arrays))],
-            "output_names": [f"out{i}"
-                             for i in range(len(exported.out_avals))],
-            "input_specs": [{"shape": (manifest_shapes[i] if manifest_shapes
-                                       else list(a.shape)),
-                             "dtype": str(a.dtype)}
-                            for i, a in enumerate(arrays)],
-            "format": "jax.export/stablehlo",
-        }
-        with open(path_prefix + ".pdmodel.json", "w") as f:
-            json.dump(manifest, f, indent=2)
-        return path_prefix
+        return write_export_artifacts(
+            path_prefix, exported, [f"x{i}" for i in range(len(arrays))],
+            (manifest_shapes if manifest_shapes
+             else [list(a.shape) for a in arrays]),
+            [a.dtype for a in arrays])
     finally:
         if locals().get("converted_patch"):
             layer.__dict__.pop("forward", None)

@@ -51,8 +51,13 @@ class StaticFunction:
             self._method = function.__func__
         else:
             self._method = function
+        self._raw_method = self._method
         self._method = _maybe_convert(self._method)
         self._build_compiled()
+        # a second compiled path for ProgramTranslator.enable(False):
+        # the reference toggles dy2static dynamically per call
+        self._compiled_converted = self._compiled
+        self._compiled_raw = None
 
     def _build_compiled(self):
         layer = self._layer
@@ -84,17 +89,32 @@ class StaticFunction:
             instance.__dict__[key] = cached
         return cached
 
+    def _active_compiled(self):
+        if ProgramTranslator._enabled or self._method is self._raw_method:
+            return self._compiled_converted
+        if self._compiled_raw is None:
+            conv = self._method
+            self._method = self._raw_method
+            try:
+                self._build_compiled()
+                self._compiled_raw = self._compiled
+            finally:
+                self._method = conv
+                self._compiled = self._compiled_converted
+        return self._compiled_raw
+
     def __call__(self, *args, **kwargs):
         rng = _random.split_key()
+        compiled = self._active_compiled()
         if self._layer is not None:
             params, buffers = state_pytrees(self._layer)
-            out, new_buffers = self._compiled(params, buffers, rng, args,
-                                              kwargs)
+            out, new_buffers = compiled(params, buffers, rng, args,
+                                        kwargs)
             bmap = dict(self._layer.named_buffers())
             for name, val in new_buffers.items():
                 bmap[name]._value = val
             return out
-        return self._compiled(rng, args, kwargs)
+        return compiled(rng, args, kwargs)
 
     @property
     def inner_function(self):
@@ -109,10 +129,18 @@ def _maybe_convert(method):
     if getattr(method, "__not_to_static__", False) or \
             getattr(method, "__dy2static__", False):
         return method
+    if not ProgramTranslator._enabled:
+        return method  # ProgramTranslator.enable(False): plain tracing
     from . import dy2static
 
     try:
-        return dy2static.convert_function(method)
+        converted = dy2static.convert_function(method)
+        if _LOG_LEVELS["code_level"] > 0 and \
+                getattr(converted, "__converted_source__", None):
+            print(f"[dy2static] transformed code of "
+                  f"{getattr(method, '__qualname__', method)}:\n"
+                  f"{converted.__converted_source__}")
+        return converted
     except dy2static.BenignNoConversion:
         return method  # nothing to convert: plain tracing is not a hazard
     except dy2static.ConversionError as e:
@@ -198,3 +226,53 @@ class TracedLayer:
 
     def save_inference_model(self, path, feed=None, fetch=None):
         save(self._layer, path)
+
+
+class TranslatedLayer:
+    """Type alias contract (fluid/dygraph/io.py TranslatedLayer): what
+    jit.load returns.  Here jit.load reconstructs the ORIGINAL Layer
+    class (pickled module-scope class + state dict), which is strictly
+    richer than the reference's program-backed shell; this name exists
+    for isinstance-style compatibility."""
+
+    def __new__(cls, *a, **k):
+        raise TypeError(
+            "TranslatedLayer is not constructed directly; use "
+            "paddle.jit.load(path)")
+
+
+_LOG_LEVELS = {"verbosity": 0, "code_level": 0}
+
+
+def set_verbosity(level=0, also_to_stdout=False):
+    """dy2static logging verbosity (jit/set_verbosity): stored and
+    exposed; conversion warnings always go through warnings.warn."""
+    _LOG_LEVELS["verbosity"] = int(level)
+
+
+def set_code_level(level=100, also_to_stdout=False):
+    """dy2static transformed-code printing (jit/set_code_level): at any
+    level > 0, convert_function prints the recompiled source."""
+    _LOG_LEVELS["code_level"] = int(level)
+
+
+class ProgramTranslator:
+    """Singleton switch for dy2static (dygraph_to_static/
+    program_translator.py ProgramTranslator): enable(False) makes
+    to_static fall back to plain tracing."""
+
+    _instance = None
+    _enabled = True
+
+    @classmethod
+    def get_instance(cls):
+        if cls._instance is None:
+            cls._instance = cls()
+        return cls._instance
+
+    def enable(self, enable_to_static=True):
+        type(self)._enabled = bool(enable_to_static)
+
+    @property
+    def enable_to_static(self):
+        return type(self)._enabled

@@ -682,6 +682,7 @@ def convert_function(fn):
         out = ns[fdef.name]
     out = functools.wraps(fn)(out)
     out.__dy2static__ = True
+    out.__converted_source__ = ast.unparse(tree)
     return out
 
 

@@ -98,6 +98,10 @@ def data(name, shape, dtype="float32", lod_level=0):
     return Variable(name=name, shape=shape, dtype=dtype)
 
 
+def builtins_any_is(v, seq):
+    return any(v is s for s in seq)
+
+
 class Executor:
     """Executor parity (fluid/executor.py Executor:475 / run:916): runs a
     captured Program (fetch evaluation and minimize-training under
@@ -127,6 +131,14 @@ class Executor:
         if not isinstance(prog, Program):
             raise TypeError(f"cannot run {type(prog).__name__}")
         feed = feed or {}
+        if fetch_list:
+            # remember fetch roots so static.save can find the captured
+            # parameters of inference-only programs
+            seen = getattr(prog, "_captured_vars", [])
+            for v in fetch_list:
+                if not builtins_any_is(v, seen):
+                    seen.append(v)
+            prog._captured_vars = seen
         if prog._train is not None:
             loss_var, opt = prog._train
             return train_step(loss_var, opt, feed, fetch_list,
@@ -141,8 +153,14 @@ def scope_guard(scope):
     yield
 
 
+_global_scope = None
+
+
 def global_scope():
-    return None
+    global _global_scope
+    if _global_scope is None:
+        _global_scope = Scope()
+    return _global_scope
 
 
 class CompiledProgram:
@@ -215,3 +233,329 @@ def Print(input, first_n=-1, message=None, summarize=20,
         return v
 
     return apply(f, input)
+
+
+# --------------------------------------------------------------------------
+# reference paddle.static surface completion (round-4)
+# --------------------------------------------------------------------------
+import os  # noqa: E402
+
+from ..nn.layer_base import ParamAttr  # noqa: E402
+from .program import Variable  # noqa: E402,F401
+
+
+def create_parameter(shape, dtype="float32", name=None, attr=None,
+                     is_bias=False, default_initializer=None):
+    from .. import create_parameter as _cp
+
+    return _cp(shape, dtype, name, attr, is_bias, default_initializer)
+
+
+def create_global_var(shape, value, dtype="float32", persistable=False,
+                      force_cpu=False, name=None):
+    from .. import create_global_var as _cg
+
+    return _cg(shape, value, dtype, persistable, force_cpu, name)
+
+
+def cpu_places(device_count=None):
+    from ..framework.place import CPUPlace
+
+    n = device_count or 1
+    return [CPUPlace() for _ in range(n)]
+
+
+def cuda_places(device_ids=None):
+    """There is no CUDA here; accelerator places are TPUPlace
+    (framework/place.py) — returned so device-list plumbing keeps
+    working."""
+    from ..framework.place import TPUPlace
+
+    ids = device_ids if device_ids is not None else [0]
+    return [TPUPlace(i) for i in ids]
+
+
+def xpu_places(device_ids=None):
+    return cuda_places(device_ids)
+
+
+class Scope:
+    """Variable scope shim (fluid/executor.py global_scope): eager
+    tensors own their storage, so a scope is a name->Tensor dict."""
+
+    def __init__(self):
+        self._vars = {}
+
+    def var(self, name):
+        from ..tensor import Tensor
+
+        import jax.numpy as jnp
+
+        if name not in self._vars:
+            self._vars[name] = Tensor(jnp.zeros(()))
+        return self._vars[name]
+
+    def find_var(self, name):
+        return self._vars.get(name)
+
+
+class ParallelExecutor:
+    """Shim (fluid/parallel_executor.py): multi-device execution is a
+    sharding decision on the jitted step (paddle_tpu.distributed); runs
+    delegate to Executor."""
+
+    def __init__(self, use_cuda=False, loss_name=None, main_program=None,
+                 build_strategy=None, exec_strategy=None, **kw):
+        self._exe = Executor()
+        self._program = main_program
+
+    def run(self, fetch_list=None, feed=None, program=None, **kw):
+        return self._exe.run(program or self._program, feed=feed,
+                             fetch_list=fetch_list)
+
+
+class WeightNormParamAttr(ParamAttr):
+    """ParamAttr requesting weight normalization (fluid/param_attr.py
+    WeightNormParamAttr).  The static-graph reparameterization hook does
+    not exist here; `dim` is recorded and nn.utils-style weight norm
+    should be applied at the layer level."""
+
+    def __init__(self, dim=None, **kwargs):
+        super().__init__(**kwargs)
+        self.dim = dim
+
+
+@contextlib.contextmanager
+def name_scope(prefix=None):
+    """Naming-only context (framework.py name_scope): names are cosmetic
+    under tracing; kept for script compatibility."""
+    yield
+
+
+def py_func(func, x, out=None, backward_func=None,
+            skip_vars_in_backward_input=None):
+    """Run a python callable on tensors (py_func_op.cc).  Eager python IS
+    the host language: the call happens directly; under program capture
+    this is unsupported (use eager mode or to_static)."""
+    import builtins
+
+    from .program import Variable as _V
+
+    xs = x if isinstance(x, (list, tuple)) else [x]
+    if builtins.any(isinstance(a, _V) for a in xs):
+        raise NotImplementedError(
+            "py_func inside a captured Program is unsupported; run this "
+            "part eagerly or wrap it with paddle.jit.to_static (README "
+            "static-graph compatibility)")
+    return func(*xs)
+
+
+def auc(input, label, curve="ROC", num_thresholds=4095, topk=1,
+        slide_steps=1):
+    """Batch AUC of concrete predictions (metrics/auc_op.cc): returns
+    (auc_value, batch_auc, [stat_pos, stat_neg]) like the reference's
+    three outputs.  Streaming accumulation lives in paddle.metric.Auc."""
+    import numpy as np
+
+    from ..metric import Auc as _Auc
+    from ..tensor import Tensor, unwrap
+
+    m = _Auc(num_thresholds=num_thresholds)
+    m.update(np.asarray(unwrap(input)), np.asarray(unwrap(label)))
+    v = float(m.accumulate())
+    return (Tensor(np.float32(v)), Tensor(np.float32(v)),
+            [Tensor(m._stat_pos.astype(np.float32)),
+             Tensor(m._stat_neg.astype(np.float32))])
+
+
+# -- program/parameter persistence ----------------------------------------
+def _program_params(program):
+    """Named captured parameters of a Program: the train objective's, or
+    the tensors captured by fetch DAGs Executor.run has evaluated (kept
+    on program._captured_vars)."""
+    from .program import collect_params
+
+    roots = []
+    if program is not None:
+        if program._train is not None:
+            roots.append(program._train[0])
+        roots.extend(getattr(program, "_captured_vars", ()))
+    ps = collect_params(roots) if roots else []
+    return {getattr(p, "name", None) or f"param_{i}": p
+            for i, p in enumerate(ps)}
+
+
+def save(program, model_path, protocol=4, **configs):
+    """Persist a captured Program's parameters (static.save contract:
+    .pdparams; no ProgramDesc exists to write — the compiled artifact
+    path is inference.save_inference_model/StableHLO, see README)."""
+    import pickle as _p
+    import warnings as _w
+
+    import numpy as _np
+
+    params = {k: _np.asarray(v.numpy())
+              for k, v in _program_params(program).items()}
+    if not params:
+        _w.warn(
+            "static.save: this Program has no captured parameters (no "
+            "minimize registered and no fetch evaluated yet) — writing "
+            "an empty .pdparams", stacklevel=2)
+    os.makedirs(os.path.dirname(model_path) or ".", exist_ok=True)
+    with open(model_path + ".pdparams", "wb") as f:
+        _p.dump(params, f, protocol=protocol)
+
+
+def load(program, model_path, executor=None, var_list=None):
+    """Restore parameters saved by static.save into the Program's
+    captured tensors (by name, shape-checked)."""
+    import pickle as _p
+
+    import numpy as _np
+
+    with open(model_path + ".pdparams", "rb") as f:
+        state = _p.load(f)
+    tgt = _program_params(program)
+    for k, v in state.items():
+        if k in tgt:
+            have = tuple(tgt[k].shape)
+            want = tuple(_np.shape(v))
+            if have != want:
+                raise ValueError(
+                    f"static.load: parameter {k!r} has shape "
+                    f"{list(have)} but the checkpoint holds "
+                    f"{list(want)}")
+            tgt[k].set_value(v)
+
+
+def load_program_state(model_path, var_list=None):
+    import pickle as _p
+
+    with open(model_path + ".pdparams", "rb") as f:
+        return _p.load(f)
+
+
+def set_program_state(program, state_dict):
+    import numpy as _np
+
+    tgt = _program_params(program)
+    for k, v in state_dict.items():
+        if k in tgt:
+            if tuple(tgt[k].shape) != tuple(_np.shape(v)):
+                raise ValueError(
+                    f"set_program_state: parameter {k!r} shape "
+                    f"{list(tgt[k].shape)} != state {list(_np.shape(v))}")
+            tgt[k].set_value(v)
+
+
+def save_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    save(main_program, os.path.join(dirname, filename or "params"))
+
+
+def load_vars(executor, dirname, main_program=None, vars=None,
+              predicate=None, filename=None):
+    load(main_program, os.path.join(dirname, filename or "params"))
+
+
+def save_inference_model(path_prefix, feed_vars, fetch_vars, executor=None,
+                         program=None, **kwargs):
+    """Static-graph export (fluid/io.py save_inference_model:1198): the
+    captured fetch DAG compiles straight to the StableHLO serving
+    artifact (.pdexport + manifest) that inference.Predictor loads;
+    captured parameters are baked into the exported graph as constants
+    (a dedicated-weights export is jit.save / inference on a Layer)."""
+    import json as _json
+    import pickle as _pickle
+
+    import numpy as _np
+
+    import jax as _jax
+
+    from ..framework.dtype import convert_dtype
+    from ..tensor import unwrap
+    from .program import _eval_fn, collect_params
+
+    feeds = feed_vars if isinstance(feed_vars, (list, tuple)) else [feed_vars]
+    fetches = fetch_vars if isinstance(fetch_vars, (list, tuple)) \
+        else [fetch_vars]
+    leaf_names = [fv.name for fv in feeds]
+    params = collect_params(list(fetches))
+    param_vals = [unwrap(p) for p in params]
+    f = _eval_fn(list(fetches), leaf_names, params)
+
+    def fn(*arrays):
+        return tuple(f(list(arrays), param_vals))
+
+    from ..inference import symbolic_input_specs, write_export_artifacts
+
+    manifest_shapes = [[-1 if (d is None or d < 0) else int(d)
+                        for d in fv.shape] for fv in feeds]
+    dtypes = [convert_dtype(fv.dtype) or "float32" for fv in feeds]
+    specs = symbolic_input_specs(manifest_shapes, dtypes)
+    if specs is None:
+        specs = [_jax.ShapeDtypeStruct(tuple(shp), _np.dtype(dt))
+                 for shp, dt in zip(manifest_shapes, dtypes)]
+    exported = _jax.export.export(_jax.jit(fn))(*specs)
+    return write_export_artifacts(
+        path_prefix, exported, [fv.name for fv in feeds],
+        manifest_shapes, dtypes, aot_params={})  # params baked constant
+
+
+def load_inference_model(path_prefix, executor=None, **kwargs):
+    from ..inference import load_inference_model as _load
+
+    return _load(path_prefix)
+
+
+def serialize_program(feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError(
+        "there is no ProgramDesc to serialize on TPU (README static-graph "
+        "compatibility): export compiled graphs with "
+        "static.save_inference_model (StableHLO) and parameters with "
+        "static.save")
+
+
+def deserialize_program(data):
+    raise NotImplementedError(
+        "there is no ProgramDesc on TPU; load StableHLO exports with "
+        "static.load_inference_model (README static-graph compatibility)")
+
+
+def serialize_persistables(feed_vars, fetch_vars, **kwargs):
+    raise NotImplementedError(
+        "serialize parameters with static.save / load with static.load "
+        "(no ProgramDesc persistable scan exists on TPU; README)")
+
+
+def deserialize_persistables(program, data, executor=None):
+    raise NotImplementedError(
+        "restore parameters with static.load / set_program_state "
+        "(README static-graph compatibility)")
+
+
+def save_to_file(path, content):
+    with open(path, "wb") as f:
+        f.write(content if isinstance(content, bytes) else bytes(content))
+
+
+def load_from_file(path):
+    with open(path, "rb") as f:
+        return f.read()
+
+
+def append_backward(loss, parameter_list=None, no_grad_set=None,
+                    callbacks=None):
+    raise NotImplementedError(
+        "append_backward's op-insertion contract has no analog under "
+        "tracing: use optimizer.minimize(loss) on a captured Program "
+        "(gradients are taken by jax.value_and_grad at Executor.run; "
+        "README static-graph compatibility)")
+
+
+def gradients(targets, inputs, target_gradients=None, no_grad_set=None):
+    raise NotImplementedError(
+        "symbolic static.gradients is not part of the capture layer: "
+        "differentiate with paddle.grad (eager), jax.grad inside "
+        "to_static, or optimizer.minimize on a Program (README "
+        "static-graph compatibility)")

@@ -33,6 +33,13 @@ def _ref_names(path):
     ("distributed.fleet", "distributed/fleet/__init__.py"),
     ("optimizer", "optimizer/__init__.py"),
     ("io", "io/__init__.py"),
+    ("static", "static/__init__.py"),
+    ("jit", "jit/__init__.py"),
+    ("amp", "amp/__init__.py"),
+    ("vision", "vision/__init__.py"),
+    ("vision.transforms", "vision/transforms/__init__.py"),
+    ("text", "text/__init__.py"),
+    ("utils", "utils/__init__.py"),
 ])
 def test_reference_api_surface_all_present(mod, rel):
     names = _ref_names(os.path.join(REF_ROOT, rel))
@@ -136,3 +143,46 @@ def test_dynamic_decode_beam_search():
     s = np.asarray(seqs.numpy())
     # best beam follows 1,2,3,4(end)
     assert s.shape[0] == 2 and list(s[0, 0, :4]) == [1, 2, 3, 4]
+
+
+def test_static_persistence_and_export(tmp_path):
+    import numpy as np
+
+    from paddle_tpu import static
+
+    paddle.seed(0)
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 3], "float32")
+        w = static.create_parameter([3, 1], name="w")
+        pred = paddle.matmul(x, w)
+        cost = (pred ** 2).mean()
+        paddle.optimizer.SGD(learning_rate=0.0).minimize(cost)
+    exe = static.Executor()
+    exe.run(main, feed={"x": np.ones((2, 3), np.float32)},
+            fetch_list=[cost])
+    # param save/load roundtrip by name
+    p0 = np.asarray(w.numpy()).copy()
+    static.save(main, str(tmp_path / "m"))
+    w.set_value(np.zeros((3, 1), np.float32))
+    static.load(main, str(tmp_path / "m"))
+    np.testing.assert_allclose(np.asarray(w.numpy()), p0)
+    # static export -> predictor serve
+    eval_prog = main.clone(for_test=True)
+    with static.program_guard(eval_prog):
+        pass
+    static.save_inference_model(str(tmp_path / "exp"), [x], [pred])
+    pred_exe = static.load_inference_model(str(tmp_path / "exp"))
+    out, = pred_exe.run([np.ones((2, 3), np.float32)])
+    np.testing.assert_allclose(out, np.ones((2, 3), np.float32) @ p0,
+                               rtol=1e-5)
+    # ProgramTranslator off -> plain tracing path still runs
+    paddle.jit.ProgramTranslator.get_instance().enable(False)
+    try:
+        @paddle.jit.to_static
+        def g(t):
+            return t * 2.0
+        r = g(paddle.to_tensor(np.ones(2, np.float32)))
+        np.testing.assert_allclose(np.asarray(r.numpy()), [2.0, 2.0])
+    finally:
+        paddle.jit.ProgramTranslator.get_instance().enable(True)

@@ -361,3 +361,31 @@ class TestClosureSemantics:
         paddle.jit.to_static(net)
         out = net(paddle.to_tensor(np.ones(2, np.float32)))
         np.testing.assert_allclose(np.asarray(out.numpy()), [4.0, 4.0])
+
+
+class TestProgramTranslatorToggle:
+    def test_enable_false_after_decoration_takes_effect(self):
+        import warnings
+
+        pt = paddle.jit.ProgramTranslator.get_instance()
+
+        @paddle.jit.to_static
+        def f(x):
+            if x.sum() > 0:
+                y = x * 2.0
+            else:
+                y = x * 3.0
+            return y
+
+        xp = paddle.to_tensor(np.ones(2, np.float32))
+        np.testing.assert_allclose(np.asarray(f(xp).numpy()), [2.0, 2.0])
+        # disabling AFTER decoration must route to the unconverted path:
+        # the tensor-dependent `if` then fails under plain tracing, which
+        # proves conversion is genuinely bypassed per call
+        pt.enable(False)
+        try:
+            with pytest.raises(Exception):
+                f(xp)
+        finally:
+            pt.enable(True)
+        np.testing.assert_allclose(np.asarray(f(xp).numpy()), [2.0, 2.0])

@@ -180,14 +180,14 @@ C = {
     "batch_norm": "impl:paddle_tpu.nn.functional.batch_norm",
     "sync_batch_norm": "impl:paddle_tpu.nn.SyncBatchNorm",
     "inplace_abn": A_FUSION,
-    "data_norm": "impl:paddle_tpu.nn.functional.batch_norm",
+    "data_norm": "impl:paddle_tpu.nn.functional.data_norm",
     "affine_channel": "impl:paddle_tpu.vision.ops.affine_channel",
     "shuffle_channel": "impl:paddle_tpu.vision.ops.channel_shuffle",
     "space_to_depth": "impl:paddle_tpu.vision.ops.space_to_depth",
     "pad_constant_like": "impl:paddle_tpu.nn.functional.pad",
     "pad2d": "impl:paddle_tpu.nn.functional.pad",
     "pad3d": "impl:paddle_tpu.nn.functional.pad",
-    "random_crop": "impl:paddle_tpu.vision.transforms.RandomCrop",
+    "random_crop": "impl:paddle_tpu.vision.ops.random_crop",
     # ---- rnn family ------------------------------------------------------
     "rnn": "impl:paddle_tpu.nn.SimpleRNN",
     "lstm": "impl:paddle_tpu.nn.LSTM",
@@ -379,8 +379,7 @@ C = {
     "var_conv_2d": N_REC, "tree_conv": N_REC,
     "partial_concat": "impl:paddle_tpu.concat",
     "partial_sum": "impl:paddle_tpu.add_n",
-    "fsp": "non:FSP knowledge-distillation matrix (slim distillation "
-           "out of scope; composable as bmm(a.T,b)/HW)",
+    "fsp": "impl:paddle_tpu.nn.functional.fsp_matrix",
     "similarity_focus": N_REC,
     "center_loss2": N_REC,
     # ---- misc ------------------------------------------------------------
