@@ -96,6 +96,8 @@ from . import core  # noqa: E402
 from . import distribution  # noqa: E402
 from . import regularizer  # noqa: E402
 from . import slim  # noqa: E402
+from . import device  # noqa: E402
+from . import onnx  # noqa: E402
 from .hapi import Model  # noqa: E402
 from .framework.io_state import load, save  # noqa: E402
 from .nn.layer_base import ParamAttr  # noqa: E402

@@ -1,0 +1,8 @@
+"""paddle.device — device query/selection (reference python/paddle/
+device.py).  The accelerator here is TPU: is_compiled_with_cuda/xpu are
+honestly False, and the CUDA/XPU place *aliases* (like set_device
+('xpu:0') or XPUPlace) map onto the TPU place so ported scripts keep
+running on the accelerator that exists."""
+from .framework.place import (  # noqa: F401
+    get_device, set_device, is_compiled_with_cuda, is_compiled_with_xpu,
+    XPUPlace, get_cudnn_version)

@@ -132,3 +132,13 @@ def is_compiled_with_tpu() -> bool:
 def device_count() -> int:
     p = get_place()
     return len([d for d in jax.devices() if _platform_matches(d, p.device_type)])
+
+
+def is_compiled_with_xpu() -> bool:  # reference API parity; always False
+    return False
+
+
+def get_cudnn_version():
+    """None: no cuDNN exists here (reference returns None when CUDA is
+    absent)."""
+    return None

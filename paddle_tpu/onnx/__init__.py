@@ -1,0 +1,11 @@
+"""paddle.onnx — export surface (reference python/paddle/onnx/export.py
+delegates to paddle2onnx)."""
+
+
+def export(layer, path, input_spec=None, opset_version=9, **configs):
+    """ONNX export is not part of the TPU build: the serving artifact is
+    StableHLO via paddle_tpu.inference.save_inference_model /
+    paddle_tpu.static.save_inference_model (jax.export) — the
+    TPU-compilable exchange format.  COVERAGE.md documents the
+    disposition; convert StableHLO downstream if ONNX is required."""
+    raise NotImplementedError(export.__doc__)
