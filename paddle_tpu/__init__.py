@@ -99,6 +99,7 @@ from . import slim  # noqa: E402
 from . import device  # noqa: E402
 from . import onnx  # noqa: E402
 from .hapi import Model  # noqa: E402
+from .hapi import flops, summary  # noqa: E402
 from .framework.io_state import load, save  # noqa: E402
 from .nn.layer_base import ParamAttr  # noqa: E402
 from .distributed.parallel import DataParallel  # noqa: E402

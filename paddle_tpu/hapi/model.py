@@ -329,6 +329,10 @@ class Model:
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
 
+    def summary(self, input_size=None, dtype=None):
+        """Parameter summary (hapi Model.summary)."""
+        return summary(self.network, input_size, dtype)
+
 
 import contextlib as _ctx
 
@@ -347,3 +351,52 @@ def summary(net, input_size=None, dtypes=None):
     out = "\n".join(lines) + f"\nTotal params: {total}"
     print(out)
     return {"total_params": total}
+
+
+def flops(net, input_size, custom_ops=None, print_detail=False):
+    """Forward FLOPs of a network (hapi/dynamic_flops.py).  TPU-native:
+    XLA's own cost model counts them — jit-compile the forward on zero
+    inputs of `input_size` and read compiled cost_analysis, which covers
+    every op the hardware will actually run (the reference hand-counts a
+    per-layer table)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ..nn.layer_base import functional_call, state_pytrees
+    from ..tensor import Tensor
+
+    sizes = input_size if isinstance(input_size[0], (list, tuple)) \
+        else [input_size]
+    # preserve PER-SUBLAYER modes (a blanket net.train() would flip
+    # deliberately-frozen sublayers back to training)
+    modes = [(l, l.training) for l in net.sublayers(include_self=True)] \
+        if hasattr(net, "sublayers") else [(net, net.training)]
+    net.eval()
+    try:
+        params, buffers = state_pytrees(net)
+
+        def fwd(params, *xs):
+            out, _ = functional_call(net, params,
+                                     tuple(Tensor(x) for x in xs),
+                                     buffers=buffers)
+            outs = out if isinstance(out, (tuple, list)) else [out]
+            return tuple(o.value for o in outs)
+
+        xs = [jnp.zeros(tuple(s), jnp.float32) for s in sizes]
+        compiled = jax.jit(fwd).lower(params, *xs).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+        if "flops" not in ca:
+            import warnings
+
+            warnings.warn(
+                "flops(): this backend's compiled cost_analysis() does "
+                "not report a 'flops' key; returning 0", stacklevel=2)
+        total = int(ca.get("flops", 0.0))
+        if print_detail:
+            print(f"XLA-analyzed forward FLOPs for input {input_size}: "
+                  f"{total:,}")
+        return total
+    finally:
+        for layer, mode in modes:
+            layer.training = mode

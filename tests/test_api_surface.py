@@ -40,6 +40,10 @@ def _ref_names(path):
     ("vision.transforms", "vision/transforms/__init__.py"),
     ("text", "text/__init__.py"),
     ("utils", "utils/__init__.py"),
+    ("metric", "metric/__init__.py"),
+    ("inference", "inference/__init__.py"),
+    ("regularizer", "regularizer.py"),
+    ("hapi", "hapi/__init__.py"),
 ])
 def test_reference_api_surface_all_present(mod, rel):
     names = _ref_names(os.path.join(REF_ROOT, rel))

@@ -213,3 +213,18 @@ class TestRegularizer:
         opt.step()
         np.testing.assert_allclose(np.asarray(p.numpy()),
                                    w0 - 0.1 * np.sign(w0), rtol=1e-5)
+
+
+class TestSummaryFlops:
+    def test_flops_xla_cost_model(self):
+        import paddle_tpu as paddle
+
+        net = paddle.nn.Linear(8, 4)
+        f = paddle.flops(net, [2, 8])
+        assert 100 <= f <= 200, f  # 2*B*in*out + bias adds
+
+    def test_model_summary_totals(self):
+        import paddle_tpu as paddle
+
+        m = paddle.Model(paddle.nn.Linear(4, 2))
+        assert m.summary()["total_params"] == 10
