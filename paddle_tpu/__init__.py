@@ -98,6 +98,11 @@ from . import regularizer  # noqa: E402
 from . import slim  # noqa: E402
 from . import device  # noqa: E402
 from . import onnx  # noqa: E402
+from . import compat  # noqa: E402
+from . import sysconfig  # noqa: E402
+from . import reader  # noqa: E402
+from . import incubate  # noqa: E402
+from .batch import batch  # noqa: E402 — reference python/paddle/__init__.py:27
 from .hapi import Model  # noqa: E402
 from .hapi import flops, summary  # noqa: E402
 from .framework.io_state import load, save  # noqa: E402

@@ -1,0 +1,31 @@
+"""`paddle.batch` — batched-reader combinator.
+
+Reference parity: python/paddle/batch.py:18 (every fluid-era example
+script wraps its sample reader with this before feeding an executor or
+DataLoader.set_sample_list_generator).
+"""
+from __future__ import annotations
+
+__all__ = ["batch"]
+
+
+def batch(reader, batch_size, drop_last=False):
+    """Wrap a sample-yielding reader into one that yields lists of
+    `batch_size` samples; a short final batch is kept unless
+    `drop_last`."""
+    if batch_size <= 0 or int(batch_size) != batch_size:
+        raise ValueError(
+            f"batch_size should be a positive integer, got {batch_size!r}")
+    batch_size = int(batch_size)
+
+    def batch_reader():
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) == batch_size:
+                yield buf
+                buf = []
+        if buf and not drop_last:
+            yield buf
+
+    return batch_reader

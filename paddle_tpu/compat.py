@@ -1,0 +1,80 @@
+"""`paddle.compat` — py2/py3 string + arithmetic compatibility helpers.
+
+Reference parity: python/paddle/compat.py (to_text:36, to_bytes:132,
+round:217, floor_division:243, get_exception_message:260).  Kept for
+API parity with fluid-era scripts; on py3 these are mostly thin.
+"""
+from __future__ import annotations
+
+import math
+
+__all__ = ["long_type", "to_text", "to_bytes", "round", "floor_division",
+           "get_exception_message"]
+
+long_type = int
+
+
+def _convert(obj, conv, inplace):
+    if obj is None:
+        return obj
+    if isinstance(obj, list):
+        if inplace:
+            obj[:] = [_convert(o, conv, False) for o in obj]
+            return obj
+        return [_convert(o, conv, False) for o in obj]
+    if isinstance(obj, set):
+        new = {_convert(o, conv, False) for o in obj}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    if isinstance(obj, dict):
+        new = {_convert(k, conv, False): v for k, v in obj.items()}
+        if inplace:
+            obj.clear()
+            obj.update(new)
+            return obj
+        return new
+    return conv(obj)
+
+
+def to_text(obj, encoding="utf-8", inplace=False):
+    """bytes (or containers of bytes) -> str; str passes through."""
+    def conv(o):
+        if isinstance(o, bytes):
+            return o.decode(encoding)
+        if isinstance(o, str):
+            return o
+        raise TypeError(f"Can't convert {type(o).__name__} to text")
+    return _convert(obj, conv, inplace)
+
+
+def to_bytes(obj, encoding="utf-8", inplace=False):
+    """str (or containers of str) -> bytes; bytes passes through."""
+    def conv(o):
+        if isinstance(o, str):
+            return o.encode(encoding)
+        if isinstance(o, bytes):
+            return o
+        raise TypeError(f"Can't convert {type(o).__name__} to bytes")
+    return _convert(obj, conv, inplace)
+
+
+def round(x, d=0):  # noqa: A001 - reference shadows the builtin too
+    """py2-style round-half-away-from-zero (py3 builtin rounds half to
+    even: builtin round(2.5)==2 but compat.round(2.5)==3.0)."""
+    if x is None or (isinstance(x, float) and math.isnan(x)):
+        return x
+    p = 10 ** d
+    if x >= 0:
+        return float(math.floor(x * p + 0.5)) / p
+    return float(math.ceil(x * p - 0.5)) / p
+
+
+def floor_division(x, y):
+    return x // y
+
+
+def get_exception_message(exc):
+    return str(exc)

@@ -75,3 +75,13 @@ distributed_optimizer = fleet.distributed_optimizer
 distributed_model = fleet.distributed_model
 save_inference_model = fleet.save_inference_model
 save_persistables = fleet.save_persistables
+# optimizer-facade delegates (reference __init__.py:66-73 binds the
+# wrapped-optimizer passthroughs the same way)
+minimize = fleet.minimize
+step = fleet.step
+clear_grad = fleet.clear_grad
+get_lr = fleet.get_lr
+set_lr = fleet.set_lr
+state_dict = fleet.state_dict
+set_state_dict = fleet.set_state_dict
+util = fleet.util

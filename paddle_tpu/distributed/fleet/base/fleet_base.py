@@ -187,8 +187,44 @@ class Fleet:
         self._ensure_init()
         if strategy is not None:
             self._user_defined_strategy = strategy
-        return DistributedOptimizer(optimizer, self._user_defined_strategy,
-                                    self)
+        self.user_defined_optimizer = DistributedOptimizer(
+            optimizer, self._user_defined_strategy, self)
+        return self.user_defined_optimizer
+
+    # -- optimizer-facade delegates (reference fleet_base.py:931-1014:
+    # after distributed_optimizer(), fleet.minimize/step/... forward to
+    # the wrapped optimizer so scripts can drive training off the
+    # singleton) -----------------------------------------------------
+    def _opt(self):
+        opt = getattr(self, "user_defined_optimizer", None)
+        if opt is None:
+            raise RuntimeError(
+                "call fleet.distributed_optimizer(...) before using the "
+                "fleet optimizer facade (minimize/step/get_lr/...)")
+        return opt
+
+    def minimize(self, loss, startup_program=None, parameter_list=None,
+                 no_grad_set=None):
+        return self._opt().minimize(loss, startup_program, parameter_list,
+                                    no_grad_set)
+
+    def step(self):
+        return self._opt().step()
+
+    def clear_grad(self):
+        return self._opt().clear_grad()
+
+    def get_lr(self):
+        return self._opt().get_lr()
+
+    def set_lr(self, value):
+        return self._opt().set_lr(value)
+
+    def state_dict(self):
+        return self._opt().state_dict()
+
+    def set_state_dict(self, sd):
+        return self._opt().set_state_dict(sd)
 
     # PS-era no-ops kept for script compatibility (collective-only build,
     # SURVEY.md §2.5):

@@ -1,0 +1,12 @@
+"""`paddle.incubate` — experimental-API namespace.
+
+Reference parity: python/paddle/incubate/__init__.py — exports the
+incubating `optimizer` module (LookAhead, ModelAverage) and the
+`reader` tooling.  Here those graduated implementations live in
+paddle_tpu.optimizer.wrappers / paddle_tpu.reader; this namespace
+re-exports them under the incubate paths fluid-era scripts use.
+"""
+from .. import reader  # noqa: F401
+from . import optimizer  # noqa: F401
+
+__all__ = ["reader", "optimizer"]

@@ -1,0 +1,12 @@
+"""incubate.optimizer — LookAhead / ModelAverage incubating paths.
+
+Reference parity: python/paddle/incubate/optimizer/__init__.py (these
+graduated into paddle_tpu.optimizer.wrappers; re-exported here under
+the incubate names).
+"""
+from ..optimizer.wrappers import (  # noqa: F401
+    EMA, ExponentialMovingAverage, LookaheadOptimizer, ModelAverage)
+
+LookAhead = LookaheadOptimizer  # incubate spelling (incubate/optimizer/lookahead.py)
+
+__all__ = ["LookAhead", "ModelAverage"]

@@ -40,6 +40,9 @@ class GPTConfig:
     tensor_parallel: bool = False   # annotate weights for an `mp` mesh axis
     sequence_parallel: bool = False  # ring attention over an `sp` mesh axis
     tie_word_embeddings: bool = True
+    recompute: bool = False  # remat each block (fluid RecomputeOptimizer,
+                             # optimizer.py:4533) — activations between
+                             # blocks are the only saved residuals
 
     @property
     def ffn_size(self):
@@ -145,8 +148,13 @@ class GPTModel(Layer):
         pos = paddle.arange(input_ids.shape[1])
         x = self.wte(input_ids) + self.wpe(pos)
         x = self.drop(x)
-        for blk in self.h:
-            x = blk(x)
+        if self.cfg.recompute:
+            from ..distributed.recompute import recompute as _remat
+            for blk in self.h:
+                x = _remat(blk, x)
+        else:
+            for blk in self.h:
+                x = blk(x)
         return self.ln_f(x)
 
 

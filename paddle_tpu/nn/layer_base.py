@@ -426,14 +426,18 @@ def _swapped_state(layer: Layer, params: dict | None, buffers: dict | None):
 
 
 def functional_call(layer: Layer, params: dict | None, args=(), kwargs=None,
-                    buffers: dict | None = None, rng=None, mutable: bool = True):
+                    buffers: dict | None = None, rng=None, mutable: bool = True,
+                    method: str | None = None):
     """Run layer.forward with `params`/`buffers` substituted, returning
     (output, new_buffers).  Safe to call inside jax.jit/grad tracing: the
     tape is suspended and randomness must come from `rng`.
+    `method` names an alternate entry point (e.g. GPTForCausalLM.loss, the
+    chunked LM-head path) — called directly, so forward hooks are skipped.
     """
     kwargs = kwargs or {}
     ctx = _random.rng_guard(rng) if rng is not None else contextlib.nullcontext()
     with autograd.suspend_tape(), ctx, _swapped_state(layer, params, buffers) as bmap:
-        out = layer(*args, **kwargs)
+        fn = layer if method is None else getattr(layer, method)
+        out = fn(*args, **kwargs)
         new_buffers = {k: t.value for k, t in bmap.items()} if mutable else None
     return out, new_buffers

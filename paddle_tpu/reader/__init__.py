@@ -1,0 +1,263 @@
+"""`paddle.reader` — reader (generator-creator) combinators.
+
+Reference parity: python/paddle/reader/decorator.py (map_readers:91,
+shuffle:133, chain:182, compose:247, buffered:307, firstn:366,
+xmap_readers:411, multiprocess_reader:504, cache:51).  A "reader" here
+is a zero-arg callable returning an iterator of samples; every
+combinator returns a new reader and is lazy until called.
+
+These are host-side data plumbing, deliberately independent of jax —
+the modern path is paddle_tpu.io.DataLoader, but the fluid-era example
+scripts compose pipelines with these.
+"""
+from __future__ import annotations
+
+import itertools
+import multiprocessing
+import queue as _queue
+import random as _random
+import threading
+
+__all__ = [
+    "cache", "map_readers", "buffered", "compose", "chain", "shuffle",
+    "firstn", "xmap_readers", "multiprocess_reader", "ComposeNotAligned",
+]
+
+
+def cache(reader):
+    """Materialize `reader`'s output once; replay from memory thereafter."""
+    all_data = tuple(reader())
+
+    def cached():
+        yield from all_data
+
+    return cached
+
+
+def map_readers(func, *readers):
+    """Yield func(s1, s2, ...) over samples zipped from each reader."""
+
+    def mapped():
+        its = [r() for r in readers]
+        yield from map(func, *its)
+
+    return mapped
+
+
+def shuffle(reader, buf_size):
+    """Shuffle within a sliding buffer of `buf_size` samples.
+
+    Draws from the framework RNG chain (paddle.seed reproduces the
+    order) rather than the global `random` module.
+    """
+
+    epoch = itertools.count()
+
+    def shuffled():
+        from ..framework import random as _fr
+        # per-epoch stream: reproducible after paddle.seed(), but each
+        # pass over the reader shuffles differently (the reference's
+        # global random.shuffle likewise advances across epochs)
+        rng = _random.Random(f"{_fr.get_seed()}:{next(epoch)}")
+        buf = []
+        for sample in reader():
+            buf.append(sample)
+            if len(buf) >= buf_size:
+                rng.shuffle(buf)
+                yield from buf
+                buf = []
+        if buf:
+            rng.shuffle(buf)
+            yield from buf
+
+    return shuffled
+
+
+def chain(*readers):
+    """Concatenate readers end-to-end."""
+
+    def chained():
+        yield from itertools.chain(*(r() for r in readers))
+
+    return chained
+
+
+class ComposeNotAligned(ValueError):
+    pass
+
+
+def compose(*readers, **kwargs):
+    """Zip readers into combined samples: reader A yielding (1, 2) and
+    reader B yielding 3 compose to (1, 2, 3).  With check_alignment
+    (default True), readers of unequal length raise ComposeNotAligned."""
+    check_alignment = kwargs.pop("check_alignment", True)
+    if kwargs:
+        raise TypeError(f"unexpected kwargs {sorted(kwargs)}")
+
+    def make_tuple(x):
+        return x if isinstance(x, tuple) else (x,)
+
+    def composed():
+        its = [r() for r in readers]
+        if check_alignment:
+            _missing = object()
+            for outputs in itertools.zip_longest(*its, fillvalue=_missing):
+                if _missing in outputs:
+                    raise ComposeNotAligned(
+                        "outputs of readers are not aligned")
+                yield sum((make_tuple(o) for o in outputs), ())
+        else:
+            for outputs in zip(*its):
+                yield sum((make_tuple(o) for o in outputs), ())
+
+    return composed
+
+
+def buffered(reader, size):
+    """Read ahead into a bounded buffer on a daemon thread (overlaps
+    producer IO with consumer compute)."""
+    _end = object()
+
+    def buffered_reader():
+        q = _queue.Queue(maxsize=size)
+        exc = []
+
+        def fill():
+            try:
+                for sample in reader():
+                    q.put(sample)
+            except BaseException as e:  # noqa: BLE001 - re-raised below
+                exc.append(e)
+            finally:
+                q.put(_end)
+
+        t = threading.Thread(target=fill, daemon=True)
+        t.start()
+        while True:
+            sample = q.get()
+            if sample is _end:
+                break
+            yield sample
+        if exc:
+            raise exc[0]
+
+    return buffered_reader
+
+
+def firstn(reader, n):
+    """Limit the reader to its first `n` samples."""
+
+    def firstn_reader():
+        yield from itertools.islice(reader(), n)
+
+    return firstn_reader
+
+
+def xmap_readers(mapper, reader, process_num, buffer_size, order=False):
+    """Map `mapper` over samples with `process_num` worker threads.
+
+    With order=True, output order matches input order (workers tag each
+    sample with its index; a reorder stage releases them sequentially).
+    Threads, not processes: mappers are typically IO/numpy decode work
+    that releases the GIL; this also keeps jax-importing parents safe
+    (no fork of a live backend).
+    """
+    _end = object()
+
+    def xreader():
+        in_q = _queue.Queue(buffer_size)
+        out_q = _queue.Queue(buffer_size)
+        exc = []
+
+        def feed():
+            try:
+                for i, sample in enumerate(reader()):
+                    in_q.put((i, sample))
+            except BaseException as e:  # noqa: BLE001
+                exc.append(e)
+            finally:
+                for _ in range(process_num):
+                    in_q.put(_end)
+
+        def work():
+            while True:
+                item = in_q.get()
+                if item is _end:
+                    out_q.put(_end)
+                    return
+                i, sample = item
+                try:
+                    out_q.put((i, mapper(sample)))
+                except BaseException as e:  # noqa: BLE001
+                    exc.append(e)
+                    out_q.put(_end)
+                    return
+
+        threading.Thread(target=feed, daemon=True).start()
+        for _ in range(process_num):
+            threading.Thread(target=work, daemon=True).start()
+
+        finished, pending, next_idx = 0, {}, 0
+        while finished < process_num:
+            item = out_q.get()
+            if item is _end:
+                finished += 1
+                continue
+            i, mapped = item
+            if not order:
+                yield mapped
+            else:
+                pending[i] = mapped
+                while next_idx in pending:
+                    yield pending.pop(next_idx)
+                    next_idx += 1
+        if order:  # drain any stragglers in index order
+            for i in sorted(pending):
+                yield pending[i]
+        if exc:
+            raise exc[0]
+
+    return xreader
+
+
+def multiprocess_reader(readers, use_pipe=True, queue_size=1000):
+    """Interleave several readers, each running in its own process.
+
+    Samples are pickled through a multiprocessing.Queue (the `use_pipe`
+    flag is accepted for signature parity; both modes use the queue —
+    the reference's pipe mode is a ujson-over-pipe serialization detail,
+    not a semantic difference).
+    """
+    if len(readers) < 1:
+        raise ValueError("multiprocess_reader needs at least one reader")
+    _end = "__reader_end__"
+
+    def _worker(r, q):
+        try:
+            for sample in r():
+                q.put(sample)
+        finally:
+            q.put(_end)
+
+    def mp_reader():
+        ctx = multiprocessing.get_context("spawn")  # fork-unsafe under jax
+        q = ctx.Queue(queue_size)
+        procs = [ctx.Process(target=_worker, args=(r, q), daemon=True)
+                 for r in readers]
+        for p in procs:
+            p.start()
+        finished = 0
+        try:
+            while finished < len(readers):
+                sample = q.get()
+                if isinstance(sample, str) and sample == _end:
+                    finished += 1
+                    continue
+                yield sample
+        finally:
+            for p in procs:
+                p.join(timeout=5)
+                if p.is_alive():
+                    p.terminate()
+
+    return mp_reader

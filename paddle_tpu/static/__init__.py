@@ -134,11 +134,22 @@ class Executor:
         if fetch_list:
             # remember fetch roots so static.save can find the captured
             # parameters of inference-only programs
-            seen = getattr(prog, "_captured_vars", [])
-            for v in fetch_list:
-                if not builtins_any_is(v, seen):
-                    seen.append(v)
-            prog._captured_vars = seen
+            # Record the captured PARAMETERS (deduped by identity) —
+            # what static.save actually needs — instead of accumulating
+            # whole fetch DAGs, which kept every past expression (and
+            # everything it closed over) alive for the Program's
+            # lifetime (advisor r04).  The root list itself only keeps
+            # the most recent fetches.
+            from .program import collect_params
+
+            cap = getattr(prog, "_captured_params", [])
+            for p in collect_params(list(fetch_list)):
+                if not builtins_any_is(p, cap):
+                    cap.append(p)
+            prog._captured_params = cap
+            seen = [v for v in getattr(prog, "_captured_vars", [])
+                    if not builtins_any_is(v, fetch_list)]
+            prog._captured_vars = (seen + list(fetch_list))[-32:]
         if prog._train is not None:
             loss_var, opt = prog._train
             return train_step(loss_var, opt, feed, fetch_list,
@@ -380,7 +391,12 @@ def _program_params(program):
         if program._train is not None:
             roots.append(program._train[0])
         roots.extend(getattr(program, "_captured_vars", ()))
-    ps = collect_params(roots) if roots else []
+    ps = list(collect_params(roots)) if roots else []
+    # parameters recorded across ALL past Executor.run fetches (the
+    # root list above is bounded to recent fetches; this is not)
+    for p in getattr(program, "_captured_params", ()) if program else ():
+        if not builtins_any_is(p, ps):
+            ps.append(p)
     return {getattr(p, "name", None) or f"param_{i}": p
             for i, p in enumerate(ps)}
 

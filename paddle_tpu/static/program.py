@@ -184,6 +184,19 @@ register_deferred_hook(_is_deferred, _build)
 
 # -- evaluation ------------------------------------------------------------
 
+_JIT_CACHE_MAX = 64
+
+
+def _cache_put(cache, key, entry):
+    """FIFO-bounded insert: per-iteration fetch expressions would
+    otherwise pin one compiled executable + fetch DAG per call forever
+    (the dominant retainer behind advisor r04's leak finding — bounding
+    _captured_vars alone left this cache unbounded)."""
+    while len(cache) >= _JIT_CACHE_MAX:
+        cache.pop(next(iter(cache)))
+    cache[key] = entry
+
+
 def collect_params(fetch_vars):
     """Trainable eager Tensors captured by the DAG (stop_gradient False)."""
     params = []
@@ -240,9 +253,18 @@ def evaluate(fetch_vars, feed, params=None, jit_cache=None):
     key = (tuple(id(v) for v in fetch_vars),
            tuple((v.shape, str(v.dtype)) for v in feed_vals))
     if jit_cache is not None:
-        jf = jit_cache.get(key)
-        if jf is None:
-            jf = jit_cache[key] = jax.jit(f)
+        hit = jit_cache.get(key)
+        # id() keys can be reused after GC of the original Variables; a
+        # hit is only valid if the cached fetch list is the SAME objects
+        # (advisor r04: a stale compiled graph could otherwise run on
+        # new feeds).  The entry keeps the fetch_vars alive alongside
+        # the jitted fn, so surviving entries can't have ids recycled.
+        if hit is not None and all(a is b for a, b in
+                                   zip(hit[1], fetch_vars)):
+            jf = hit[0]
+        else:
+            jf = jax.jit(f)
+            _cache_put(jit_cache, key, (jf, list(fetch_vars)))
     else:
         jf = jax.jit(f)
     outs = jf(feed_vals, param_vals)
@@ -276,10 +298,13 @@ def train_step(loss_var, optimizer, feed, fetch_list, jit_cache=None):
     key = ("train", tuple(id(v) for v in all_vars),
            tuple((v.shape, str(v.dtype)) for v in feed_vals))
     if jit_cache is not None:
-        jf = jit_cache.get(key)
-        if jf is None:
-            jf = jit_cache[key] = jax.jit(
-                jax.value_and_grad(loss_of, has_aux=True))
+        hit = jit_cache.get(key)
+        # identity-verify the hit (see evaluate: id() reuse after GC)
+        if hit is not None and all(a is b for a, b in zip(hit[1], all_vars)):
+            jf = hit[0]
+        else:
+            jf = jax.jit(jax.value_and_grad(loss_of, has_aux=True))
+            _cache_put(jit_cache, key, (jf, list(all_vars)))
     else:
         jf = jax.jit(jax.value_and_grad(loss_of, has_aux=True))
     (loss, fetches), grads = jf([unwrap(p) for p in params], feed_vals)

@@ -1,0 +1,27 @@
+"""`paddle.sysconfig` — install-tree introspection.
+
+Reference parity: python/paddle/sysconfig.py:17 (get_include returns the
+C header dir, get_lib the shared-library dir).  Here the native core is
+csrc/core.cc built to a cached .so by paddle_tpu.core; get_lib points at
+that .so's directory and get_include at the csrc headers.
+"""
+from __future__ import annotations
+
+import os
+
+__all__ = ["get_include", "get_lib"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def get_include():
+    """Directory of the native-core C/C++ sources/headers."""
+    return os.path.normpath(os.path.join(os.path.dirname(_PKG_DIR), "csrc"))
+
+
+def get_lib():
+    """Directory containing the compiled native core
+    (libpaddle_tpu_core.so), building it on first call if needed."""
+    from . import core
+    core._load()  # compile-on-first-use; harmless no-op if unavailable
+    return os.path.dirname(core._SO)

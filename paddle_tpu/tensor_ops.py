@@ -371,13 +371,26 @@ def bitwise_not(x, out=None, name=None):
 # ---------------------------------------------------------------------------
 # manipulation — reshape/transpose/concat/split/... ops
 # ---------------------------------------------------------------------------
+def _as_dim(s):
+    """int for concrete sizes; jax.export symbolic dims pass through
+    unchanged (int() on a _DimExpr raises — shape-polymorphic serving
+    artifacts reshape with symbolic batch dims)."""
+    return int(s) if isinstance(s, (int, np.integer, float)) else s
+
+
 def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
     xs = x.shape if isinstance(x, Tensor) else list(np.shape(unwrap(x)))
     # paddle semantics: 0 means "copy this dim from input"
-    shape = [xs[i] if s == 0 else int(s) for i, s in enumerate(shape)] if 0 in list(shape) \
-        else [int(s) for s in shape]
+    def _is_zero(s):
+        return isinstance(s, (int, np.integer)) and s == 0
+
+    # NB: builtins.any — this module shadows `any` with the paddle op
+    has_zero = builtins.any(_is_zero(s) for s in shape)
+    shape = [xs[i] if _is_zero(s) else _as_dim(s)
+             for i, s in enumerate(shape)] if has_zero \
+        else [_as_dim(s) for s in shape]
     return apply(lambda v: jnp.reshape(v, shape), x)
 
 

@@ -13,6 +13,7 @@ import numpy as np
 
 from ..io import Dataset
 from . import sequence  # noqa: F401 — paddle_tpu.text.sequence op family
+from .conll05 import Conll05st  # noqa: F401 — text/datasets/conll05.py:43
 
 _CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
 

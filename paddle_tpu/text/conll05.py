@@ -1,0 +1,170 @@
+"""CoNLL-2005 semantic-role-labeling dataset.
+
+Reference parity: python/paddle/text/datasets/conll05.py:43 — each
+sample is the 9-tuple (word_ids, ctx_n2, ctx_n1, ctx_0, ctx_p1, ctx_p2,
+pred_id, mark, label_ids) the fluid SRL demo feeds; context windows are
+broadcast over the sentence and `mark` flags the 5-token predicate
+window.  Zero-egress house rule: the official conll05st-tests tar is
+used when present locally, else a deterministic synthetic SRL corpus
+marked `synthetic=True`.
+"""
+from __future__ import annotations
+
+import gzip
+import os
+import tarfile
+
+import numpy as np
+
+from ..io import Dataset
+
+__all__ = ["Conll05st"]
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+_TAR = os.path.join(_CACHE, "conll05st-tests.tar.gz")
+_WORDS_NAME = "conll05st-release/test.wsj/words/test.wsj.words.gz"
+_PROPS_NAME = "conll05st-release/test.wsj/props/test.wsj.props.gz"
+UNK_IDX = 0
+
+
+def _parse_label_column(lbl):
+    """One props column -> BIO tag sequence (reference conll05.py:200
+    bracket-walk: '(A0*' opens, '*)' closes, bare '*' continues)."""
+    cur_tag, in_bracket, seq = "O", False, []
+    for tok in lbl:
+        if tok == "*" and not in_bracket:
+            seq.append("O")
+        elif tok == "*" and in_bracket:
+            seq.append("I-" + cur_tag)
+        elif tok == "*)":
+            seq.append("I-" + cur_tag)
+            in_bracket = False
+        elif "(" in tok and ")" in tok:
+            cur_tag = tok[1:tok.find("*")]
+            seq.append("B-" + cur_tag)
+            in_bracket = False
+        elif "(" in tok:
+            cur_tag = tok[1:tok.find("*")]
+            seq.append("B-" + cur_tag)
+            in_bracket = True
+        else:
+            raise RuntimeError(f"Unexpected label: {tok}")
+    return seq
+
+
+class Conll05st(Dataset):
+    def __init__(self, data_file=None, word_dict_file=None,
+                 verb_dict_file=None, target_dict_file=None, emb_file=None,
+                 download=True):
+        self.data_file = data_file or (_TAR if os.path.exists(_TAR) else None)
+        self.emb_file = emb_file
+        self.synthetic = self.data_file is None
+        self.sentences, self.predicates, self.labels = [], [], []
+        if self.synthetic:
+            self._make_synthetic()
+        else:
+            self._load_tar()
+        self.word_dict = self._read_dict(word_dict_file) or self._build_dict(
+            (w for s in self.sentences for w in s), extra=("bos", "eos"))
+        self.predicate_dict = (self._read_dict(verb_dict_file)
+                               or self._build_dict(self.predicates))
+        self.label_dict = (self._read_dict(target_dict_file)
+                           or self._build_dict(
+                               t for ls in self.labels for t in ls))
+
+    @staticmethod
+    def _read_dict(path):
+        if path is None or not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return {ln.strip(): i for i, ln in enumerate(f) if ln.strip()}
+
+    @staticmethod
+    def _build_dict(tokens, extra=()):
+        vocab = sorted(set(tokens) | set(extra))
+        return {w: i for i, w in enumerate(vocab)}
+
+    def _make_synthetic(self):
+        rng = np.random.RandomState(0)
+        nouns = [f"n{i}" for i in range(40)]
+        verbs = [f"v{i}" for i in range(8)]
+        for _ in range(80):
+            n = int(rng.randint(4, 12))
+            vi = int(rng.randint(1, n - 1))
+            sent = [nouns[rng.randint(40)] for _ in range(n)]
+            sent[vi] = verbs[rng.randint(8)]
+            lbl = ["O"] * n
+            lbl[vi] = "B-V"
+            lbl[0], lbl[vi - 1] = "B-A0", "I-A0" if vi > 1 else lbl[vi - 1]
+            if vi + 1 < n:
+                lbl[vi + 1] = "B-A1"
+            self.sentences.append(sent)
+            self.predicates.append(sent[vi])
+            self.labels.append(lbl)
+
+    def _load_tar(self):
+        with tarfile.open(self.data_file) as tf:
+            words = gzip.decompress(
+                tf.extractfile(_WORDS_NAME).read()).decode().splitlines()
+            props = gzip.decompress(
+                tf.extractfile(_PROPS_NAME).read()).decode().splitlines()
+        sentence, columns = [], []
+        for wline, pline in zip(words, props):
+            w = wline.strip()
+            p = pline.strip().split()
+            if not w:  # sentence boundary
+                if sentence and columns:
+                    verbs = [c[0] for c in columns if c[0] != "-"]
+                    cols = list(zip(*columns))[1:]
+                    for i, col in enumerate(cols):
+                        try:
+                            seq = _parse_label_column(col)
+                        except RuntimeError:
+                            continue
+                        if "B-V" in seq and i < len(verbs):
+                            self.sentences.append(sentence)
+                            self.predicates.append(verbs[i])
+                            self.labels.append(seq)
+                sentence, columns = [], []
+                continue
+            sentence = sentence + [w.split()[0]]
+            columns.append(p)
+
+    def __getitem__(self, idx):
+        sentence, predicate = self.sentences[idx], self.predicates[idx]
+        labels = self.labels[idx]
+        n = len(sentence)
+        vi = labels.index("B-V")
+        mark = [0] * n
+        ctx = {}
+        for off, name, pad in ((-2, "n2", "bos"), (-1, "n1", "bos"),
+                               (0, "0", None), (1, "p1", "eos"),
+                               (2, "p2", "eos")):
+            j = vi + off
+            if 0 <= j < n:
+                mark[j] = 1
+                ctx[name] = sentence[j]
+            else:
+                ctx[name] = pad
+        wd = self.word_dict
+        word_idx = [wd.get(w, UNK_IDX) for w in sentence]
+        ctx_cols = [[wd.get(ctx[k], UNK_IDX)] * n
+                    for k in ("n2", "n1", "0", "p1", "p2")]
+        pred_idx = [self.predicate_dict.get(predicate, 0)] * n
+        label_idx = [self.label_dict.get(t, 0) for t in labels]
+        return (np.array(word_idx), np.array(ctx_cols[0]),
+                np.array(ctx_cols[1]), np.array(ctx_cols[2]),
+                np.array(ctx_cols[3]), np.array(ctx_cols[4]),
+                np.array(pred_idx), np.array(mark), np.array(label_idx))
+
+    def __len__(self):
+        return len(self.sentences)
+
+    def get_dict(self):
+        """(word_dict, verb_dict, label_dict) — reference conll05.py:295."""
+        return self.word_dict, self.predicate_dict, self.label_dict
+
+    def get_embedding(self):
+        if self.emb_file and os.path.exists(self.emb_file):
+            return np.load(self.emb_file)
+        return None

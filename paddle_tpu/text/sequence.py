@@ -95,9 +95,13 @@ def sequence_softmax(x, seq_len):
     (sequence_softmax_op.cc).  x [B,T] -> [B,T] with zeros at padding."""
     def f(v, ln):
         mask = jnp.arange(v.shape[1])[None, :] < ln[:, None]
-        z = jnp.where(mask, v, -jnp.inf)
+        # zero-length rows: an all(-inf) row softmaxes to NaN (and NaN
+        # survives jnp.where grads — advisor r04); compute from a
+        # NaN-free masked input and zero those rows out afterwards
+        z = jnp.where(mask, v, -1e30)
         p = jax.nn.softmax(z, axis=1)
-        return jnp.where(mask, p, 0)
+        p = jnp.where(mask, p, 0)
+        return jnp.where((ln > 0)[:, None], p, 0)
     return apply(f, x, seq_len)
 
 

@@ -1,0 +1,93 @@
+"""`paddle.utils.download` — cached artifact fetching.
+
+Reference parity: python/paddle/utils/download.py
+(get_weights_path_from_url:112, get_path_from_url:158).  Local-cache
+aware: a file already present under WEIGHTS_HOME (or DATA_HOME) —
+including one pre-seeded by the operator in an egress-less environment —
+is used without any network touch; only a cache miss attempts a
+download, and a clear error names the cache path to seed on failure.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import os.path as osp
+import shutil
+import tarfile
+import zipfile
+
+__all__ = ["get_weights_path_from_url"]
+
+WEIGHTS_HOME = osp.expanduser("~/.cache/paddle/hapi/weights")
+DATA_HOME = osp.expanduser("~/.cache/paddle/dataset")
+
+
+def is_url(path):
+    return isinstance(path, str) and path.startswith(("http://", "https://"))
+
+
+def _md5check(fullname, md5sum=None):
+    if md5sum is None:
+        return True
+    md5 = hashlib.md5()
+    with open(fullname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            md5.update(chunk)
+    return md5.hexdigest() == md5sum
+
+
+def _download(url, path, md5sum=None):
+    os.makedirs(path, exist_ok=True)
+    fname = osp.split(url)[-1]
+    fullname = osp.join(path, fname)
+    if osp.exists(fullname) and _md5check(fullname, md5sum):
+        return fullname
+    import urllib.request
+    try:
+        tmp = fullname + ".tmp"
+        with urllib.request.urlopen(url, timeout=60) as r, \
+                open(tmp, "wb") as f:
+            shutil.copyfileobj(r, f)
+        if not _md5check(tmp, md5sum):
+            os.remove(tmp)
+            raise IOError(f"md5 mismatch downloading {url}")
+        os.replace(tmp, fullname)
+        return fullname
+    except Exception as e:
+        raise RuntimeError(
+            f"Could not download {url} ({e}). In an offline environment, "
+            f"place the file at {fullname} to use the local cache.") from e
+
+
+def _decompress(fname):
+    d = osp.dirname(fname)
+    if tarfile.is_tarfile(fname):
+        with tarfile.open(fname) as tf:
+            tf.extractall(d, filter="data")
+            names = tf.getnames()
+        return osp.join(d, names[0].split("/")[0]) if names else d
+    if zipfile.is_zipfile(fname):
+        with zipfile.ZipFile(fname) as zf:
+            zf.extractall(d)
+            names = zf.namelist()
+        return osp.join(d, names[0].split("/")[0]) if names else d
+    return fname
+
+
+def get_path_from_url(url, root_dir, md5sum=None, check_exist=True):
+    """Cached fetch: return the local path for `url` under `root_dir`,
+    downloading (and un-tar/zipping) only on cache miss."""
+    fname = osp.split(url)[-1]
+    fullname = osp.join(root_dir, fname)
+    if check_exist and osp.exists(fullname) and _md5check(fullname, md5sum):
+        fullpath = fullname
+    else:
+        fullpath = _download(url, root_dir, md5sum)
+    if tarfile.is_tarfile(fullpath) or zipfile.is_zipfile(fullpath):
+        return _decompress(fullpath)
+    return fullpath
+
+
+def get_weights_path_from_url(url, md5sum=None):
+    """Local weights-cache path for `url` (downloads on miss)."""
+    return get_path_from_url(url, WEIGHTS_HOME, md5sum)

@@ -180,3 +180,8 @@ class Flowers(Dataset):
         if self.transform is not None:
             img = self.transform(img)
         return img.astype(np.float32), np.asarray(label, np.int64)
+
+
+from .folder import (  # noqa: E402,F401 — vision/datasets/folder.py:62
+    DatasetFolder, ImageFolder)
+from .voc2012 import VOC2012  # noqa: E402,F401 — vision/datasets/voc2012.py:41

@@ -1,0 +1,146 @@
+"""DatasetFolder / ImageFolder — bring-your-own-images datasets.
+
+Reference parity: python/paddle/vision/datasets/folder.py
+(DatasetFolder:62, ImageFolder:216, make_dataset:39).  Purely local
+directory walkers — no download path — so they work unchanged in a
+zero-egress environment.
+"""
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["DatasetFolder", "ImageFolder", "has_valid_extension",
+           "make_dataset", "pil_loader", "default_loader", "IMG_EXTENSIONS"]
+
+IMG_EXTENSIONS = (".jpg", ".jpeg", ".png", ".ppm", ".bmp", ".pgm", ".tif",
+                  ".tiff", ".webp", ".npy")
+
+
+def has_valid_extension(filename, extensions):
+    """Case-insensitive extension filter (reference folder.py:26)."""
+    return filename.lower().endswith(tuple(extensions))
+
+
+def make_dataset(directory, class_to_idx, extensions, is_valid_file=None):
+    """Collect (path, class_index) pairs under root/class_x/** — sorted
+    walk so sample order is deterministic across filesystems."""
+    if extensions is not None and is_valid_file is not None:
+        raise ValueError(
+            "extensions and is_valid_file cannot both be passed")
+    if is_valid_file is None:
+        def is_valid_file(p):  # noqa: PLR1704 - mirrors reference shape
+            return has_valid_extension(p, extensions)
+    samples = []
+    directory = os.path.expanduser(directory)
+    for cls in sorted(class_to_idx):
+        d = os.path.join(directory, cls)
+        if not os.path.isdir(d):
+            continue
+        for root, _, fnames in sorted(os.walk(d, followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root, fname)
+                if is_valid_file(path):
+                    samples.append((path, class_to_idx[cls]))
+    return samples
+
+
+def pil_loader(path):
+    from PIL import Image
+    with open(path, "rb") as f:
+        return Image.open(f).convert("RGB")
+
+
+def _npy_loader(path):
+    return np.load(path)
+
+
+def default_loader(path):
+    """PIL for image formats, numpy for .npy dumps (the TPU input
+    pipeline consumes numpy either way)."""
+    if path.lower().endswith(".npy"):
+        return _npy_loader(path)
+    return pil_loader(path)
+
+
+class DatasetFolder(Dataset):
+    """Generic loader for root/class_a/xxx.ext layouts.
+
+    Attributes match the reference: classes, class_to_idx, samples,
+    targets.  __getitem__ -> (sample, class_index).
+    """
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        classes, class_to_idx = self._find_classes(root)
+        samples = make_dataset(root, class_to_idx, extensions, is_valid_file)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                f"Supported extensions are: {','.join(extensions or [])}")
+        self.loader = loader or default_loader
+        self.extensions = extensions
+        self.classes = classes
+        self.class_to_idx = class_to_idx
+        self.samples = samples
+        self.targets = [s[1] for s in samples]
+
+    @staticmethod
+    def _find_classes(directory):
+        classes = sorted(e.name for e in os.scandir(directory) if e.is_dir())
+        return classes, {c: i for i, c in enumerate(classes)}
+
+    def __getitem__(self, index):
+        path, target = self.samples[index]
+        sample = self.loader(path)
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return sample, target
+
+    def __len__(self):
+        return len(self.samples)
+
+
+class ImageFolder(Dataset):
+    """Flat (label-less) image folder: __getitem__ -> [sample]
+    (reference folder.py:216 returns a single-element list)."""
+
+    def __init__(self, root, loader=None, extensions=None, transform=None,
+                 is_valid_file=None):
+        self.root = root
+        self.transform = transform
+        if extensions is None and is_valid_file is None:
+            extensions = IMG_EXTENSIONS
+        if is_valid_file is None:
+            def is_valid_file(p):
+                return has_valid_extension(p, extensions)
+        samples = []
+        for root_, _, fnames in sorted(os.walk(os.path.expanduser(root),
+                                               followlinks=True)):
+            for fname in sorted(fnames):
+                path = os.path.join(root_, fname)
+                if is_valid_file(path):
+                    samples.append(path)
+        if not samples:
+            raise RuntimeError(
+                f"Found 0 files in subfolders of: {root}\n"
+                f"Supported extensions are: {','.join(extensions or [])}")
+        self.loader = loader or default_loader
+        self.extensions = extensions
+        self.samples = samples
+
+    def __getitem__(self, index):
+        sample = self.loader(self.samples[index])
+        if self.transform is not None:
+            sample = self.transform(sample)
+        return [sample]
+
+    def __len__(self):
+        return len(self.samples)

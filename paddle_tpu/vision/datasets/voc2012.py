@@ -1,0 +1,82 @@
+"""VOC2012 segmentation dataset.
+
+Reference parity: python/paddle/vision/datasets/voc2012.py:41 — reads
+(image, segmentation-label) pairs straight out of the VOCtrainval tar
+without unpacking.  Zero-egress house rule (datasets/__init__.py): a
+local tar (explicit `data_file` or the cache path) is used when present;
+otherwise a deterministic synthetic segmentation set marked
+`synthetic=True` keeps the pipeline exercisable.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import tarfile
+
+import numpy as np
+
+from ...io import Dataset
+
+__all__ = ["VOC2012"]
+
+_CACHE = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+_VOC_TAR = os.path.join(_CACHE, "VOCtrainval_11-May-2012.tar")
+_SET_FILE = "VOCdevkit/VOC2012/ImageSets/Segmentation/{}.txt"
+_DATA_FILE = "VOCdevkit/VOC2012/JPEGImages/{}.jpg"
+_LABEL_FILE = "VOCdevkit/VOC2012/SegmentationClass/{}.png"
+_MODE_FLAG = {"train": "train", "valid": "val", "test": "val"}
+
+
+class VOC2012(Dataset):
+    """__getitem__ -> (image, label) numpy arrays (HWC uint8 image,
+    HW uint8 class-index mask), matching the reference's cv2 backend
+    output — the TPU input pipeline consumes numpy."""
+
+    def __init__(self, data_file=None, mode="train", transform=None,
+                 download=True, backend=None):
+        mode = mode.lower()
+        if mode not in _MODE_FLAG:
+            raise AssertionError(
+                f"mode should be 'train', 'valid' or 'test', got {mode}")
+        self.flag = _MODE_FLAG[mode]
+        self.transform = transform
+        self.data_file = data_file or (
+            _VOC_TAR if os.path.exists(_VOC_TAR) else None)
+        self.synthetic = self.data_file is None
+        if self.synthetic:
+            rng = np.random.RandomState(0 if self.flag == "train" else 1)
+            n = 64 if self.flag == "train" else 16
+            self._images = (rng.rand(n, 64, 64, 3) * 255).astype(np.uint8)
+            self._labels = rng.randint(0, 21, (n, 64, 64)).astype(np.uint8)
+        else:
+            self._load_anno()
+
+    def _load_anno(self):
+        self._tar = tarfile.open(self.data_file)
+        self._members = {m.name: m for m in self._tar.getmembers()}
+        names = self._tar.extractfile(
+            self._members[_SET_FILE.format(self.flag)]).read().split()
+        self._keys = [n.decode() for n in names]
+
+    def __getitem__(self, idx):
+        if self.synthetic:
+            image, label = self._images[idx], self._labels[idx]
+        else:
+            from PIL import Image
+            raw = self._tar.extractfile(
+                self._members[_DATA_FILE.format(self._keys[idx])]).read()
+            lab = self._tar.extractfile(
+                self._members[_LABEL_FILE.format(self._keys[idx])]).read()
+            image = np.asarray(Image.open(_io.BytesIO(raw)).convert("RGB"))
+            label = np.asarray(Image.open(_io.BytesIO(lab)))
+        if self.transform is not None:
+            image = self.transform(image)
+        return image, label
+
+    def __len__(self):
+        return len(self._images) if self.synthetic else len(self._keys)
+
+    def __del__(self):
+        tar = getattr(self, "_tar", None)
+        if tar is not None:
+            tar.close()

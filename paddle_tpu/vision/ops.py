@@ -1052,6 +1052,19 @@ def prroi_pool(x, boxes, boxes_num=None, output_size=7, spatial_scale=1.0,
     return apply(f, x, boxes)
 
 
+def _np_rng():
+    """numpy RandomState chained off the framework RNG so paddle.seed()
+    reproduces host-side detection sampling (advisor r04: these kernels
+    drew from the GLOBAL np.random state, which paddle.seed never
+    touches — the reference seeds its sampling engine from the op seed
+    attribute).  Each call advances the chain."""
+    from ..framework import random as _fr
+
+    key = _fr.split_key(1)
+    data = np.asarray(jax.random.key_data(key)).ravel()
+    return np.random.RandomState(data.astype(np.uint32)[-1])
+
+
 def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
                       rpn_batch_size_per_im=256, rpn_straddle_thresh=0.0,
                       rpn_fg_fraction=0.5, rpn_positive_overlap=0.7,
@@ -1074,15 +1087,16 @@ def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,
         label[best >= rpn_positive_overlap] = 1
     fg = np.where(label == 1)[0]
     num_fg = int(rpn_fg_fraction * rpn_batch_size_per_im)
+    rng = _np_rng() if use_random else None
     if len(fg) > num_fg:
-        drop = fg[num_fg:] if not use_random else np.random.choice(
+        drop = fg[num_fg:] if not use_random else rng.choice(
             fg, len(fg) - num_fg, replace=False)
         label[drop] = -1
         fg = np.where(label == 1)[0]
     bg = np.where(label == 0)[0]
     num_bg = rpn_batch_size_per_im - len(fg)
     if len(bg) > num_bg:
-        drop = bg[num_bg:] if not use_random else np.random.choice(
+        drop = bg[num_bg:] if not use_random else rng.choice(
             bg, len(bg) - num_bg, replace=False)
         label[drop] = -1
         bg = np.where(label == 0)[0]
@@ -1129,8 +1143,9 @@ def generate_proposal_labels(rpn_rois, gt_classes, gt_boxes,
     num_fg = min(int(fg_fraction * batch_size_per_im), len(fg))
     num_bg = min(batch_size_per_im - num_fg, len(bg))
     if use_random:
-        fg = np.random.permutation(fg)
-        bg = np.random.permutation(bg)
+        rng = _np_rng()
+        fg = rng.permutation(fg)
+        bg = rng.permutation(bg)
     fg, bg = fg[:num_fg], bg[:num_bg]
     keep = np.concatenate([fg, bg])
     labels = np.concatenate([gtc[arg[fg]], np.zeros(len(bg), int)])
@@ -1206,11 +1221,12 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
     am = list(anchor_mask)
     A = len(am)
 
-    def f(xv, gb, gl):
+    def f(xv, gb, gl, gs):
         B, _, H, W = xv.shape
         C = class_num
         p = xv.reshape(B, A, 5 + C, H, W)
-        px, py = jax.nn.sigmoid(p[:, :, 0]), jax.nn.sigmoid(p[:, :, 1])
+        px_l, py_l = p[:, :, 0], p[:, :, 1]  # raw logits (loss is SCE)
+        px, py = jax.nn.sigmoid(px_l), jax.nn.sigmoid(py_l)  # decoded
         pw, ph = p[:, :, 2], p[:, :, 3]
         pobj = p[:, :, 4]
         pcls = p[:, :, 5:]
@@ -1254,21 +1270,27 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         th = jnp.log(jnp.maximum(gh, 1e-9) /
                      jnp.maximum(anc_m[slot][..., 1], 1e-9))
         scale = 2.0 - gb[:, :, 2] * gb[:, :, 3]  # small-box upweight
-        px_g = px[bidx, slot, gj, gi]
-        py_g = py[bidx, slot, gj, gi]
+        pxl_g = px_l[bidx, slot, gj, gi]
+        pyl_g = py_l[bidx, slot, gj, gi]
         pw_g = pw[bidx, slot, gj, gi]
         ph_g = ph[bidx, slot, gj, gi]
-        m = resp.astype(jnp.float32) * scale
+        # every per-gt term is scaled by gt_score (mixup weighting,
+        # yolov3_loss_op.h CalcBoxLocationLoss/CalcLabelLoss)
+        m = resp.astype(jnp.float32) * scale * gs
         bce = lambda z, t: jnp.maximum(z, 0) - z * t + jnp.log1p(  # noqa
             jnp.exp(-jnp.abs(z)))
-        loss_xy = (m * ((px_g - tx) ** 2 + (py_g - ty) ** 2)).sum()
-        loss_wh = (m * ((pw_g - tw) ** 2 + (ph_g - th) ** 2)).sum()
+        # x/y: sigmoid cross-entropy on RAW logits vs tx/ty; w/h: L1 —
+        # the reference kernel's exact loss shapes (yolov3_loss_op.h
+        # CalcBoxLocationLoss), not squared error (advisor r04, medium)
+        loss_xy = (m * (bce(pxl_g, tx) + bce(pyl_g, ty))).sum()
+        loss_wh = (m * (jnp.abs(pw_g - tw) + jnp.abs(ph_g - th))).sum()
         cls_logit = pcls[bidx, slot, :, gj, gi]            # [B,G,C]
         smooth = 1.0 / C if use_label_smooth else 0.0
         tgt_cls = jax.nn.one_hot(gl, C) * (1 - 2 * smooth) + smooth
-        loss_cls = (resp[..., None] * bce(cls_logit, tgt_cls)).sum()
+        loss_cls = ((resp.astype(jnp.float32) * gs)[..., None]
+                    * bce(cls_logit, tgt_cls)).sum()
         obj_tgt = obj_tgt.at[bidx, slot, gj, gi].max(
-            resp.astype(jnp.float32))
+            resp.astype(jnp.float32) * gs)
 
         # ignore mask: predicted boxes with IoU>thresh vs any gt
         cell_x = (jnp.arange(W)[None, None, None] + px) / W
@@ -1294,12 +1316,18 @@ def yolo_loss(x, gt_box, gt_label, anchors, anchor_mask, class_num,
         iou_best = jnp.where(valid[:, None, None, None],
                              iou_pg, 0.0).max(-1)
         noobj_ok = (iou_best < ignore_thresh).astype(jnp.float32)
-        loss_obj = (obj_tgt * bce(pobj, jnp.ones_like(pobj))
-                    + (1 - obj_tgt) * noobj_ok
+        # positives: SCE against the (score-valued) target — reference
+        # CalcObjnessLoss uses the mixup score as the objectness target
+        pos = (obj_tgt > 0).astype(jnp.float32)
+        loss_obj = (pos * bce(pobj, obj_tgt)
+                    + (1 - pos) * noobj_ok
                     * bce(pobj, jnp.zeros_like(pobj))).sum()
         return (loss_xy + loss_wh + loss_cls + loss_obj) / B
 
-    return apply(f, x, gt_box, gt_label)
+    if gt_score is None:
+        ones = jnp.ones(np.shape(unwrap(gt_label)), jnp.float32)
+        return apply(f, x, gt_box, gt_label, ones)
+    return apply(f, x, gt_box, gt_label, gt_score)
 
 
 def random_crop(x, shape, seed=None):

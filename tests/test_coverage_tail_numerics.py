@@ -1,0 +1,599 @@
+"""Numeric tests for every op COVERAGE.md marked implemented-but-
+import-verified-only (VERDICT r04 weak #6 / next-step #6).  References:
+numpy closed forms for elementwise/manipulation ops, torch (CPU) for
+conv/norm/interpolate/ctc oracles — the same oracle style as the
+reference's OpTest numpy hooks (fluid/tests/unittests/op_test.py:232).
+"""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+F = paddle.nn.functional
+T = paddle.to_tensor
+rs = np.random.RandomState(0)
+
+
+def A(t):
+    return np.asarray(t.numpy() if hasattr(t, "numpy") else t)
+
+
+X1 = rs.rand(3, 4).astype(np.float32) * 0.8 + 0.1   # (0.1, 0.9)
+XS = (rs.randn(3, 4) * 2).astype(np.float32)        # signed
+
+
+# ---- elementwise unary vs numpy -------------------------------------
+UNARY = [
+    (paddle.acos, X1, np.arccos),
+    (paddle.asin, X1, np.arcsin),
+    (paddle.atan, XS, np.arctan),
+    (paddle.cosh, XS, np.cosh),
+    (paddle.sinh, XS, np.sinh),
+    (paddle.tan, X1, np.tan),
+    (paddle.log2, X1, np.log2),
+    (paddle.log10, X1, np.log10),
+    (paddle.reciprocal, X1, lambda x: 1.0 / x),
+]
+
+
+@pytest.mark.parametrize("fn,x,ref", UNARY,
+                         ids=[f[0].__name__ for f in UNARY])
+def test_unary_vs_numpy(fn, x, ref):
+    np.testing.assert_allclose(A(fn(T(x))), ref(x), rtol=1e-5, atol=1e-6)
+
+
+def test_complex_conj_imag():
+    z = (XS[:2] + 1j * XS[1:3]).astype(np.complex64)
+    np.testing.assert_allclose(A(paddle.conj(T(z))), np.conj(z))
+    np.testing.assert_allclose(A(paddle.imag(T(z))), np.imag(z))
+
+
+def test_floor_divide_and_argmin():
+    a = np.array([7.0, -7.0, 9.0], np.float32)
+    b = np.array([2.0, 2.0, -4.0], np.float32)
+    np.testing.assert_allclose(A(paddle.floor_divide(T(a), T(b))),
+                               np.floor_divide(a, b))
+    np.testing.assert_allclose(A(paddle.argmin(T(XS), axis=1)),
+                               XS.argmin(1))
+
+
+# ---- activations vs closed forms ------------------------------------
+def _selu(x, a=1.6732632423543772, s=1.0507009873554805):
+    return s * np.where(x > 0, x, a * (np.exp(x) - 1))
+
+
+ACTS = [
+    ("relu6", lambda x: F.relu6(T(x)), lambda x: np.clip(x, 0, 6)),
+    ("elu", lambda x: F.elu(T(x), alpha=0.5),
+     lambda x: np.where(x > 0, x, 0.5 * (np.exp(x) - 1))),
+    ("selu", lambda x: F.selu(T(x)), _selu),
+    ("mish", lambda x: F.mish(T(x)),
+     lambda x: x * np.tanh(np.log1p(np.exp(x)))),
+    ("swish", lambda x: F.swish(T(x)), lambda x: x / (1 + np.exp(-x))),
+    ("softsign", lambda x: F.softsign(T(x)), lambda x: x / (1 + np.abs(x))),
+    ("softshrink", lambda x: F.softshrink(T(x), threshold=0.5),
+     lambda x: np.where(x > 0.5, x - 0.5, np.where(x < -0.5, x + 0.5, 0))),
+    ("hardshrink", lambda x: F.hardshrink(T(x), threshold=0.5),
+     lambda x: np.where(np.abs(x) > 0.5, x, 0)),
+    ("hardsigmoid", lambda x: F.hardsigmoid(T(x)),
+     lambda x: np.clip(x / 6 + 0.5, 0, 1)),
+    ("hardswish", lambda x: F.hardswish(T(x)),
+     lambda x: x * np.clip(x + 3, 0, 6) / 6),
+    ("hardtanh", lambda x: F.hardtanh(T(x), min=-1, max=1),
+     lambda x: np.clip(x, -1, 1)),
+    ("leaky_relu", lambda x: F.leaky_relu(T(x), negative_slope=0.1),
+     lambda x: np.where(x > 0, x, 0.1 * x)),
+    ("log_sigmoid", lambda x: F.log_sigmoid(T(x)),
+     lambda x: -np.log1p(np.exp(-x))),
+    ("tanhshrink", lambda x: F.tanhshrink(T(x)), lambda x: x - np.tanh(x)),
+    ("thresholded_relu", lambda x: F.thresholded_relu(T(x), threshold=1.0),
+     lambda x: np.where(x > 1.0, x, 0)),
+]
+
+
+@pytest.mark.parametrize("name,fn,ref", ACTS, ids=[a[0] for a in ACTS])
+def test_activation_closed_form(name, fn, ref):
+    np.testing.assert_allclose(A(fn(XS)), ref(XS), rtol=1e-5, atol=1e-6)
+
+
+def test_prelu_and_maxout():
+    w = np.array([0.25], np.float32)
+    np.testing.assert_allclose(A(F.prelu(T(XS), T(w))),
+                               np.where(XS > 0, XS, 0.25 * XS), rtol=1e-6)
+    x = rs.randn(2, 6, 4, 4).astype(np.float32)
+    got = A(F.maxout(T(x), groups=3))
+    # maxout_op: C_out = C/groups, each output maxes over `groups`
+    # consecutive channels
+    ref = x.reshape(2, 2, 3, 4, 4).max(2)
+    np.testing.assert_allclose(got, ref)
+
+
+# ---- losses vs closed forms -----------------------------------------
+def test_bce_and_bce_with_logits():
+    p = X1
+    t = (rs.rand(3, 4) > 0.5).astype(np.float32)
+    ref = -(t * np.log(p) + (1 - t) * np.log(1 - p)).mean()
+    np.testing.assert_allclose(A(F.binary_cross_entropy(T(p), T(t))),
+                               ref, rtol=1e-5)
+    z = XS
+    ref2 = np.mean(np.maximum(z, 0) - z * t + np.log1p(np.exp(-np.abs(z))))
+    np.testing.assert_allclose(
+        A(F.binary_cross_entropy_with_logits(T(z), T(t))), ref2, rtol=1e-5)
+
+
+def test_smooth_l1_and_kl_and_margin_rank():
+    a, b = XS, XS + rs.randn(3, 4).astype(np.float32)
+    d = np.abs(a - b)
+    ref = np.where(d < 1, 0.5 * d * d, d - 0.5).mean()
+    np.testing.assert_allclose(A(F.smooth_l1_loss(T(a), T(b))), ref,
+                               rtol=1e-5)
+    p = X1 / X1.sum(-1, keepdims=True)
+    q = np.roll(p, 1, -1)
+    logq = np.log(q)
+    np.testing.assert_allclose(
+        A(F.kl_div(T(logq), T(p), reduction="sum")),
+        (p * (np.log(p) - logq)).sum(), rtol=1e-4)
+    x1, x2 = XS[0], XS[1]
+    lab = np.sign(rs.randn(4)).astype(np.float32)
+    ref3 = np.maximum(0, -lab * (x1 - x2) + 0.1).mean()
+    np.testing.assert_allclose(
+        A(F.margin_ranking_loss(T(x1), T(x2), T(lab), margin=0.1)),
+        ref3, rtol=1e-5)
+
+
+def test_nll_softmax_ce_cosine():
+    logp = np.log(X1 / X1.sum(-1, keepdims=True))
+    lab = rs.randint(0, 4, (3,))
+    np.testing.assert_allclose(
+        A(F.nll_loss(T(logp), T(lab))),
+        -logp[np.arange(3), lab].mean(), rtol=1e-5)
+    z = XS
+    lse = np.log(np.exp(z).sum(-1, keepdims=True))
+    ref = (lse.squeeze(-1) - z[np.arange(3), lab])
+    got = A(F.softmax_with_cross_entropy(T(z), T(lab[:, None])))
+    np.testing.assert_allclose(got.squeeze(), ref, rtol=1e-5)
+    a, b = XS[0], XS[1]
+    cs = (a * b).sum() / (np.linalg.norm(a) * np.linalg.norm(b))
+    np.testing.assert_allclose(A(F.cosine_similarity(T(XS[:1]), T(XS[1:2]))),
+                               [cs], rtol=1e-5)
+
+
+def test_ctc_loss_vs_torch():
+    torch = pytest.importorskip("torch")
+    B, S, C, L = 2, 8, 5, 3
+    logits = rs.randn(B, S, C).astype(np.float32)  # [B, T, C]
+    labels = rs.randint(1, C, (B, L)).astype(np.int32)
+    in_len = np.array([S, S], np.int32)
+    lab_len = np.array([L, L], np.int32)
+    got = A(F.ctc_loss(T(logits.transpose(1, 0, 2)), T(labels),
+                       T(in_len), T(lab_len), blank=0,
+                       reduction="none"))
+    tl = torch.nn.functional.ctc_loss(
+        torch.log_softmax(torch.tensor(logits.transpose(1, 0, 2)), -1),
+        torch.tensor(labels.astype(np.int64)),
+        torch.tensor(in_len.astype(np.int64)),
+        torch.tensor(lab_len.astype(np.int64)),
+        blank=0, reduction="none")
+    np.testing.assert_allclose(got, tl.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_fsp_label_smooth():
+    a = rs.randn(2, 3, 4, 4).astype(np.float32)
+    b = rs.randn(2, 5, 4, 4).astype(np.float32)
+    got = A(F.fsp_matrix(T(a), T(b)))
+    ref = np.einsum("bchw,bdhw->bcd", a, b) / 16.0
+    np.testing.assert_allclose(got, ref, rtol=1e-4)
+    oh = np.eye(4, dtype=np.float32)[[0, 2]]
+    np.testing.assert_allclose(A(F.label_smooth(T(oh), epsilon=0.1)),
+                               oh * 0.9 + 0.1 / 4, rtol=1e-6)
+
+
+# ---- manipulation vs numpy ------------------------------------------
+def test_manipulation_family():
+    np.testing.assert_allclose(A(paddle.dot(T(XS[0]), T(XS[1]))),
+                               XS[0] @ XS[1], rtol=1e-5)
+    M = XS[:3, :3]
+    v = XS[0, :3]
+    np.testing.assert_allclose(A(paddle.mv(T(M), T(v))), M @ v, rtol=1e-5)
+    np.testing.assert_allclose(A(paddle.kron(T(XS[:2, :2]), T(XS[1:3, :2]))),
+                               np.kron(XS[:2, :2], XS[1:3, :2]), rtol=1e-5)
+    np.testing.assert_allclose(A(paddle.roll(T(XS), 2, axis=1)),
+                               np.roll(XS, 2, 1))
+    parts = paddle.unbind(T(XS), axis=0)
+    assert len(parts) == 3
+    np.testing.assert_allclose(A(parts[1]), XS[1])
+    parts2 = paddle.unstack(T(XS), axis=1)
+    assert len(parts2) == 4 and A(parts2[2]).tolist() == XS[:, 2].tolist()
+    np.testing.assert_allclose(A(paddle.expand(T(XS[:1]), [3, 4])),
+                               np.broadcast_to(XS[:1], (3, 4)))
+    np.testing.assert_allclose(A(paddle.expand_as(T(XS[:1]), T(XS))),
+                               np.broadcast_to(XS[:1], (3, 4)))
+    np.testing.assert_allclose(A(paddle.full_like(T(XS), 7.0)),
+                               np.full_like(XS, 7.0))
+    e = paddle.empty([2, 3], "float32")
+    assert list(A(e).shape) == [2, 3]
+    assert bool(A(paddle.is_empty(paddle.zeros([0, 3]))))
+    assert not bool(A(paddle.is_empty(T(XS))))
+    g = paddle.meshgrid(T(np.arange(3, dtype=np.float32)),
+                        T(np.arange(2, dtype=np.float32)))
+    ref = np.meshgrid(np.arange(3), np.arange(2), indexing="ij")
+    np.testing.assert_allclose(A(g[0]), ref[0])
+    np.testing.assert_allclose(A(g[1]), ref[1])
+
+
+def test_gather_scatter_mask_family():
+    idx = np.array([[0, 1], [2, 3]], np.int64)
+    x3 = rs.randn(3, 4, 5).astype(np.float32)
+    np.testing.assert_allclose(A(paddle.gather_nd(T(x3), T(idx))),
+                               x3[idx[:, 0], idx[:, 1]])
+    base = np.zeros((4,), np.float32)
+    upd = np.array([1.0, 2.0, 3.0], np.float32)
+    sidx = np.array([[1], [1], [3]], np.int64)
+    got = A(paddle.scatter_nd_add(T(base), T(sidx), T(upd)))
+    np.testing.assert_allclose(got, [0, 3, 0, 3])
+    m = XS > 0
+    np.testing.assert_allclose(A(paddle.masked_select(T(XS), T(m))), XS[m])
+    np.testing.assert_allclose(A(paddle.nonzero(T((XS > 0).astype(
+        np.float32)))), np.argwhere(XS > 0))
+    inputs = [T(np.full((2, 2), i, np.float32)) for i in range(3)]
+    sel = np.array([[2], [0]], np.int32)
+    got = A(paddle.multiplex(inputs, T(sel)))
+    np.testing.assert_allclose(got, [[2, 2], [0, 0]])
+    x = rs.randn(2, 5).astype(np.float32)
+    ii = np.array([[0, 2], [4, 1]], np.int64)
+    np.testing.assert_allclose(A(paddle.index_sample(T(x), T(ii))),
+                               np.take_along_axis(x, ii, 1))
+
+
+def test_unique_histogram_shard_onehot_crop():
+    v = np.array([2, 1, 2, 3, 1], np.int64)
+    u = A(paddle.unique(T(v)))
+    np.testing.assert_allclose(np.sort(u), [1, 2, 3])
+    u2, cnt = paddle.unique(T(v), return_counts=True)
+    order = np.argsort(A(u2))
+    np.testing.assert_allclose(A(cnt)[order], [2, 2, 1])
+    h = A(paddle.histogram(T(np.array([0.1, 0.5, 0.9], np.float32)),
+                           bins=2, min=0.0, max=1.0))
+    np.testing.assert_allclose(h, [1, 2])  # 0.5 falls in the right bin
+    sh = A(paddle.shard_index(T(np.array([[1], [5], [9]], np.int64)),
+                              index_num=12, nshards=3, shard_id=1,
+                              ignore_value=-1))
+    # shard 1 owns [4, 8): 5 -> 5-4=1, others ignored
+    np.testing.assert_allclose(sh, [[-1], [1], [-1]])
+    oh = A(F.one_hot(T(np.array([0, 2], np.int64)), num_classes=3))
+    np.testing.assert_allclose(oh, np.eye(3)[[0, 2]])
+    c = A(paddle.crop(T(XS), shape=[2, 2], offsets=[1, 1]))
+    np.testing.assert_allclose(c, XS[1:3, 1:3])
+    sm = A(F.sequence_mask(T(np.array([1, 3], np.int64)), maxlen=4))
+    np.testing.assert_allclose(sm, [[1, 0, 0, 0], [1, 1, 1, 0]])
+
+
+def test_sequence_erase():
+    from paddle_tpu.text.sequence import sequence_erase
+    x = np.array([[3, 5, 3, 7, 0]], np.int64)
+    ln = np.array([5])
+    out, new_len = sequence_erase(T(x), T(ln), tokens=[3])
+    assert int(A(new_len)[0]) == 3
+    np.testing.assert_allclose(A(out)[0, :3], [5, 7, 0])
+
+
+# ---- conv / norm / resize vs torch ----------------------------------
+def _torch():
+    return pytest.importorskip("torch")
+
+
+def test_conv_transpose2d_vs_torch():
+    torch = _torch()
+    x = rs.randn(1, 3, 6, 6).astype(np.float32)
+    w = rs.randn(3, 4, 3, 3).astype(np.float32)  # [Cin, Cout, kh, kw]
+    got = A(F.conv2d_transpose(T(x), T(w), stride=2, padding=1))
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_depthwise_conv_transpose_vs_torch():
+    torch = _torch()
+    x = rs.randn(1, 4, 6, 6).astype(np.float32)
+    w = rs.randn(4, 1, 3, 3).astype(np.float32)
+    got = A(F.conv2d_transpose(T(x), T(w), stride=2, groups=4))
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2, groups=4).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_conv3d_and_transpose_vs_torch():
+    torch = _torch()
+    x = rs.randn(1, 2, 5, 5, 5).astype(np.float32)
+    w = rs.randn(3, 2, 3, 3, 3).astype(np.float32)
+    got = A(F.conv3d(T(x), T(w), padding=1))
+    ref = torch.nn.functional.conv3d(torch.tensor(x), torch.tensor(w),
+                                     padding=1).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    wt = rs.randn(2, 3, 3, 3, 3).astype(np.float32)
+    got2 = A(F.conv3d_transpose(T(x), T(wt), stride=2))
+    ref2 = torch.nn.functional.conv_transpose3d(
+        torch.tensor(x), torch.tensor(wt), stride=2).numpy()
+    np.testing.assert_allclose(got2, ref2, rtol=1e-4, atol=1e-4)
+
+
+def test_norms_vs_torch():
+    torch = _torch()
+    x = rs.randn(2, 6, 4, 4).astype(np.float32)
+    w = rs.rand(6).astype(np.float32) + 0.5
+    b = rs.randn(6).astype(np.float32)
+    got = A(F.group_norm(T(x), num_groups=3, weight=T(w), bias=T(b),
+                         epsilon=1e-5))
+    ref = torch.nn.functional.group_norm(
+        torch.tensor(x), 3, torch.tensor(w), torch.tensor(b),
+        eps=1e-5).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    got2 = A(F.instance_norm(T(x), weight=T(w), bias=T(b), eps=1e-5))
+    ref2 = torch.nn.functional.instance_norm(
+        torch.tensor(x), weight=torch.tensor(w), bias=torch.tensor(b),
+        eps=1e-5).numpy()
+    np.testing.assert_allclose(got2, ref2, rtol=1e-4, atol=1e-4)
+    # paddle's lrn_op uses alpha * sum (torch divides alpha by size);
+    # hand torch the pre-multiplied alpha so both compute the same thing
+    got3 = A(F.local_response_norm(T(x), size=3, alpha=1e-4))
+    ref3 = torch.nn.functional.local_response_norm(
+        torch.tensor(x), 3, alpha=3e-4).numpy()
+    np.testing.assert_allclose(got3, ref3, rtol=1e-4, atol=1e-4)
+
+
+def test_data_norm():
+    x = rs.randn(4, 3).astype(np.float32)
+    size = np.full((3,), 4.0, np.float32)
+    ssum = x.sum(0)
+    sqsum = (x * x).sum(0)
+    got = A(F.data_norm(T(x), batch_size=T(size), batch_sum=T(ssum),
+                        batch_square_sum=T(sqsum)))
+    mean = ssum / 4
+    scale = 1.0 / np.sqrt(sqsum / 4 - mean ** 2 + 1e-4)
+    np.testing.assert_allclose(got, (x - mean) * scale, rtol=1e-3,
+                               atol=1e-3)
+
+
+def test_interpolate_modes_vs_torch():
+    torch = _torch()
+    x = rs.randn(1, 2, 6, 6).astype(np.float32)
+    tx = torch.tensor(x)
+    for mode, tmode, kw in [("nearest", "nearest", {}),
+                            ("bilinear", "bilinear",
+                             {"align_corners": False}),
+                            ("bicubic", "bicubic",
+                             {"align_corners": False})]:
+        got = A(F.interpolate(T(x), size=[12, 12], mode=mode, **kw))
+        ref = torch.nn.functional.interpolate(tx, size=(12, 12),
+                                              mode=tmode, **kw).numpy()
+        np.testing.assert_allclose(got, ref, rtol=1e-3, atol=2e-3,
+                                   err_msg=mode)
+    x1 = rs.randn(1, 2, 8).astype(np.float32)
+    got = A(F.interpolate(T(x1), size=[16], mode="linear",
+                          align_corners=False))
+    ref = torch.nn.functional.interpolate(
+        torch.tensor(x1), size=16, mode="linear",
+        align_corners=False).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+    x3 = rs.randn(1, 2, 4, 4, 4).astype(np.float32)
+    got = A(F.interpolate(T(x3), size=[8, 8, 8], mode="trilinear",
+                          align_corners=False))
+    ref = torch.nn.functional.interpolate(
+        torch.tensor(x3), size=(8, 8, 8), mode="trilinear",
+        align_corners=False).numpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_pool3d_and_pixel_shuffle():
+    torch = _torch()
+    x = rs.randn(1, 2, 4, 4, 4).astype(np.float32)
+    got = A(F.max_pool3d(T(x), kernel_size=2, stride=2))
+    ref = torch.nn.functional.max_pool3d(torch.tensor(x), 2, 2).numpy()
+    np.testing.assert_allclose(got, ref)
+    y = rs.randn(1, 8, 3, 3).astype(np.float32)
+    got2 = A(F.pixel_shuffle(T(y), 2))
+    ref2 = torch.nn.functional.pixel_shuffle(torch.tensor(y), 2).numpy()
+    np.testing.assert_allclose(got2, ref2)
+
+
+# ---- rnn cells / rnn layer ------------------------------------------
+def test_rnn_cells_vs_torch():
+    torch = _torch()
+    paddle.seed(0)
+    x = rs.randn(2, 4).astype(np.float32)
+    h = rs.randn(2, 6).astype(np.float32)
+    c = rs.randn(2, 6).astype(np.float32)
+
+    cell = paddle.nn.LSTMCell(4, 6)
+    tcell = torch.nn.LSTMCell(4, 6)
+    with torch.no_grad():
+        tcell.weight_ih.copy_(torch.tensor(A(cell.weight_ih)))
+        tcell.weight_hh.copy_(torch.tensor(A(cell.weight_hh)))
+        tcell.bias_ih.copy_(torch.tensor(A(cell.bias_ih)))
+        tcell.bias_hh.copy_(torch.tensor(A(cell.bias_hh)))
+    out, (h2, c2) = cell(T(x), (T(h), T(c)))
+    th, tc = tcell(torch.tensor(x), (torch.tensor(h), torch.tensor(c)))
+    np.testing.assert_allclose(A(h2), th.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose(A(c2), tc.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+    gcell = paddle.nn.GRUCell(4, 6)
+    tg = torch.nn.GRUCell(4, 6)
+    with torch.no_grad():
+        tg.weight_ih.copy_(torch.tensor(A(gcell.weight_ih)))
+        tg.weight_hh.copy_(torch.tensor(A(gcell.weight_hh)))
+        tg.bias_ih.copy_(torch.tensor(A(gcell.bias_ih)))
+        tg.bias_hh.copy_(torch.tensor(A(gcell.bias_hh)))
+    out, h3 = gcell(T(x), T(h))
+    th3 = tg(torch.tensor(x), torch.tensor(h))
+    np.testing.assert_allclose(A(h3), th3.detach().numpy(), rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_simple_rnn_runs_and_grads():
+    paddle.seed(0)
+    net = paddle.nn.SimpleRNN(4, 6, num_layers=1)
+    x = T(rs.randn(2, 5, 4).astype(np.float32))
+    x.stop_gradient = False
+    out, h = net(x)
+    assert list(A(out).shape) == [2, 5, 6]
+    loss = (out ** 2).mean()
+    loss.backward()
+    assert np.isfinite(A(x.grad)).all()
+
+
+# ---- random family (statistical / shape) ----------------------------
+def test_random_family():
+    paddle.seed(7)
+    b = A(paddle.bernoulli(T(np.full((2000,), 0.3, np.float32))))
+    assert set(np.unique(b)) <= {0.0, 1.0}
+    assert 0.2 < b.mean() < 0.4
+    m = A(paddle.multinomial(T(np.array([0.0, 0.7, 0.3], np.float32)),
+                             num_samples=500, replacement=True))
+    assert 0 not in np.unique(m)
+    u = A(paddle.uniform([2000], min=-2.0, max=2.0))
+    assert u.min() >= -2 and u.max() <= 2 and abs(u.mean()) < 0.2
+    tn = paddle.nn.initializer.TruncatedNormal(mean=0.0, std=1.0)
+    p = paddle.create_parameter([1000], attr=paddle.ParamAttr(
+        initializer=tn))
+    vals = A(p)
+    assert np.abs(vals).max() <= 2.0 + 1e-5  # truncation at 2 std
+    from paddle_tpu.vision.ops import random_crop
+    img = rs.randn(3, 8, 8).astype(np.float32)
+    crop = A(random_crop(T(img), [4, 4]))
+    assert crop.shape == (3, 4, 4)
+    paddle.seed(11)
+    c1 = A(random_crop(T(img), [4, 4]))
+    paddle.seed(11)
+    c2 = A(random_crop(T(img), [4, 4]))
+    np.testing.assert_allclose(c1, c2)  # paddle.seed reproduces crops
+
+
+def test_detection_sampling_reproducible_under_seed():
+    """advisor r04: use_random sampling must follow paddle.seed."""
+    from paddle_tpu.vision.ops import generate_proposal_labels
+    rois = np.array([[0, 0, 10, 10], [0, 0, 9, 9], [20, 20, 30, 30],
+                     [40, 40, 50, 50], [1, 1, 11, 11]], np.float32)
+    gtc = np.array([3])
+    gtb = np.array([[0, 0, 10, 10]], np.float32)
+    paddle.seed(5)
+    r1, l1, t1 = generate_proposal_labels(
+        T(rois), T(gtc), T(gtb), batch_size_per_im=4, use_random=True)
+    paddle.seed(5)
+    r2, l2, t2 = generate_proposal_labels(
+        T(rois), T(gtc), T(gtb), batch_size_per_im=4, use_random=True)
+    np.testing.assert_allclose(A(r1), A(r2))
+    np.testing.assert_allclose(A(l1), A(l2))
+
+
+# ---- misc remaining --------------------------------------------------
+def test_elementwise_remainder():
+    np.testing.assert_allclose(A(paddle.ceil(T(XS))), np.ceil(XS))
+    np.testing.assert_allclose(A(paddle.floor(T(XS))), np.floor(XS))
+    np.testing.assert_allclose(A(paddle.square(T(XS))), XS * XS, rtol=1e-6)
+    import math
+    np.testing.assert_allclose(
+        A(paddle.erf(T(np.array([0.0, 1.0], np.float32)))),
+        [0.0, math.erf(1.0)], rtol=1e-5)
+    z = (XS[:2] + 1j * XS[1:3]).astype(np.complex64)
+    np.testing.assert_allclose(A(paddle.real(T(z))), np.real(z))
+    a3 = np.array([1.0, 0.0, 0.0], np.float32)
+    b3 = np.array([0.0, 1.0, 0.0], np.float32)
+    np.testing.assert_allclose(A(paddle.cross(T(a3), T(b3))),
+                               np.cross(a3, b3))
+    y = paddle.assign(T(XS))
+    np.testing.assert_allclose(A(y), XS)
+
+
+def test_update_loss_scaling_transitions():
+    import jax.numpy as jnp
+
+    from paddle_tpu.amp import update_loss_scaling
+    # overflow: scale halves (after decr_every_n=2 bad steps), good resets
+    s, g, b = update_loss_scaling(jnp.float32(1024.0), jnp.int32(5),
+                                  jnp.int32(1), jnp.bool_(True),
+                                  decr_every_n=2)
+    assert float(s) == 512.0 and int(g) == 0
+    # clean streak reaching incr_every_n: scale doubles
+    s2, g2, b2 = update_loss_scaling(jnp.float32(1024.0), jnp.int32(999),
+                                     jnp.int32(0), jnp.bool_(False),
+                                     incr_every_n=1000)
+    assert float(s2) == 2048.0 and int(b2) == 0
+
+
+def test_collective_reduce_ops():
+    import jax
+
+    import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed.collective import ReduceOp, reduce
+    from paddle_tpu.distributed.mesh import build_mesh, mesh_guard
+
+    mesh = build_mesh({"dp": jax.device_count()})
+    with mesh_guard(mesh):
+        for op, ref in [(ReduceOp.SUM, lambda v, n: v * n),
+                        (ReduceOp.MAX, lambda v, n: v),
+                        (ReduceOp.MIN, lambda v, n: v),
+                        (ReduceOp.PROD, lambda v, n: v ** n)]:
+            # fresh tensor per op: all_reduce writes back in place
+            x = T(np.array([2.0, 3.0], np.float32))
+            out = reduce(x, dst=0, op=op)
+            np.testing.assert_allclose(
+                A(out), ref(np.array([2.0, 3.0]), jax.device_count()),
+                rtol=1e-5)
+
+
+def test_affine_channel_and_clip_by_norm():
+    from paddle_tpu.vision.ops import affine_channel
+    x = rs.randn(1, 3, 2, 2).astype(np.float32)
+    s = np.array([1.0, 2.0, 0.5], np.float32)
+    b = np.array([0.0, 1.0, -1.0], np.float32)
+    got = A(affine_channel(T(x), T(s), T(b)))
+    ref = x * s[None, :, None, None] + b[None, :, None, None]
+    np.testing.assert_allclose(got, ref, rtol=1e-6)
+
+    clip = paddle.nn.ClipGradByNorm(clip_norm=1.0)
+    g = np.array([3.0, 4.0], np.float32)  # norm 5 -> scaled to 1
+    p = paddle.create_parameter([2], attr=paddle.ParamAttr())
+    p.grad = T(g)
+    out = clip([(p, p.grad)])
+    gg = A(out[0][1])
+    np.testing.assert_allclose(np.linalg.norm(gg), 1.0, rtol=1e-5)
+
+
+def test_check_finite_and_unscale():
+    from paddle_tpu.amp import check_finite_and_unscale
+    import jax.numpy as jnp
+    grads = {"a": jnp.array([2.0, 4.0]), "b": jnp.array([6.0])}
+    out, found = check_finite_and_unscale(grads, jnp.float32(2.0))
+    assert not bool(found)
+    np.testing.assert_allclose(np.asarray(out["a"]), [1.0, 2.0])
+    grads = {"a": jnp.array([np.inf])}
+    _, found = check_finite_and_unscale(grads, jnp.float32(2.0))
+    assert bool(found)
+
+
+def test_beam_search_decode_and_retinanet_output():
+    from paddle_tpu.text import beam_search_decode, gather_tree
+    # [T, B, W]: 3 steps, 1 batch, 2 beams; step-2 beam 0 came from
+    # parent beam 1, so its backtracked path is 2 -> 4 -> 5
+    ids = T(np.array([[[1, 2]], [[3, 4]], [[5, 6]]], np.int64))
+    parents = T(np.array([[[0, 0]], [[0, 1]], [[1, 0]]], np.int64))
+    tree = A(gather_tree(ids, parents))
+    assert tree.shape == (3, 1, 2)
+    np.testing.assert_allclose(tree[:, 0, 0], [2, 4, 5])
+    scores = T(np.array([[0.9, 0.1]], np.float32))
+    seqs, sc = beam_search_decode(ids, parents, scores)
+    assert A(seqs).shape == (1, 2, 3)
+    np.testing.assert_allclose(A(seqs)[0, 0], [2, 4, 5])
+
+    from paddle_tpu.vision.ops import retinanet_detection_output
+    # smoke numeric: one level, one anchor ([A,4] deltas / [A,C] scores)
+    bboxes = T(np.zeros((1, 4), np.float32))  # zero deltas: box == anchor
+    scores = T(np.array([[0.9, 0.1]], np.float32))
+    anchors = T(np.array([[0.0, 0.0, 10.0, 10.0]], np.float32))
+    im_info = T(np.array([[20.0, 20.0, 1.0]], np.float32))
+    dets = A(retinanet_detection_output([bboxes], [scores], [anchors],
+                                        im_info, score_threshold=0.05))
+    assert dets.shape[-1] == 6 and dets.shape[0] >= 1
+    assert dets[0, 1] == pytest.approx(0.9)  # top score survives

@@ -1,0 +1,91 @@
+"""Data-parallel scaling shape (BASELINE config 2's "linear scaling"
+target, VERDICT r04 weak #4): with per-device batch held constant, the
+per-device compiled work must stay constant as dp grows 1 -> 8 — that is
+the throughput model behind linear scaling (total samples/s = dp x
+per-device samples/s).  Asserted deterministically from XLA cost
+analysis (8 virtual CPU devices share real cores, so wall-clock here
+cannot show the linearity a real pod would).
+Reference: fluid/dygraph/parallel.py:314 (DataParallel scale_loss /
+apply_collective_grads)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.mesh import build_mesh, mesh_guard
+from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+from paddle_tpu.vision.models import resnet18
+
+
+PER_DEVICE_B = 2
+
+
+def _compiled_step(dp):
+    mesh = build_mesh({"dp": dp}, devices=jax.devices()[:dp])
+    with mesh_guard(mesh):
+        paddle.seed(0)
+        model = resnet18(num_classes=10)
+        model.train()
+        params, buffers = state_pytrees(model)
+        opt = paddle.optimizer.Momentum(learning_rate=0.1, momentum=0.9)
+        opt_state = opt.init_pytree(params)
+
+        def step(carry, images, labels):
+            p, s = carry
+
+            def loss_fn(p):
+                out, _ = functional_call(model, p,
+                                         (paddle.Tensor(images),),
+                                         buffers=buffers)
+                logits = out.value.astype(jnp.float32)
+                logp = jax.nn.log_softmax(logits, -1)
+                return -jnp.take_along_axis(
+                    logp, labels[:, None], -1).mean()
+
+            loss, grads = jax.value_and_grad(loss_fn)(p)
+            p, s = opt.apply_pytree(p, grads, s, lr=0.1, step=1)
+            return (p, s), loss
+
+        B = PER_DEVICE_B * dp
+        rs = np.random.RandomState(0)
+        images = jax.device_put(
+            jnp.asarray(rs.randn(B, 3, 32, 32), jnp.float32),
+            NamedSharding(mesh, P("dp")))
+        labels = jax.device_put(
+            jnp.asarray(rs.randint(0, 10, (B,)), jnp.int32),
+            NamedSharding(mesh, P("dp")))
+        rep = NamedSharding(mesh, P())
+        carry = jax.device_put((params, opt_state), rep)
+        compiled = jax.jit(step).lower(carry, images, labels).compile()
+        return compiled, (carry, images, labels)
+
+
+def _flops(compiled):
+    ca = compiled.cost_analysis()
+    ca = ca[0] if isinstance(ca, (list, tuple)) else ca
+    return float(ca.get("flops", 0.0))
+
+
+def test_dp_scaling_constant_per_device_work():
+    if jax.device_count() < 8:
+        pytest.skip("needs the 8-virtual-device conftest mesh")
+    c1, args1 = _compiled_step(1)
+    c8, args8 = _compiled_step(8)
+    f1, f8 = _flops(c1), _flops(c8)
+    assert f1 > 0 and f8 > 0
+    # XLA reports per-device flops for SPMD partitioned modules: with
+    # per-device batch fixed, dp=8 work per device must stay within 15%
+    # of dp=1 (the grad all-reduce adds no flops, only comms)
+    assert f8 / f1 < 1.15, (f1, f8)
+    # the dp grad sync must exist (all-reduce over the dp axis); dp=1
+    # compiles to a single-device module with no collective
+    hlo8 = c8.as_text()
+    assert "all-reduce" in hlo8
+    assert "all-reduce" not in c1.as_text()
+    # both actually execute
+    (_, loss1) = c1(*args1)
+    (_, loss8) = c8(*args8)
+    assert np.isfinite(float(np.asarray(loss1)))
+    assert np.isfinite(float(np.asarray(loss8)))

@@ -1,0 +1,166 @@
+"""Round-5 surface tail (VERDICT r04 next-step #5): paddle.batch, the
+reader decorator suite, DatasetFolder/ImageFolder, VOC2012, Conll05st,
+compat, sysconfig, utils.download, incubate."""
+import os
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+
+
+def test_batch_reader():
+    def reader():
+        yield from range(10)
+
+    assert list(paddle.batch(reader, 3)()) == [
+        [0, 1, 2], [3, 4, 5], [6, 7, 8], [9]]
+    assert list(paddle.batch(reader, 3, drop_last=True)()) == [
+        [0, 1, 2], [3, 4, 5], [6, 7, 8]]
+    with pytest.raises(ValueError):
+        paddle.batch(reader, 0)
+
+
+def test_reader_decorators():
+    rd = paddle.reader
+
+    def r5():
+        yield from range(5)
+
+    assert list(rd.cache(r5)()) == [0, 1, 2, 3, 4]
+    assert list(rd.map_readers(lambda a, b: a + b, r5, r5)()) == [
+        0, 2, 4, 6, 8]
+    assert list(rd.chain(r5, r5)()) == list(range(5)) * 2
+    assert list(rd.firstn(r5, 3)()) == [0, 1, 2]
+    assert list(rd.buffered(r5, 2)()) == [0, 1, 2, 3, 4]
+    # compose: tuple-flattening zip; misaligned lengths raise
+    got = list(rd.compose(r5, rd.map_readers(lambda x: (x, x), r5))())
+    assert got[2] == (2, 2, 2)
+    def r3():
+        yield from range(3)
+    with pytest.raises(rd.ComposeNotAligned):
+        list(rd.compose(r5, r3)())
+    assert len(list(rd.compose(r5, r3, check_alignment=False)())) == 3
+    # shuffle: same multiset, reproducible under paddle.seed
+    paddle.seed(123)
+    a = list(rd.shuffle(r5, 5)())
+    paddle.seed(123)
+    b = list(rd.shuffle(r5, 5)())
+    assert sorted(a) == [0, 1, 2, 3, 4] and a == b
+    # xmap: unordered covers all, ordered preserves order
+    out = list(rd.xmap_readers(lambda x: x * 10, r5, 2, 4)())
+    assert sorted(out) == [0, 10, 20, 30, 40]
+    out = list(rd.xmap_readers(lambda x: x * 10, r5, 3, 4, order=True)())
+    assert out == [0, 10, 20, 30, 40]
+
+
+def test_dataset_folder(tmp_path):
+    from paddle_tpu.vision.datasets import DatasetFolder, ImageFolder
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for j in range(2):
+            np.save(d / f"{j}.npy",
+                    np.full((4, 4, 3), j, dtype=np.float32))
+    ds = DatasetFolder(str(tmp_path))
+    assert ds.classes == ["cat", "dog"]
+    assert ds.class_to_idx == {"cat": 0, "dog": 1}
+    assert len(ds) == 4 and ds.targets.count(1) == 2
+    sample, target = ds[3]
+    assert sample.shape == (4, 4, 3) and target == 1
+    # transform applies
+    ds2 = DatasetFolder(str(tmp_path), transform=lambda x: x + 1)
+    assert float(ds2[0][0][0, 0, 0]) == 1.0
+    flat = ImageFolder(str(tmp_path))
+    assert len(flat) == 4 and isinstance(flat[0], list)
+    with pytest.raises(RuntimeError):
+        DatasetFolder(str(tmp_path), extensions=(".jpg",))
+
+
+def test_dataset_folder_pil(tmp_path):
+    PIL = pytest.importorskip("PIL")
+    from PIL import Image
+
+    from paddle_tpu.vision.datasets import DatasetFolder
+    d = tmp_path / "a"
+    d.mkdir()
+    Image.fromarray(np.zeros((5, 6, 3), np.uint8)).save(d / "x.png")
+    ds = DatasetFolder(str(tmp_path))
+    img, target = ds[0]
+    assert np.asarray(img).shape == (5, 6, 3) and target == 0
+
+
+def test_voc2012_synthetic():
+    from paddle_tpu.vision.datasets import VOC2012
+    ds = VOC2012(mode="train")
+    assert ds.synthetic and len(ds) == 64
+    img, lab = ds[0]
+    assert img.shape == (64, 64, 3) and lab.shape == (64, 64)
+    assert lab.max() <= 20
+    with pytest.raises(AssertionError):
+        VOC2012(mode="bogus")
+
+
+def test_conll05():
+    from paddle_tpu.text import Conll05st
+    ds = Conll05st()
+    assert ds.synthetic and len(ds) == 80
+    sample = ds[0]
+    assert len(sample) == 9
+    n = len(sample[0])
+    assert all(len(col) == n for col in sample)
+    word_d, verb_d, label_d = ds.get_dict()
+    assert "B-V" in label_d
+    # mark flags the <=5-token predicate window
+    assert 1 <= sample[7].sum() <= 5
+    # the ctx_0 column is the predicate itself, broadcast
+    vi = list(ds.labels[0]).index("B-V")
+    assert sample[3][0] == word_d[ds.sentences[0][vi]]
+
+
+def test_compat():
+    c = paddle.compat
+    assert c.to_text(b"ab") == "ab"
+    assert c.to_bytes("ab") == b"ab"
+    assert c.to_text({b"k"}) == {"k"}
+    lst = [b"x", [b"y"]]
+    c.to_text(lst, inplace=True)
+    assert lst == ["x", ["y"]]
+    assert c.round(2.5) == 3.0 and c.round(-2.5) == -3.0
+    assert c.round(2.345, 2) == 2.35
+    assert c.floor_division(7, 2) == 3
+    assert c.get_exception_message(ValueError("boom")) == "boom"
+
+
+def test_sysconfig():
+    inc = paddle.sysconfig.get_include()
+    assert os.path.basename(inc) == "csrc"
+    assert isinstance(paddle.sysconfig.get_lib(), str)
+
+
+def test_download_cache(tmp_path):
+    from paddle_tpu.utils.download import get_path_from_url
+    # pre-seeded cache file is returned without any network touch
+    f = tmp_path / "weights.bin"
+    f.write_bytes(b"abc")
+    got = get_path_from_url("http://example.invalid/weights.bin",
+                            str(tmp_path))
+    assert got == str(f)
+    with pytest.raises(RuntimeError, match="local cache"):
+        get_path_from_url("http://example.invalid/missing.bin",
+                          str(tmp_path))
+
+
+def test_incubate():
+    assert paddle.incubate.optimizer.LookAhead is not None
+    assert paddle.incubate.optimizer.ModelAverage is not None
+    assert paddle.incubate.reader is paddle.reader
+
+
+def test_fleet_optimizer_facade():
+    import paddle_tpu.distributed.fleet as fleet
+    opt = paddle.optimizer.SGD(learning_rate=0.5)
+    fopt = fleet.distributed_optimizer(opt)
+    assert fleet.fleet.get_lr() == 0.5
+    fleet.fleet.set_lr(0.25)
+    assert fopt.get_lr() == 0.25

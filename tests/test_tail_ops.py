@@ -505,6 +505,37 @@ class TestVisionTail:
         g = np.asarray(x.grad.numpy())
         assert np.isfinite(g).all() and np.abs(g).sum() > 0
 
+    def test_yolo_loss_numeric_parity(self):
+        """Hand-computed reference value (yolov3_loss_op.h semantics:
+        sigmoid cross-entropy on raw x/y logits, L1 on w/h, every per-gt
+        term scaled by gt_score, objectness target = score)."""
+        # 1 anchor (16x16 px), 2x2 grid, stride 32, one gt at cell (1,1)
+        H = W = 2
+        xv = np.full((1, 1 * 7, H, W), 0.1, np.float32)  # 5+C, C=2
+        gb = np.array([[[0.75, 0.75, 0.25, 0.25]]], np.float32)
+        gl = np.array([[1]])
+        gs = np.array([[0.5]], np.float32)
+        loss = V.yolo_loss(T(xv), T(gb), T(gl), anchors=[16, 16],
+                           anchor_mask=[0], class_num=2,
+                           ignore_thresh=2.0,  # no cell is ignored
+                           downsample_ratio=32, gt_score=T(gs))
+
+        def bce(z, t):
+            return max(z, 0.0) - z * t + np.log1p(np.exp(-abs(z)))
+
+        tx = ty = 0.5           # gx = gy = 1.5
+        tw = th = 0.0           # gt wh == anchor wh (16 px)
+        scale = 2.0 - 0.25 * 0.25
+        m = scale * 0.5         # resp * scale * gt_score
+        exp_xy = m * (bce(0.1, tx) + bce(0.1, ty))
+        exp_wh = m * (abs(0.1 - tw) + abs(0.1 - th))
+        exp_cls = 0.5 * (bce(0.1, 0.0) + bce(0.1, 1.0))
+        # positive cell: SCE vs score; 3 negatives: SCE vs 0
+        exp_obj = bce(0.1, 0.5) + 3 * bce(0.1, 0.0)
+        expected = exp_xy + exp_wh + exp_cls + exp_obj
+        np.testing.assert_allclose(float(np.asarray(loss.numpy())),
+                                   expected, rtol=1e-5)
+
     def test_correlation_numpy_reference(self):
         rs = np.random.RandomState(0)
         a = rs.randn(1, 4, 6, 6).astype(np.float32)

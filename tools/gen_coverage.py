@@ -487,30 +487,77 @@ def classify(op):
     return None
 
 
+def _test_refs():
+    """CODE references in the test tree: every identifier (Name ids,
+    Attribute attrs, def names, keyword args) plus exact short string
+    constants (parametrize ids / mode= selectors).  AST-based so prose
+    in comments and docstrings does NOT count — a raw-text grep marked
+    ops 'tested' because a docstring mentioned them."""
+    import ast
+
+    refs = set()
+    for p in sorted((OUT.parent / "tests").glob("*.py")):
+        try:
+            tree = ast.parse(p.read_text(errors="replace"))
+        except SyntaxError:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Name):
+                refs.add(node.id)
+            elif isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+            elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                   ast.ClassDef)):
+                refs.add(node.name)
+            elif isinstance(node, ast.keyword) and node.arg:
+                refs.add(node.arg)
+            elif (isinstance(node, ast.Constant)
+                  and isinstance(node.value, str)
+                  and len(node.value) <= 40):
+                refs.add(node.value)
+    return refs
+
+
+def is_tested(path, op, refs):
+    """An implemented op counts as TESTED when test CODE references its
+    public symbol (the dotted path's final attribute) or the reference
+    op name itself (VERDICT r04 weak #6: 'implemented' used to mean
+    import-verified only — nobody could say which ops had a numeric
+    test vs an import probe)."""
+    return path.rsplit(".", 1)[-1] in refs or op in refs
+
+
 def main(check=False):
     base, grads = harvest()
-    rows, unclassified, badpaths = [], [], []
+    refs = _test_refs()
+    rows, unclassified, badpaths, untested = [], [], [], []
     for op in base:
         cls = classify(op)
         if cls is None:
             unclassified.append(op)
-            rows.append((op, "UNCLASSIFIED", ""))
+            rows.append((op, "UNCLASSIFIED", "", ""))
             continue
         kind, _, detail = cls.partition(":")
         if kind == "impl":
             ok = resolve(detail)
             if not ok:
                 badpaths.append((op, detail))
+            tested = is_tested(detail, op, refs)
+            if not tested:
+                untested.append(op)
             rows.append((op, "implemented", f"`{detail}`"
-                         + ("" if ok else " **(UNRESOLVED)**")))
+                         + ("" if ok else " **(UNRESOLVED)**"),
+                         "yes" if tested else "no"))
         elif kind == "abs":
-            rows.append((op, "absorbed", detail))
+            rows.append((op, "absorbed", detail, ""))
         else:
-            rows.append((op, "non-goal", detail))
+            rows.append((op, "non-goal", detail, ""))
 
     counts = {}
-    for _, st, _ in rows:
+    for _, st, _, _ in rows:
         counts[st] = counts.get(st, 0) + 1
+    n_impl = counts.get("implemented", 0)
+    n_tested = n_impl - len(untested)
 
     lines = [
         "# COVERAGE — reference op registry vs paddle_tpu",
@@ -545,19 +592,36 @@ def main(check=False):
     for st in ("implemented", "absorbed", "non-goal", "UNCLASSIFIED"):
         if counts.get(st):
             lines.append(f"| {st} | {counts[st]} |")
-    lines += ["", "| op | status | where / why |", "|---|---|---|"]
-    for op, st, d in rows:
-        lines.append(f"| {op} | {st} | {d} |")
+    lines += [
+        "",
+        f"Of the {n_impl} implemented ops, **{n_tested} are tested** (a "
+        f"test references the public symbol or the reference op name) and "
+        f"**{len(untested)} are import-verified only** "
+        f"({100 * len(untested) / max(n_impl, 1):.1f}%).  "
+        "`--check` fails if the untested share exceeds 15% — a newly "
+        "implemented op must land with a test.",
+        "",
+        "| op | status | where / why | tested |",
+        "|---|---|---|---|",
+    ]
+    for op, st, d, t in rows:
+        lines.append(f"| {op} | {st} | {d} | {t} |")
     lines.append("")
     OUT.write_text("\n".join(lines))
-    print(f"wrote {OUT}: {counts}")
+    print(f"wrote {OUT}: {counts}; implemented tested {n_tested}/{n_impl}")
     if unclassified:
         print("UNCLASSIFIED:", " ".join(unclassified))
     if badpaths:
         print("UNRESOLVED impl paths:")
         for op, p in badpaths:
             print(f"  {op}: {p}")
-    if check and (unclassified or badpaths):
+    if untested:
+        print("implemented but untested:", " ".join(untested))
+    over_budget = len(untested) > 0.15 * max(n_impl, 1)
+    if check and (unclassified or badpaths or over_budget):
+        if over_budget:
+            print(f"FAIL: untested implemented share "
+                  f"{100 * len(untested) / n_impl:.1f}% > 15%")
         return 1
     return 0
 

@@ -1,23 +1,25 @@
 #!/bin/bash
 # Watch for the axon TPU tunnel to return; when it does, run the full
-# bench and append the TPU-platform lines to BENCH_session_r04.jsonl
-# (round-3 verdict #1: record TPU evidence whenever the chip is
-# reachable — the tunnel has multi-hour transient outages).
+# bench and append the TPU-platform lines to BENCH_session_r05.jsonl.
+# (VERDICT r04 next-step #1: TPU evidence whenever the chip is
+# reachable — the tunnel has multi-hour transient outages.)
 cd /root/repo
 LOG=/tmp/tpu_watch.log
-for i in $(seq 1 60); do
+RUN=/tmp/bench_r5_watch.jsonl
+for i in $(seq 1 90); do
   probe=$(timeout 150 python bench.py --probe 2>/dev/null | tail -1)
   if echo "$probe" | grep -q '"ok": true' && ! echo "$probe" | grep -q '"platform": "cpu"'; then
     echo "$(date -u +%FT%TZ) TPU up; running full bench" >> "$LOG"
-    timeout 5400 python bench.py > /tmp/bench_r4_run2.jsonl 2>>"$LOG"
-    if grep -q '"platform": "TPU' /tmp/bench_r4_run2.jsonl; then
-      ntpu=$(grep -c '"platform": "TPU' /tmp/bench_r4_run2.jsonl)
-      bert=$(grep -q 'bert_base_samples_per_sec_per_chip' /tmp/bench_r4_run2.jsonl && echo yes || echo no)
+    timeout 9000 python bench.py > "$RUN" 2>>"$LOG"
+    if grep -q '"platform": "TPU' "$RUN"; then
+      ntpu=$(grep -c '"platform": "TPU' "$RUN")
+      bert=$(grep -q 'bert_base_samples_per_sec_per_chip' "$RUN" && echo yes || echo no)
       {
-        echo "{\"metric\": \"session_note\", \"value\": 1.0, \"unit\": \"note\", \"vs_baseline\": 0.0, \"note\": \"second session run $(date -u +%FT%TZ) after tunnel recovery; tpu_lines=$ntpu bert_on_tpu=$bert\"}"
-        cat /tmp/bench_r4_run2.jsonl
-      } >> BENCH_session_r04.jsonl
-      git commit -q -m "Record second TPU bench session (tunnel recovery)" -- BENCH_session_r04.jsonl
+        echo "{\"metric\": \"session_note\", \"value\": 1.0, \"unit\": \"note\", \"vs_baseline\": 0.0, \"note\": \"r05 watch run $(date -u +%FT%TZ); tpu_lines=$ntpu bert_on_tpu=$bert\"}"
+        cat "$RUN"
+      } >> BENCH_session_r05.jsonl
+      git add BENCH_session_r05.jsonl
+      git commit -q -m "Record TPU bench session (r05 watcher)" -- BENCH_session_r05.jsonl
       echo "$(date -u +%FT%TZ) SUCCESS committed (tpu_lines=$ntpu bert=$bert)" >> "$LOG"
       if [ "$bert" = yes ]; then exit 0; fi
       echo "$(date -u +%FT%TZ) bert still missing; continuing watch" >> "$LOG"
@@ -27,5 +29,5 @@ for i in $(seq 1 60); do
   else
     echo "$(date -u +%FT%TZ) probe down" >> "$LOG"
   fi
-  sleep 420
+  sleep 360
 done
