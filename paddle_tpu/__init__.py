@@ -102,6 +102,7 @@ from . import compat  # noqa: E402
 from . import sysconfig  # noqa: E402
 from . import reader  # noqa: E402
 from . import incubate  # noqa: E402
+from . import version  # noqa: E402
 from .batch import batch  # noqa: E402 — reference python/paddle/__init__.py:27
 from .hapi import Model  # noqa: E402
 from .hapi import flops, summary  # noqa: E402
@@ -224,3 +225,15 @@ def fill_constant(shape, dtype, value, force_cpu=False, out=None, name=None):
 # tensor-array ops at top level (python/paddle/tensor/__init__.py aliases)
 from .static.nn import (  # noqa: E402,F401
     array_length, array_read, array_write, create_array)
+
+# remaining reference top-level exports (python/paddle/__init__.py):
+# callbacks module alias, device introspection, fluid-era tensor aliases
+from .framework.place import (  # noqa: E402,F401
+    get_cudnn_version, is_compiled_with_xpu)
+from .hapi import callbacks  # noqa: E402,F401
+reverse = flip  # noqa: F405 — fluid paddle.reverse (reverse_op.cc)
+standard_normal = randn  # noqa: F405 — tensor/random.py alias
+
+# fluid compat namespace LAST: fluid.layers re-exports the legacy
+# aliases defined above (fill_constant etc.) at import time
+from . import fluid  # noqa: E402,F401

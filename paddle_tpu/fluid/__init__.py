@@ -1,0 +1,48 @@
+"""`paddle.fluid` — the fluid-era compatibility namespace.
+
+Reference parity: python/paddle/fluid/__init__.py.  Every name here is a
+re-export of the modern implementation (static program capture, the 2.0
+op surface, the functional layer builders in `fluid.layers`) so that the
+classic fluid workflow —
+
+    img = fluid.data("img", [None, 784])
+    pred = fluid.layers.fc(img, 10, act="softmax")
+    loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+    fluid.optimizer.SGD(0.1).minimize(loss)
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+    exe.run(feed={...}, fetch_list=[loss])
+
+— runs unchanged.  There is no ProgramDesc IR underneath (README
+component map): programs are deferred expression DAGs jit-compiled by
+Executor.run, and export is StableHLO.
+"""
+from __future__ import annotations
+
+from .. import core  # noqa: F401
+from .. import optimizer  # noqa: F401
+from .. import regularizer  # noqa: F401
+from ..framework.place import (  # noqa: F401
+    CPUPlace, CUDAPinnedPlace, CUDAPlace)
+from ..framework.random import seed  # noqa: F401
+from ..nn import clip  # noqa: F401
+from ..nn import initializer  # noqa: F401
+from ..nn.layer_base import ParamAttr  # noqa: F401
+from ..static import (  # noqa: F401
+    BuildStrategy, CompiledProgram, Executor, ExecutionStrategy, Program,
+    create_parameter, data, default_main_program,
+    default_startup_program, program_guard)
+from . import dygraph  # noqa: F401
+from . import io  # noqa: F401
+from . import layers  # noqa: F401
+from . import nets  # noqa: F401
+from . import unique_name  # noqa: F401
+
+__all__ = [
+    "core", "optimizer", "regularizer", "initializer", "clip", "layers",
+    "nets", "unique_name",
+    "dygraph", "io", "CPUPlace", "CUDAPlace", "CUDAPinnedPlace",
+    "ParamAttr", "Executor", "Program", "data", "program_guard",
+    "default_main_program", "default_startup_program",
+    "create_parameter", "seed",
+]

@@ -1,0 +1,59 @@
+"""fluid.dygraph — imperative-mode compatibility names.
+
+Reference parity: python/paddle/fluid/dygraph/ (guard:base.py,
+to_variable, Linear/Embedding/Conv2D layer aliases,
+save_dygraph/load_dygraph:checkpoint.py).  This framework is eager by
+default, so `guard()` is a no-op context and `to_variable` is
+paddle.to_tensor.
+"""
+from __future__ import annotations
+
+import contextlib
+
+import paddle_tpu as paddle
+from ..nn import Conv2D, Embedding, Layer, LayerList, Sequential  # noqa: F401
+from ..nn import Linear as _Linear
+
+__all__ = ["guard", "to_variable", "Layer", "Linear", "Embedding",
+           "Conv2D", "LayerList", "Sequential", "save_dygraph",
+           "load_dygraph", "no_grad"]
+
+no_grad = paddle.no_grad
+
+
+@contextlib.contextmanager
+def guard(place=None):
+    """Eager execution is the default; kept for script compatibility."""
+    yield
+
+
+def to_variable(value, name=None, zero_copy=None, dtype=None):
+    t = paddle.to_tensor(value, dtype=dtype)
+    t.stop_gradient = False
+    return t
+
+
+class Linear(_Linear):
+    """fluid.dygraph.Linear(input_dim, output_dim, act=None) — same
+    geometry as nn.Linear plus the fluid act-string argument."""
+
+    def __init__(self, input_dim, output_dim, param_attr=None,
+                 bias_attr=None, act=None, dtype="float32"):
+        super().__init__(input_dim, output_dim, weight_attr=param_attr,
+                         bias_attr=bias_attr)
+        self._act = act
+
+    def forward(self, x):
+        out = super().forward(x)
+        if self._act:
+            out = getattr(paddle.nn.functional, self._act)(out)
+        return out
+
+
+def save_dygraph(state_dict, model_path):
+    paddle.save(state_dict, model_path + ".pdparams")
+
+
+def load_dygraph(model_path):
+    sd = paddle.load(model_path + ".pdparams")
+    return sd, None  # (param_dict, opt_dict) tuple like the reference

@@ -1,0 +1,38 @@
+"""fluid.io — reader combinators + persistence entry points.
+
+Reference parity: python/paddle/fluid/io.py (batch/shuffle re-exported
+from the reader suite; save/load_inference_model:1198,1453;
+save/load_persistables:620,994 map to the static Program persistence).
+"""
+from __future__ import annotations
+
+from ..batch import batch  # noqa: F401
+from ..reader import (  # noqa: F401
+    buffered, cache, chain, compose, firstn, map_readers,
+    multiprocess_reader, shuffle, xmap_readers)
+from ..static import (  # noqa: F401
+    load_inference_model, save_inference_model)
+from ..static import load as _static_load
+from ..static import save as _static_save
+
+__all__ = ["batch", "shuffle", "buffered", "cache", "chain", "compose",
+           "firstn", "map_readers", "xmap_readers", "multiprocess_reader",
+           "save_inference_model", "load_inference_model",
+           "save_persistables", "load_persistables"]
+
+
+def save_persistables(executor, dirname, main_program=None, filename=None):
+    """Persist a program's parameters (fluid io.py:620)."""
+    import os
+
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    _static_save(prog, os.path.join(dirname, filename or "persistables"))
+
+
+def load_persistables(executor, dirname, main_program=None, filename=None):
+    import os
+
+    from ..static import default_main_program
+    prog = main_program or default_main_program()
+    _static_load(prog, os.path.join(dirname, filename or "persistables"))

@@ -1,0 +1,250 @@
+"""fluid.layers — the fluid-era functional layer builders.
+
+Reference parity: python/paddle/fluid/layers/{nn,tensor,control_flow}.py.
+Two kinds of names live here:
+
+* parameter-creating builders (`fc`, `embedding`, `conv2d`) — the fluid
+  idiom where calling the function materializes the layer's parameters
+  (via static.create_parameter, so they are owned by the enclosing
+  program_guard) and returns the symbolic output Variable;
+* plain op re-exports — the deferred-capable 2.0 ops under their fluid
+  names.
+
+Everything composes with the static Program capture: outputs of these
+functions are deferred Variables that Executor.run jit-evaluates.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+import paddle_tpu as paddle
+
+from ..nn import functional as F
+from ..static import create_parameter, data  # noqa: F401
+from ..static.nn import (  # noqa: F401
+    array_length, array_read, array_write, case, cond, create_array,
+    increment, switch_case, while_loop)
+
+__all__ = [
+    "data", "fc", "embedding", "conv2d", "pool2d", "cross_entropy",
+    "softmax_with_cross_entropy", "mean", "accuracy", "dropout",
+    "create_parameter", "while_loop", "cond", "case", "switch_case",
+    "relu", "sigmoid", "tanh", "softmax", "concat", "reshape",
+    "transpose", "elementwise_add", "elementwise_sub", "elementwise_mul",
+    "elementwise_div", "mul", "matmul", "reduce_mean", "reduce_sum",
+    "fill_constant", "assign", "cast", "one_hot", "uniform_random",
+    "gaussian_random", "squeeze", "unsqueeze", "clip", "scale", "sums",
+    "batch_norm", "layer_norm",
+]
+
+_ACTS = {None: lambda x: x, "relu": F.relu, "sigmoid": F.sigmoid,
+         "tanh": paddle.tanh, "softmax": F.softmax}
+
+
+def _apply_act(out, act):
+    if act not in _ACTS:
+        raise ValueError(f"unsupported act {act!r}; one of "
+                         f"{sorted(k for k in _ACTS if k)}")
+    return _ACTS[act](out)
+
+
+def fc(input, size, num_flatten_dims=1, param_attr=None, bias_attr=None,
+       act=None, name=None):
+    """Fully-connected builder (fluid/layers/nn.py fc): creates w/b on
+    call, flattens trailing dims past `num_flatten_dims`, applies act."""
+    in_shape = list(input.shape)
+    flat = int(np.prod([d for d in in_shape[num_flatten_dims:]]))
+    if len(in_shape) > num_flatten_dims + 1:
+        lead = in_shape[:num_flatten_dims]
+        # leading batch dim is ALWAYS -1: deferred Variables report the
+        # placeholder batch (1), not the runtime one — -1 re-infers it
+        input = paddle.reshape(
+            input, [-1] + [int(d) for d in lead[1:]] + [flat])
+    w = create_parameter([flat, size], attr=param_attr,
+                         name=name and f"{name}.w_0")
+    out = paddle.matmul(input, w)
+    if bias_attr is not False:
+        b = create_parameter([size], attr=bias_attr, is_bias=True,
+                             name=name and f"{name}.b_0")
+        out = out + b
+    return _apply_act(out, act)
+
+
+def embedding(input, size, is_sparse=False, is_distributed=False,
+              padding_idx=None, param_attr=None, dtype="float32"):
+    """Embedding builder (fluid/layers/nn.py embedding): size=[V, E]."""
+    w = create_parameter(list(size), dtype=dtype, attr=param_attr)
+    out = F.embedding(input, w, padding_idx=padding_idx)
+    return out
+
+
+def conv2d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None, data_format="NCHW"):
+    """Conv builder (fluid/layers/nn.py conv2d), NCHW."""
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    c_in = input.shape[1 if data_format == "NCHW" else -1]
+    w = create_parameter(
+        [num_filters, c_in // groups, *filter_size], attr=param_attr,
+        name=name and f"{name}.w_0")
+    out = F.conv2d(input, w, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups,
+                   data_format=data_format)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], attr=bias_attr, is_bias=True,
+                             name=name and f"{name}.b_0")
+        bshape = ([1, num_filters, 1, 1] if data_format == "NCHW"
+                  else [1, 1, 1, num_filters])
+        out = out + paddle.reshape(b, bshape)
+    return _apply_act(out, act)
+
+
+def cross_entropy(input, label, soft_label=False, ignore_index=-100):
+    """fluid cross_entropy: `input` is POST-SOFTMAX probabilities
+    (fluid/layers/nn.py cross_entropy) — unlike 2.0 F.cross_entropy,
+    which takes logits.  Returns per-sample loss [N, 1]; positions whose
+    hard label equals `ignore_index` contribute zero."""
+    eps = 1e-8
+    if soft_label:
+        out = -paddle.sum(label * paddle.log(input + eps), axis=-1,
+                          keepdim=True)
+        return out
+    lab = paddle.reshape(label, [-1])
+    num_classes = input.shape[-1]
+    keep = cast(lab != ignore_index, "float32")
+    safe_lab = cast(lab != ignore_index, "int64") * lab  # index 0 if ignored
+    oh = F.one_hot(safe_lab, num_classes)
+    picked = paddle.sum(oh * input, axis=-1, keepdim=True)
+    return -paddle.log(picked + eps) * paddle.reshape(keep, [-1, 1])
+
+
+def softmax_with_cross_entropy(logits, label, soft_label=False, axis=-1,
+                               return_softmax=False):
+    out = F.softmax_with_cross_entropy(logits, label, soft_label=soft_label,
+                                       axis=axis)
+    if return_softmax:
+        return out, F.softmax(logits, axis=axis)
+    return out
+
+
+def accuracy(input, label, k=1, correct=None, total=None):
+    """Batch top-k accuracy as a (deferred) scalar
+    (fluid/layers/metric_op.py accuracy): a sample counts when its label
+    appears among the k highest-scoring classes."""
+    lab = paddle.reshape(label, [-1])
+    if k == 1:
+        hit = cast(paddle.argmax(input, axis=-1) == lab, "float32")
+    else:
+        _, topi = paddle.topk(input, k=k, axis=-1)
+        eq = cast(topi == paddle.reshape(lab, [-1, 1]), "float32")
+        hit = paddle.sum(eq, axis=-1)
+    return paddle.mean(hit)
+
+
+def dropout(x, dropout_prob, is_test=False, seed=None, name=None,
+            dropout_implementation="downgrade_in_infer"):
+    return F.dropout(x, p=dropout_prob, training=not is_test,
+                     mode=dropout_implementation)
+
+
+def _ones_attr(attr):
+    """fluid norm layers default scale to 1.0 (layer_norm_op.cc)."""
+    if attr is not None:
+        return attr
+    from ..nn.initializer import Constant
+    from ..nn.layer_base import ParamAttr
+    return ParamAttr(initializer=Constant(1.0))
+
+
+def batch_norm(input, act=None, is_test=False, momentum=0.9, epsilon=1e-5,
+               param_attr=None, bias_attr=None, data_layout="NCHW",
+               name=None, moving_mean_name=None, moving_variance_name=None):
+    """Builder form.  Train mode normalizes with batch statistics (the
+    fluid static-graph behavior); is_test=True normalizes with the
+    moving_mean/moving_variance PARAMETERS created here (init 0/1,
+    non-trainable) — restore real statistics by name via static.load /
+    load_persistables before serving.  Divergence: the builder does not
+    update the moving averages during training (no in-graph state
+    mutation in the deferred capture) — train with paddle.nn.BatchNorm2D
+    when running statistics must be learned in-graph."""
+    from ..nn.initializer import Constant
+    from ..nn.layer_base import ParamAttr
+
+    c = input.shape[1 if data_layout == "NCHW" else -1]
+    w = create_parameter([c], attr=_ones_attr(param_attr))
+    b = create_parameter([c], attr=bias_attr, is_bias=True)
+    shape = [1, c, 1, 1] if data_layout == "NCHW" else [1, 1, 1, c]
+    if is_test:
+        mm = create_parameter(
+            [c], attr=ParamAttr(name=moving_mean_name,
+                                initializer=Constant(0.0), trainable=False))
+        mv = create_parameter(
+            [c], attr=ParamAttr(name=moving_variance_name,
+                                initializer=Constant(1.0), trainable=False))
+        mean = paddle.reshape(mm, shape)
+        var = paddle.reshape(mv, shape)
+    else:
+        axes = [0, 2, 3] if data_layout == "NCHW" else [0, 1, 2]
+        mean = paddle.mean(input, axis=axes, keepdim=True)
+        var = paddle.mean((input - mean) ** 2, axis=axes, keepdim=True)
+    out = (input - mean) / paddle.sqrt(var + epsilon)
+    out = out * paddle.reshape(w, shape) + paddle.reshape(b, shape)
+    return _apply_act(out, act)
+
+
+def layer_norm(input, scale=True, shift=True, begin_norm_axis=1,
+               epsilon=1e-5, param_attr=None, bias_attr=None, act=None,
+               name=None):
+    shape = list(input.shape)[begin_norm_axis:]
+    n = int(np.prod(shape))
+    w = create_parameter([n], attr=_ones_attr(param_attr)) if scale \
+        else None
+    b = create_parameter([n], attr=bias_attr, is_bias=True) if shift \
+        else None
+    flat_w = paddle.reshape(w, shape) if w is not None else None
+    flat_b = paddle.reshape(b, shape) if b is not None else None
+    out = F.layer_norm(input, shape, weight=flat_w, bias=flat_b,
+                       epsilon=epsilon)
+    return _apply_act(out, act)
+
+
+def mul(x, y, x_num_col_dims=1, y_num_col_dims=1):
+    return paddle.matmul(x, y)
+
+
+def sums(input, out=None):
+    return paddle.add_n(input)
+
+
+def scale(x, scale=1.0, bias=0.0, bias_after_scale=True, act=None):
+    out = x * scale + bias if bias_after_scale else (x + bias) * scale
+    return _apply_act(out, act)
+
+
+# plain op re-exports under their fluid names
+pool2d = F.pool2d
+relu = F.relu
+sigmoid = F.sigmoid
+tanh = paddle.tanh
+softmax = F.softmax
+concat = paddle.concat
+reshape = paddle.reshape
+transpose = paddle.transpose
+elementwise_add = paddle.elementwise_add
+elementwise_sub = paddle.elementwise_sub
+elementwise_mul = paddle.elementwise_mul
+elementwise_div = paddle.elementwise_div
+matmul = paddle.matmul
+reduce_mean = paddle.reduce_mean
+reduce_sum = paddle.reduce_sum
+fill_constant = paddle.fill_constant
+assign = paddle.assign
+cast = paddle.cast
+one_hot = F.one_hot
+uniform_random = paddle.uniform
+gaussian_random = paddle.randn
+squeeze = paddle.squeeze
+unsqueeze = paddle.unsqueeze
+clip = paddle.clip
+mean = paddle.mean

@@ -1,0 +1,83 @@
+"""fluid.nets — composite network helpers.
+
+Reference parity: python/paddle/fluid/nets.py (simple_img_conv_pool:29,
+img_conv_group:143, glu:335, scaled_dot_product_attention:382).  Each is
+a composition of fluid.layers builders, so they capture into static
+Programs and run eagerly alike.
+"""
+from __future__ import annotations
+
+import paddle_tpu as paddle
+
+from ..nn import functional as F
+from . import layers
+
+__all__ = ["simple_img_conv_pool", "img_conv_group", "glu",
+           "scaled_dot_product_attention"]
+
+
+def simple_img_conv_pool(input, num_filters, filter_size, pool_size,
+                         pool_stride, pool_padding=0, pool_type="max",
+                         global_pooling=False, conv_stride=1,
+                         conv_padding=0, conv_dilation=1, conv_groups=1,
+                         param_attr=None, bias_attr=None, act=None):
+    conv = layers.conv2d(input, num_filters=num_filters,
+                         filter_size=filter_size, stride=conv_stride,
+                         padding=conv_padding, dilation=conv_dilation,
+                         groups=conv_groups, param_attr=param_attr,
+                         bias_attr=bias_attr, act=act)
+    return F.pool2d(conv, pool_size=pool_size, pool_type=pool_type,
+                    pool_stride=pool_stride, pool_padding=pool_padding,
+                    global_pooling=global_pooling)
+
+
+def img_conv_group(input, conv_num_filter, pool_size, conv_padding=1,
+                   conv_filter_size=3, conv_act=None, param_attr=None,
+                   conv_with_batchnorm=False, conv_batchnorm_drop_rate=0.0,
+                   pool_stride=1, pool_type="max", use_cudnn=True):
+    """VGG-style conv block stack + one pool (nets.py:143)."""
+    tmp = input
+    if isinstance(conv_num_filter, int):
+        conv_num_filter = [conv_num_filter]
+    n = len(conv_num_filter)
+
+    def per(item):
+        return item if isinstance(item, (list, tuple)) else [item] * n
+
+    padding, fsize, acts = (per(conv_padding), per(conv_filter_size),
+                            per(conv_act))
+    bn_drop = per(conv_batchnorm_drop_rate)
+    for i in range(n):
+        tmp = layers.conv2d(tmp, num_filters=conv_num_filter[i],
+                            filter_size=fsize[i], padding=padding[i],
+                            param_attr=param_attr,
+                            act=None if conv_with_batchnorm else acts[i])
+        if conv_with_batchnorm:
+            tmp = layers.batch_norm(tmp, act=acts[i])
+            if bn_drop[i] > 0:
+                tmp = layers.dropout(tmp, dropout_prob=bn_drop[i])
+    return F.pool2d(tmp, pool_size=pool_size, pool_type=pool_type,
+                    pool_stride=pool_stride)
+
+
+def glu(input, dim=-1):
+    """Gated linear unit: split in half along dim, a * sigmoid(b)."""
+    a, b = paddle.split(input, 2, axis=dim)
+    return a * F.sigmoid(b)
+
+
+def scaled_dot_product_attention(queries, keys, values, num_heads=1,
+                                 dropout_rate=0.0):
+    """Multi-head attention over [B, S, D] inputs (nets.py:382); routes
+    through the same scaled_dot_product_attention the transformer stack
+    uses (flash-attention kernel on TPU)."""
+    Sq, D = queries.shape[1], queries.shape[2]
+    Sk = keys.shape[1]
+    hd = D // num_heads
+    # batch dim as -1: fluid programs declare it dynamic (None)
+    q = paddle.reshape(queries, [-1, Sq, num_heads, hd])
+    k = paddle.reshape(keys, [-1, Sk, num_heads, hd])
+    v = paddle.reshape(values, [-1, Sk, num_heads, hd])
+    out = F.scaled_dot_product_attention(q, k, v, dropout_p=dropout_rate,
+                                         training=dropout_rate > 0)
+    return paddle.reshape(out, [-1, Sq, D])

@@ -1,0 +1,5 @@
+"""fluid.unique_name — re-export of the shared generator
+(framework/unique_name.py; reference fluid/unique_name.py:84)."""
+from ..framework.unique_name import generate, guard, switch  # noqa: F401
+
+__all__ = ["generate", "switch", "guard"]

@@ -1,0 +1,30 @@
+"""Per-prefix unique name generation (fluid/unique_name.py:84).
+
+One process-wide counter chain shared by builder-created parameters
+(fluid.layers.fc) and Layer-created ones (nn/layer_base.py), so names
+never collide across the two styles.  Re-exported as
+paddle.utils.unique_name and paddle.fluid.unique_name.
+"""
+from __future__ import annotations
+
+import contextlib
+
+from ..nn.layer_base import _unique_name
+
+__all__ = ["generate", "switch", "guard"]
+
+
+def generate(key):
+    return _unique_name(key)
+
+
+def switch(new_generator=None, new_para_name_checker=None):
+    """Accepted for compatibility; the global counter is process-wide
+    (names stay unique across a switch, which is the property callers
+    rely on)."""
+    return None, None
+
+
+@contextlib.contextmanager
+def guard(new_generator=None):
+    yield

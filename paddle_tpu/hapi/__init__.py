@@ -1,5 +1,7 @@
 from .model import Model  # noqa: F401
 from .model import flops, summary  # noqa: F401
+from . import logger  # noqa: F401 — ref hapi/__init__.py
+from . import model_summary  # noqa: F401
 from . import callbacks  # noqa: F401
 from .callbacks import (  # noqa: F401
     Callback,

@@ -1,0 +1,6 @@
+"""hapi.model_summary — the module paddle.summary lives in upstream
+(reference python/paddle/hapi/model_summary.py); re-exported from the
+XLA-cost-analysis-backed implementation in hapi/model.py."""
+from .model import summary  # noqa: F401
+
+__all__ = ["summary"]

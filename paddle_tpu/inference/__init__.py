@@ -28,7 +28,8 @@ from ..framework.dtype import convert_dtype
 from ..tensor import Tensor
 
 __all__ = ["Config", "Predictor", "create_predictor",
-           "save_inference_model", "load_inference_model", "PrecisionType"]
+           "save_inference_model", "load_inference_model", "PrecisionType",
+           "DataType", "PlaceType"]
 
 
 class PrecisionType:
@@ -36,6 +37,28 @@ class PrecisionType:
     Half = 1
     Bfloat16 = 2
     Int8 = 3
+
+
+class DataType:
+    """Tensor element types over the serving boundary
+    (paddle_infer_declare.h PaddleDType)."""
+    FLOAT32 = 0
+    INT64 = 1
+    INT32 = 2
+    UINT8 = 3
+    INT8 = 4
+    FLOAT16 = 5
+    BFLOAT16 = 6
+
+
+class PlaceType:
+    """Handle placement (paddle_tensor.h PlaceType); TPU serves from the
+    accelerator, kCPU is the host fallback."""
+    kUNK = -1
+    kCPU = 0
+    kGPU = 1
+    kTPU = 2
+    kXPU = 3
 
 
 def _natural_key(name):

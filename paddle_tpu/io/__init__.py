@@ -99,11 +99,26 @@ class Subset(Dataset):
         return len(self.indices)
 
 
+def _host_rng():
+    """numpy RandomState chained off the framework RNG: paddle.seed()
+    reproduces host-side sampling/shuffling, and test order can't bleed
+    through the GLOBAL np.random state (the reference seeds its sampler
+    RNGs from op/program seeds the same way).  Each call advances the
+    chain, so successive epochs draw different permutations."""
+    import jax
+
+    from ..framework import random as _fr
+
+    key = _fr.split_key(1)
+    data = np.asarray(jax.random.key_data(key)).ravel()
+    return np.random.RandomState(data.astype(np.uint32)[-1])
+
+
 def random_split(dataset, lengths, generator=None):
     total = len(dataset)
     if sum(lengths) != total:
         raise ValueError("sum of lengths must equal dataset size")
-    perm = np.random.permutation(total)
+    perm = _host_rng().permutation(total)
     out, start = [], 0
     for ln in lengths:
         out.append(Subset(dataset, perm[start:start + ln].tolist()))
@@ -140,9 +155,10 @@ class RandomSampler(Sampler):
 
     def __iter__(self):
         n = len(self.data_source)
+        rng = _host_rng()
         if self.replacement:
-            return iter(np.random.randint(0, n, self.num_samples).tolist())
-        return iter(np.random.permutation(n)[:self.num_samples].tolist())
+            return iter(rng.randint(0, n, self.num_samples).tolist())
+        return iter(rng.permutation(n)[:self.num_samples].tolist())
 
     def __len__(self):
         return self.num_samples
@@ -156,8 +172,9 @@ class WeightedRandomSampler(Sampler):
 
     def __iter__(self):
         p = self.weights / self.weights.sum()
-        return iter(np.random.choice(len(self.weights), self.num_samples,
-                                     replace=self.replacement, p=p).tolist())
+        return iter(_host_rng().choice(len(self.weights), self.num_samples,
+                                       replace=self.replacement,
+                                       p=p).tolist())
 
     def __len__(self):
         return self.num_samples

@@ -276,3 +276,7 @@ class ProgramTranslator:
     @property
     def enable_to_static(self):
         return type(self)._enabled
+
+
+# submodule export (reference jit/__init__.py: `from . import dy2static`)
+from . import dy2static  # noqa: E402,F401

@@ -427,3 +427,5 @@ class DetectionMAP(Metric):
                             * mpre[idx + 1]).sum())
             aps.append(ap)
         return float(np.mean(aps)) if aps else 0.0
+
+from . import metrics  # noqa: E402,F401 — ref metric/__init__.py submodule

@@ -1,0 +1,7 @@
+"""paddle.metric.metrics — the module the reference re-exports classes
+from (python/paddle/metric/__init__.py: `from .metrics import ...`);
+aliased to the package surface here."""
+from . import (  # noqa: F401
+    Accuracy, Auc, Metric, Precision, Recall)
+
+__all__ = ["Metric", "Accuracy", "Precision", "Recall", "Auc"]

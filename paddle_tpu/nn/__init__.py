@@ -4,6 +4,11 @@ Reference parity: python/paddle/nn/__init__.py (2.0 API surface).
 """
 from . import functional  # noqa: F401
 from . import initializer  # noqa: F401
+from . import utils  # noqa: F401
+from .functional import extension  # noqa: F401 — ref nn/__init__.py:19
+from .layer import common  # noqa: F401 — ref nn/__init__.py:20
+from .utils import weight_norm_hook  # noqa: F401 — ref nn/__init__.py:22
+from .utils import remove_weight_norm, weight_norm  # noqa: F401
 from .layer_base import Layer, Parameter, ParamAttr, functional_call, state_pytrees  # noqa: F401
 from .layer.container import LayerDict, LayerList, ParameterList, Sequential  # noqa: F401
 from .layer.common import (  # noqa: F401

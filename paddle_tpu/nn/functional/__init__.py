@@ -2041,3 +2041,28 @@ def pad_constant_like(x, y, pad_value=0.0, name=None):
         return jnp.pad(b, pads, constant_values=pad_value)
 
     return apply(f, x, y)
+
+
+def brelu(x, t_min=0.0, t_max=24.0, name=None):
+    """fluid brelu (activation_op.cc BRelu): clip to [t_min, t_max]."""
+    return hardtanh(x, min=t_min, max=t_max)
+
+
+def gather_tree(ids, parents):
+    """Beam-search backtrace (paddle.nn.functional.gather_tree alias of
+    the text decoding op — reference nn/functional/__init__.py exports
+    it here too)."""
+    from ...text import gather_tree as _gt
+
+    return _gt(ids, parents)
+
+
+# reference-structure submodule aliases (python/paddle/nn/functional/
+# {activation,common,conv,extension,loss,pooling}.py): imported LAST so
+# they can re-export the flat surface above
+from . import activation  # noqa: E402,F401
+from . import common  # noqa: E402,F401
+from . import conv  # noqa: E402,F401
+from . import extension  # noqa: E402,F401
+from . import loss  # noqa: E402,F401
+from . import pooling  # noqa: E402,F401

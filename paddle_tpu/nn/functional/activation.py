@@ -1,0 +1,11 @@
+"""paddle.nn.functional.activation — submodule alias re-exporting the reference
+module's names (python/paddle/nn/functional/activation.py __all__) from the
+flat functional surface."""
+
+from . import (  # noqa: F401
+    brelu, elu, gelu, hardshrink, hardsigmoid, hardswish, hardtanh,
+    leaky_relu, log_sigmoid, log_softmax, maxout, prelu, relu, relu6,
+    selu, sigmoid, softmax, softplus, softshrink, softsign, swish,
+    tanh, tanhshrink, thresholded_relu)
+
+__all__ = ['brelu', 'elu', 'gelu', 'hardshrink', 'hardsigmoid', 'hardswish', 'hardtanh', 'leaky_relu', 'log_sigmoid', 'log_softmax', 'maxout', 'prelu', 'relu', 'relu6', 'selu', 'sigmoid', 'softmax', 'softplus', 'softshrink', 'softsign', 'swish', 'tanh', 'tanhshrink', 'thresholded_relu']

@@ -1,0 +1,10 @@
+"""paddle.nn.functional.common — submodule alias re-exporting the reference
+module's names (python/paddle/nn/functional/common.py __all__) from the
+flat functional surface."""
+
+from . import (  # noqa: F401
+    alpha_dropout, bilinear, cosine_similarity, dropout, dropout2d,
+    dropout3d, interpolate, label_smooth, linear, pad, unfold,
+    upsample)
+
+__all__ = ['alpha_dropout', 'bilinear', 'cosine_similarity', 'dropout', 'dropout2d', 'dropout3d', 'interpolate', 'label_smooth', 'linear', 'pad', 'unfold', 'upsample']

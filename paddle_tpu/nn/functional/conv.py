@@ -1,0 +1,9 @@
+"""paddle.nn.functional.conv — submodule alias re-exporting the reference
+module's names (python/paddle/nn/functional/conv.py __all__) from the
+flat functional surface."""
+
+from . import (  # noqa: F401
+    conv1d, conv1d_transpose, conv2d, conv2d_transpose, conv3d,
+    conv3d_transpose)
+
+__all__ = ['conv1d', 'conv1d_transpose', 'conv2d', 'conv2d_transpose', 'conv3d', 'conv3d_transpose']

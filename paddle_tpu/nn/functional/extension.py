@@ -1,0 +1,8 @@
+"""paddle.nn.functional.extension — submodule alias re-exporting the reference
+module's names (python/paddle/nn/functional/extension.py __all__) from the
+flat functional surface."""
+
+from . import (  # noqa: F401
+    diag_embed)
+
+__all__ = ['diag_embed']

@@ -1,0 +1,12 @@
+"""paddle.nn.functional.loss — submodule alias re-exporting the reference
+module's names (python/paddle/nn/functional/loss.py __all__) from the
+flat functional surface."""
+
+from . import (  # noqa: F401
+    binary_cross_entropy, binary_cross_entropy_with_logits,
+    cross_entropy, ctc_loss, dice_loss, hsigmoid_loss, kl_div,
+    l1_loss, log_loss, margin_ranking_loss, mse_loss, nll_loss,
+    npair_loss, sigmoid_focal_loss, smooth_l1_loss,
+    softmax_with_cross_entropy, square_error_cost)
+
+__all__ = ['binary_cross_entropy', 'binary_cross_entropy_with_logits', 'cross_entropy', 'ctc_loss', 'dice_loss', 'hsigmoid_loss', 'kl_div', 'l1_loss', 'log_loss', 'margin_ranking_loss', 'mse_loss', 'nll_loss', 'npair_loss', 'sigmoid_focal_loss', 'smooth_l1_loss', 'softmax_with_cross_entropy', 'square_error_cost']

@@ -1,0 +1,89 @@
+"""Weight normalization as a forward-pre-hook reparameterization.
+
+Reference parity: python/paddle/nn/utils/weight_norm_hook.py
+(weight_norm:155, remove_weight_norm:202): `weight` is replaced by
+magnitude `weight_g` and direction `weight_v`, recombined as
+w = g * v / ||v|| before every forward.  ||v|| is computed over all
+dims except `dim` (dim=None -> whole-tensor norm).
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+import numpy as np
+
+from ...tensor import Tensor, apply, unwrap
+from ..layer_base import Parameter
+
+__all__ = ["weight_norm", "remove_weight_norm"]
+
+
+def _norm_except_dim(v, dim):
+    if dim is None:
+        return jnp.sqrt(jnp.sum(jnp.square(v)))
+    axes = tuple(i for i in range(v.ndim) if i != dim)
+    return jnp.sqrt(jnp.sum(jnp.square(v), axis=axes, keepdims=True))
+
+
+def _recompute(g, v, dim):
+    def f(gv, vv):
+        n = _norm_except_dim(vv, dim)
+        return gv * vv / jnp.maximum(n, 1e-12)
+
+    return apply(f, g, v)
+
+
+class WeightNorm:
+    def __init__(self, name, dim):
+        self.name = name
+        self.dim = dim
+
+    def __call__(self, layer, inputs):
+        g = layer._parameters[self.name + "_g"]
+        v = layer._parameters[self.name + "_v"]
+        w = _recompute(g, v, self.dim)
+        # plain object attribute: bypasses Layer.__setattr__ so the
+        # recomputed tensor is not registered as a buffer/parameter
+        object.__setattr__(layer, self.name, w)
+        return None
+
+
+def weight_norm(layer, name="weight", dim=0):
+    if name + "_g" in layer._parameters:
+        raise ValueError(f"weight_norm already applied to {name!r}")
+    w = layer._parameters.get(name)
+    if w is None:
+        raise ValueError(f"{type(layer).__name__} has no parameter {name!r}")
+    w_val = unwrap(w)
+    g0 = np.asarray(_norm_except_dim(w_val, dim))
+    del layer._parameters[name]
+    layer.add_parameter(name + "_g", Parameter(jnp.asarray(g0),
+                                               name=f"{name}_g"))
+    layer.add_parameter(name + "_v", Parameter(w_val, name=f"{name}_v"))
+    fn = WeightNorm(name, dim)
+    handle = layer.register_forward_pre_hook(fn)
+    fn._handle = handle
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    hooks[name] = fn
+    object.__setattr__(layer, "_weight_norm_hooks", hooks)
+    # materialize once so layer.<name> exists before the first forward
+    fn(layer, None)
+    return layer
+
+
+def remove_weight_norm(layer, name="weight"):
+    hooks = getattr(layer, "_weight_norm_hooks", {})
+    fn = hooks.pop(name, None)
+    if fn is None:
+        raise ValueError(f"weight_norm of {name!r} not found on "
+                         f"{type(layer).__name__}")
+    g = layer._parameters.pop(name + "_g")
+    v = layer._parameters.pop(name + "_v")
+    w = _recompute(g, v, fn.dim)
+    if hasattr(layer, name):
+        try:
+            object.__delattr__(layer, name)
+        except AttributeError:
+            pass
+    fn._handle.remove()
+    layer.add_parameter(name, Parameter(unwrap(w), name=name))
+    return layer

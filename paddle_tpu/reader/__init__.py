@@ -102,7 +102,9 @@ def compose(*readers, **kwargs):
         if check_alignment:
             _missing = object()
             for outputs in itertools.zip_longest(*its, fillvalue=_missing):
-                if _missing in outputs:
+                # identity test, NOT `in`: tuple membership uses == which
+                # numpy array samples evaluate elementwise
+                if any(o is _missing for o in outputs):
                     raise ComposeNotAligned(
                         "outputs of readers are not aligned")
                 yield sum((make_tuple(o) for o in outputs), ())

@@ -149,6 +149,28 @@ class Variable:
     def __neg__(self):
         return self._op("scale", -1.0)
 
+    # comparisons defer too (fluid.layers.accuracy: argmax(pred) == label);
+    # identity hashing is preserved — the capture machinery keys on id()
+    def __eq__(self, o):
+        return self._op("equal", o)
+
+    def __ne__(self, o):
+        return self._op("not_equal", o)
+
+    def __lt__(self, o):
+        return self._op("less_than", o)
+
+    def __le__(self, o):
+        return self._op("less_equal", o)
+
+    def __gt__(self, o):
+        return self._op("greater_than", o)
+
+    def __ge__(self, o):
+        return self._op("greater_equal", o)
+
+    __hash__ = object.__hash__
+
     def __getattr__(self, item):
         # tensor methods (v.mean(), v.reshape(...)) resolve to the
         # tensor_ops function of the same name, keeping ONE op surface

@@ -1085,7 +1085,14 @@ def segment_mean(data, segment_ids, num_segments=None, name=None):
 # dtype casting helper (paddle.cast)
 # ---------------------------------------------------------------------------
 def cast(x, dtype):
-    return x.astype(dtype) if isinstance(x, Tensor) else Tensor(unwrap(x)).astype(dtype)
+    if isinstance(x, Tensor):
+        return x.astype(dtype)
+    # non-Tensor (deferred Variable / raw array): route through apply so
+    # static-program capture defers the cast like every other op
+    from .framework.dtype import convert_dtype
+
+    np_dt = convert_dtype(dtype)
+    return apply(lambda v: v.astype(np_dt), x)
 
 
 def increment(x, value=1.0, name=None):

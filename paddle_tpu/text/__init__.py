@@ -318,3 +318,5 @@ def linear_chain_crf(emission, transition, label, seq_len):
         return gold - logz
 
     return apply(f, emission, transition, label, seq_len)
+
+from . import datasets  # noqa: E402,F401 — ref text/__init__.py submodule

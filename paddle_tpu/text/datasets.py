@@ -1,0 +1,8 @@
+"""paddle.text.datasets — submodule alias (reference
+python/paddle/text/__init__.py: `from . import datasets`); the dataset
+classes live on the package for direct access either way."""
+from . import (  # noqa: F401
+    Conll05st, Imdb, Imikolov, Movielens, UCIHousing, WMT14, WMT16)
+
+__all__ = ["Conll05st", "Imdb", "Imikolov", "Movielens", "UCIHousing",
+           "WMT14", "WMT16"]

@@ -1,5 +1,56 @@
+from . import download  # noqa: F401
 from . import profiler  # noqa: F401
+from ..framework import unique_name  # noqa: F401 — ref utils/__init__.py
+from .deprecated import deprecated  # noqa: F401
+from .download import get_weights_path_from_url  # noqa: F401
 from .lazy_import import try_import  # noqa: F401
+from .profiler import Profiler, ProfilerOptions, get_profiler  # noqa: F401
+
+__all__ = ["deprecated", "download", "run_check", "unique_name",
+           "load_op_library", "require_version", "try_import",
+           "get_weights_path_from_url"]
+
+
+def require_version(min_version, max_version=None):
+    """Check the installed framework version against [min, max]
+    (reference fluid/framework.py require_version)."""
+    from ..version import full_version
+
+    def parts(v):
+        p = [int(x) for x in str(v).split("+")[0].split(".")[:3]]
+        return p + [0] * (3 - len(p))  # zero-pad: '2.0' allows 2.0.x
+
+    cur = parts(full_version)
+    if parts(min_version) > cur:
+        raise Exception(
+            f"installed version {full_version} < required {min_version}")
+    if max_version is not None and parts(max_version) < cur:
+        raise Exception(
+            f"installed version {full_version} > allowed {max_version}")
+    return True
+
+
+def load_op_library(lib_filename):
+    """Custom C++ op loading is the reference's mechanism for user
+    kernels; here custom kernels are Pallas/jax functions registered in
+    python — nothing to dlopen."""
+    import warnings
+    warnings.warn(
+        "load_op_library is a no-op on the TPU build: write custom ops as "
+        "jax/Pallas functions (ops/pallas/) instead of C++ operator "
+        "libraries", stacklevel=2)
+
+
+class OpLastCheckpointChecker:
+    """Op-version compatibility checker (reference utils/op_version.py).
+    The TPU build has no op-version registry — StableHLO artifacts carry
+    their own compatibility guarantees — so queries return empty."""
+
+    def check_modified(self, *a, **k):
+        return []
+
+    def check_bugfix(self, *a, **k):
+        return []
 
 
 def run_check():

@@ -1,0 +1,33 @@
+"""paddle.utils.deprecated — deprecation-warning decorator.
+
+Reference parity: python/paddle/utils/deprecated.py (appends a
+Deprecated note to the docstring and warns once per call site).
+"""
+from __future__ import annotations
+
+import functools
+import warnings
+
+__all__ = ["deprecated"]
+
+
+def deprecated(update_to="", since="", reason=""):
+    def decorator(func):
+        msg = f"API {func.__module__}.{func.__name__} is deprecated"
+        if since:
+            msg += f" since {since}"
+        if update_to:
+            msg += f", use {update_to} instead"
+        if reason:
+            msg += f"; reason: {reason}"
+        note = f"\n\n    .. warning:: {msg}\n"
+        func.__doc__ = (func.__doc__ or "") + note
+
+        @functools.wraps(func)
+        def wrapper(*args, **kwargs):
+            warnings.warn(msg, DeprecationWarning, stacklevel=2)
+            return func(*args, **kwargs)
+
+        return wrapper
+
+    return decorator

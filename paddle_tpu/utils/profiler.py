@@ -93,3 +93,76 @@ def profiler(state="All", sorted_key=None, profile_path="/tmp/paddle_tpu_profile
         yield
     finally:
         stop_profiler(sorted_key, profile_path)
+
+
+class ProfilerOptions:
+    """Option bag (reference utils/profiler.py ProfilerOptions): a dict
+    facade over the knobs the TPU profiler honors (output_dir; the
+    CUDA-specific ones are accepted and inert)."""
+
+    DEFAULTS = {
+        "state": "All", "sorted_key": "default", "tracer_level": "Default",
+        "batch_range": [0, 100], "output_thread_detail": False,
+        "profile_path": "/tmp/paddle_tpu_profile",
+        "timeline_path": "/tmp/paddle_tpu_profile/host_trace.json",
+        "op_summary_path": "", "exit_on_finished": False,
+    }
+
+    def __init__(self, options=None):
+        self._options = dict(self.DEFAULTS)
+        if options:
+            self._options.update(options)
+
+    def with_state(self, state):
+        self._options["state"] = state
+        return self
+
+    def __getitem__(self, name):
+        if name not in self._options:
+            raise ValueError(f"ProfilerOptions does not have an option "
+                             f"named {name}.")
+        return self._options[name]
+
+
+class Profiler:
+    """Start/stop facade over the jax.profiler + host-event tracing
+    (reference utils/profiler.py Profiler; use as a context manager or
+    via start()/stop())."""
+
+    def __init__(self, enabled=True, options=None):
+        self.enabled = enabled
+        self.profiler_options = ProfilerOptions(options)
+        self._running = False
+
+    def start(self):
+        if self.enabled and not self._running:
+            start_profiler(self.profiler_options["profile_path"],
+                           self.profiler_options["state"])
+            self._running = True
+        return self
+
+    def stop(self):
+        if self._running:
+            stop_profiler(self.profiler_options["sorted_key"],
+                          self.profiler_options["profile_path"])
+            self._running = False
+
+    def reset(self):
+        from .. import core as _native
+        _native.trace_clear()
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+
+
+_profiler_singleton = None
+
+
+def get_profiler(options=None):
+    global _profiler_singleton
+    if _profiler_singleton is None:
+        _profiler_singleton = Profiler(options=options)
+    return _profiler_singleton

@@ -1,0 +1,32 @@
+"""`paddle.version` — build version metadata.
+
+Reference parity: the generated python/paddle/version.py (setup.py
+write_version_py): full_version/major/minor/patch/rc, commit, istaged,
+with_mkl, and the mkl()/show() helpers.
+"""
+from __future__ import annotations
+
+full_version = "2.0.0+tpu"
+major = "2"
+minor = "0"
+patch = "0"
+rc = "0"
+istaged = True
+commit = "tpu-native"
+with_mkl = "OFF"
+
+__all__ = ["full_version", "major", "minor", "patch", "rc", "istaged",
+           "commit", "with_mkl", "mkl", "show"]
+
+
+def mkl():
+    return with_mkl
+
+
+def show():
+    print("full_version:", full_version)
+    print("major:", major)
+    print("minor:", minor)
+    print("patch:", patch)
+    print("rc:", rc)
+    print("commit:", commit)

@@ -1,4 +1,7 @@
 from . import datasets  # noqa: F401
+from . import image  # noqa: F401
 from . import models  # noqa: F401
 from . import transforms  # noqa: F401
 from . import ops  # noqa: F401
+from .image import (  # noqa: F401 — ref vision/__init__.py DEFINE_ALIAS
+    get_image_backend, image_load, set_image_backend)

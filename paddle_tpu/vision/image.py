@@ -1,0 +1,40 @@
+"""paddle.vision.image — image backend selection + loading.
+
+Reference parity: python/paddle/vision/image.py:23
+(set_image_backend/get_image_backend/image_load).  Backends: 'pil'
+(default) and 'cv2' is accepted but served through PIL->numpy (cv2 is
+not in this environment; arrays come back HWC like cv2 would return).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["set_image_backend", "get_image_backend", "image_load"]
+
+_BACKEND = "pil"
+
+
+def set_image_backend(backend):
+    global _BACKEND
+    if backend not in ("pil", "cv2"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2'], but got {backend}")
+    _BACKEND = backend
+
+
+def get_image_backend():
+    return _BACKEND
+
+
+def image_load(path, backend=None):
+    """Load an image: PIL.Image for the pil backend, HWC ndarray for
+    cv2."""
+    backend = backend or _BACKEND
+    if backend not in ("pil", "cv2"):
+        raise ValueError(
+            f"Expected backend are one of ['pil', 'cv2'], but got {backend}")
+    from PIL import Image
+    img = Image.open(path)
+    if backend == "cv2":
+        return np.asarray(img)
+    return img

@@ -1,0 +1,11 @@
+"""Vision transforms package (reference python/paddle/vision/transforms/):
+class transforms in .transforms, host-side functional ops in
+.functional; both surfaces re-exported here."""
+from . import functional  # noqa: F401
+from . import transforms  # noqa: F401
+from .functional import (  # noqa: F401
+    adjust_brightness, adjust_contrast, adjust_hue, center_crop, crop,
+    hflip, normalize, pad, resize, rotate, to_grayscale, to_tensor, vflip)
+from .transforms import (  # noqa: F401
+    BaseTransform, CenterCrop, Compose, Normalize, RandomCrop,
+    RandomHorizontalFlip, Resize, ToTensor, Transpose)

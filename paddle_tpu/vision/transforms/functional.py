@@ -1,0 +1,177 @@
+"""paddle.vision.transforms.functional — host-side image ops.
+
+Reference parity: python/paddle/vision/transforms/functional.py:39
+(to_tensor, hflip, vflip, resize, pad, rotate, to_grayscale, crop,
+center_crop, adjust_brightness/contrast/hue, normalize).  Operates on
+PIL images or numpy HWC arrays — preprocessing stays on the host (it
+feeds the device prefetch pipeline, not XLA).
+"""
+from __future__ import annotations
+
+import numbers
+
+import numpy as np
+
+__all__ = ["to_tensor", "hflip", "vflip", "resize", "pad", "rotate",
+           "to_grayscale", "crop", "center_crop", "adjust_brightness",
+           "adjust_contrast", "adjust_hue", "normalize"]
+
+
+def _is_pil(img):
+    try:
+        from PIL import Image
+        return isinstance(img, Image.Image)
+    except ImportError:
+        return False
+
+
+def _to_pil(img):
+    from PIL import Image
+    if _is_pil(img):
+        return img
+    arr = np.asarray(img)
+    if arr.dtype != np.uint8:
+        arr = np.clip(arr, 0, 255).astype(np.uint8)
+    return Image.fromarray(arr)
+
+
+def to_tensor(pic, data_format="CHW"):
+    """PIL/HWC-ndarray -> float32, CHW (or HWC) layout.  Rescales by
+    1/255 iff the input is 8-bit (PIL or uint8 ndarray) — dtype-based
+    like the reference, so a near-black uint8 image normalizes the same
+    as a bright one."""
+    was_uint8 = _is_pil(pic) or np.asarray(pic).dtype == np.uint8
+    arr = np.asarray(pic, np.float32)
+    if was_uint8:
+        arr = arr / 255.0
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    if data_format == "CHW":
+        arr = arr.transpose(2, 0, 1)
+    return arr
+
+
+def hflip(img):
+    if _is_pil(img):
+        from PIL import Image
+        return img.transpose(Image.FLIP_LEFT_RIGHT)
+    return np.asarray(img)[:, ::-1].copy()
+
+
+def vflip(img):
+    if _is_pil(img):
+        from PIL import Image
+        return img.transpose(Image.FLIP_TOP_BOTTOM)
+    return np.asarray(img)[::-1].copy()
+
+
+_PIL_INTERP = {"nearest": 0, "bilinear": 2, "bicubic": 3, "lanczos": 1}
+
+
+def resize(img, size, interpolation="bilinear"):
+    """size: int (short side) or (h, w)."""
+    pil = _to_pil(img)
+    w, h = pil.size
+    if isinstance(size, int):
+        if (w <= h and w == size) or (h <= w and h == size):
+            out = pil
+        elif w < h:
+            out = pil.resize((size, int(size * h / w)),
+                             _PIL_INTERP[interpolation])
+        else:
+            out = pil.resize((int(size * w / h), size),
+                             _PIL_INTERP[interpolation])
+    else:
+        oh, ow = size
+        out = pil.resize((ow, oh), _PIL_INTERP[interpolation])
+    return out if _is_pil(img) else np.asarray(out)
+
+
+def pad(img, padding, fill=0, padding_mode="constant"):
+    """padding: int | (pad_lr, pad_tb) | (l, t, r, b)."""
+    arr = np.asarray(img)
+    was_pil = _is_pil(img)
+    if isinstance(padding, numbers.Number):
+        l = t = r = b = int(padding)  # noqa: E741
+    elif len(padding) == 2:
+        l = r = int(padding[0])  # noqa: E741
+        t = b = int(padding[1])
+    else:
+        l, t, r, b = (int(p) for p in padding)  # noqa: E741
+    spec = [(t, b), (l, r)] + [(0, 0)] * (arr.ndim - 2)
+    if padding_mode == "constant":
+        out = np.pad(arr, spec, constant_values=fill)
+    else:
+        mode = {"edge": "edge", "reflect": "reflect",
+                "symmetric": "symmetric"}[padding_mode]
+        out = np.pad(arr, spec, mode=mode)
+    return _to_pil(out) if was_pil else out
+
+
+def rotate(img, angle, interpolation="nearest", expand=False, center=None,
+           fill=0):
+    pil = _to_pil(img)
+    out = pil.rotate(angle, resample=_PIL_INTERP.get(interpolation, 0),
+                     expand=expand, center=center, fillcolor=fill)
+    return out if _is_pil(img) else np.asarray(out)
+
+
+def to_grayscale(img, num_output_channels=1):
+    pil = _to_pil(img).convert("L")
+    if num_output_channels == 3:
+        arr = np.asarray(pil)
+        out = np.stack([arr] * 3, -1)
+        return _to_pil(out) if _is_pil(img) else out
+    return pil if _is_pil(img) else np.asarray(pil)
+
+
+def crop(img, top, left, height, width):
+    arr = np.asarray(img)
+    out = arr[top:top + height, left:left + width]
+    return _to_pil(out) if _is_pil(img) else out
+
+
+def center_crop(img, output_size):
+    if isinstance(output_size, numbers.Number):
+        output_size = (int(output_size), int(output_size))
+    arr = np.asarray(img)
+    h, w = arr.shape[0], arr.shape[1]
+    th, tw = output_size
+    return crop(img, max((h - th) // 2, 0), max((w - tw) // 2, 0), th, tw)
+
+
+def _enhance(img, factor, enhancer_name):
+    from PIL import ImageEnhance
+    pil = _to_pil(img)
+    out = getattr(ImageEnhance, enhancer_name)(pil).enhance(factor)
+    return out if _is_pil(img) else np.asarray(out)
+
+
+def adjust_brightness(img, brightness_factor):
+    return _enhance(img, brightness_factor, "Brightness")
+
+
+def adjust_contrast(img, contrast_factor):
+    return _enhance(img, contrast_factor, "Contrast")
+
+
+def adjust_hue(img, hue_factor):
+    """Shift hue by hue_factor in [-0.5, 0.5] via HSV rotation."""
+    if not -0.5 <= hue_factor <= 0.5:
+        raise ValueError(f"hue_factor {hue_factor} not in [-0.5, 0.5]")
+    pil = _to_pil(img)
+    hsv = np.asarray(pil.convert("HSV")).copy()
+    hsv[..., 0] = (hsv[..., 0].astype(np.int16)
+                   + int(hue_factor * 255)) % 256
+    from PIL import Image
+    out = Image.fromarray(hsv, "HSV").convert("RGB")
+    return out if _is_pil(img) else np.asarray(out)
+
+
+def normalize(img, mean, std, data_format="CHW", to_rgb=False):
+    arr = np.asarray(img, np.float32)
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    if data_format == "CHW":
+        return (arr - mean[:, None, None]) / std[:, None, None]
+    return (arr - mean) / std

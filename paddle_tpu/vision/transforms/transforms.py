@@ -7,6 +7,8 @@ import numbers
 
 import numpy as np
 
+from ...io import _host_rng
+
 
 class Compose:
     def __init__(self, transforms):
@@ -28,14 +30,13 @@ class ToTensor(BaseTransform):
         self.data_format = data_format
 
     def _apply_image(self, img):
-        arr = np.asarray(img, np.float32)
-        if arr.max() > 1.5:
-            arr = arr / 255.0
-        if arr.ndim == 2:
-            arr = arr[None] if self.data_format == "CHW" else arr[..., None]
-        elif self.data_format == "CHW" and arr.shape[-1] in (1, 3, 4):
-            arr = arr.transpose(2, 0, 1)
-        return arr
+        from .functional import to_tensor
+        arr = np.asarray(img)
+        if arr.ndim == 3 and arr.shape[-1] not in (1, 3, 4):
+            # already CHW-ish input: only dtype-normalize
+            out = arr.astype(np.float32)
+            return out / 255.0 if arr.dtype == np.uint8 else out
+        return to_tensor(img, data_format=self.data_format)
 
 
 class Normalize(BaseTransform):
@@ -64,7 +65,9 @@ class Resize(BaseTransform):
         self.size = (size, size) if isinstance(size, int) else tuple(size)
 
     def _apply_image(self, img):
-        arr = np.asarray(img, np.float32)
+        # keep the input dtype: uint8 in -> uint8 out, so a downstream
+        # ToTensor still sees 8-bit data and rescales by 1/255
+        arr = np.asarray(img)
         chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
         h_ax, w_ax = (1, 2) if chw else (0, 1)
         oh, ow = self.size
@@ -81,7 +84,8 @@ class RandomHorizontalFlip(BaseTransform):
         self.prob = prob
 
     def _apply_image(self, img):
-        if np.random.rand() < self.prob:
+        # framework RNG chain: paddle.seed reproduces augmentation
+        if _host_rng().rand() < self.prob:
             arr = np.asarray(img)
             chw = arr.ndim == 3 and arr.shape[0] in (1, 3, 4)
             return arr[..., ::-1].copy() if not chw else arr[:, :, ::-1].copy()
@@ -104,8 +108,9 @@ class RandomCrop(BaseTransform):
             arr = np.pad(arr, pad)
         th, tw = self.size
         h, w = arr.shape[h_ax], arr.shape[w_ax]
-        y = np.random.randint(0, max(h - th, 0) + 1)
-        x = np.random.randint(0, max(w - tw, 0) + 1)
+        rng = _host_rng()
+        y = rng.randint(0, max(h - th, 0) + 1)
+        x = rng.randint(0, max(w - tw, 0) + 1)
         sl = [slice(None)] * arr.ndim
         sl[h_ax] = slice(y, y + th)
         sl[w_ax] = slice(x, x + tw)

@@ -25,6 +25,19 @@ def _ref_names(path):
     # slipped through 4 rounds (VERDICT r04 weak #7).
     names |= set(re.findall(r"^import paddle\.(\w+)$", src, re.M))
     names |= set(re.findall(r"^(\w+) = \w+[\w.]*", src, re.M))
+    # plain from-imports are exports too (`from .deprecated import
+    # deprecated` — how paddle.utils exports most of its surface); skip
+    # __future__ py2 artifacts
+    for m in re.finditer(r"^from\s+([.\w]+)\s+import\s+([^#\n(]+)", src,
+                         re.M):
+        if m.group(1) == "__future__":
+            continue
+        for part in m.group(2).split(","):
+            part = part.strip()
+            if " as " in part:
+                part = part.split(" as ")[-1].strip()
+            if part.isidentifier():
+                names.add(part)
     # module-level plumbing calls, not API: monkey_patch_* etc.
     names -= {"monkey_patch_variable", "monkey_patch_math_varbase"}
     return {n for n in names if not n.startswith("_")}

@@ -1,0 +1,198 @@
+"""paddle.fluid compatibility namespace: the classic fluid-era script
+shapes must run unchanged (reference fluid/tests/book style).  Programs
+are deferred expression DAGs under the hood (static/program.py) — no
+ProgramDesc — but the workflow below is byte-for-byte the fluid idiom."""
+import numpy as np
+
+import paddle_tpu.fluid as fluid
+import paddle_tpu as paddle
+
+
+def test_fluid_recognize_digits_workflow():
+    """fluid/tests/book/test_recognize_digits.py shape: data -> fc ->
+    softmax -> cross_entropy -> SGD.minimize -> Executor loop."""
+    paddle.seed(0)
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        img = fluid.data("img", [None, 64], "float32")
+        label = fluid.data("label", [None, 1], "int64")
+        h = fluid.layers.fc(img, 32, act="relu")
+        pred = fluid.layers.fc(h, 10, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(pred, label))
+        acc = fluid.layers.accuracy(pred, label)
+        fluid.optimizer.SGD(learning_rate=0.5).minimize(loss)
+
+    exe = fluid.Executor(fluid.CPUPlace())
+    exe.run(fluid.default_startup_program())
+
+    rs = np.random.RandomState(0)
+    # linearly separable toy digits: class = argmax of 10 fixed probes
+    W = rs.randn(64, 10).astype(np.float32)
+    X = rs.randn(256, 64).astype(np.float32)
+    Y = (X @ W).argmax(1).astype(np.int64)[:, None]
+    first = last = None
+    for ep in range(30):
+        lv, av = exe.run(main, feed={"img": X, "label": Y},
+                         fetch_list=[loss, acc])
+        first = float(lv) if first is None else first
+        last, acc_v = float(lv), float(av)
+    assert last < first * 0.5, (first, last)
+    assert acc_v > 0.8, acc_v
+
+
+def test_fluid_layers_builders():
+    paddle.seed(1)
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.data("x", [None, 3, 8, 8], "float32")
+        c = fluid.layers.conv2d(x, num_filters=4, filter_size=3,
+                                padding=1, act="relu")
+        p = fluid.layers.pool2d(c, pool_size=2, pool_stride=2) \
+            if hasattr(fluid.layers, "pool2d") else c
+        e = fluid.layers.embedding(
+            fluid.data("ids", [None, 5], "int64"), size=[20, 6])
+        bn = fluid.layers.batch_norm(c)
+        ln = fluid.layers.layer_norm(fluid.data("h", [None, 16],
+                                                "float32"))
+    exe = fluid.Executor()
+    feed = {"x": np.random.RandomState(0).randn(2, 3, 8, 8)
+            .astype(np.float32),
+            "ids": np.random.RandomState(1).randint(0, 20, (2, 5)),
+            "h": np.random.RandomState(2).randn(2, 16).astype(np.float32)}
+    cv, ev, bnv, lnv = exe.run(main, feed=feed,
+                               fetch_list=[c, e, bn, ln])
+    assert cv.shape == (2, 4, 8, 8) and (cv >= 0).all()  # relu applied
+    assert ev.shape == (2, 5, 6)
+    assert bnv.shape == (2, 4, 8, 8)
+    np.testing.assert_allclose(lnv.mean(-1), 0.0, atol=1e-5)
+
+
+def test_fluid_dygraph_and_io(tmp_path):
+    with fluid.dygraph.guard():
+        net = fluid.dygraph.Linear(4, 2, act="relu")
+        x = fluid.dygraph.to_variable(
+            np.ones((3, 4), np.float32))
+        out = net(x)
+        assert list(np.asarray(out.numpy()).shape) == [3, 2]
+        assert (np.asarray(out.numpy()) >= 0).all()
+        fluid.dygraph.save_dygraph(net.state_dict(), str(tmp_path / "m"))
+        sd, opt_sd = fluid.dygraph.load_dygraph(str(tmp_path / "m"))
+        assert opt_sd is None and set(sd) == set(net.state_dict())
+
+    # io: reader combinators are the same objects as paddle.reader
+    def r():
+        yield from range(4)
+    assert list(fluid.io.batch(r, 2)()) == [[0, 1], [2, 3]]
+
+
+def test_fluid_layers_review_fixes():
+    """Review findings: ignore_index masking, top-k accuracy, NHWC conv
+    bias, is_test batch_norm, compose with ndarray samples."""
+    # ignore_index: ignored positions contribute exactly zero
+    p = paddle.to_tensor(np.full((3, 4), 0.25, np.float32))
+    lab = paddle.to_tensor(np.array([[1], [0], [2]]))
+    l_all = np.asarray(fluid.layers.cross_entropy(p, lab).numpy())
+    l_ign = np.asarray(fluid.layers.cross_entropy(
+        p, lab, ignore_index=0).numpy())
+    assert l_ign[1, 0] == 0.0 and l_all[1, 0] > 1.0
+    np.testing.assert_allclose(l_ign[[0, 2]], l_all[[0, 2]])
+
+    # top-k accuracy (eager): label in top-2 but not top-1
+    logits = paddle.to_tensor(np.array([[0.1, 0.9, 0.5]], np.float32))
+    lab2 = paddle.to_tensor(np.array([[2]]))
+    assert float(np.asarray(fluid.layers.accuracy(
+        logits, lab2, k=1).numpy())) == 0.0
+    assert float(np.asarray(fluid.layers.accuracy(
+        logits, lab2, k=2).numpy())) == 1.0
+
+    # NHWC conv bias broadcasts over channels, not height
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.data("x", [None, 8, 8, 3], "float32")
+        c = fluid.layers.conv2d(x, num_filters=5, filter_size=3,
+                                padding=1, data_format="NHWC")
+    out, = fluid.Executor().run(
+        main, feed={"x": np.zeros((2, 8, 8, 3), np.float32)},
+        fetch_list=[c])
+    assert out.shape == (2, 8, 8, 5)
+
+    # is_test batch_norm: fixed moving stats, batch-size-1 safe
+    main2 = fluid.Program()
+    with fluid.program_guard(main2):
+        xi = fluid.data("xi", [None, 3, 4, 4], "float32")
+        bn = fluid.layers.batch_norm(xi, is_test=True)
+    one = np.random.RandomState(0).randn(1, 3, 4, 4).astype(np.float32)
+    o1, = fluid.Executor().run(main2, feed={"xi": one}, fetch_list=[bn])
+    # moving stats init (mean 0, var 1): output ~= input, NOT collapsed
+    np.testing.assert_allclose(o1, one, rtol=1e-2, atol=1e-2)
+
+    # compose with ndarray samples must not crash on membership check
+    def ra():
+        yield np.ones(3)
+        yield np.zeros(3)
+
+    got = list(paddle.reader.compose(ra, ra)())
+    assert len(got) == 2 and len(got[0]) == 2  # (arr_a, arr_b) per sample
+
+
+def test_to_tensor_dtype_based_scaling():
+    from paddle_tpu.vision.transforms import functional as TF
+    dark = np.ones((4, 4, 3), np.uint8)          # max()==1 but uint8
+    out = TF.to_tensor(dark)
+    np.testing.assert_allclose(out, 1.0 / 255.0, rtol=1e-6)
+    flt = np.ones((4, 4, 3), np.float32)         # float stays unscaled
+    np.testing.assert_allclose(TF.to_tensor(flt), 1.0)
+
+
+def test_require_version_bounds():
+    paddle.utils.require_version("1.0")
+    paddle.utils.require_version("1.0", "2.0")   # 2.0 allows 2.0.x
+    paddle.utils.require_version("2.0.0", "2.0.0")
+    import pytest as _pytest
+    with _pytest.raises(Exception):
+        paddle.utils.require_version("3.0")
+
+
+def test_fluid_nets_and_unique_name():
+    paddle.seed(3)
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        img = fluid.data("img", [None, 1, 28, 28], "float32")
+        # the recognize_digits conv net, verbatim from the book script
+        c1 = fluid.nets.simple_img_conv_pool(
+            img, num_filters=6, filter_size=5, pool_size=2,
+            pool_stride=2, act="relu")
+        c2 = fluid.nets.simple_img_conv_pool(
+            c1, num_filters=16, filter_size=5, pool_size=2,
+            pool_stride=2, act="relu")
+    exe = fluid.Executor()
+    out1, out2 = exe.run(
+        main, feed={"img": np.random.RandomState(0)
+                    .randn(2, 1, 28, 28).astype(np.float32)},
+        fetch_list=[c1, c2])
+    assert out1.shape == (2, 6, 12, 12)
+    assert out2.shape == (2, 16, 4, 4)
+
+    # glu halves the feature dim
+    x = paddle.to_tensor(np.random.RandomState(1)
+                         .randn(3, 8).astype(np.float32))
+    g = fluid.nets.glu(x)
+    assert list(np.asarray(g.numpy()).shape) == [3, 4]
+
+    a = fluid.unique_name.generate("fc")
+    b = fluid.unique_name.generate("fc")
+    assert a != b and a.startswith("fc")
+
+
+def test_fluid_softmax_ce_and_version():
+    logits = paddle.to_tensor(
+        np.random.RandomState(0).randn(4, 6).astype(np.float32))
+    lab = paddle.to_tensor(np.array([[1], [2], [3], [0]]))
+    out, sm = fluid.layers.softmax_with_cross_entropy(
+        logits, lab, return_softmax=True)
+    assert np.asarray(out.numpy()).shape[0] == 4
+    np.testing.assert_allclose(np.asarray(sm.numpy()).sum(-1), 1.0,
+                               rtol=1e-5)
+    import paddle_tpu.version as v
+    assert v.full_version and v.major == "2"
+    v.show()

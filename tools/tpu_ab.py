@@ -1,0 +1,70 @@
+#!/usr/bin/env python
+"""Interactive on-chip A/B harness: run one bench config under variant
+environments and print a comparison table.  For the perf-tuning session
+when the TPU tunnel is up (BASELINE.md headline configs) — e.g. is the
+Pallas flash-attention kernel actually faster than plain-XLA attention
+at BERT's seq 128, and does the space-to-depth stem pay off at 224^2?
+
+Usage:  python tools/tpu_ab.py bert
+        python tools/tpu_ab.py resnet50
+"""
+import json
+import os
+import subprocess
+import sys
+
+VARIANTS = {
+    "bert": [
+        ("pallas_flash", {"FLAGS_USE_PALLAS_KERNELS": "1"}),
+        ("xla_attention", {"FLAGS_USE_PALLAS_KERNELS": "0"}),
+    ],
+    "ernie": [
+        ("pallas_flash", {"FLAGS_USE_PALLAS_KERNELS": "1"}),
+        ("xla_attention", {"FLAGS_USE_PALLAS_KERNELS": "0"}),
+    ],
+    "resnet50": [
+        ("default", {}),
+    ],
+    "longseq": [
+        ("pallas_flash", {"FLAGS_USE_PALLAS_KERNELS": "1"}),
+    ],
+}
+
+
+def run(cfg, name, extra_env, timeout=1500):
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env.update(extra_env)
+    p = subprocess.run(
+        [sys.executable, os.path.join(os.path.dirname(__file__), "..",
+                                      "bench.py"), "--config", cfg],
+        env=env, capture_output=True, text=True, timeout=timeout)
+    for line in reversed(p.stdout.splitlines()):
+        if line.startswith("{") and '"metric"' in line:
+            d = json.loads(line)
+            if not d.get("partial"):
+                return d
+    return {"error": p.stderr[-300:]}
+
+
+def main():
+    cfg = sys.argv[1] if len(sys.argv) > 1 else "bert"
+    rows = []
+    for name, env in VARIANTS.get(cfg, [("default", {})]):
+        print(f"[ab] running {cfg} variant {name} ...", file=sys.stderr)
+        r = run(cfg, name, env)
+        rows.append((name, r))
+        print(json.dumps({"variant": name, **r}), flush=True)
+    best = max((r for _, r in rows if "value" in r),
+               key=lambda r: r.get("value", 0), default=None)
+    if best:
+        print(json.dumps({"metric": f"{cfg}_ab_best",
+                          "value": best.get("value"),
+                          "unit": best.get("unit", ""),
+                          "vs_baseline": best.get("vs_baseline", 0.0),
+                          "winner": [n for n, r in rows if r is best][0]}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()
