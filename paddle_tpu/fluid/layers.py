@@ -248,3 +248,333 @@ squeeze = paddle.squeeze
 unsqueeze = paddle.unsqueeze
 clip = paddle.clip
 mean = paddle.mean
+
+
+# -- builder tail (the static.nn surface: python/paddle/static/nn/
+#    __init__.py re-exports these from fluid.layers) -------------------
+
+def conv2d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    c_in = input.shape[1]
+    w = create_parameter([c_in, num_filters // groups, *filter_size],
+                         attr=param_attr)
+    out = F.conv2d_transpose(input, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size)
+    # (output_size resolves to output_padding inside F.conv2d_transpose)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        out = out + paddle.reshape(b, [1, num_filters, 1, 1])
+    return _apply_act(out, act)
+
+
+def conv3d(input, num_filters, filter_size, stride=1, padding=0,
+           dilation=1, groups=1, param_attr=None, bias_attr=None,
+           act=None, name=None):
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    c_in = input.shape[1]
+    w = create_parameter([num_filters, c_in // groups, *filter_size],
+                         attr=param_attr)
+    out = F.conv3d(input, w, stride=stride, padding=padding,
+                   dilation=dilation, groups=groups)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        out = out + paddle.reshape(b, [1, num_filters, 1, 1, 1])
+    return _apply_act(out, act)
+
+
+def conv3d_transpose(input, num_filters, filter_size=None, output_size=None,
+                     stride=1, padding=0, dilation=1, groups=1,
+                     param_attr=None, bias_attr=None, act=None, name=None):
+    if isinstance(filter_size, int):
+        filter_size = [filter_size] * 3
+    c_in = input.shape[1]
+    w = create_parameter([c_in, num_filters // groups, *filter_size],
+                         attr=param_attr)
+    out = F.conv3d_transpose(input, w, stride=stride, padding=padding,
+                             dilation=dilation, groups=groups,
+                             output_size=output_size)
+    if bias_attr is not False:
+        b = create_parameter([num_filters], attr=bias_attr, is_bias=True)
+        out = out + paddle.reshape(b, [1, num_filters, 1, 1, 1])
+    return _apply_act(out, act)
+
+
+def bilinear_tensor_product(x, y, size, act=None, name=None,
+                            param_attr=None, bias_attr=None):
+    """out_k = x^T W_k y + b_k (bilinear_tensor_product_op.cc)."""
+    dx, dy = x.shape[-1], y.shape[-1]
+    w = create_parameter([size, dx, dy], attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([size], attr=bias_attr, is_bias=True)
+    out = F.bilinear(x, y, w, b)
+    return _apply_act(out, act)
+
+
+def group_norm(input, groups, epsilon=1e-5, param_attr=None,
+               bias_attr=None, act=None, data_layout="NCHW", name=None):
+    c = input.shape[1 if data_layout == "NCHW" else -1]
+    w = create_parameter([c], attr=_ones_attr(param_attr))
+    b = create_parameter([c], attr=bias_attr, is_bias=True)
+    out = F.group_norm(input, num_groups=groups, weight=w, bias=b,
+                       epsilon=epsilon, data_format=data_layout)
+    return _apply_act(out, act)
+
+
+def instance_norm(input, epsilon=1e-5, param_attr=None, bias_attr=None,
+                  name=None):
+    c = input.shape[1]
+    w = create_parameter([c], attr=_ones_attr(param_attr))
+    b = create_parameter([c], attr=bias_attr, is_bias=True)
+    return F.instance_norm(input, weight=w, bias=b, eps=epsilon)
+
+
+def prelu(x, mode="all", param_attr=None, name=None):
+    """Trainable leaky slope: one alpha ('all'), per-channel ('channel'),
+    or per-element ('element') — prelu_op.cc."""
+    if mode == "all":
+        shape = [1]
+    elif mode == "channel":
+        shape = [x.shape[1]]
+    elif mode == "element":
+        shape = [int(d) for d in x.shape[1:]]
+    else:
+        raise ValueError(f"prelu mode {mode!r} not in all/channel/element")
+    from ..nn.initializer import Constant
+    from ..nn.layer_base import ParamAttr
+    alpha = create_parameter(
+        shape, attr=param_attr or ParamAttr(initializer=Constant(0.25)))
+    return F.prelu(x, alpha)
+
+
+def row_conv(input, future_context_size, param_attr=None, act=None):
+    d = input.shape[-1]
+    w = create_parameter([future_context_size + 1, d], attr=param_attr)
+    return _apply_act(F.row_conv(input, w), act)
+
+
+def data_norm(input, act=None, epsilon=1e-5, param_attr=None,
+              data_layout="NCHW", in_place=False, name=None,
+              moving_mean_name=None, moving_variance_name=None,
+              do_model_average_for_mean_and_var=True, slot_dim=-1,
+              sync_stats=False, summary_decay_rate=0.9999999,
+              enable_scale_and_shift=False):
+    """Global-statistics normalization (data_norm_op.cc): the batch
+    size/sum/square-sum accumulators are non-trainable parameters,
+    restorable by name like the reference's persistable stats."""
+    from ..nn.initializer import Constant
+    from ..nn.layer_base import ParamAttr
+    c = input.shape[-1 if data_layout != "NCHW" else 1]
+    stat = lambda init, nm: create_parameter(  # noqa: E731
+        [c], attr=ParamAttr(initializer=Constant(init), name=nm,
+                            trainable=False))
+    size = stat(1e4, None)
+    ssum = stat(0.0, moving_mean_name)
+    sqsum = stat(1e4, moving_variance_name)
+    out = F.data_norm(input, batch_size=size, batch_sum=ssum,
+                      batch_square_sum=sqsum, epsilon=epsilon)
+    return _apply_act(out, act)
+
+
+def spectral_norm(weight, dim=0, power_iters=1, eps=1e-12, name=None):
+    """Spectral normalization of a weight Variable/Tensor
+    (spectral_norm_op.cc): w / sigma_max, sigma estimated by
+    `power_iters` rounds from a created non-trainable u vector."""
+    import jax.numpy as jnp
+
+    from ..nn.initializer import Normal
+    from ..nn.layer_base import ParamAttr
+    from ..tensor import apply as _apply
+    shape = list(weight.shape)
+    h = int(shape[dim])
+    u = create_parameter(
+        [h], attr=ParamAttr(initializer=Normal(0.0, 1.0), trainable=False))
+
+    def f(w, uv):
+        wm = jnp.moveaxis(w, dim, 0).reshape(h, -1)
+        for _ in range(max(1, int(power_iters))):
+            v = wm.T @ uv
+            v = v / (jnp.linalg.norm(v) + eps)
+            uv = wm @ v
+            uv = uv / (jnp.linalg.norm(uv) + eps)
+        sigma = uv @ wm @ v
+        return w / (sigma + eps)
+
+    return _apply(f, weight, u)
+
+
+def crf_decoding(input, param_attr=None, label=None, length=None,
+                 transition=None):
+    """Viterbi decode of emissions under a (created or given) CRF
+    transition matrix (crf_decoding_op.cc); returns the best path.
+
+    The parameter uses the reference layout [c+2, c] (row 0 = start
+    transitions, row 1 = stop, rows 2.. = tag-to-tag); ViterbiDecoder
+    wants a square matrix over an augmented tag space with BOS/EOS as
+    the last two tags, so the layout is adapted here and the emissions
+    padded with -1e9 for the two virtual tags (never selected)."""
+    import jax.numpy as jnp
+
+    from ..tensor import apply as _apply
+    from ..text import ViterbiDecoder
+
+    c = int(input.shape[-1])
+    trans = transition
+    if trans is None:
+        trans = create_parameter([c + 2, c], attr=param_attr)
+
+    def to_square(t):
+        # [c+2, c] -> [(c+2), (c+2)]: tag block, bos row, eos column
+        sq = jnp.full((c + 2, c + 2), -1e9, t.dtype)
+        sq = sq.at[:c, :c].set(t[2:])          # tag -> tag
+        sq = sq.at[c, :c].set(t[0])            # BOS -> tag (start)
+        sq = sq.at[:c, c + 1].set(t[1])        # tag -> EOS (stop)
+        return sq
+
+    sq_trans = _apply(to_square, trans)
+    padded = _apply(
+        lambda v: jnp.concatenate(
+            [v, jnp.full(v.shape[:-1] + (2,), -1e9, v.dtype)], -1),
+        input)
+    if length is None:
+        # batch-shaped full-length vector, deferred-safe (the symbolic
+        # batch dim is unknown at capture time): sum of ones over L
+        n = int(input.shape[1])
+        length = cast(paddle.sum(input[:, :, 0] * 0 + 1, axis=1),
+                      "int64") * 0 + n
+    _, path = ViterbiDecoder(sq_trans, include_bos_eos_tag=True)(
+        padded, length)
+    return path
+
+
+def deform_conv2d(x, offset, mask, num_filters, filter_size, stride=1,
+                  padding=0, dilation=1, groups=1, deformable_groups=1,
+                  im2col_step=1, param_attr=None, bias_attr=None,
+                  name=None):
+    from ..vision.ops import deform_conv2d as _dcn
+    if isinstance(filter_size, int):
+        filter_size = [filter_size, filter_size]
+    c_in = x.shape[1]
+    w = create_parameter([num_filters, c_in // groups, *filter_size],
+                         attr=param_attr)
+    b = None
+    if bias_attr is not False:
+        b = create_parameter([num_filters], attr=bias_attr, is_bias=True)
+    return _dcn(x, offset, w, bias=b, stride=stride, padding=padding,
+                dilation=dilation, deformable_groups=deformable_groups,
+                groups=groups, mask=mask)
+
+
+def py_func(func, x, out, backward_func=None, skip_vars_in_backward_input=None):
+    """Host python inside a compiled program via jax.pure_callback
+    (py_func_op.cc analog: the callback runs on the host with numpy
+    arrays at execution time, even under jit).  `out` declares the
+    result spec: a Variable (or list) created with fluid.data /
+    create_parameter whose shape/dtype describe the output.
+    backward_func is not supported (jax derives gradients; a custom vjp
+    needs jax.custom_vjp on a pure function)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as _np
+
+    from ..tensor import apply as _apply
+    if backward_func is not None:
+        raise NotImplementedError(
+            "py_func backward_func: wrap the computation with "
+            "jax.custom_vjp instead (jax owns autodiff)")
+    outs = out if isinstance(out, (list, tuple)) else [out]
+    xs = x if isinstance(x, (list, tuple)) else [x]
+
+    def f(*vals):
+        # resolve dynamic (None/-1) out dims from the first input's
+        # TRACED shape — concrete at trace time, so the callback spec
+        # matches any runtime batch size
+        specs = []
+        for o in outs:
+            shape = tuple(
+                (vals[0].shape[j] if j < vals[0].ndim else 1)
+                if (d is None or d == -1) else int(d)
+                for j, d in enumerate(o.shape))
+            specs.append(jax.ShapeDtypeStruct(shape,
+                                              _np.dtype(str(o.dtype))))
+
+        def host(*arrs):
+            r = func(*arrs)
+            rs = r if isinstance(r, (list, tuple)) else [r]
+            return tuple(_np.asarray(v, s.dtype)
+                         for v, s in zip(rs, specs))
+        res = jax.pure_callback(host, tuple(specs), *vals)
+        return res if len(res) > 1 else res[0]
+
+    return _apply(f, *xs, _multi_out=len(outs) > 1)
+
+
+def nce(input, label, num_total_classes, **kwargs):
+    raise NotImplementedError(
+        "nce: host-side negative-sampling table is a documented non-goal "
+        "(COVERAGE.md); use softmax_with_cross_entropy over sampled "
+        "logits, or the full softmax — the TPU-native answer")
+
+
+def sparse_embedding(input, size, **kwargs):
+    raise NotImplementedError(
+        "sparse_embedding is part of the parameter-server stack "
+        "(SURVEY.md 2.5, documented non-goal); use fluid.layers."
+        "embedding / nn.Embedding — gradients are dense pytree arrays")
+
+
+def multi_box_head(inputs, image, base_size, num_classes, aspect_ratios,
+                   min_ratio=None, max_ratio=None, min_sizes=None,
+                   max_sizes=None, steps=None, step_w=None, step_h=None,
+                   offset=0.5, variance=(0.1, 0.1, 0.2, 0.2),
+                   flip=True, clip=False, kernel_size=1, pad=0, stride=1,
+                   name=None, min_max_aspect_ratios_order=False):
+    """SSD detection head (multi_box_head in fluid/layers/detection.py):
+    per feature level, conv loc (priors*4) + conf (priors*C) heads and
+    prior boxes; returns (mbox_locs, mbox_confs, boxes, variances)
+    concatenated across levels."""
+    from ..vision.ops import prior_box as _prior_box
+
+    if min_sizes is None:
+        n = len(inputs)
+        step = int((max_ratio - min_ratio) / (n - 2)) if n > 2 else 0
+        min_sizes, max_sizes = [], []
+        for ratio in range(min_ratio, max_ratio + 1, step or 1):
+            min_sizes.append(base_size * ratio / 100.0)
+            max_sizes.append(base_size * (ratio + step) / 100.0)
+        min_sizes = [base_size * 0.1] + min_sizes[:n - 1]
+        max_sizes = [base_size * 0.2] + max_sizes[:n - 1]
+    locs, confs, boxes_l, vars_l = [], [], [], []
+    for i, feat in enumerate(inputs):
+        mins = min_sizes[i] if isinstance(min_sizes[i], (list, tuple)) \
+            else [min_sizes[i]]
+        maxs = (max_sizes[i] if isinstance(max_sizes[i], (list, tuple))
+                else [max_sizes[i]]) if max_sizes else []
+        ar = aspect_ratios[i] if isinstance(aspect_ratios[i],
+                                            (list, tuple)) \
+            else [aspect_ratios[i]]
+        box, var = _prior_box(feat, image, min_sizes=list(mins),
+                              max_sizes=list(maxs) or None,
+                              aspect_ratios=list(ar), flip=flip,
+                              clip=clip, variance=list(variance),
+                              offset=offset)
+        h, w = int(feat.shape[2]), int(feat.shape[3])
+        num_priors = int(np.prod(box.shape[:-1])) // (h * w)
+        loc = conv2d(feat, num_priors * 4, kernel_size, padding=pad,
+                     stride=stride)
+        conf = conv2d(feat, num_priors * num_classes, kernel_size,
+                      padding=pad, stride=stride)
+        # batch dim -1 (symbolic at capture time); H/W/priors static
+        locs.append(paddle.reshape(paddle.transpose(loc, [0, 2, 3, 1]),
+                                   [-1, h * w * num_priors, 4]))
+        confs.append(paddle.reshape(paddle.transpose(conf, [0, 2, 3, 1]),
+                                    [-1, h * w * num_priors, num_classes]))
+        boxes_l.append(paddle.reshape(box, [-1, 4]))
+        vars_l.append(paddle.reshape(var, [-1, 4]))
+    return (paddle.concat(locs, axis=1), paddle.concat(confs, axis=1),
+            paddle.concat(boxes_l, axis=0), paddle.concat(vars_l, axis=0))

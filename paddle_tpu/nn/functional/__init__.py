@@ -355,9 +355,41 @@ def conv1d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
                  transpose=True, output_padding=output_padding)
 
 
+def _transpose_output_padding(x, weight, stride, padding, dilation,
+                              output_size, nsp, data_format):
+    """Requested output_size -> per-dim output_padding (conv_transpose
+    semantics: out = (in-1)*s - 2*p + d*(k-1) + 1 + output_padding)."""
+    if output_size is None:
+        return 0
+    sizes = ([output_size] * nsp if isinstance(output_size, int)
+             else list(output_size))
+    channel_last = data_format[-1] == "C"
+    xshape = list(getattr(x, "shape", None) or np.shape(unwrap(x)))
+    wshape = list(getattr(weight, "shape", None) or np.shape(unwrap(weight)))
+    in_sp = xshape[1:1 + nsp] if channel_last else xshape[2:2 + nsp]
+    k_sp = wshape[-nsp:]
+    s = [stride] * nsp if isinstance(stride, int) else list(stride)
+    p = [padding] * nsp if isinstance(padding, int) else list(padding)
+    d = [dilation] * nsp if isinstance(dilation, int) else list(dilation)
+    out_pad = []
+    for i in range(nsp):
+        base = (in_sp[i] - 1) * s[i] - 2 * p[i] + d[i] * (k_sp[i] - 1) + 1
+        op_i = int(sizes[i]) - base
+        if not 0 <= op_i < s[i] + 1:
+            raise ValueError(
+                f"output_size[{i}]={sizes[i]} unreachable: base deconv "
+                f"size is {base}, output_padding must be in [0, {s[i]}]")
+        out_pad.append(op_i)
+    return out_pad
+
+
 def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None, data_format="NCHW",
                      name=None):
+    if output_size is not None:
+        output_padding = _transpose_output_padding(
+            x, weight, stride, padding, dilation, output_size, 2,
+            data_format)
     return _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
                  2, transpose=True, output_padding=output_padding)
 
@@ -365,6 +397,10 @@ def conv2d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0
 def conv3d_transpose(x, weight, bias=None, stride=1, padding=0, output_padding=0,
                      dilation=1, groups=1, output_size=None, data_format="NCDHW",
                      name=None):
+    if output_size is not None:
+        output_padding = _transpose_output_padding(
+            x, weight, stride, padding, dilation, output_size, 3,
+            data_format)
     return _conv(x, weight, bias, stride, padding, dilation, groups, data_format,
                  3, transpose=True, output_padding=output_padding)
 
@@ -1889,9 +1925,21 @@ def crf_decoding(input, transition, seq_len=None, label=None, name=None):
     return paths
 
 
-def data_norm(input, epsilon=1e-5, **kwargs):
-    """data_norm_op.cc: normalize by accumulated batch statistics — the
-    stateless form normalizes with the batch's own moments."""
+def data_norm(input, batch_size=None, batch_sum=None,
+              batch_square_sum=None, epsilon=1e-4, **kwargs):
+    """data_norm_op.cc: normalize by ACCUMULATED statistics when the
+    size/sum/square-sum accumulators are given (mean = sum/size,
+    scale = rsqrt(square_sum/size - mean^2 + eps) — the op's serving
+    path); falls back to the batch's own moments without them."""
+    if batch_size is not None and batch_sum is not None \
+            and batch_square_sum is not None:
+        def f(v, n, s, sq):
+            mean = s / n
+            scale = jax.lax.rsqrt(sq / n - mean * mean + epsilon)
+            return (v - mean) * scale
+
+        return apply(f, input, batch_size, batch_sum, batch_square_sum)
+
     def f(v):
         mu = v.mean(0, keepdims=True)
         var = v.var(0, keepdims=True)

@@ -400,3 +400,22 @@ def fori_collect(lower, upper, body, init):
         return carry, y
 
     return jax.lax.scan(scan_body, init, jnp.arange(lower, upper))
+
+
+# -- builder surface (reference python/paddle/static/nn/__init__.py
+#    re-exports these from fluid.layers; imported lazily to avoid the
+#    static <-> fluid import cycle at package-init time) --------------
+def __getattr__(name):
+    _builders = {
+        "fc", "embedding", "conv2d", "conv2d_transpose", "conv3d",
+        "conv3d_transpose", "batch_norm", "layer_norm", "group_norm",
+        "instance_norm", "data_norm", "bilinear_tensor_product", "prelu",
+        "row_conv", "spectral_norm", "crf_decoding", "deform_conv2d",
+        "py_func", "nce", "sparse_embedding", "multi_box_head",
+        "create_parameter",
+    }
+    if name in _builders:
+        from ..fluid import layers as _fl
+
+        return getattr(_fl, name)
+    raise AttributeError(name)

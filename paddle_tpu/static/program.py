@@ -14,9 +14,11 @@ compiles into exactly the same XLA program a `to_static` rewrite would
 produce.  Real `nn.Layer` parameters stay eager Tensors: trainable ones
 become differentiable jit inputs, everything else is baked constant.
 
-Deliberate limits (documented divergence, README "static graph" section):
-multi-output deferred ops and data-dependent python control flow inside a
-program_guard block are not capturable — use `to_static` for those.
+Deliberate limit (documented divergence, README "static graph" section):
+data-dependent python control flow inside a program_guard block is not
+capturable — use `to_static` (or static.nn.cond/while_loop) for that.
+Multi-output ops capture as one shared op node with per-output index
+Variables (_build).
 """
 from __future__ import annotations
 
@@ -149,6 +151,11 @@ class Variable:
     def __neg__(self):
         return self._op("scale", -1.0)
 
+    def __getitem__(self, idx):
+        from ..tensor import apply as _apply
+
+        return _apply(lambda v: v[idx], self)
+
     # comparisons defer too (fluid.layers.accuracy: argmax(pred) == label);
     # identity hashing is preserved — the capture machinery keys on id()
     def __eq__(self, o):
@@ -193,12 +200,20 @@ def _is_deferred(args, kwargs):
 
 
 def _build(fn, args, kwargs, multi):
-    if multi:
-        raise NotImplementedError(
-            "multi-output ops cannot be captured into a static Program; "
-            "wrap this computation with paddle.jit.to_static instead "
-            "(README: static-graph compatibility)")
-    return Variable(fn=fn, args=args, kwargs=kwargs)
+    if not multi:
+        return Variable(fn=fn, args=args, kwargs=kwargs)
+    # Multi-output op (topk, ViterbiDecoder, ...): one shared op node
+    # evaluates the function once; each returned Variable indexes into
+    # its tuple result.  The output count comes from abstract shape
+    # evaluation at capture time (jax.eval_shape over the DAG, the same
+    # machinery Variable.shape uses).
+    op = Variable(fn=fn, args=args, kwargs=kwargs)
+    outs = op._abstract()
+    if not isinstance(outs, (tuple, list)):
+        return Variable(fn=fn, args=args, kwargs=kwargs)
+    return tuple(
+        Variable(fn=(lambda t, _i=i: t[_i]), args=(op,))
+        for i in range(len(outs)))
 
 
 register_deferred_hook(_is_deferred, _build)
@@ -220,9 +235,13 @@ def _cache_put(cache, key, entry):
 
 
 def collect_params(fetch_vars):
-    """Trainable eager Tensors captured by the DAG (stop_gradient False)."""
+    """Trainable eager Tensors captured by the DAG (stop_gradient False).
+    Eager Tensors can appear directly in a fetch list (host-computed
+    outputs like prior_box) — they capture no parameters themselves."""
     params = []
     for v in fetch_vars:
+        if not isinstance(v, Variable):
+            continue
         for t in v.tensors():
             if not t.stop_gradient and not any(t is p for p in params):
                 params.append(t)
@@ -257,8 +276,13 @@ def _eval_fn(fetch_vars, leaf_names, params):
 
 
 def evaluate(fetch_vars, feed, params=None, jit_cache=None):
-    """Evaluate DAG nodes under jax.jit.  feed: {name: array}."""
-    fetch_vars = [v for v in fetch_vars]
+    """Evaluate DAG nodes under jax.jit.  feed: {name: array}.
+    Eager Tensors in the fetch list (host-computed values like
+    prior_box outputs) pass through without entering the jit."""
+    all_fetches = list(fetch_vars)
+    eager = {i: v for i, v in enumerate(all_fetches)
+             if not isinstance(v, Variable)}
+    fetch_vars = [v for v in all_fetches if isinstance(v, Variable)]
     leaves = []
     for v in fetch_vars:
         for leaf in v.leaves():
@@ -290,7 +314,10 @@ def evaluate(fetch_vars, feed, params=None, jit_cache=None):
     else:
         jf = jax.jit(f)
     outs = jf(feed_vals, param_vals)
-    return [np.asarray(o) for o in outs]
+    # re-interleave eager fetches at their original positions
+    it = iter(np.asarray(o) for o in outs)
+    return [np.asarray(unwrap(eager[i])) if i in eager else next(it)
+            for i in range(len(all_fetches))]
 
 
 def train_step(loss_var, optimizer, feed, fetch_list, jit_cache=None):

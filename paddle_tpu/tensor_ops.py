@@ -381,7 +381,11 @@ def _as_dim(s):
 def reshape(x, shape, name=None):
     if isinstance(shape, Tensor):
         shape = shape.tolist()
-    xs = x.shape if isinstance(x, Tensor) else list(np.shape(unwrap(x)))
+    # Tensors and deferred Variables both expose .shape; raw arrays via
+    # np.shape.  NB: a Variable's batch dim reports the placeholder (1),
+    # so 0-copy of a symbolic batch dim would bake it — prefer -1 there.
+    xs = (x.shape if hasattr(x, "shape") and not isinstance(x, np.ndarray)
+          else list(np.shape(unwrap(x))))
     # paddle semantics: 0 means "copy this dim from input"
     def _is_zero(s):
         return isinstance(s, (int, np.integer)) and s == 0
@@ -427,10 +431,14 @@ def flatten(x, start_axis=0, stop_axis=-1, name=None):
 
 def concat(x, axis=0, name=None):
     xs = list(x)
-    tensor_inputs = [t for t in xs if isinstance(t, Tensor)]
+    # spread through apply when ANY element is a Tensor or a deferred
+    # Variable (a list arg hides Variables from the deferred-hook check;
+    # raw jnp.concatenate cannot consume them)
+    wrapped = [t for t in xs if isinstance(t, Tensor)
+               or type(t).__name__ == "Variable"]
     ax = int(unwrap(axis)) if not isinstance(axis, int) else axis
-    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *xs) if tensor_inputs else \
-        Tensor(jnp.concatenate([unwrap(v) for v in xs], axis=ax))
+    return apply(lambda *vs: jnp.concatenate(vs, axis=ax), *xs) if wrapped \
+        else Tensor(jnp.concatenate([unwrap(v) for v in xs], axis=ax))
 
 
 def stack(x, axis=0, name=None):

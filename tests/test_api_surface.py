@@ -55,6 +55,7 @@ def _ref_names(path):
     ("optimizer", "optimizer/__init__.py"),
     ("io", "io/__init__.py"),
     ("static", "static/__init__.py"),
+    ("static.nn", "static/nn/__init__.py"),
     ("jit", "jit/__init__.py"),
     ("amp", "amp/__init__.py"),
     ("vision", "vision/__init__.py"),

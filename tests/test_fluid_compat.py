@@ -3,6 +3,7 @@ shapes must run unchanged (reference fluid/tests/book style).  Programs
 are deferred expression DAGs under the hood (static/program.py) — no
 ProgramDesc — but the workflow below is byte-for-byte the fluid idiom."""
 import numpy as np
+import pytest
 
 import paddle_tpu.fluid as fluid
 import paddle_tpu as paddle
@@ -182,6 +183,136 @@ def test_fluid_nets_and_unique_name():
     a = fluid.unique_name.generate("fc")
     b = fluid.unique_name.generate("fc")
     assert a != b and a.startswith("fc")
+
+
+def test_static_nn_builders():
+    """static.nn re-exports the fluid builder surface (reference
+    static/nn/__init__.py); builders create params and compute right."""
+    sn = paddle.static.nn
+    paddle.seed(6)
+    rs = np.random.RandomState(0)
+
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.data("x", [None, 3, 8, 8], "float32")
+        ct = sn.conv2d_transpose(x, num_filters=5, filter_size=3, stride=2)
+        gn = sn.group_norm(x, groups=3)
+        inorm = sn.instance_norm(x)
+        pr = sn.prelu(x, mode="channel")
+        a = fluid.data("a", [None, 4], "float32")
+        b = fluid.data("b", [None, 6], "float32")
+        bt = sn.bilinear_tensor_product(a, b, size=7)
+    feed = {"x": rs.randn(2, 3, 8, 8).astype(np.float32),
+            "a": rs.randn(2, 4).astype(np.float32),
+            "b": rs.randn(2, 6).astype(np.float32)}
+    ctv, gnv, inv, prv, btv = fluid.Executor().run(
+        main, feed=feed, fetch_list=[ct, gn, inorm, pr, bt])
+    assert ctv.shape == (2, 5, 17, 17)
+    np.testing.assert_allclose(gnv.mean(), 0.0, atol=1e-4)
+    np.testing.assert_allclose(inv.mean(axis=(2, 3)), 0.0, atol=1e-4)
+    assert prv.shape == (2, 3, 8, 8)
+    assert btv.shape == (2, 7)
+
+    # spectral_norm: result has max singular value ~1 along dim 0
+    w = paddle.to_tensor(rs.randn(6, 4).astype(np.float32) * 3)
+    wsn = paddle.static.nn.spectral_norm(w, dim=0, power_iters=20)
+    s = np.linalg.svd(np.asarray(wsn.numpy()), compute_uv=False)
+    np.testing.assert_allclose(s[0], 1.0, rtol=1e-3)
+
+    # loud non-goal stubs
+    import pytest as _pytest
+    with _pytest.raises(NotImplementedError, match="non-goal"):
+        sn.nce(None, None, 10)
+    with _pytest.raises(NotImplementedError, match="parameter-server"):
+        sn.sparse_embedding(None, [10, 4])
+
+
+def test_crf_decoding_and_multi_box_head():
+    """Review fixes: crf_decoding's [c+2, c] layout adapts to the
+    square ViterbiDecoder space; multi_box_head captures with symbolic
+    batch dims."""
+    from paddle_tpu.nn.layer_base import ParamAttr
+    from paddle_tpu.nn.initializer import Constant
+
+    paddle.seed(8)
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        em = fluid.data("em", [None, 6, 3], "float32")
+        path = paddle.static.nn.crf_decoding(
+            em, param_attr=ParamAttr(initializer=Constant(0.0)))
+    rs = np.random.RandomState(0)
+    E = rs.randn(2, 6, 3).astype(np.float32)
+    pv, = fluid.Executor().run(main, feed={"em": E}, fetch_list=[path])
+    # zero transitions: best path == per-step argmax of emissions
+    np.testing.assert_array_equal(pv, E.argmax(-1))
+
+    main2 = fluid.Program()
+    with fluid.program_guard(main2):
+        f1 = fluid.data("f1", [None, 8, 4, 4], "float32")
+        f2 = fluid.data("f2", [None, 8, 2, 2], "float32")
+        img = fluid.data("img", [None, 3, 32, 32], "float32")
+        locs, confs, boxes, vars_ = paddle.static.nn.multi_box_head(
+            [f1, f2], img, base_size=32, num_classes=5,
+            aspect_ratios=[[2.0], [2.0]], min_ratio=20, max_ratio=90)
+    feed = {"f1": rs.randn(3, 8, 4, 4).astype(np.float32),
+            "f2": rs.randn(3, 8, 2, 2).astype(np.float32),
+            "img": rs.randn(3, 3, 32, 32).astype(np.float32)}
+    lv, cv, bv, vv = fluid.Executor().run(
+        main2, feed=feed, fetch_list=[locs, confs, boxes, vars_])
+    assert lv.shape[0] == 3 and lv.shape[2] == 4      # batch 3 survives
+    assert cv.shape[:2] == lv.shape[:2] and cv.shape[2] == 5
+    assert bv.shape == (lv.shape[1], 4) == vv.shape
+
+
+def test_conv_transpose_output_size_and_data_norm_stats():
+    torch = pytest.importorskip("torch")
+    rs = np.random.RandomState(0)
+    F2 = paddle.nn.functional
+    x = rs.randn(1, 3, 7, 7).astype(np.float32)
+    w = rs.randn(3, 4, 3, 3).astype(np.float32)
+    # stride 2 base output is 15; request 16 -> output_padding 1
+    got = np.asarray(F2.conv2d_transpose(
+        paddle.to_tensor(x), paddle.to_tensor(w), stride=2,
+        output_size=[16, 16]).numpy())
+    ref = torch.nn.functional.conv_transpose2d(
+        torch.tensor(x), torch.tensor(w), stride=2,
+        output_padding=1).numpy()
+    assert got.shape == (1, 4, 16, 16)
+    np.testing.assert_allclose(got, ref, rtol=1e-4, atol=1e-4)
+
+    # data_norm uses the GIVEN accumulators, not the batch's moments
+    xb = rs.randn(4, 3).astype(np.float32)
+    n = np.full((3,), 100.0, np.float32)
+    s = np.full((3,), 50.0, np.float32)      # mean 0.5
+    sq = np.full((3,), 125.0, np.float32)    # var 1.25 - 0.25 = 1.0
+    got = np.asarray(F2.data_norm(
+        paddle.to_tensor(xb), batch_size=paddle.to_tensor(n),
+        batch_sum=paddle.to_tensor(s),
+        batch_square_sum=paddle.to_tensor(sq)).numpy())
+    np.testing.assert_allclose(got, (xb - 0.5) / np.sqrt(1.0 + 1e-4),
+                               rtol=1e-4)
+
+
+def test_py_func_host_callback():
+    """py_func runs arbitrary host python inside the compiled program
+    (jax.pure_callback under jit — the py_func_op.cc analog)."""
+    main = fluid.Program()
+    with fluid.program_guard(main):
+        x = fluid.data("x", [None, 3], "float32")
+        spec = fluid.data("o", [None, 3], "float32")  # out spec holder
+
+        def host_fn(arr):
+            return np.sort(arr, axis=-1)[:, ::-1].copy()  # numpy-only op
+
+        y = paddle.static.nn.py_func(host_fn, x, spec)
+        z = y * 2.0
+    # batch size 2 != the spec's placeholder 1: dynamic dims must
+    # resolve from the traced input shape
+    X = np.array([[3.0, 1.0, 2.0], [0.0, 5.0, 4.0]], np.float32)
+    yv, zv = fluid.Executor().run(main, feed={"x": X},
+                                  fetch_list=[y, z])
+    np.testing.assert_allclose(yv, [[3.0, 2.0, 1.0], [5.0, 4.0, 0.0]])
+    np.testing.assert_allclose(zv, 2 * yv)
 
 
 def test_fluid_softmax_ce_and_version():

@@ -8,6 +8,23 @@ import paddle_tpu as paddle
 from paddle_tpu import static
 
 
+def test_multi_output_op_captures():
+    """topk (a _multi_out op) must capture into the program as one
+    shared op node whose outputs are index Variables — both outputs
+    evaluate from a single op run and fetches agree with eager."""
+    main = static.Program()
+    with static.program_guard(main):
+        x = static.data("x", [None, 4], "float32")
+        vals, idx = paddle.topk(x, k=2, axis=-1)
+        total = vals.sum()
+    X = np.array([[0.0, 3.0, 1.0, 2.0]], np.float32)
+    v, i, t = static.Executor().run(
+        main, feed={"x": X}, fetch_list=[vals, idx, total])
+    assert i.tolist() == [[1, 3]]
+    np.testing.assert_allclose(v, [[3.0, 2.0]])
+    np.testing.assert_allclose(float(t), 5.0)
+
+
 class TestProgramCapture:
     def test_data_returns_symbolic_variable(self):
         x = static.data("x", [None, 4], "float32")
