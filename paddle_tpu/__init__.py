@@ -237,3 +237,4 @@ standard_normal = randn  # noqa: F405 — tensor/random.py alias
 # fluid compat namespace LAST: fluid.layers re-exports the legacy
 # aliases defined above (fill_constant etc.) at import time
 from . import fluid  # noqa: E402,F401
+from . import dataset  # noqa: E402,F401 — ref python/paddle/dataset/

@@ -1,0 +1,27 @@
+"""`paddle.dataset` — the fluid-era reader-creator dataset package.
+
+Reference parity: python/paddle/dataset/ (mnist.py:96 train/test,
+uci_housing.py:91, imdb.py:106, imikolov.py:119, cifar.py, movielens.py,
+wmt14.py:122, wmt16.py, conll05.py, flowers.py, voc2012.py, common.py,
+image.py).  Every classic book script opens with
+``paddle.dataset.mnist.train()`` — these adapters serve the SAME sample
+tuples from the modern Dataset classes (zero-egress house rule: local
+files when present, deterministic synthetic fallbacks otherwise).
+"""
+from . import cifar  # noqa: F401
+from . import common  # noqa: F401
+from . import conll05  # noqa: F401
+from . import flowers  # noqa: F401
+from . import image  # noqa: F401
+from . import imdb  # noqa: F401
+from . import imikolov  # noqa: F401
+from . import mnist  # noqa: F401
+from . import movielens  # noqa: F401
+from . import uci_housing  # noqa: F401
+from . import voc2012  # noqa: F401
+from . import wmt14  # noqa: F401
+from . import wmt16  # noqa: F401
+
+__all__ = ["mnist", "cifar", "uci_housing", "imdb", "imikolov",
+           "movielens", "wmt14", "wmt16", "conll05", "flowers",
+           "voc2012", "common", "image"]

@@ -1,0 +1,42 @@
+"""dataset.cifar — reader creators (reference dataset/cifar.py):
+(3072-float32 image in [0, 1], int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train10", "test10", "train100", "test100"]
+
+
+def _reader_creator(cls_name, mode):
+    def reader():
+        from ..vision import datasets as D
+
+        ds = getattr(D, cls_name)(mode=mode)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            arr = np.asarray(img, np.float32).reshape(-1)
+            if arr.max() > 1.5:
+                arr = arr / 255.0
+            yield arr, int(np.asarray(lab))
+
+    return reader
+
+
+def train10():
+    return _reader_creator("Cifar10", "train")
+
+
+def test10():
+    return _reader_creator("Cifar10", "test")
+
+
+def train100():
+    return _reader_creator("Cifar100", "train")
+
+
+def test100():
+    return _reader_creator("Cifar100", "test")
+
+
+def fetch():
+    pass

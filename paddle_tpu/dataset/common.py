@@ -1,0 +1,38 @@
+"""dataset.common — cache-dir + checksum helpers
+(reference python/paddle/dataset/common.py: DATA_HOME, md5file,
+download)."""
+from __future__ import annotations
+
+import hashlib
+import os
+
+from ..utils.download import get_path_from_url
+
+__all__ = ["DATA_HOME", "md5file", "download"]
+
+DATA_HOME = os.path.expanduser("~/.cache/paddle_tpu/datasets")
+
+
+def md5file(fname):
+    h = hashlib.md5()
+    with open(fname, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def download(url, module_name, md5sum, save_name=None):
+    """Cached fetch under DATA_HOME/<module_name> (local-cache-aware:
+    pre-seeded files are used without any network touch).  `save_name`
+    renames the artifact so callers that open DATA_HOME/<module>/<name>
+    find it there."""
+    root = os.path.join(DATA_HOME, module_name)
+    if save_name is not None:
+        target = os.path.join(root, save_name)
+        if os.path.exists(target):
+            return target
+        got = get_path_from_url(url, root, md5sum)
+        if got != target:
+            os.replace(got, target)
+        return target
+    return get_path_from_url(url, root, md5sum)

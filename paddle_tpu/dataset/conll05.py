@@ -1,0 +1,34 @@
+"""dataset.conll05 — SRL reader creator (reference dataset/conll05.py):
+test() yields the 9-tuple (word, ctx_n2..ctx_p2, pred, mark, label)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["get_dict", "get_embedding", "test"]
+
+
+def _ds():
+    from ..text import Conll05st
+
+    return Conll05st()
+
+
+def get_dict():
+    return _ds().get_dict()
+
+
+def get_embedding():
+    return _ds().get_embedding()
+
+
+def test():
+    def reader():
+        ds = _ds()
+        for i in range(len(ds)):
+            yield tuple(np.asarray(c).tolist() for c in ds[i])
+
+    return reader
+
+
+def fetch():
+    pass

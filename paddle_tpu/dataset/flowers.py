@@ -1,0 +1,35 @@
+"""dataset.flowers — reader creators (reference dataset/flowers.py):
+(CHW float32 image, int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "valid"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..vision.datasets import Flowers
+
+        ds = Flowers(mode=mode)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            yield np.asarray(img, np.float32), int(np.asarray(lab))
+
+    return reader
+
+
+def train(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader_creator("train")
+
+
+def test(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader_creator("test")
+
+
+def valid(mapper=None, buffered_size=1024, use_xmap=True):
+    return _reader_creator("test")
+
+
+def fetch():
+    pass

@@ -1,0 +1,82 @@
+"""dataset.image — host-side image helpers for reader pipelines
+(reference python/paddle/dataset/image.py: load_image, simple_transform,
+resize_short, center_crop, left_right_flip, to_chw).  numpy/PIL based —
+this feeds readers, not XLA."""
+from __future__ import annotations
+
+import numpy as np
+
+from ..vision.transforms import functional as _F
+
+__all__ = ["load_image", "resize_short", "center_crop", "random_crop",
+           "left_right_flip", "to_chw", "simple_transform",
+           "load_and_transform"]
+
+
+def load_image(file_path, is_color=True):
+    from PIL import Image
+
+    img = Image.open(file_path)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def resize_short(im, size):
+    """Resize so the SHORT side equals `size` (reference image.py)."""
+    return np.asarray(_F.resize(im, int(size)))
+
+
+def center_crop(im, size, is_color=True):
+    return np.asarray(_F.center_crop(im, int(size)))
+
+
+def left_right_flip(im, is_color=True):
+    return np.asarray(_F.hflip(im))
+
+
+def to_chw(im, order=(2, 0, 1)):
+    arr = np.asarray(im)
+    if arr.ndim == 2:
+        arr = arr[..., None]
+    return arr.transpose(order)
+
+
+def random_crop(im, size, is_color=True):
+    from ..io import _host_rng
+
+    arr = np.asarray(im)
+    h, w = arr.shape[0], arr.shape[1]
+    rng = _host_rng()
+    y = rng.randint(0, max(h - size, 0) + 1)
+    x = rng.randint(0, max(w - size, 0) + 1)
+    return arr[y:y + size, x:x + size]
+
+
+def simple_transform(im, resize_size, crop_size, is_train, is_color=True,
+                     mean=None):
+    """resize_short -> (random|center) crop (+ train-time flip) -> CHW
+    float32, optionally mean-subtracted (reference image.py
+    simple_transform)."""
+    im = resize_short(im, resize_size)
+    if is_train:
+        im = random_crop(im, crop_size, is_color)
+        from ..io import _host_rng
+
+        if _host_rng().rand() < 0.5:
+            im = left_right_flip(im, is_color)
+    else:
+        im = center_crop(im, crop_size, is_color)
+    im = to_chw(im).astype(np.float32)
+    if mean is not None:
+        mean = np.asarray(mean, np.float32)
+        if mean.ndim == 1 and mean.size == im.shape[0]:
+            im -= mean.reshape(-1, 1, 1)   # per-channel mean over CHW
+        else:
+            im -= mean                      # scalar or full-image array
+    return im
+
+
+def load_and_transform(filename, resize_size, crop_size, is_train,
+                       is_color=True, mean=None):
+    return simple_transform(load_image(filename, is_color), resize_size,
+                            crop_size, is_train, is_color, mean)

@@ -1,0 +1,45 @@
+"""dataset.imdb — reader creators (reference dataset/imdb.py:106):
+train/test take a word_idx dict and yield (word-id list, 0/1 label);
+word_dict() builds the vocabulary."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["build_dict", "train", "test", "word_dict"]
+
+
+def word_dict():
+    from ..text import Imdb
+
+    ds = Imdb(mode="train")
+    return dict(ds.word_idx)
+
+
+def build_dict(pattern=None, cutoff=None):
+    """Reference signature build_dict(pattern, cutoff) — args accepted
+    for compatibility; the vocabulary comes from the dataset itself."""
+    return word_dict()
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..text import Imdb
+
+        ds = Imdb(mode=mode)
+        for i in range(len(ds)):
+            doc, lab = ds[i]
+            yield [int(t) for t in np.asarray(doc)], int(np.asarray(lab))
+
+    return reader
+
+
+def train(word_idx=None):
+    return _reader_creator("train")
+
+
+def test(word_idx=None):
+    return _reader_creator("test")
+
+
+def fetch():
+    pass

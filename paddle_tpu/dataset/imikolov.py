@@ -1,0 +1,57 @@
+"""dataset.imikolov — n-gram LM reader creators (reference
+dataset/imikolov.py:119): samples are n-token tuples of word ids."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["build_dict", "train", "test"]
+
+
+class DataType:
+    NGRAM = 1
+    SEQ = 2
+
+
+def build_dict(min_word_freq=50):
+    from ..text import Imikolov
+
+    return dict(Imikolov(mode="train").word_idx)
+
+
+def _reader_creator(mode, n, data_type):
+    if data_type == DataType.SEQ:
+        def seq_reader():
+            from ..text import Imikolov
+
+            ds = Imikolov(mode=mode, window_size=max(int(n), 2))
+            for i in range(len(ds)):
+                ctx, nxt = ds[i]
+                # SEQ: one id list per sample (reference imikolov.py:137
+                # yields the whole sentence as word ids)
+                yield [int(t) for t in np.asarray(ctx)] + \
+                    [int(t) for t in np.asarray(nxt)]
+
+        return seq_reader
+
+    def reader():
+        from ..text import Imikolov
+
+        ds = Imikolov(mode=mode, window_size=max(int(n), 2))
+        for i in range(len(ds)):
+            ctx, nxt = ds[i]
+            yield tuple(int(t) for t in np.asarray(ctx)) + \
+                tuple(int(t) for t in np.asarray(nxt))
+
+    return reader
+
+
+def train(word_idx=None, n=5, data_type=DataType.NGRAM):
+    return _reader_creator("train", n, data_type)
+
+
+def test(word_idx=None, n=5, data_type=DataType.NGRAM):
+    return _reader_creator("test", n, data_type)
+
+
+def fetch():
+    pass

@@ -1,0 +1,38 @@
+"""dataset.mnist — reader creators (reference dataset/mnist.py:96).
+
+Samples match the reference: (784-float32 image scaled to [-1, 1],
+int label)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..vision.datasets import MNIST
+
+        ds = MNIST(mode=mode)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            arr = np.asarray(img, np.float32).reshape(-1)
+            if arr.max() > 1.5:          # raw 0..255 -> [-1, 1]
+                arr = arr / 127.5 - 1.0
+            elif arr.max() <= 1.0 and arr.min() >= 0.0:
+                arr = arr * 2.0 - 1.0    # [0,1] -> [-1,1]
+            yield arr, int(np.asarray(lab))
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")
+
+
+def fetch():
+    pass

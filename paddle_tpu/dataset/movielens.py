@@ -1,0 +1,39 @@
+"""dataset.movielens — reader creators (reference dataset/movielens.py):
+([user_id], [movie_id], [rating]) feature rows."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "max_user_id", "max_movie_id"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..text import Movielens
+
+        ds = Movielens(mode=mode)
+        for i in range(len(ds)):
+            (u, m), r = ds[i]
+            yield [int(u)], [int(m)], [float(np.asarray(r))]
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")
+
+
+def max_user_id():
+    return 500
+
+
+def max_movie_id():
+    return 1000
+
+
+def fetch():
+    pass

@@ -1,0 +1,31 @@
+"""dataset.uci_housing — reader creators (reference
+dataset/uci_housing.py:91): (13-float feature vector, [price])."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..text import UCIHousing
+
+        ds = UCIHousing(mode=mode)
+        for i in range(len(ds)):
+            x, y = ds[i]
+            yield np.asarray(x, np.float32), np.asarray(y, np.float32)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")
+
+
+def fetch():
+    pass

@@ -1,0 +1,35 @@
+"""dataset.voc2012 — segmentation reader creators (reference
+dataset/voc2012.py): (image HWC uint8, label mask HW uint8)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "val"]
+
+
+def _reader_creator(mode):
+    def reader():
+        from ..vision.datasets import VOC2012
+
+        ds = VOC2012(mode=mode)
+        for i in range(len(ds)):
+            img, lab = ds[i]
+            yield np.asarray(img), np.asarray(lab)
+
+    return reader
+
+
+def train():
+    return _reader_creator("train")
+
+
+def test():
+    return _reader_creator("test")
+
+
+def val():
+    return _reader_creator("valid")
+
+
+def fetch():
+    pass

@@ -1,0 +1,40 @@
+"""dataset.wmt14 — translation reader creators (reference
+dataset/wmt14.py:122): (src_ids, trg_ids, trg_ids_next)."""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["train", "test", "get_dict"]
+
+
+def _reader_creator(mode, dict_size, cls_name="WMT14"):
+    def reader():
+        from .. import text as T
+
+        ds = getattr(T, cls_name)(mode=mode, dict_size=dict_size)
+        for i in range(len(ds)):
+            src, trg, trg_next = ds[i]
+            yield ([int(t) for t in np.asarray(src)],
+                   [int(t) for t in np.asarray(trg)],
+                   [int(t) for t in np.asarray(trg_next)])
+
+    return reader
+
+
+def train(dict_size=30000):
+    return _reader_creator("train", dict_size)
+
+
+def test(dict_size=30000):
+    return _reader_creator("test", dict_size)
+
+
+def get_dict(dict_size=30000, reverse=True):
+    d = {i: f"tok{i}" for i in range(dict_size)}
+    if not reverse:
+        d = {v: k for k, v in d.items()}
+    return d, dict(d)
+
+
+def fetch():
+    pass

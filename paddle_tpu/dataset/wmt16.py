@@ -1,0 +1,23 @@
+"""dataset.wmt16 — reader creators (reference dataset/wmt16.py); same
+sample tuples as wmt14 over the WMT16 split."""
+from __future__ import annotations
+
+from . import wmt14 as _w
+
+__all__ = ["train", "test", "get_dict"]
+
+
+def train(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return _w._reader_creator("train", src_dict_size, cls_name="WMT16")
+
+
+def test(src_dict_size=30000, trg_dict_size=30000, src_lang="en"):
+    return _w._reader_creator("test", src_dict_size, cls_name="WMT16")
+
+
+def get_dict(lang, dict_size=30000, reverse=False):
+    return _w.get_dict(dict_size, reverse)[0]
+
+
+def fetch():
+    pass

@@ -56,6 +56,7 @@ def _ref_names(path):
     ("io", "io/__init__.py"),
     ("static", "static/__init__.py"),
     ("static.nn", "static/nn/__init__.py"),
+    ("dataset", "dataset/__init__.py"),
     ("jit", "jit/__init__.py"),
     ("amp", "amp/__init__.py"),
     ("vision", "vision/__init__.py"),
