@@ -113,6 +113,7 @@ from .distributed.parallel import DataParallel  # noqa: E402
 disable_static = lambda: None  # imperative is the default mode  # noqa: E731
 enable_static = static.enable_static
 in_dynamic_mode = lambda: not static.in_static_mode()  # noqa: E731
+in_dygraph_mode = in_dynamic_mode  # fluid-era spelling (framework.py)
 
 __version__ = "0.1.0"
 

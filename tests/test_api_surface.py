@@ -38,8 +38,14 @@ def _ref_names(path):
                 part = part.split(" as ")[-1].strip()
             if part.isidentifier():
                 names.add(part)
-    # module-level plumbing calls, not API: monkey_patch_* etc.
-    names -= {"monkey_patch_variable", "monkey_patch_math_varbase"}
+    # module-level plumbing, not API: monkey patches and the fluid
+    # type-checking/dispatch helpers leaf modules import internally
+    # (scoped: 'Variable' stays pinned — it is a real export in the
+    # reference static/__init__.py __all__)
+    names -= {"monkey_patch_variable", "monkey_patch_math_varbase",
+              "check_dtype", "check_type", "check_variable_and_dtype",
+              "control_flow", "ops", "out_dtype", "core",
+              "convert_dtype", "LayerHelper"}
     return {n for n in names if not n.startswith("_")}
 
 
@@ -57,6 +63,7 @@ def _ref_names(path):
     ("static", "static/__init__.py"),
     ("static.nn", "static/nn/__init__.py"),
     ("dataset", "dataset/__init__.py"),
+    ("distribution", "distribution.py"),
     ("jit", "jit/__init__.py"),
     ("amp", "amp/__init__.py"),
     ("vision", "vision/__init__.py"),
