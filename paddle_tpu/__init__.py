@@ -115,7 +115,7 @@ enable_static = static.enable_static
 in_dynamic_mode = lambda: not static.in_static_mode()  # noqa: E731
 in_dygraph_mode = in_dynamic_mode  # fluid-era spelling (framework.py)
 
-__version__ = "0.1.0"
+__version__ = "2.0.0+tpu"  # keep in sync with version.full_version
 
 
 # -- fluid-era creation/compat surface (python/paddle/__init__.py aliases) --
