@@ -1,0 +1,9 @@
+#!/bin/bash
+# CPU-only test runner. Strips the axon pool IP BEFORE python starts so the
+# environment's sitecustomize never registers/dials the single-client TPU
+# tunnel (register() runs at interpreter startup and blocks when the tunnel
+# is held or wedged — see bench.py _tunnel_lock). Always run the test suite
+# through this wrapper while any TPU bench is running.
+cd /root/repo || exit 1
+if [ $# -eq 0 ]; then set -- tests/ -q; fi
+exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m pytest "$@"

@@ -6,8 +6,20 @@
 cd /root/repo
 LOG=/tmp/tpu_watch.log
 RUN=/tmp/bench_r5_watch.jsonl
-for i in $(seq 1 90); do
-  probe=$(timeout 150 python bench.py --probe 2>/dev/null | tail -1)
+# Cadence: 15 min between probes. The tunnel relay is single-client and a
+# failed dial may extend the wedge; sparse probes give the grant time to
+# expire. The probe is wrapped in a NON-BLOCKING flock on the shared
+# tunnel lock taken BEFORE python starts (the sitecustomize register()
+# dials at interpreter startup): if another process holds the tunnel the
+# cycle is skipped, never contended. The full-bench run is NOT wrapped —
+# bench.py's drive() takes the same lock around each subprocess itself
+# (an outer hold here would deadlock those).
+for i in $(seq 1 30); do
+  probe=$(flock -n /tmp/axon_tunnel.lock -c "timeout 250 python bench.py --probe" 2>/dev/null | tail -1)
+  if [ -z "$probe" ]; then
+    echo "$(date -u +%FT%TZ) lock busy or probe hung; skipping cycle" >> "$LOG"
+    sleep 900; continue
+  fi
   if echo "$probe" | grep -q '"ok": true' && ! echo "$probe" | grep -q '"platform": "cpu"'; then
     echo "$(date -u +%FT%TZ) TPU up; running full bench" >> "$LOG"
     timeout 9000 python bench.py > "$RUN" 2>>"$LOG"
@@ -29,5 +41,5 @@ for i in $(seq 1 90); do
   else
     echo "$(date -u +%FT%TZ) probe down" >> "$LOG"
   fi
-  sleep 360
+  sleep 900
 done
