@@ -1,0 +1,584 @@
+"""jaxpr -> ONNX GraphProto conversion.
+
+The exporter traces a Layer's eval-mode forward to a jaxpr (params closed
+over -> graph initializers) and maps each equation to standard ONNX ops
+(target opset 13).  This replaces the reference's paddle2onnx delegation
+(python/paddle/onnx/export.py) with a direct trace-based converter — the
+same architectural role paddle2onnx's ProgramDesc walker plays, built on
+jaxpr instead.
+
+Unsupported primitives raise UnsupportedOnnxOp naming the primitive and
+the layer path, so a failed export is attributable rather than silently
+wrong.  bfloat16 is widened to float32 (ONNX runtimes' common denominator).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+from . import proto
+
+INT64_MIN = -(1 << 63)
+
+
+class UnsupportedOnnxOp(NotImplementedError):
+    pass
+
+
+def _np(x):
+    arr = np.asarray(x)
+    if str(arr.dtype) == "bfloat16":  # widen: ONNX runtimes' common ground
+        arr = arr.astype(np.float32)
+    return arr
+
+
+def _widen(dt) -> np.dtype:
+    return np.dtype(np.float32) if str(dt) == "bfloat16" else np.dtype(dt)
+
+
+class GraphBuilder:
+    def __init__(self):
+        self.nodes = []
+        self.initializers = []
+        self._names = {}
+        self._n = 0
+        self._const_cache = {}
+        self.init_names = set()
+
+    def fresh(self, hint="t"):
+        self._n += 1
+        return f"{hint}_{self._n}"
+
+    def add(self, op_type, inputs, n_out=1, **attrs):
+        outs = [self.fresh(op_type.lower()) for _ in range(n_out)]
+        self.nodes.append(proto.node(op_type, inputs, outs,
+                                     name=self.fresh(op_type), **attrs))
+        return outs if n_out > 1 else outs[0]
+
+    def const(self, arr, hint="c"):
+        """Register a constant as an initializer; dedup small ones."""
+        arr = _np(arr)
+        key = None
+        if arr.size <= 64:
+            key = (str(arr.dtype), arr.shape, arr.tobytes())
+            if key in self._const_cache:
+                return self._const_cache[key]
+        name = self.fresh(hint)
+        self.initializers.append(proto.tensor(name, arr))
+        self.init_names.add(name)
+        if key is not None:
+            self._const_cache[key] = name
+        return name
+
+    def i64(self, values, hint="shape"):
+        return self.const(np.asarray(values, np.int64), hint)
+
+
+def convert_jaxpr(closed, input_names, builder=None):
+    """Walk a ClosedJaxpr, emitting ONNX nodes; returns (builder,
+    output_names)."""
+    g = builder or GraphBuilder()
+    env = {}
+
+    jaxpr = closed.jaxpr
+
+    def read(atom):
+        from jax._src import core as jcore
+
+        if isinstance(atom, jcore.Literal):
+            return g.const(atom.val, "lit")
+        return env[atom]
+
+    for var, val in zip(jaxpr.constvars, closed.consts):
+        env[var] = g.const(val, "w")
+    for var, name in zip(jaxpr.invars, input_names):
+        env[var] = name
+
+    _emit_eqns(g, env, jaxpr.eqns, read)
+
+    outs = []
+    for ov in jaxpr.outvars:
+        nm = read(ov)
+        outs.append(nm)
+    return g, outs
+
+
+# --- emitters --------------------------------------------------------------
+
+_SIMPLE = {
+    "add": "Add", "sub": "Sub", "mul": "Mul", "div": "Div",
+    "max": "Max", "min": "Min", "pow": "Pow",
+    "neg": "Neg", "sign": "Sign", "floor": "Floor", "ceil": "Ceil",
+    "round": "Round", "abs": "Abs", "exp": "Exp", "log": "Log",
+    "tanh": "Tanh", "logistic": "Sigmoid", "erf": "Erf", "sqrt": "Sqrt",
+    "sin": "Sin", "cos": "Cos", "tan": "Tan", "asin": "Asin",
+    "acos": "Acos", "atan": "Atan", "sinh": "Sinh", "cosh": "Cosh",
+    "asinh": "Asinh", "acosh": "Acosh", "atanh": "Atanh",
+    "and": "And", "or": "Or", "xor": "Xor", "not": "Not",
+    "eq": "Equal", "lt": "Less", "le": "LessOrEqual",
+    "gt": "Greater", "ge": "GreaterOrEqual",
+    "stop_gradient": "Identity", "copy": "Identity",
+    "add_any": "Add",
+}
+
+
+def _scalar_like(g, eqn_invar, value):
+    dt = _widen(eqn_invar.aval.dtype)
+    return g.const(np.asarray(value, dt), "s")
+
+
+def _ematch(name):
+    def deco(fn):
+        _EMITTERS[name] = fn
+        return fn
+    return deco
+
+
+_EMITTERS = {}
+
+for _jax_name, _onnx_name in _SIMPLE.items():
+    def _mk(op):
+        def _f(g, ins, eqn):
+            return g.add(op, ins)
+        return _f
+    _EMITTERS[_jax_name] = _mk(_onnx_name)
+
+
+@_ematch("ne")
+def _ne(g, ins, eqn):
+    return g.add("Not", [g.add("Equal", ins)])
+
+
+@_ematch("rsqrt")
+def _rsqrt(g, ins, eqn):
+    return g.add("Reciprocal", [g.add("Sqrt", ins)])
+
+
+@_ematch("log1p")
+def _log1p(g, ins, eqn):
+    one = _scalar_like(g, eqn.invars[0], 1)
+    return g.add("Log", [g.add("Add", [ins[0], one])])
+
+
+@_ematch("expm1")
+def _expm1(g, ins, eqn):
+    one = _scalar_like(g, eqn.invars[0], 1)
+    return g.add("Sub", [g.add("Exp", ins), one])
+
+
+@_ematch("rem")
+def _rem(g, ins, eqn):
+    # jax lax.rem keeps the dividend's sign (C fmod); ONNX Mod needs
+    # fmod=1 for that (fmod=0 is integer-only, divisor-signed)
+    return g.add("Mod", ins, fmod=1)
+
+
+@_ematch("erfc")
+def _erfc(g, ins, eqn):
+    one = _scalar_like(g, eqn.invars[0], 1)
+    return g.add("Sub", [one, g.add("Erf", ins)])
+
+
+@_ematch("cbrt")
+def _cbrt(g, ins, eqn):
+    third = _scalar_like(g, eqn.invars[0], 1.0 / 3.0)
+    return g.add("Pow", [ins[0], third])
+
+
+@_ematch("integer_pow")
+def _integer_pow(g, ins, eqn):
+    y = _scalar_like(g, eqn.invars[0], eqn.params["y"])
+    return g.add("Pow", [ins[0], y])
+
+
+@_ematch("clamp")
+def _clamp(g, ins, eqn):
+    # jax: clamp(min, operand, max); min/max may be broadcast tensors, so
+    # lower as elementwise Max(Min(x, hi), lo) rather than ONNX Clip
+    lo, x, hi = ins
+    return g.add("Max", [g.add("Min", [x, hi]), lo])
+
+
+@_ematch("select_n")
+def _select_n(g, ins, eqn):
+    if len(ins) != 3:
+        raise UnsupportedOnnxOp(f"select_n with {len(ins) - 1} cases")
+    pred, case_f, case_t = ins
+    return g.add("Where", [pred, case_t, case_f])
+
+
+@_ematch("convert_element_type")
+def _convert(g, ins, eqn):
+    dt = _widen(eqn.params["new_dtype"])
+    return g.add("Cast", ins, to=int(proto.NP_TO_ONNX[dt]))
+
+
+@_ematch("reshape")
+def _reshape(g, ins, eqn):
+    if eqn.params.get("dimensions") is not None:
+        perm = list(eqn.params["dimensions"])
+        ins = [g.add("Transpose", ins, perm=perm)]
+    return g.add("Reshape", [ins[0], g.i64(eqn.params["new_sizes"])])
+
+
+@_ematch("squeeze")
+def _squeeze(g, ins, eqn):
+    return g.add("Reshape", [ins[0], g.i64(eqn.outvars[0].aval.shape)])
+
+
+@_ematch("expand_dims")
+def _expand_dims(g, ins, eqn):
+    return g.add("Reshape", [ins[0], g.i64(eqn.outvars[0].aval.shape)])
+
+
+@_ematch("transpose")
+def _transpose(g, ins, eqn):
+    return g.add("Transpose", ins, perm=list(eqn.params["permutation"]))
+
+
+@_ematch("broadcast_in_dim")
+def _broadcast(g, ins, eqn):
+    shape = list(eqn.params["shape"])
+    bdims = list(eqn.params["broadcast_dimensions"])
+    in_shape = eqn.invars[0].aval.shape
+    mid = [1] * len(shape)
+    for i, d in enumerate(bdims):
+        mid[d] = in_shape[i]
+    x = ins[0]
+    if list(in_shape) != mid:
+        x = g.add("Reshape", [x, g.i64(mid)])
+    if mid != shape:
+        x = g.add("Expand", [x, g.i64(shape)])
+    elif x == ins[0]:
+        x = g.add("Identity", [x])
+    return x
+
+
+@_ematch("concatenate")
+def _concat(g, ins, eqn):
+    return g.add("Concat", ins, axis=int(eqn.params["dimension"]))
+
+
+@_ematch("slice")
+def _slice(g, ins, eqn):
+    starts = list(eqn.params["start_indices"])
+    ends = list(eqn.params["limit_indices"])
+    steps = list(eqn.params["strides"] or [1] * len(starts))
+    axes = list(range(len(starts)))
+    return g.add("Slice", [ins[0], g.i64(starts), g.i64(ends),
+                           g.i64(axes), g.i64(steps)])
+
+
+@_ematch("rev")
+def _rev(g, ins, eqn):
+    dims = list(eqn.params["dimensions"])
+    return g.add("Slice", [ins[0], g.i64([-1] * len(dims)),
+                           g.i64([INT64_MIN] * len(dims)),
+                           g.i64(dims), g.i64([-1] * len(dims))])
+
+
+@_ematch("dynamic_slice")
+def _dynamic_slice(g, ins, eqn):
+    # runtime starts: Cast each scalar index to int64, Unsqueeze, Concat.
+    # NOTE jax clamps out-of-range starts; ONNX Slice clamps ends only —
+    # exported graphs must keep starts in range (true for the layer zoo).
+    operand, idx = ins[0], ins[1:]
+    sizes = list(eqn.params["slice_sizes"])
+    parts = [g.add("Reshape",
+                   [g.add("Cast", [i], to=int(proto.NP_TO_ONNX[np.dtype(np.int64)])),
+                    g.i64([1])]) for i in idx]
+    starts = g.add("Concat", parts, axis=0)
+    ends = g.add("Add", [starts, g.i64(sizes)])
+    axes = g.i64(list(range(len(sizes))))
+    return g.add("Slice", [operand, starts, ends, axes])
+
+
+@_ematch("pad")
+def _pad(g, ins, eqn):
+    cfg = eqn.params["padding_config"]
+    if any(i != 0 for _, _, i in cfg):
+        raise UnsupportedOnnxOp("interior (dilation) padding")
+    los = [lo for lo, _, _ in cfg]
+    his = [hi for _, hi, _ in cfg]
+    x = ins[0]
+    if any(v > 0 for v in los + his):
+        pads = [max(v, 0) for v in los] + [max(v, 0) for v in his]
+        x = g.add("Pad", [x, g.i64(pads), ins[1]], mode="constant")
+    if any(v < 0 for v in los + his):  # negative padding == crop
+        starts = [-min(v, 0) for v in los]
+        shape = eqn.outvars[0].aval.shape
+        ends = [s + e for s, e in zip(starts, shape)]
+        x = g.add("Slice", [x, g.i64(starts), g.i64(ends),
+                            g.i64(list(range(len(starts))))])
+    return x
+
+
+@_ematch("iota")
+def _iota(g, ins, eqn):
+    p = eqn.params
+    dt = _widen(p["dtype"])
+    shape, dim = list(p["shape"]), int(p["dimension"])
+    rng = np.arange(shape[dim], dtype=dt)
+    view = [1] * len(shape)
+    view[dim] = shape[dim]
+    return g.const(np.broadcast_to(rng.reshape(view), shape), "iota")
+
+
+@_ematch("gather")
+def _gather(g, ins, eqn):
+    dn = eqn.params["dimension_numbers"]
+    sizes = tuple(eqn.params["slice_sizes"])
+    op_shape = tuple(eqn.invars[0].aval.shape)
+    if (len(dn.start_index_map) == 1 and dn.collapsed_slice_dims
+            == dn.start_index_map and not getattr(dn, "operand_batching_dims",
+                                                  ())):
+        a = dn.start_index_map[0]
+        want = op_shape[:a] + (1,) + op_shape[a + 1:]
+        if sizes == want:
+            idx_shape = tuple(eqn.invars[1].aval.shape)[:-1]
+            out_shape = tuple(eqn.outvars[0].aval.shape)
+            expect = op_shape[:a] + idx_shape + op_shape[a + 1:]
+            if expect != out_shape:  # jnp.take with different offset layout
+                raise UnsupportedOnnxOp(
+                    f"gather layout {dn} (out {out_shape} != {expect})")
+            idx = g.add("Reshape", [ins[1], g.i64(idx_shape or [1])])
+            out = g.add("Gather", [ins[0], idx], axis=int(a))
+            if not idx_shape:  # scalar take: drop the kept unit dim
+                out = g.add("Reshape", [out, g.i64(out_shape)])
+            return out
+    raise UnsupportedOnnxOp(f"general gather {dn} sizes={sizes}")
+
+
+@_ematch("dot_general")
+def _dot_general(g, ins, eqn):
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    l_aval, r_aval = eqn.invars[0].aval, eqn.invars[1].aval
+    ls, rs = tuple(l_aval.shape), tuple(r_aval.shape)
+    lhs, rhs = ins
+    out_shape = tuple(eqn.outvars[0].aval.shape)
+
+    # fast path: plain matmul semantics (no batch, contract last x first)
+    if (not lb and len(lc) == 1 and lc[0] == len(ls) - 1
+            and rc == (0,) and len(rs) >= 1):
+        out = g.add("MatMul", [lhs, rhs])
+    else:
+        lfree = [d for d in range(len(ls)) if d not in lc and d not in lb]
+        rfree = [d for d in range(len(rs)) if d not in rc and d not in rb]
+        B = int(np.prod([ls[d] for d in lb], initial=1))
+        M = int(np.prod([ls[d] for d in lfree], initial=1))
+        K = int(np.prod([ls[d] for d in lc], initial=1))
+        N = int(np.prod([rs[d] for d in rfree], initial=1))
+        l2 = g.add("Transpose", [lhs], perm=list(lb) + lfree + list(lc))
+        l2 = g.add("Reshape", [l2, g.i64([B, M, K])])
+        r2 = g.add("Transpose", [rhs], perm=list(rb) + list(rc) + rfree)
+        r2 = g.add("Reshape", [r2, g.i64([B, K, N])])
+        mm = g.add("MatMul", [l2, r2])
+        out = g.add("Reshape", [mm, g.i64(out_shape)])
+
+    out_dt = _widen(eqn.outvars[0].aval.dtype)
+    if out_dt != _widen(l_aval.dtype):
+        out = g.add("Cast", [out], to=int(proto.NP_TO_ONNX[out_dt]))
+    return out
+
+
+@_ematch("conv_general_dilated")
+def _conv(g, ins, eqn):
+    p = eqn.params
+    dn = p["dimension_numbers"]
+    if any(d != 1 for d in p["lhs_dilation"]):
+        raise UnsupportedOnnxOp("transposed convolution (lhs_dilation)")
+    if p.get("batch_group_count", 1) != 1:
+        raise UnsupportedOnnxOp("batch_group_count != 1")
+    lhs_spec, rhs_spec, out_spec = dn
+    x = g.add("Transpose", [ins[0]], perm=list(lhs_spec))   # -> NC(spatial)
+    w = g.add("Transpose", [ins[1]], perm=list(rhs_spec))   # -> OI(spatial)
+    pads = [lo for lo, _ in p["padding"]] + [hi for _, hi in p["padding"]]
+    y = g.add("Conv", [x, w],
+              strides=list(p["window_strides"]),
+              pads=pads,
+              dilations=list(p["rhs_dilation"]),
+              group=int(p["feature_group_count"]))
+    # out_spec[i] = jax position of canonical dim i; the Conv result is
+    # canonical NC(spatial), so jax dim j = canonical dim inv[j]
+    inv = [0] * len(out_spec)
+    for i, d in enumerate(out_spec):
+        inv[d] = i
+    return g.add("Transpose", [y], perm=inv)
+
+
+def _pool_layout(eqn):
+    p = eqn.params
+    win = list(p["window_dimensions"])
+    strides = list(p["window_strides"])
+    padding = list(p["padding"])
+    if any(d != 1 for d in list(p.get("base_dilation", [])) or [1]):
+        raise UnsupportedOnnxOp("pool base_dilation")
+    if any(d != 1 for d in list(p.get("window_dilation", [])) or [1]):
+        raise UnsupportedOnnxOp("pool window_dilation")
+    spatial = [i for i, w in enumerate(win) if w != 1 or strides[i] != 1
+               or padding[i] != (0, 0)]
+    passive = [i for i in range(len(win)) if i not in spatial]
+    if len(passive) < 2:
+        raise UnsupportedOnnxOp(f"pool window {win} has no N/C dims")
+    # N and C = the first two passive dims in order; everything windowed
+    # (plus remaining passive dims, windows of 1) is spatial
+    spatial = [i for i in range(len(win)) if i not in passive[:2]]
+    perm = passive[:2] + spatial
+    return perm, [win[i] for i in spatial], [strides[i] for i in spatial], \
+        ([padding[i][0] for i in spatial] + [padding[i][1] for i in spatial])
+
+
+def _emit_pool(g, ins, eqn, op, **extra):
+    perm, kernel, strides, pads = _pool_layout(eqn)
+    x = g.add("Transpose", [ins[0]], perm=perm)
+    y = g.add(op, [x], kernel_shape=kernel, strides=strides, pads=pads,
+              **extra)
+    inv = [0] * len(perm)
+    for i, d in enumerate(perm):
+        inv[d] = i
+    return g.add("Transpose", [y], perm=inv)
+
+
+@_ematch("reduce_window_max")
+def _maxpool(g, ins, eqn):
+    return _emit_pool(g, ins, eqn, "MaxPool")
+
+
+@_ematch("reduce_window_sum")
+def _sumpool(g, ins, eqn):
+    perm, kernel, _, _ = _pool_layout(eqn)
+    avg = _emit_pool(g, ins, eqn, "AveragePool", count_include_pad=1)
+    n = _scalar_like(g, eqn.invars[0], float(np.prod(kernel)))
+    return g.add("Mul", [avg, n])
+
+
+def _reduce(onnx_op, axes_as_input):
+    def _f(g, ins, eqn):
+        axes = [int(a) for a in eqn.params["axes"]]
+        if axes_as_input:  # ReduceSum carries axes as an input in opset 13
+            return g.add(onnx_op, [ins[0], g.i64(axes)], keepdims=0)
+        return g.add(onnx_op, ins, axes=axes, keepdims=0)
+    return _f
+
+
+_EMITTERS["reduce_sum"] = _reduce("ReduceSum", True)
+_EMITTERS["reduce_max"] = _reduce("ReduceMax", False)
+_EMITTERS["reduce_min"] = _reduce("ReduceMin", False)
+_EMITTERS["reduce_prod"] = _reduce("ReduceProd", False)
+
+
+def _reduce_bool(onnx_op):
+    def _f(g, ins, eqn):
+        axes = [int(a) for a in eqn.params["axes"]]
+        f = g.add("Cast", ins, to=proto.FLOAT)
+        if onnx_op == "ReduceMin":  # all()
+            r = g.add("ReduceMin", [f], axes=axes, keepdims=0)
+        else:                        # any()
+            r = g.add("ReduceMax", [f], axes=axes, keepdims=0)
+        half = g.const(np.float32(0.5))
+        return g.add("Greater", [r, half])
+    return _f
+
+
+_EMITTERS["reduce_and"] = _reduce_bool("ReduceMin")
+_EMITTERS["reduce_or"] = _reduce_bool("ReduceMax")
+
+
+def _arg_reduce(onnx_op):
+    def _f(g, ins, eqn):
+        axes = list(eqn.params["axes"])
+        if len(axes) != 1:
+            raise UnsupportedOnnxOp(f"{onnx_op} over {axes}")
+        out = g.add(onnx_op, ins, axis=int(axes[0]), keepdims=0)
+        dt = _widen(eqn.params["index_dtype"])
+        if dt != np.dtype(np.int64):
+            out = g.add("Cast", [out], to=int(proto.NP_TO_ONNX[dt]))
+        return out
+    return _f
+
+
+_EMITTERS["argmax"] = _arg_reduce("ArgMax")
+_EMITTERS["argmin"] = _arg_reduce("ArgMin")
+
+
+@_ematch("cumsum")
+def _cumsum(g, ins, eqn):
+    axis = g.const(np.asarray(eqn.params["axis"], np.int64))
+    return g.add("CumSum", [ins[0], axis],
+                 reverse=int(bool(eqn.params.get("reverse", False))))
+
+
+@_ematch("square")
+def _square(g, ins, eqn):
+    return g.add("Mul", [ins[0], ins[0]])
+
+
+@_ematch("is_finite")
+def _is_finite(g, ins, eqn):
+    nan = g.add("IsNaN", ins)
+    inf = g.add("IsInf", ins)
+    return g.add("Not", [g.add("Or", [nan, inf])])
+
+
+# --- call-like primitives: inline the inner jaxpr --------------------------
+
+
+def _inline(g, env, eqn, closed, read):
+    inner = closed.jaxpr
+    sub_env = {}
+    for var, val in zip(inner.constvars, closed.consts):
+        sub_env[var] = g.const(val, "w")
+    for var, outer in zip(inner.invars, eqn.invars):
+        sub_env[var] = read(outer)
+
+    def sub_read(atom):
+        from jax._src import core as jcore
+
+        if isinstance(atom, jcore.Literal):
+            return g.const(atom.val, "lit")
+        return sub_env[atom]
+
+    _emit_eqns(g, sub_env, inner.eqns, sub_read)
+    return [sub_read(ov) for ov in inner.outvars]
+
+
+def _closed_of(eqn):
+    from jax._src import core as jcore
+
+    for key in ("jaxpr", "call_jaxpr", "fun_jaxpr"):
+        cj = eqn.params.get(key)
+        if cj is None:
+            continue
+        if isinstance(cj, jcore.ClosedJaxpr):
+            return cj
+        return jcore.ClosedJaxpr(cj, ())
+    raise UnsupportedOnnxOp(f"call primitive without jaxpr: {eqn}")
+
+
+_CALL_PRIMS = ("jit", "pjit", "closed_call", "core_call", "xla_call", "remat2",
+               "checkpoint", "custom_jvp_call", "custom_vjp_call",
+               "custom_jvp_call_jaxpr", "custom_vjp_call_jaxpr")
+
+
+def _emit_eqns(g, env, eqns, read):
+    from jax._src import core as jcore
+
+    for eqn in eqns:
+        prim = eqn.primitive.name
+        if prim in _CALL_PRIMS or (prim.startswith("custom_")
+                                   and "call" in prim):
+            outs = _inline(g, env, eqn, _closed_of(eqn), read)
+            for var, nm in zip(eqn.outvars, outs):
+                env[var] = nm
+            continue
+        ins = [read(v) for v in eqn.invars]
+        emit = _EMITTERS.get(prim)
+        if emit is None:
+            raise UnsupportedOnnxOp(
+                f"primitive '{prim}' has no ONNX mapping (eqn: {eqn})")
+        outs = emit(g, ins, eqn)
+        if not isinstance(outs, (list, tuple)):
+            outs = [outs]
+        for var, nm in zip(eqn.outvars, outs):
+            if isinstance(var, jcore.DropVar):
+                continue
+            env[var] = nm

@@ -1,0 +1,226 @@
+"""Minimal ONNX protobuf wire-format codec (no external deps).
+
+The ONNX serialization format is protobuf; this module hand-encodes the
+small subset of onnx.proto needed for inference graphs (ModelProto /
+GraphProto / NodeProto / TensorProto / ValueInfoProto / AttributeProto)
+using the public field numbers from the ONNX spec, and provides a generic
+decoder for round-trip validation and the numpy runtime.
+
+Why hand-rolled: this image ships protoc 3.21 but protobuf-python 6.x,
+whose generated-code version check rejects 3.21 gencode — and the `onnx`
+package itself is absent.  The wire format (varint / length-delimited)
+is trivial and stable, so encoding it directly is the dependency-free
+path.  Reference behavior target: python/paddle/onnx/export.py (which
+delegates to paddle2onnx); the artifact layout (`<path>.onnx` ModelProto)
+matches what that produces.
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+# --- ONNX enums (public spec values) --------------------------------------
+
+# TensorProto.DataType
+FLOAT, UINT8, INT8, UINT16, INT16, INT32, INT64 = 1, 2, 3, 4, 5, 6, 7
+STRING, BOOL, FLOAT16, DOUBLE, UINT32, UINT64 = 8, 9, 10, 11, 12, 13
+BFLOAT16 = 16
+
+NP_TO_ONNX = {
+    np.dtype(np.float32): FLOAT, np.dtype(np.float64): DOUBLE,
+    np.dtype(np.float16): FLOAT16, np.dtype(np.int8): INT8,
+    np.dtype(np.uint8): UINT8, np.dtype(np.int16): INT16,
+    np.dtype(np.uint16): UINT16, np.dtype(np.int32): INT32,
+    np.dtype(np.int64): INT64, np.dtype(np.uint32): UINT32,
+    np.dtype(np.uint64): UINT64, np.dtype(np.bool_): BOOL,
+}
+ONNX_TO_NP = {v: k for k, v in NP_TO_ONNX.items()}
+
+# AttributeProto.AttributeType
+A_FLOAT, A_INT, A_STRING, A_TENSOR = 1, 2, 3, 4
+A_FLOATS, A_INTS, A_STRINGS = 6, 7, 8
+
+# --- wire-level encoding ---------------------------------------------------
+
+
+def _varint(n: int) -> bytes:
+    if n < 0:  # proto int64 negative: 10-byte two's-complement varint
+        n += 1 << 64
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def _tag(field: int, wire: int) -> bytes:
+    return _varint((field << 3) | wire)
+
+
+def vint(field: int, value: int) -> bytes:
+    """varint-typed field (int32/int64/enum/bool)."""
+    return _tag(field, 0) + _varint(int(value))
+
+
+def ld(field: int, payload: bytes) -> bytes:
+    """length-delimited field (string/bytes/sub-message/packed)."""
+    return _tag(field, 2) + _varint(len(payload)) + payload
+
+
+def s(field: int, text) -> bytes:
+    return ld(field, text if isinstance(text, bytes) else text.encode())
+
+
+def f32(field: int, value: float) -> bytes:
+    return _tag(field, 5) + struct.pack("<f", value)
+
+
+def packed_i64(field: int, values) -> bytes:
+    return ld(field, b"".join(_varint(int(v)) for v in values))
+
+
+def packed_f32(field: int, values) -> bytes:
+    return ld(field, struct.pack(f"<{len(values)}f", *values))
+
+
+# --- message builders (field numbers from the public onnx.proto) -----------
+
+
+def tensor(name: str, arr: np.ndarray) -> bytes:
+    """TensorProto: dims=1, data_type=2, raw_data=9, name=8."""
+    arr = np.ascontiguousarray(arr)
+    dt = NP_TO_ONNX[arr.dtype]
+    return (packed_i64(1, arr.shape)
+            + vint(2, dt)
+            + s(8, name)
+            + ld(9, arr.tobytes()))
+
+
+def _attr(name: str, value) -> bytes:
+    """AttributeProto: name=1, f=2, i=3, s=4, t=5, floats=7, ints=8,
+    strings=9, type=20."""
+    body = s(1, name)
+    if isinstance(value, bool):
+        return body + vint(3, int(value)) + vint(20, A_INT)
+    if isinstance(value, int):
+        return body + vint(3, value) + vint(20, A_INT)
+    if isinstance(value, float):
+        return body + f32(2, value) + vint(20, A_FLOAT)
+    if isinstance(value, (str, bytes)):
+        return body + s(4, value) + vint(20, A_STRING)
+    if isinstance(value, np.ndarray):
+        return body + ld(5, tensor(name, value)) + vint(20, A_TENSOR)
+    if isinstance(value, (list, tuple)):
+        if all(isinstance(v, (int, np.integer)) for v in value):
+            return (body + b"".join(vint(8, int(v)) for v in value)
+                    + vint(20, A_INTS))
+        if all(isinstance(v, (float, np.floating)) for v in value):
+            return (body + b"".join(f32(7, float(v)) for v in value)
+                    + vint(20, A_FLOATS))
+        if all(isinstance(v, (str, bytes)) for v in value):
+            return (body + b"".join(s(9, v) for v in value)
+                    + vint(20, A_STRINGS))
+    raise TypeError(f"unsupported attribute {name}={value!r}")
+
+
+def node(op_type: str, inputs, outputs, name: str = "", **attrs) -> bytes:
+    """NodeProto: input=1, output=2, name=3, op_type=4, attribute=5."""
+    return (b"".join(s(1, i) for i in inputs)
+            + b"".join(s(2, o) for o in outputs)
+            + s(3, name or (op_type + "_" + (outputs[0] if outputs else "")))
+            + s(4, op_type)
+            + b"".join(ld(5, _attr(k, v)) for k, v in attrs.items()))
+
+
+def value_info(name: str, dtype: np.dtype, shape) -> bytes:
+    """ValueInfoProto{name=1, type=2} / TypeProto{tensor_type=1} /
+    TypeProto.Tensor{elem_type=1, shape=2} / TensorShapeProto{dim=1} /
+    Dimension{dim_value=1, dim_param=2}."""
+    dims = b""
+    for d in shape:
+        if isinstance(d, int) and d >= 0:
+            dims += ld(1, vint(1, d))
+        else:  # symbolic / unknown
+            dims += ld(1, s(2, str(d)))
+    tensor_type = vint(1, NP_TO_ONNX[np.dtype(dtype)]) + ld(2, dims)
+    return s(1, name) + ld(2, ld(1, tensor_type))
+
+
+def graph(nodes, name, inputs, outputs, initializers) -> bytes:
+    """GraphProto: node=1, name=2, initializer=5, input=11, output=12."""
+    return (b"".join(ld(1, n) for n in nodes)
+            + s(2, name)
+            + b"".join(ld(5, t) for t in initializers)
+            + b"".join(ld(11, vi) for vi in inputs)
+            + b"".join(ld(12, vi) for vi in outputs))
+
+
+def model(graph_bytes: bytes, opset_version: int = 13,
+          producer: str = "paddle_tpu") -> bytes:
+    """ModelProto: ir_version=1, producer_name=2, graph=7, opset_import=8.
+    OperatorSetIdProto: domain=1 (default ''), version=2."""
+    return (vint(1, 8)  # IR version 8 (opset 13-17 era)
+            + s(2, producer)
+            + ld(7, graph_bytes)
+            + ld(8, vint(2, opset_version)))
+
+
+# --- generic decoder -------------------------------------------------------
+
+
+def parse(data: bytes):
+    """Decode one protobuf message into {field_no: [values]} where a value
+    is an int (wire 0), a 4/8-byte struct (wire 5/1, returned as raw
+    bytes), or bytes (wire 2 — caller re-parses sub-messages)."""
+    fields = {}
+    i, n = 0, len(data)
+    while i < n:
+        key, i = _read_varint(data, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(data, i)
+        elif wire == 2:
+            ln, i = _read_varint(data, i)
+            v = data[i:i + ln]
+            i += ln
+        elif wire == 5:
+            v = data[i:i + 4]
+            i += 4
+        elif wire == 1:
+            v = data[i:i + 8]
+            i += 8
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append(v)
+    return fields
+
+
+def _read_varint(data: bytes, i: int):
+    shift = result = 0
+    while True:
+        b = data[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            return result, i
+        shift += 7
+
+
+def parse_packed_i64(payload: bytes):
+    out, i = [], 0
+    while i < len(payload):
+        v, i = _read_varint(payload, i)
+        if v >= 1 << 63:
+            v -= 1 << 64
+        out.append(v)
+    return out
+
+
+def signed(v: int) -> int:
+    """Interpret a decoded varint as int64."""
+    return v - (1 << 64) if v >= 1 << 63 else v

@@ -1,0 +1,390 @@
+"""Numpy evaluator for the ONNX op subset the exporter emits.
+
+Exists so exported artifacts can be validated end-to-end in this image
+(which has no `onnx`/`onnxruntime`): tests export a Layer, re-load the
+.onnx bytes through the generic protobuf decoder, execute the graph in
+numpy, and compare against the Layer's own forward.  It is a validation
+runtime, not a serving engine — the serving path is StableHLO via
+paddle_tpu.inference (reference analog: paddle2onnx consumers vs
+AnalysisPredictor, analysis_predictor.cc:306).
+"""
+from __future__ import annotations
+
+import struct
+
+import numpy as np
+
+from . import proto
+
+
+def _u(b):
+    return b.decode()
+
+
+class _Msg:
+    """Typed view over proto.parse output."""
+
+    def __init__(self, data: bytes):
+        self.f = proto.parse(data)
+
+    def ints(self, n):
+        return [proto.signed(v) for v in self.f.get(n, [])]
+
+    def int(self, n, default=0):
+        v = self.f.get(n)
+        return proto.signed(v[0]) if v else default
+
+    def strs(self, n):
+        return [_u(v) for v in self.f.get(n, [])]
+
+    def str_(self, n, default=""):
+        v = self.f.get(n)
+        return _u(v[0]) if v else default
+
+    def subs(self, n):
+        return [_Msg(v) for v in self.f.get(n, [])]
+
+    def sub(self, n):
+        v = self.f.get(n)
+        return _Msg(v[0]) if v else None
+
+    def bytes_(self, n):
+        v = self.f.get(n)
+        return v[0] if v else b""
+
+    def float_(self, n, default=0.0):
+        v = self.f.get(n)
+        return struct.unpack("<f", v[0])[0] if v else default
+
+
+def _tensor_to_np(t: _Msg) -> np.ndarray:
+    dims = proto.parse_packed_i64(t.bytes_(1)) if 1 in t.f else []
+    # dims may be unpacked varints too
+    if 1 in t.f and isinstance(t.f[1][0], int):
+        dims = [proto.signed(v) for v in t.f[1]]
+    dt = proto.ONNX_TO_NP[t.int(2)]
+    raw = t.bytes_(9)
+    if raw:
+        return np.frombuffer(raw, dt).reshape(dims).copy()
+    return np.zeros(dims, dt)
+
+
+class _Attr:
+    def __init__(self, m: _Msg):
+        self.name = m.str_(1)
+        self.type = m.int(20)
+        self.m = m
+
+    @property
+    def value(self):
+        t = self.type
+        if t == proto.A_INT:
+            return self.m.int(3)
+        if t == proto.A_FLOAT:
+            return self.m.float_(2)
+        if t == proto.A_STRING:
+            return self.m.str_(4)
+        if t == proto.A_INTS:
+            return self.m.ints(8)
+        if t == proto.A_FLOATS:
+            return [struct.unpack("<f", v)[0] for v in self.m.f.get(7, [])]
+        if t == proto.A_TENSOR:
+            return _tensor_to_np(self.m.sub(5))
+        raise ValueError(f"attr type {t}")
+
+
+class Node:
+    def __init__(self, m: _Msg):
+        self.inputs = m.strs(1)
+        self.outputs = m.strs(2)
+        self.op_type = m.str_(4)
+        self.attrs = {a.name: a.value
+                      for a in (_Attr(x) for x in m.subs(5))}
+
+
+class ONNXModel:
+    """Parse + execute a ModelProto produced by paddle_tpu.onnx.export."""
+
+    def __init__(self, path_or_bytes):
+        if isinstance(path_or_bytes, str):
+            with open(path_or_bytes, "rb") as f:
+                path_or_bytes = f.read()
+        model = _Msg(path_or_bytes)
+        self.ir_version = model.int(1)
+        self.opset = (model.subs(8)[0].int(2)) if model.subs(8) else 0
+        g = model.sub(7)
+        self.graph_name = g.str_(2)
+        self.nodes = [Node(n) for n in g.subs(1)]
+        self.initializers = {t.str_(8): _tensor_to_np(t) for t in g.subs(5)}
+        self.input_names = [vi.str_(1) for vi in g.subs(11)]
+        self.output_names = [vi.str_(1) for vi in g.subs(12)]
+
+    def run(self, feeds):
+        if isinstance(feeds, (list, tuple)):
+            feeds = dict(zip(self.input_names, feeds))
+        env = dict(self.initializers)
+        for k, v in feeds.items():
+            env[k] = np.asarray(v)
+        for node in self.nodes:
+            fn = _OPS.get(node.op_type)
+            if fn is None:
+                raise NotImplementedError(f"runtime op {node.op_type}")
+            args = [env[i] if i else None for i in node.inputs]
+            out = fn(node, *args)
+            if not isinstance(out, tuple):
+                out = (out,)
+            for name, val in zip(node.outputs, out):
+                env[name] = val
+        return [env[o] for o in self.output_names]
+
+
+# --- op table --------------------------------------------------------------
+
+_OPS = {}
+
+
+def _op(name):
+    def deco(fn):
+        _OPS[name] = fn
+        return fn
+    return deco
+
+
+def _binop(name, fn):
+    _OPS[name] = lambda n, a, b: fn(a, b)
+
+
+def _unop(name, fn):
+    _OPS[name] = lambda n, a: fn(a)
+
+
+_binop("Add", lambda a, b: a + b)
+_binop("Sub", lambda a, b: a - b)
+_binop("Mul", lambda a, b: a * b)
+_binop("Div", lambda a, b: a / b if a.dtype.kind == "f" else a // b)
+_binop("Pow", lambda a, b: np.power(a, b.astype(a.dtype)))
+_binop("Mod", np.fmod)
+_binop("Max", np.maximum)
+_binop("Min", np.minimum)
+_binop("And", np.logical_and)
+_binop("Or", np.logical_or)
+_binop("Xor", np.logical_xor)
+_binop("Equal", lambda a, b: a == b)
+_binop("Less", lambda a, b: a < b)
+_binop("LessOrEqual", lambda a, b: a <= b)
+_binop("Greater", lambda a, b: a > b)
+_binop("GreaterOrEqual", lambda a, b: a >= b)
+_binop("MatMul", lambda a, b: np.matmul(a, b))
+_unop("Neg", np.negative)
+_unop("Abs", np.abs)
+_unop("Sign", np.sign)
+_unop("Floor", np.floor)
+_unop("Ceil", np.ceil)
+_unop("Round", lambda a: np.round(a))
+_unop("Exp", np.exp)
+_unop("Log", np.log)
+_unop("Sqrt", np.sqrt)
+_unop("Reciprocal", lambda a: 1.0 / a)
+_unop("Tanh", np.tanh)
+_unop("Sigmoid", lambda a: 1.0 / (1.0 + np.exp(-a)))
+_unop("Sin", np.sin)
+_unop("Cos", np.cos)
+_unop("Tan", np.tan)
+_unop("Asin", np.arcsin)
+_unop("Acos", np.arccos)
+_unop("Atan", np.arctan)
+_unop("Sinh", np.sinh)
+_unop("Cosh", np.cosh)
+_unop("Asinh", np.arcsinh)
+_unop("Acosh", np.arccosh)
+_unop("Atanh", np.arctanh)
+_unop("Not", np.logical_not)
+_unop("Identity", lambda a: a)
+_unop("IsNaN", np.isnan)
+_unop("IsInf", np.isinf)
+
+
+@_op("Erf")
+def _erf(n, a):
+    # Abramowitz-Stegun 7.1.26 is too lossy for parity tests; use the
+    # complementary construction via numpy's vectorized math.erf
+    from math import erf
+    return np.vectorize(erf, otypes=[a.dtype])(a)
+
+
+@_op("Where")
+def _where(n, c, x, y):
+    return np.where(c, x, y)
+
+
+@_op("Cast")
+def _cast(n, a):
+    return a.astype(proto.ONNX_TO_NP[n.attrs["to"]])
+
+
+@_op("Reshape")
+def _reshape(n, a, shape):
+    shape = [int(s) for s in shape]
+    return a.reshape(shape)
+
+
+@_op("Transpose")
+def _transpose(n, a):
+    return np.transpose(a, n.attrs.get("perm"))
+
+
+@_op("Expand")
+def _expand(n, a, shape):
+    return np.broadcast_to(a, [int(s) for s in shape]).copy()
+
+
+@_op("Concat")
+def _concat(n, *xs):
+    return np.concatenate(xs, axis=n.attrs["axis"])
+
+
+@_op("Gather")
+def _gather(n, a, idx):
+    return np.take(a, idx.astype(np.int64), axis=n.attrs.get("axis", 0))
+
+
+@_op("Slice")
+def _slice(n, data, starts, ends, axes=None, steps=None):
+    starts = [int(v) for v in starts]
+    ends = [int(v) for v in ends]
+    axes = list(range(len(starts))) if axes is None else [int(v) for v in axes]
+    steps = [1] * len(starts) if steps is None else [int(v) for v in steps]
+    sl = [slice(None)] * data.ndim
+    for st, en, ax, sp in zip(starts, ends, axes, steps):
+        if sp < 0 and en <= -(1 << 62):  # INT64_MIN sentinel: to the start
+            en = None
+        sl[ax] = slice(st, en, sp)
+    return data[tuple(sl)].copy()
+
+
+@_op("Pad")
+def _pad(n, data, pads, value=None):
+    k = data.ndim
+    pads = [int(p) for p in pads]
+    width = [(pads[i], pads[k + i]) for i in range(k)]
+    cv = float(value) if value is not None and value.dtype.kind == "f" \
+        else (int(value) if value is not None else 0)
+    return np.pad(data, width, constant_values=cv)
+
+
+def _reduce(np_fn):
+    def f(n, a, axes_in=None):
+        axes = n.attrs.get("axes")
+        if axes_in is not None:
+            axes = [int(v) for v in axes_in]
+        axes = tuple(axes) if axes else None
+        keep = bool(n.attrs.get("keepdims", 1))
+        return np_fn(a, axis=axes, keepdims=keep)
+    return f
+
+
+_OPS["ReduceSum"] = _reduce(np.sum)
+_OPS["ReduceMax"] = _reduce(np.max)
+_OPS["ReduceMin"] = _reduce(np.min)
+_OPS["ReduceProd"] = _reduce(np.prod)
+_OPS["ReduceMean"] = _reduce(np.mean)
+
+
+@_op("ArgMax")
+def _argmax(n, a):
+    out = np.argmax(a, axis=n.attrs["axis"])
+    return out if n.attrs.get("keepdims", 1) == 0 \
+        else np.expand_dims(out, n.attrs["axis"])
+
+
+@_op("ArgMin")
+def _argmin(n, a):
+    out = np.argmin(a, axis=n.attrs["axis"])
+    return out if n.attrs.get("keepdims", 1) == 0 \
+        else np.expand_dims(out, n.attrs["axis"])
+
+
+@_op("CumSum")
+def _cumsum(n, a, axis):
+    ax = int(np.asarray(axis).reshape(()))
+    if n.attrs.get("reverse"):
+        return np.flip(np.cumsum(np.flip(a, axis=ax), axis=ax), axis=ax)
+    return np.cumsum(a, axis=ax)
+
+
+def _pool_view(a, kernel, strides, pads):
+    """(N, C, *spatial) -> windows (N, C, *out_spatial, *kernel)."""
+    k = len(kernel)
+    if any(p != 0 for p in pads):
+        width = [(0, 0), (0, 0)] + [(pads[i], pads[k + i]) for i in range(k)]
+        a = np.pad(a, width, constant_values=0)
+    from numpy.lib.stride_tricks import sliding_window_view
+    win = sliding_window_view(a, kernel, axis=tuple(range(2, 2 + k)))
+    idx = (slice(None), slice(None)) + tuple(
+        slice(None, None, s) for s in strides)
+    return win[idx + (Ellipsis,)]
+
+
+@_op("MaxPool")
+def _maxpool(n, a):
+    k = len(n.attrs["kernel_shape"])
+    pads = n.attrs.get("pads", [0] * 2 * k)
+    if any(p != 0 for p in pads):
+        # pad with -inf so padding never wins the max
+        width = [(0, 0), (0, 0)] + [(pads[i], pads[k + i]) for i in range(k)]
+        a = np.pad(a, width, constant_values=-np.inf if a.dtype.kind == "f"
+                   else np.iinfo(a.dtype).min)
+        pads = [0] * 2 * k
+    v = _pool_view(a, n.attrs["kernel_shape"],
+                   n.attrs.get("strides", [1] * k), pads)
+    return v.max(axis=tuple(range(-k, 0)))
+
+
+@_op("AveragePool")
+def _avgpool(n, a):
+    k = len(n.attrs["kernel_shape"])
+    v = _pool_view(a, n.attrs["kernel_shape"],
+                   n.attrs.get("strides", [1] * k),
+                   n.attrs.get("pads", [0] * 2 * k))
+    # exporter always sets count_include_pad=1
+    return v.mean(axis=tuple(range(-k, 0)))
+
+
+@_op("Conv")
+def _conv(n, x, w, b=None):
+    strides = n.attrs.get("strides")
+    dil = n.attrs.get("dilations")
+    group = n.attrs.get("group", 1)
+    k = w.ndim - 2
+    strides = strides or [1] * k
+    dil = dil or [1] * k
+    pads = n.attrs.get("pads", [0] * 2 * k)
+    if any(d != 1 for d in dil):  # dilate the kernel explicitly
+        wd_shape = list(w.shape[:2]) + [
+            (w.shape[2 + i] - 1) * dil[i] + 1 for i in range(k)]
+        wd = np.zeros(wd_shape, w.dtype)
+        wd[(slice(None), slice(None))
+           + tuple(slice(None, None, dil[i]) for i in range(k))] = w
+        w = wd
+    width = [(0, 0), (0, 0)] + [(pads[i], pads[k + i]) for i in range(k)]
+    x = np.pad(x, width)
+    N, C = x.shape[:2]
+    O, I = w.shape[:2]  # I = C // group
+    from numpy.lib.stride_tricks import sliding_window_view
+    win = sliding_window_view(x, w.shape[2:], axis=tuple(range(2, 2 + k)))
+    win = win[(slice(None), slice(None))
+              + tuple(slice(None, None, s) for s in strides) + (Ellipsis,)]
+    # win: (N, C, *out, *kern); contract per group
+    og = O // group
+    outs = []
+    for gi in range(group):
+        wg = w[gi * og:(gi + 1) * og]          # (og, I, *kern)
+        xg = win[:, gi * I:(gi + 1) * I]       # (N, I, *out, *kern)
+        outs.append(np.einsum(
+            xg, [0, 1] + list(range(2, 2 + k)) + list(range(10, 10 + k)),
+            wg, [9, 1] + list(range(10, 10 + k)),
+            [0, 9] + list(range(2, 2 + k))))
+    y = np.concatenate(outs, axis=1)
+    if b is not None:
+        y = y + b.reshape((1, -1) + (1,) * k)
+    return y.astype(x.dtype)

@@ -1,0 +1,149 @@
+"""paddle.onnx.export: jaxpr -> ONNX ModelProto, validated by round-trip
+execution through the in-tree numpy runtime (this image has no
+onnx/onnxruntime).  Reference analog: python/paddle/onnx/export.py
+(paddle2onnx); parity bar = exported graph reproduces the Layer's
+forward numerics."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu import onnx as ponnx
+from paddle_tpu.onnx import proto
+
+rs = np.random.RandomState(0)
+
+
+def _roundtrip(layer, inputs, atol=1e-5, n_outs=1):
+    layer.eval()
+    f = ponnx.export(layer, "/tmp/onnx_test_artifact",
+                     example_inputs=list(inputs))
+    m = ponnx.ONNXModel(f)
+    got = m.run(list(inputs))
+    want = layer(*[paddle.to_tensor(x) for x in inputs])
+    want = [np.asarray(w.numpy()) for w in
+            (want if isinstance(want, (list, tuple)) else [want])]
+    assert len(got) == len(want) >= n_outs
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        np.testing.assert_allclose(g, w, atol=atol, rtol=1e-4)
+    return m
+
+
+def test_mlp_layernorm_roundtrip():
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.LayerNorm(16),
+                        nn.Linear(16, 4), nn.Softmax(-1))
+    m = _roundtrip(net, [rs.randn(5, 8).astype(np.float32)])
+    assert m.opset >= 13 and m.input_names == ["x0"]
+
+
+def test_cnn_conv_pool_roundtrip():
+    paddle.seed(5)
+    net = nn.Sequential(
+        nn.Conv2D(3, 8, 3, padding=1), nn.ReLU(), nn.MaxPool2D(2, 2),
+        nn.Conv2D(8, 16, 3, stride=2, padding=1), nn.BatchNorm2D(16),
+        nn.ReLU(), nn.Flatten(), nn.Linear(16 * 4 * 4, 10))
+    _roundtrip(net, [rs.randn(2, 3, 16, 16).astype(np.float32)], atol=1e-4)
+
+
+def test_grouped_dilated_conv_roundtrip():
+    paddle.seed(6)
+    net = nn.Conv2D(8, 8, 3, padding=2, dilation=2, groups=4)
+    _roundtrip(net, [rs.randn(2, 8, 12, 12).astype(np.float32)], atol=1e-4)
+
+
+def test_embedding_gather_roundtrip():
+    paddle.seed(7)
+    net = nn.Sequential(nn.Embedding(100, 12), nn.Linear(12, 4))
+    _roundtrip(net, [rs.randint(0, 100, (3, 7)).astype(np.int32)])
+
+
+def test_transformer_encoder_layer_roundtrip():
+    paddle.seed(9)
+    net = nn.TransformerEncoderLayer(d_model=32, nhead=4,
+                                     dim_feedforward=64, dropout=0.0)
+    _roundtrip(net, [rs.randn(2, 9, 32).astype(np.float32)], atol=1e-4)
+
+
+def test_bert_model_roundtrip():
+    from paddle_tpu.models import BertConfig, BertModel
+
+    paddle.seed(11)
+    model = BertModel(BertConfig(
+        vocab_size=500, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=64, dropout=0.0))
+    ids = rs.randint(0, 500, (2, 16)).astype(np.int32)
+    _roundtrip(model, [ids], atol=5e-4, n_outs=2)
+
+
+def test_input_spec_path_and_return_name(tmp_path):
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(1)
+    net = nn.Linear(4, 2)
+    net.eval()
+    f = ponnx.export(net, str(tmp_path / "m"),
+                     input_spec=[InputSpec([3, 4], "float32")])
+    assert f.endswith(".onnx")
+    m = ponnx.ONNXModel(f)
+    out = m.run([np.zeros((3, 4), np.float32)])[0]
+    assert out.shape == (3, 2)
+
+
+def test_unsupported_primitive_raises_loudly():
+    class TopK(nn.Layer):
+        def forward(self, x):
+            v, _ = paddle.topk(x, k=2)
+            return v
+
+    with pytest.raises((ponnx.UnsupportedOnnxOp, NotImplementedError)):
+        ponnx.export(TopK(), "/tmp/onnx_topk",
+                     example_inputs=[rs.randn(3, 5).astype(np.float32)])
+
+
+def test_bfloat16_widens_to_f32():
+    paddle.seed(2)
+    net = nn.Linear(4, 3)
+    net.astype("bfloat16")
+    net.eval()
+    f = ponnx.export(net, "/tmp/onnx_bf16",
+                     example_inputs=[rs.randn(2, 4).astype(np.float32)])
+    m = ponnx.ONNXModel(f)
+    for t in m.initializers.values():
+        assert t.dtype != np.float16 and str(t.dtype) != "bfloat16"
+    out = m.run([np.ones((2, 4), np.float32)])[0]
+    assert out.dtype == np.float32 and np.isfinite(out).all()
+
+
+def test_rem_cumsum_scalar_take_semantics():
+    """Regression: lax.rem keeps the dividend's sign (Mod fmod=1),
+    reverse cumsum must flip the cumsum axis, and scalar take exports
+    through the Gather + Reshape path."""
+    class Ops(nn.Layer):
+        def forward(self, x):
+            r = paddle.remainder(x, paddle.to_tensor(np.float32(3.0)))
+            c = paddle.cumsum(x, axis=1)
+            s = x[1]  # scalar take along axis 0
+            return r, c, s
+
+    x = np.array([[-5., 4., -1.], [2., -7., 6.]], np.float32)
+    _roundtrip(Ops(), [x], n_outs=3)
+
+
+def test_wire_format_parses_as_protobuf():
+    """The artifact must be real protobuf: re-decode the model with the
+    generic parser and check the spec field numbers are where they
+    should be (ModelProto.graph=7, opset_import=8; GraphProto.node=1)."""
+    paddle.seed(4)
+    net = nn.Linear(2, 2)
+    net.eval()
+    f = ponnx.export(net, "/tmp/onnx_wire",
+                     example_inputs=[np.zeros((1, 2), np.float32)])
+    with open(f, "rb") as fh:
+        blob = fh.read()
+    top = proto.parse(blob)
+    assert 7 in top and 8 in top          # graph, opset_import
+    assert proto.signed(top[1][0]) == 8   # ir_version
+    graph = proto.parse(top[7][0])
+    assert 1 in graph and 11 in graph and 12 in graph  # nodes, ins, outs
