@@ -317,10 +317,14 @@ def _iota(g, ins, eqn):
     p = eqn.params
     dt = _widen(p["dtype"])
     shape, dim = list(p["shape"]), int(p["dimension"])
-    rng = np.arange(shape[dim], dtype=dt)
     view = [1] * len(shape)
     view[dim] = shape[dim]
-    return g.const(np.broadcast_to(rng.reshape(view), shape), "iota")
+    # store only the 1-D arange; Expand at run time (a broadcasted (S,S)
+    # causal-mask iota would otherwise bake O(S^2) bytes into the file)
+    rng = g.const(np.arange(shape[dim], dtype=dt).reshape(view), "iota")
+    if view == shape:
+        return g.add("Identity", [rng])
+    return g.add("Expand", [rng, g.i64(shape)])
 
 
 @_ematch("gather")
@@ -356,9 +360,10 @@ def _dot_general(g, ins, eqn):
     lhs, rhs = ins
     out_shape = tuple(eqn.outvars[0].aval.shape)
 
-    # fast path: plain matmul semantics (no batch, contract last x first)
+    # fast path: plain matmul semantics (no batch, contract last x first,
+    # rhs at most rank 2 — higher-rank rhs needs the general lowering)
     if (not lb and len(lc) == 1 and lc[0] == len(ls) - 1
-            and rc == (0,) and len(rs) >= 1):
+            and rc == (0,) and len(rs) <= 2):
         out = g.add("MatMul", [lhs, rhs])
     else:
         lfree = [d for d in range(len(ls)) if d not in lc and d not in lb]

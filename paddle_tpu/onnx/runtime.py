@@ -58,10 +58,12 @@ class _Msg:
 
 
 def _tensor_to_np(t: _Msg) -> np.ndarray:
-    dims = proto.parse_packed_i64(t.bytes_(1)) if 1 in t.f else []
-    # dims may be unpacked varints too
-    if 1 in t.f and isinstance(t.f[1][0], int):
+    if 1 not in t.f:
+        dims = []
+    elif isinstance(t.f[1][0], int):  # dims as unpacked wire-0 varints
         dims = [proto.signed(v) for v in t.f[1]]
+    else:                             # packed (what proto.tensor emits)
+        dims = proto.parse_packed_i64(t.f[1][0])
     dt = proto.ONNX_TO_NP[t.int(2)]
     raw = t.bytes_(9)
     if raw:
@@ -161,7 +163,9 @@ def _unop(name, fn):
 _binop("Add", lambda a, b: a + b)
 _binop("Sub", lambda a, b: a - b)
 _binop("Mul", lambda a, b: a * b)
-_binop("Div", lambda a, b: a / b if a.dtype.kind == "f" else a // b)
+# integer Div truncates toward zero (ONNX spec + lax.div), not floor
+_binop("Div", lambda a, b: a / b if a.dtype.kind == "f"
+       else (np.sign(a) * np.sign(b) * (np.abs(a) // np.abs(b))).astype(a.dtype))
 _binop("Pow", lambda a, b: np.power(a, b.astype(a.dtype)))
 _binop("Mod", np.fmod)
 _binop("Max", np.maximum)

@@ -32,7 +32,7 @@ from ..tensor import Tensor, apply, unwrap
 
 __all__ = ["QAT", "ImperativeQuantAware", "fake_quant",
            "QuantizedLinear", "QuantizedConv2D", "save_quantized_model",
-           "load_quantized_predictor"]
+           "load_quantized_predictor", "PostTrainingQuantization"]
 
 
 def fake_quant(x, scale, bits=8):
@@ -161,19 +161,27 @@ def save_quantized_model(model, path_prefix, input_spec=None,
     for holder, name, sub in _walk(model):
         if isinstance(sub, _QuantWrapper):
             w = np.asarray(unwrap(sub.inner.weight))
-            # abs-max of the CURRENT weight — the same value _wscale()
-            # returns during the eval-mode export trace below.  The
-            # weight_scale buffer only updates on training forwards, so
-            # after the final optimizer step it is stale and the packed
-            # int8 payload would not reproduce the served numerics.
-            scale = float(np.max(np.abs(w)))
             qmax = 2 ** (sub._wbits - 1) - 1
-            step = max(scale, 1e-8) / qmax
+            if isinstance(sub, _PTQWrapper):
+                # calibration froze the scale (maybe per-channel) — the
+                # wrapper computes with exactly this buffer, so the int8
+                # payload must pack with it too
+                scale = np.asarray(unwrap(sub.weight_scale), np.float32)
+            else:
+                # QAT: abs-max of the CURRENT weight — the same value
+                # _wscale() returns during the eval-mode export trace
+                # below.  The weight_scale buffer only updates on training
+                # forwards, so after the final optimizer step it is stale
+                # and the packed int8 payload would not reproduce the
+                # served numerics.
+                scale = np.float32(np.max(np.abs(w)))
+            step = np.maximum(scale, 1e-8) / qmax
             wq = np.clip(np.round(w / step), -qmax, qmax).astype(np.int8)
             key = _layer_path(model, sub)
             qlayers[key] = {
                 "int8_weight": wq,
-                "weight_scale": scale,
+                "weight_scale": (scale.tolist() if scale.ndim
+                                 else float(scale)),
                 "act_scale": float(np.asarray(unwrap(sub.act_scale))),
                 "bits": sub._wbits,
             }
@@ -200,6 +208,241 @@ def _layer_path(root, target):
         if sub is target:
             return name
     return f"id{id(target)}"
+
+
+# --------------------------------------------------------------------------
+# Post-training quantization
+# --------------------------------------------------------------------------
+
+
+class _PTQWrapper(_QuantWrapper):
+    """Frozen-scale variant used by PostTrainingQuantization: both scales
+    come from calibration buffers (weight_scale may be per-channel) and
+    are never re-observed — eval-only, no STE training path."""
+
+    def forward(self, x):
+        xq = fake_quant(x, self.act_scale, self._abits)
+        wq = fake_quant(self.inner.weight, self.weight_scale, self._wbits)
+        return self._compute(xq, wq)
+
+
+class _PTQLinear(_PTQWrapper, QuantizedLinear):
+    pass
+
+
+class _PTQConv2D(_PTQWrapper, QuantizedConv2D):
+    pass
+
+
+_PTQ_TYPES = {"Linear": _PTQLinear, "Conv2D": _PTQConv2D}
+# per-channel axis of the weight tensor: Linear weight is [in, out]
+# (nn/functional linear convention), Conv2D weight is [out, in, kh, kw]
+_CHANNEL_AXIS = {"Linear": 1, "Conv2D": 0}
+
+_HIST_BINS = 2048
+
+
+class _ActObserver:
+    """Accumulates |activation| statistics across calibration batches:
+    running abs-max, per-batch abs-max list, and a re-binnable histogram
+    (the data the KL/hist/mse threshold searches run on).  Mirrors the
+    collection phase of the reference's PostTrainingQuantization
+    (post_training_quantization.py:120 _sample_abs_max/_sample_histogram)
+    without its Program instrumentation — here it is a forward-pre-hook.
+    """
+
+    def __init__(self):
+        self.abs_max = 0.0
+        self.batch_maxes = []
+        self.hist = np.zeros(_HIST_BINS, np.float64)
+        self.hist_max = 0.0
+
+    def collect(self, x):
+        a = np.abs(np.asarray(unwrap(x), np.float32)).ravel()
+        m = float(a.max()) if a.size else 0.0
+        self.batch_maxes.append(m)
+        self.abs_max = max(self.abs_max, m)
+        if m == 0.0:
+            return
+        if m > self.hist_max:  # re-bin the old histogram into the new range
+            if self.hist_max > 0.0:
+                old_centers = (np.arange(_HIST_BINS) + 0.5) \
+                    * (self.hist_max / _HIST_BINS)
+                idx = np.minimum(
+                    (old_centers / m * _HIST_BINS).astype(np.int64),
+                    _HIST_BINS - 1)
+                new = np.zeros(_HIST_BINS, np.float64)
+                np.add.at(new, idx, self.hist)
+                self.hist = new
+            self.hist_max = m
+        h, _ = np.histogram(a, bins=_HIST_BINS, range=(0.0, self.hist_max))
+        self.hist += h
+
+    # --- threshold selection ---------------------------------------------
+
+    def threshold(self, algo, hist_percent=0.99999, bits=8):
+        if self.abs_max == 0.0:
+            return 1e-8
+        if algo in ("abs_max", "min_max"):
+            return self.abs_max
+        if algo == "avg":
+            return float(np.mean(self.batch_maxes))
+        if algo == "hist":
+            cdf = np.cumsum(self.hist) / max(self.hist.sum(), 1.0)
+            bin_ = int(np.searchsorted(cdf, hist_percent))
+            return (bin_ + 0.5) * self.hist_max / _HIST_BINS
+        if algo == "KL":
+            return self._kl_threshold(bits)
+        if algo == "mse":
+            return self._mse_threshold(bits)
+        raise ValueError(f"unknown PTQ algo '{algo}'")
+
+    def _kl_threshold(self, bits):
+        """TensorRT-style search: pick the clip bin whose clipped+requantized
+        distribution minimizes KL(P||Q) against the original."""
+        levels = 2 ** (bits - 1)
+        hist = self.hist / max(self.hist.sum(), 1.0)
+        best_bin, best_kl = _HIST_BINS - 1, np.inf
+        for end in range(levels, _HIST_BINS + 1, 16):
+            p = hist[:end].copy()
+            p[-1] += hist[end:].sum()  # clip mass onto the last kept bin
+            psum = p.sum()
+            if psum <= 0:
+                continue
+            p /= psum
+            # Q is built from the UNCLIPPED slice: the clipped tail mass
+            # belongs to P only, so saturating early (end == levels) is
+            # penalized by exactly that tail mass rather than scoring a
+            # degenerate KL of 0
+            ref = hist[:end]
+            q = np.zeros(end)
+            chunk = end / levels
+            for i in range(levels):  # downsample to the int8 grid
+                lo = int(i * chunk)
+                hi = max(int((i + 1) * chunk), lo + 1)
+                mass = ref[lo:hi].sum()
+                nz = (ref[lo:hi] > 0).sum()
+                if nz:
+                    q[lo:hi] = np.where(ref[lo:hi] > 0, mass / nz, 0.0)
+            qsum = q.sum()
+            if qsum <= 0:
+                continue
+            q /= qsum
+            keep = p > 0
+            kl = float(np.sum(p[keep] * np.log(
+                p[keep] / np.maximum(q[keep], 1e-12))))
+            if kl < best_kl:
+                best_kl, best_bin = kl, end - 1
+        return (best_bin + 0.5) * self.hist_max / _HIST_BINS
+
+    def _mse_threshold(self, bits):
+        """Pick the clip threshold minimizing expected squared quant error
+        under the collected histogram."""
+        qmax = 2 ** (bits - 1) - 1
+        centers = (np.arange(_HIST_BINS) + 0.5) * (self.hist_max / _HIST_BINS)
+        best_t, best_err = self.abs_max, np.inf
+        for frac in np.linspace(0.3, 1.0, 50):
+            t = self.hist_max * frac
+            step = t / qmax
+            q = np.clip(np.round(centers / step), -qmax, qmax) * step
+            err = float(np.sum(self.hist * (centers - q) ** 2))
+            if err < best_err:
+                best_err, best_t = err, t
+        return best_t
+
+
+class PostTrainingQuantization:
+    """Calibration-based int8 quantization of a trained model — no
+    retraining (reference: fluid/contrib/slim/quantization/
+    post_training_quantization.py:120, minus the Program/executor
+    machinery: calibration here is eager forwards over a DataLoader).
+
+    ``algo``: activation-threshold selection — 'abs_max' (global max),
+    'avg' (mean of per-batch maxes), 'hist' (percentile),
+    'KL' (min-divergence clip), 'mse' (min squared error clip).
+    ``weight_quantize_type``: 'abs_max' (per-tensor) or
+    'channel_wise_abs_max' (per-output-channel, the reference default
+    for conv).
+
+    Usage::
+
+        ptq = PostTrainingQuantization(model, data_loader, batch_nums=8,
+                                       algo='KL')
+        qmodel = ptq.quantize()
+        ptq.save_quantized_model('export/int8_model', example_inputs=[x])
+    """
+
+    def __init__(self, model: Layer, data_loader=None, batch_nums=10,
+                 algo="hist", hist_percent=0.99999,
+                 quantizable_layer_type=("Linear", "Conv2D"),
+                 weight_bits=8, activation_bits=8,
+                 weight_quantize_type="channel_wise_abs_max"):
+        self._model = model
+        self._loader = data_loader
+        self._batch_nums = int(batch_nums)
+        self._algo = algo
+        self._hist_percent = hist_percent
+        self._types = tuple(quantizable_layer_type)
+        self._wbits = weight_bits
+        self._abits = activation_bits
+        self._wtype = weight_quantize_type
+        self._quantized = None
+
+    def quantize(self) -> Layer:
+        model = self._model
+        model.eval()
+        # 1) attach observers to every quantizable leaf
+        observers, removes = {}, []
+        for holder, name, sub in _walk(model):
+            kind = type(sub).__name__
+            if kind in self._types and kind in _PTQ_TYPES:
+                obs = _ActObserver()
+                observers[id(sub)] = (holder, name, sub, kind, obs)
+                removes.append(sub.register_forward_pre_hook(
+                    lambda layer, args, _o=obs: _o.collect(args[0])))
+        if not observers:
+            raise ValueError("no quantizable sublayers found "
+                             f"(types={self._types})")
+        # 2) calibration forwards
+        if self._loader is not None:
+            for i, batch in enumerate(self._loader):
+                if i >= self._batch_nums:
+                    break
+                xs = batch[0] if isinstance(batch, (list, tuple)) else batch
+                model(xs if isinstance(xs, Tensor) else Tensor(np.asarray(xs)))
+        for h in removes:
+            h.remove()
+        if not any(obs.batch_maxes for *_, obs in observers.values()):
+            raise ValueError(
+                "PostTrainingQuantization saw no calibration batches — "
+                "pass a data_loader yielding representative inputs "
+                "(activation scales cannot be inferred without them)")
+        # 3) freeze scales into PTQ wrappers
+        for holder, name, sub, kind, obs in observers.values():
+            wrapper = _PTQ_TYPES[kind](sub, self._wbits, self._abits)
+            act_scale = obs.threshold(self._algo, self._hist_percent,
+                                      self._abits) if obs.batch_maxes \
+                else float(np.max(np.abs(np.asarray(unwrap(sub.weight)))))
+            wrapper.act_scale.set_value(jnp.asarray(act_scale, jnp.float32))
+            w = np.asarray(unwrap(sub.weight), np.float32)
+            if self._wtype == "channel_wise_abs_max":
+                axis = _CHANNEL_AXIS[kind]
+                red = tuple(i for i in range(w.ndim) if i != axis)
+                ws = np.max(np.abs(w), axis=red, keepdims=True)
+            else:
+                ws = np.max(np.abs(w))
+            wrapper.weight_scale.set_value(
+                jnp.asarray(np.maximum(ws, 1e-8), jnp.float32))
+            setattr(holder, name, wrapper)
+        self._quantized = model
+        return model
+
+    def save_quantized_model(self, path_prefix, input_spec=None,
+                             example_inputs=None):
+        if self._quantized is None:
+            self.quantize()
+        return save_quantized_model(self._quantized, path_prefix,
+                                    input_spec, example_inputs)
 
 
 def load_quantized_predictor(path_prefix):

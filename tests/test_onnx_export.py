@@ -131,6 +131,37 @@ def test_rem_cumsum_scalar_take_semantics():
     _roundtrip(Ops(), [x], n_outs=3)
 
 
+def test_general_dot_general_high_rank_rhs():
+    """Regression: einsum with rank-3 rhs must take the general
+    transpose/reshape lowering, not the MatMul fast path."""
+    class Heads(nn.Layer):
+        def __init__(self):
+            super().__init__()
+            self.w = self.create_parameter([6, 2, 3])
+
+        def forward(self, x):  # bsh,hnd->bsnd
+            import paddle_tpu
+
+            return paddle_tpu.einsum("bsh,hnd->bsnd", x, self.w)
+
+    _roundtrip(Heads(), [rs.randn(2, 4, 6).astype(np.float32)])
+
+
+def test_iota_exports_compact_and_int_div_truncates():
+    class IotaDiv(nn.Layer):
+        def forward(self, x):
+            pos = paddle.arange(0, 8, dtype="int32")          # iota
+            q = paddle.floor_divide(paddle.to_tensor(
+                np.int32(-3)) * pos, paddle.to_tensor(np.int32(2)))
+            return x + pos.astype("float32"), q
+
+    m = _roundtrip(IotaDiv(), [rs.randn(2, 8).astype(np.float32)],
+                   n_outs=2)
+    # iota stored as 1-D arange, never a broadcast blob: no initializer
+    # larger than the model weights should exist
+    assert all(t.size <= 64 for t in m.initializers.values())
+
+
 def test_wire_format_parses_as_protobuf():
     """The artifact must be real protobuf: re-decode the model with the
     generic parser and check the spec field numbers are where they

@@ -1,0 +1,137 @@
+"""PostTrainingQuantization (reference: fluid/contrib/slim/quantization/
+post_training_quantization.py:120): calibration-only int8 — observer
+statistics, threshold algorithms, channel-wise weight scales, accuracy
+within budget of fp32, and the int8 export artifact."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.slim import (PostTrainingQuantization,
+                             load_quantized_predictor)
+from paddle_tpu.slim import _ActObserver, _PTQWrapper  # noqa: internals
+
+rs = np.random.RandomState(0)
+
+
+class MLP(nn.Layer):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(8, 32)
+        self.relu = nn.ReLU()
+        self.fc2 = nn.Linear(32, 2)
+
+    def forward(self, x):
+        return self.fc2(self.relu(self.fc1(x)))
+
+
+def _loader(n_batches=8, batch=16, d=8):
+    for _ in range(n_batches):
+        yield paddle.to_tensor(rs.randn(batch, d).astype(np.float32))
+
+
+def test_observer_thresholds_ordered():
+    obs = _ActObserver()
+    for _ in range(16):
+        obs.collect(paddle.to_tensor(
+            rs.randn(1024).astype(np.float32)))
+    t_max = obs.threshold("abs_max")
+    t_avg = obs.threshold("avg")
+    t_hist = obs.threshold("hist", hist_percent=0.999)
+    t_kl = obs.threshold("KL")
+    t_mse = obs.threshold("mse")
+    # clipping algorithms must clip: thresholds below the global abs-max,
+    # but positive and of the right magnitude for N(0,1) data
+    assert 0 < t_avg <= t_max
+    assert 0.5 < t_hist < t_max
+    assert 0.5 < t_kl <= t_max + 1e-6
+    assert 0.5 < t_mse <= t_max + 1e-6
+
+
+def test_observer_rebinning_keeps_mass():
+    obs = _ActObserver()
+    obs.collect(paddle.to_tensor(np.full(100, 0.5, np.float32)))
+    mass1 = obs.hist.sum()
+    # a 10x larger batch forces a histogram re-bin
+    obs.collect(paddle.to_tensor(np.full(50, 5.0, np.float32)))
+    assert obs.hist_max == pytest.approx(5.0)
+    assert obs.hist.sum() == pytest.approx(mass1 + 50)
+
+
+def test_ptq_accuracy_close_to_fp32():
+    paddle.seed(7)
+    model = MLP()
+    x_eval = rs.randn(64, 8).astype(np.float32)
+    want = np.asarray(model(paddle.to_tensor(x_eval)).numpy())
+
+    ptq = PostTrainingQuantization(model, _loader(), batch_nums=8,
+                                   algo="hist")
+    qmodel = ptq.quantize()
+    got = np.asarray(qmodel(paddle.to_tensor(x_eval)).numpy())
+    # int8 budget: small relative error on the logits
+    rel = np.abs(got - want).max() / (np.abs(want).max() + 1e-8)
+    assert rel < 0.1, rel
+    # wrapped layers replaced in place
+    assert isinstance(qmodel.fc1, _PTQWrapper)
+    assert isinstance(qmodel.fc2, _PTQWrapper)
+
+
+@pytest.mark.parametrize("algo", ["abs_max", "avg", "hist", "KL", "mse"])
+def test_ptq_all_algos_run(algo):
+    paddle.seed(1)
+    model = MLP()
+    q = PostTrainingQuantization(model, _loader(4), batch_nums=4,
+                                 algo=algo).quantize()
+    out = q(paddle.to_tensor(rs.randn(4, 8).astype(np.float32)))
+    assert np.isfinite(np.asarray(out.numpy())).all()
+
+
+def test_channel_wise_weight_scales():
+    paddle.seed(2)
+    net = nn.Sequential(nn.Conv2D(2, 6, 3, padding=1), nn.ReLU(),
+                        nn.Flatten(), nn.Linear(6 * 4 * 4, 3))
+
+    def loader():
+        for _ in range(3):
+            yield paddle.to_tensor(rs.randn(2, 2, 4, 4).astype(np.float32))
+
+    q = PostTrainingQuantization(
+        net, loader(), batch_nums=3,
+        weight_quantize_type="channel_wise_abs_max").quantize()
+    conv_scale = np.asarray(q[0].weight_scale.numpy())
+    fc_scale = np.asarray(q[3].weight_scale.numpy())
+    assert conv_scale.shape == (6, 1, 1, 1)   # per out-channel (OIHW)
+    assert fc_scale.shape == (1, 3)           # per out-feature ([in, out])
+    assert (conv_scale > 0).all() and (fc_scale > 0).all()
+
+
+def test_ptq_export_int8_artifact(tmp_path):
+    paddle.seed(3)
+    model = MLP()
+    x = rs.randn(4, 8).astype(np.float32)
+    ptq = PostTrainingQuantization(model, _loader(4), batch_nums=4,
+                                   algo="avg")
+    qmodel = ptq.quantize()
+    want = np.asarray(qmodel(paddle.to_tensor(x)).numpy())
+    prefix = str(tmp_path / "ptq_model")
+    ptq.save_quantized_model(prefix, example_inputs=[x])
+    pred = load_quantized_predictor(prefix)
+    got, = pred.run([x])
+    np.testing.assert_allclose(np.asarray(got), want, atol=1e-5)
+    for rec in pred.quant_params.values():
+        assert rec["int8_weight"].dtype == np.int8
+        assert rec["act_scale"] > 0
+
+
+def test_ptq_requires_quantizable_layers():
+    with pytest.raises(ValueError):
+        PostTrainingQuantization(nn.ReLU(), _loader(1)).quantize()
+
+
+def test_ptq_requires_calibration_batches():
+    """Regression: no loader (or an empty one) must raise, not silently
+    substitute weight magnitudes for activation scales."""
+    with pytest.raises(ValueError, match="calibration"):
+        PostTrainingQuantization(MLP(), data_loader=None).quantize()
+    with pytest.raises(ValueError, match="calibration"):
+        PostTrainingQuantization(MLP(), data_loader=iter(())).quantize()
