@@ -68,7 +68,7 @@ class GPTAttention(Layer):
             self.out = Linear(H, H, weight_attr=_init(cfg))
         self.dropout = Dropout(cfg.dropout)
 
-    def forward(self, x):
+    def forward(self, x, return_kv=False):
         cfg = self.cfg
         B, S = x.shape[0], x.shape[1]
         nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
@@ -89,7 +89,60 @@ class GPTAttention(Layer):
                 q, k, v, dropout_p=cfg.attn_dropout, is_causal=True,
                 training=self.training)
         ctx = T.reshape(ctx, [B, S, cfg.hidden_size])
-        return self.dropout(self.out(ctx))
+        out = self.dropout(self.out(ctx))
+        if return_kv:
+            return out, k, v  # [B, S, nh, hd] — prefill seeds the KV cache
+        return out
+
+    def decode_step(self, x, k_cache, v_cache, pos):
+        """One-token cached attention (the KV-cache serving path; the
+        reference's analog is fused_multi_transformer's CacheKV decode,
+        operators/fused/ — here it is lax-level dynamic_update_slice +
+        masked attention over the static cache, jit/scan-safe).
+
+        x: [B, 1, H] hidden; caches: [B, S_max, nh, hd]; pos: scalar int32
+        index of the slot this token occupies.  Returns (out, k', v').
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..tensor import unwrap
+
+        cfg = self.cfg
+        B = x.shape[0]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = T.reshape(self.qkv(x), [B, 1, 3, nh, hd])
+        q = unwrap(qkv[:, :, 0])                     # [B, 1, nh, hd]
+        k = unwrap(qkv[:, :, 1])
+        v = unwrap(qkv[:, :, 2])
+        pos = jnp.asarray(unwrap(pos), jnp.int32)
+        zero = jnp.int32(0)
+        k_cache = lax.dynamic_update_slice(
+            unwrap(k_cache), k, (zero, pos, zero, zero))
+        v_cache = lax.dynamic_update_slice(
+            unwrap(v_cache), v, (zero, pos, zero, zero))
+        if cfg.tensor_parallel:
+            # same head-axis pinning as forward(): without it GSPMD may
+            # pick a gathered layout for the per-step attention and pay
+            # an all-gather every decode step
+            q = unwrap(shard_constraint(Tensor(q), None, None, "mp", None))
+            k_cache = unwrap(shard_constraint(
+                Tensor(k_cache), None, None, "mp", None))
+            v_cache = unwrap(shard_constraint(
+                Tensor(v_cache), None, None, "mp", None))
+        # masked attention over the whole static cache: slots past `pos`
+        # are -inf so the softmax ignores unwritten entries
+        scores = jnp.einsum("bqnd,bsnd->bnqs", q, k_cache) \
+            * (1.0 / float(hd) ** 0.5)
+        valid = jnp.arange(k_cache.shape[1]) <= pos   # [S_max]
+        scores = jnp.where(valid[None, None, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jnp.exp(scores - lax.stop_gradient(
+            scores.max(axis=-1, keepdims=True)))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, v_cache)
+        out = self.out(Tensor(ctx.reshape(B, 1, cfg.hidden_size)))
+        return out, Tensor(k_cache), Tensor(v_cache)
 
 
 class GPTMLP(Layer):
@@ -118,10 +171,22 @@ class GPTBlock(Layer):
         self.ln_2 = LayerNorm(cfg.hidden_size, epsilon=cfg.layer_norm_epsilon)
         self.mlp = GPTMLP(cfg)
 
-    def forward(self, x):
+    def forward(self, x, return_kv=False):
+        if return_kv:
+            a, k, v = self.attn(self.ln_1(x), return_kv=True)
+            x = x + a
+            x = x + self.mlp(self.ln_2(x))
+            return x, k, v
         x = x + self.attn(self.ln_1(x))
         x = x + self.mlp(self.ln_2(x))
         return x
+
+    def decode_step(self, x, k_cache, v_cache, pos):
+        a, k_cache, v_cache = self.attn.decode_step(
+            self.ln_1(x), k_cache, v_cache, pos)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_cache, v_cache
 
 
 class GPTModel(Layer):
@@ -157,6 +222,50 @@ class GPTModel(Layer):
                 x = blk(x)
         return self.ln_f(x)
 
+    def prefill(self, input_ids, cache_len):
+        """Batched prompt pass seeding per-layer KV caches of static
+        length ``cache_len`` (>= prompt + new tokens).  Returns
+        (hidden [B,S,H], caches: tuple of (k,v) [B,cache_len,nh,hd])."""
+        import jax.numpy as jnp
+
+        import paddle_tpu as paddle
+
+        from ..tensor import unwrap
+
+        cfg = self.cfg
+        if self.training:
+            raise RuntimeError(
+                "prefill/decode_step are eval-only serving paths (the "
+                "decode half applies no dropout, so a training-mode "
+                "prefill would be statistically inconsistent with it); "
+                "call model.eval() first")
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        pos = paddle.arange(S)
+        x = self.drop(self.wte(input_ids) + self.wpe(pos))
+        caches = []
+        for blk in self.h:
+            x, k, v = blk(x, return_kv=True)
+            kc = jnp.zeros((B, cache_len, nh, hd),
+                           unwrap(k).dtype).at[:, :S].set(unwrap(k))
+            vc = jnp.zeros((B, cache_len, nh, hd),
+                           unwrap(v).dtype).at[:, :S].set(unwrap(v))
+            caches.append((kc, vc))
+        return self.ln_f(x), tuple(caches)
+
+    def decode_step(self, token_ids, pos, caches):
+        """One decode step: token_ids [B,1] at absolute position ``pos``
+        (scalar); caches as returned by prefill.  Returns (hidden [B,1,H],
+        new caches)."""
+        from ..tensor import unwrap
+
+        x = self.wte(token_ids) + self.wpe(T.reshape(Tensor(pos), [1]))
+        new_caches = []
+        for blk, (kc, vc) in zip(self.h, caches):
+            x, kc, vc = blk.decode_step(x, kc, vc, pos)
+            new_caches.append((unwrap(kc), unwrap(vc)))
+        return self.ln_f(x), tuple(new_caches)
+
 
 class GPTForCausalLM(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -169,10 +278,7 @@ class GPTForCausalLM(Layer):
 
     def forward(self, input_ids, labels=None):
         hidden = self.gpt(input_ids)
-        if self.cfg.tie_word_embeddings:
-            logits = T.matmul(hidden, T.transpose(self.gpt.wte.weight, [1, 0]))
-        else:
-            logits = self.lm_head(hidden)
+        logits = self._head(hidden)
         if labels is None:
             return logits
         loss = fused.softmax_cross_entropy(
@@ -192,3 +298,109 @@ class GPTForCausalLM(Layer):
         loss = fused.fused_linear_cross_entropy(
             hidden[:, :-1], w, input_ids[:, 1:])
         return T.mean(loss)
+
+    def _head(self, hidden):
+        if self.cfg.tie_word_embeddings:
+            return T.matmul(hidden,
+                            T.transpose(self.gpt.wte.weight, [1, 0]))
+        return self.lm_head(hidden)
+
+    def _generate_traced(self, input_ids, rng, max_new_tokens, temperature,
+                         top_k, do_sample):
+        """jit-traced generation body: batched prefill, then lax.scan
+        single-token decode over static-size KV caches — the
+        TPU-idiomatic serving loop (static shapes, no per-step dispatch;
+        the reference's dynamic while_loop + beam_search_op decoders,
+        operators/beam_search_op.cc, trade shape dynamism for host
+        round-trips that ICI latency makes prohibitive here)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..tensor import unwrap
+
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        cache_len = S + int(max_new_tokens)
+        if cache_len > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt {S} + max_new_tokens {max_new_tokens} exceeds "
+                f"max_position_embeddings {self.cfg.max_position_embeddings}")
+
+        def sample(logits, key):
+            logits = unwrap(logits)[:, -1]            # [B, V]
+            if not do_sample:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            logits = logits / jnp.maximum(temperature, 1e-6)
+            if top_k and top_k > 0:
+                kth = jax.lax.top_k(logits, top_k)[0][:, -1:]
+                logits = jnp.where(logits < kth,
+                                   jnp.finfo(logits.dtype).min, logits)
+            return jax.random.categorical(key, logits).astype(jnp.int32)
+
+        hidden, caches = self.gpt.prefill(input_ids, cache_len)
+        key, sub = jax.random.split(rng)
+        tok = sample(self._head(hidden[:, -1:]), sub)  # first new token
+
+        def step(carry, _):
+            tok, pos, caches, key = carry
+            key, sub = jax.random.split(key)
+            hidden, caches = self.gpt.decode_step(
+                Tensor(tok[:, None]), pos, caches)
+            nxt = sample(self._head(hidden), sub)
+            return (nxt, pos + 1, caches, key), tok
+
+        (last, _, _, _), toks = jax.lax.scan(
+            step, (tok, jnp.asarray(S, jnp.int32), caches, key),
+            None, length=int(max_new_tokens) - 1)
+        toks = jnp.concatenate(
+            [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)  # [B, new]
+        return jnp.concatenate([unwrap(input_ids), toks], axis=1)
+
+    def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
+                 top_k=0, do_sample=False, seed=0):
+        """Autoregressive generation with a static KV cache.
+
+        Greedy by default; ``do_sample=True`` enables temperature / top-k
+        categorical sampling.  The whole loop (prefill + every decode
+        step) compiles to ONE XLA program per (batch, prompt_len,
+        max_new_tokens) shape — cached across calls.  Returns
+        [B, prompt_len + max_new_tokens] int32 token ids (prompt
+        included), matching the HF/paddlenlp generate contract.
+        """
+        import jax
+        import numpy as np
+
+        from ..nn.layer_base import functional_call, state_pytrees
+
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        ids = input_ids if isinstance(input_ids, Tensor) \
+            else Tensor(np.asarray(input_ids, np.int32))
+        was_training = self.training
+        self.eval()
+        try:
+            params, buffers = state_pytrees(self)
+            # sampling knobs only shape the program when do_sample is on
+            key_static = (ids.shape[0], ids.shape[1], int(max_new_tokens),
+                          bool(do_sample),
+                          (float(temperature), int(top_k))
+                          if do_sample else None)
+            cache = getattr(self, "_gen_cache", None)
+            if cache is None:
+                cache = self._gen_cache = {}
+            if key_static not in cache:
+                def run(params, ids_arr, rng):
+                    out, _ = functional_call(
+                        self, params,
+                        (Tensor(ids_arr), rng, max_new_tokens, temperature,
+                         top_k, do_sample),
+                        buffers=buffers, mutable=False,
+                        method="_generate_traced")
+                    return out
+
+                cache[key_static] = jax.jit(run)
+            fn = cache[key_static]
+            rng = jax.random.PRNGKey(seed)
+            return Tensor(fn(params, ids.value.astype("int32"), rng))
+        finally:
+            if was_training:
+                self.train()
