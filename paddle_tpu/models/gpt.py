@@ -305,8 +305,76 @@ class GPTForCausalLM(Layer):
                             T.transpose(self.gpt.wte.weight, [1, 0]))
         return self.lm_head(hidden)
 
+    def _beam_traced(self, input_ids, max_new_tokens, num_beams,
+                     eos_token_id):
+        """jit-traced beam search over the KV cache: beams live as an
+        expanded batch [B*W]; each step expands W*V candidates through
+        text.beam_search_step (the beam_search_op.cc redesign), reorders
+        the caches along the surviving parents, and the final sequences
+        are backtracked with text.gather_tree (gather_tree_op.cc)."""
+        import jax
+        import jax.numpy as jnp
+
+        from ..tensor import unwrap
+        from ..text import beam_search_decode, beam_search_step
+
+        B, S = input_ids.shape[0], input_ids.shape[1]
+        W = int(num_beams)
+        V = self.cfg.vocab_size
+        cache_len = S + int(max_new_tokens)
+        eos = V if eos_token_id is None else int(eos_token_id)  # V = never
+
+        ids = unwrap(input_ids)
+        # prefill ONCE per prompt; beams only diverge after the first
+        # expansion, so the caches/last-hidden just repeat along batch
+        hidden, caches = self.gpt.prefill(input_ids, cache_len)
+        caches = tuple((jnp.repeat(k, W, axis=0), jnp.repeat(v, W, axis=0))
+                       for k, v in caches)
+
+        def log_probs(hidden):
+            lg = unwrap(self._head(hidden))[:, -1]            # [B*W, V]
+            return jax.nn.log_softmax(lg, axis=-1).reshape(B, W, V)
+
+        lg0 = unwrap(self._head(hidden[:, -1:]))[:, -1]       # [B, V]
+        lp0 = jnp.broadcast_to(
+            jax.nn.log_softmax(lg0, axis=-1)[:, None, :], (B, W, V))
+        scores0 = jnp.full((B, W), jnp.finfo(jnp.float32).min,
+                           jnp.float32).at[:, 0].set(0.0)
+        finished0 = jnp.zeros((B, W), bool)
+        batch_base = (jnp.arange(B, dtype=jnp.int32)[:, None] * W)
+
+        def step(carry, _):
+            lp, scores, finished, caches, pos = carry
+            tok, parents, scores = (
+                unwrap(t) for t in beam_search_step(
+                    Tensor(lp), Tensor(scores), W, end_token=eos,
+                    finished=Tensor(finished)))
+            tok = tok.astype(jnp.int32)
+            parents = parents.astype(jnp.int32)
+            sel = (batch_base + parents).reshape(-1)          # [B*W]
+            finished = jnp.take_along_axis(finished, parents, axis=1) \
+                | (tok == eos)
+            caches = tuple((k[sel], v[sel]) for k, v in caches)
+            hidden, caches = self.gpt.decode_step(
+                Tensor(tok.reshape(B * W, 1)), pos, caches)
+            return ((log_probs(hidden), scores, finished, caches, pos + 1),
+                    (tok, parents))
+
+        (_, scores, _, _, _), (toks, parents) = jax.lax.scan(
+            step, (lp0, scores0, finished0, caches,
+                   jnp.asarray(S, jnp.int32)),
+            None, length=int(max_new_tokens))
+        # backtrack surviving paths (beam_search_decode_op analog)
+        seqs, scores = beam_search_decode(Tensor(toks), Tensor(parents),
+                                          Tensor(scores))
+        best = jnp.argmax(unwrap(scores), axis=1)             # [B]
+        seq = jnp.take_along_axis(
+            unwrap(seqs), best[:, None, None].astype(jnp.int32),
+            axis=1)[:, 0]
+        return jnp.concatenate([ids, seq.astype(jnp.int32)], axis=1)
+
     def _generate_traced(self, input_ids, rng, max_new_tokens, temperature,
-                         top_k, do_sample):
+                         top_k, do_sample, eos_token_id):
         """jit-traced generation body: batched prefill, then lax.scan
         single-token decode over static-size KV caches — the
         TPU-idiomatic serving loop (static shapes, no per-step dispatch;
@@ -320,10 +388,8 @@ class GPTForCausalLM(Layer):
 
         B, S = input_ids.shape[0], input_ids.shape[1]
         cache_len = S + int(max_new_tokens)
-        if cache_len > self.cfg.max_position_embeddings:
-            raise ValueError(
-                f"prompt {S} + max_new_tokens {max_new_tokens} exceeds "
-                f"max_position_embeddings {self.cfg.max_position_embeddings}")
+        V = self.cfg.vocab_size
+        eos = V if eos_token_id is None else int(eos_token_id)  # V = never
 
         def sample(logits, key):
             logits = unwrap(logits)[:, -1]            # [B, V]
@@ -339,32 +405,38 @@ class GPTForCausalLM(Layer):
         hidden, caches = self.gpt.prefill(input_ids, cache_len)
         key, sub = jax.random.split(rng)
         tok = sample(self._head(hidden[:, -1:]), sub)  # first new token
+        finished = tok == eos
 
         def step(carry, _):
-            tok, pos, caches, key = carry
+            tok, finished, pos, caches, key = carry
             key, sub = jax.random.split(key)
             hidden, caches = self.gpt.decode_step(
                 Tensor(tok[:, None]), pos, caches)
             nxt = sample(self._head(hidden), sub)
-            return (nxt, pos + 1, caches, key), tok
+            nxt = jnp.where(finished, jnp.int32(eos), nxt)  # pad past eos
+            finished = finished | (nxt == eos)
+            return (nxt, finished, pos + 1, caches, key), tok
 
-        (last, _, _, _), toks = jax.lax.scan(
-            step, (tok, jnp.asarray(S, jnp.int32), caches, key),
+        (last, _, _, _, _), toks = jax.lax.scan(
+            step, (tok, finished, jnp.asarray(S, jnp.int32), caches, key),
             None, length=int(max_new_tokens) - 1)
         toks = jnp.concatenate(
             [jnp.moveaxis(toks, 0, 1), last[:, None]], axis=1)  # [B, new]
         return jnp.concatenate([unwrap(input_ids), toks], axis=1)
 
     def generate(self, input_ids, max_new_tokens=32, temperature=1.0,
-                 top_k=0, do_sample=False, seed=0):
+                 top_k=0, do_sample=False, seed=0, num_beams=1,
+                 eos_token_id=None):
         """Autoregressive generation with a static KV cache.
 
         Greedy by default; ``do_sample=True`` enables temperature / top-k
-        categorical sampling.  The whole loop (prefill + every decode
-        step) compiles to ONE XLA program per (batch, prompt_len,
-        max_new_tokens) shape — cached across calls.  Returns
-        [B, prompt_len + max_new_tokens] int32 token ids (prompt
-        included), matching the HF/paddlenlp generate contract.
+        categorical sampling; ``num_beams > 1`` runs beam search (length
+        penalty not applied; finished beams propose only
+        ``eos_token_id``).  The whole loop (prefill + every decode step)
+        compiles to ONE XLA program per (batch, prompt_len,
+        max_new_tokens, mode) shape — cached across calls in a per-shape
+        dict.  Returns [B, prompt_len + max_new_tokens] int32 token ids
+        (prompt included), matching the HF/paddlenlp generate contract.
         """
         import jax
         import numpy as np
@@ -373,29 +445,49 @@ class GPTForCausalLM(Layer):
 
         if max_new_tokens < 1:
             raise ValueError("max_new_tokens must be >= 1")
+        if num_beams > 1 and do_sample:
+            raise ValueError("beam search and sampling are exclusive "
+                             "(num_beams > 1 with do_sample=True)")
         ids = input_ids if isinstance(input_ids, Tensor) \
             else Tensor(np.asarray(input_ids, np.int32))
+        if ids.shape[1] + int(max_new_tokens) \
+                > self.cfg.max_position_embeddings:
+            raise ValueError(
+                f"prompt {ids.shape[1]} + max_new_tokens {max_new_tokens} "
+                f"exceeds max_position_embeddings "
+                f"{self.cfg.max_position_embeddings}")
         was_training = self.training
         self.eval()
         try:
             params, buffers = state_pytrees(self)
             # sampling knobs only shape the program when do_sample is on
             key_static = (ids.shape[0], ids.shape[1], int(max_new_tokens),
-                          bool(do_sample),
+                          bool(do_sample), int(num_beams),
+                          None if eos_token_id is None else int(eos_token_id),
                           (float(temperature), int(top_k))
                           if do_sample else None)
             cache = getattr(self, "_gen_cache", None)
             if cache is None:
                 cache = self._gen_cache = {}
             if key_static not in cache:
-                def run(params, ids_arr, rng):
-                    out, _ = functional_call(
-                        self, params,
-                        (Tensor(ids_arr), rng, max_new_tokens, temperature,
-                         top_k, do_sample),
-                        buffers=buffers, mutable=False,
-                        method="_generate_traced")
-                    return out
+                if num_beams > 1:
+                    def run(params, ids_arr, rng):
+                        out, _ = functional_call(
+                            self, params,
+                            (Tensor(ids_arr), max_new_tokens, num_beams,
+                             eos_token_id),
+                            buffers=buffers, mutable=False,
+                            method="_beam_traced")
+                        return out
+                else:
+                    def run(params, ids_arr, rng):
+                        out, _ = functional_call(
+                            self, params,
+                            (Tensor(ids_arr), rng, max_new_tokens,
+                             temperature, top_k, do_sample, eos_token_id),
+                            buffers=buffers, mutable=False,
+                            method="_generate_traced")
+                        return out
 
                 cache[key_static] = jax.jit(run)
             fn = cache[key_static]

@@ -81,6 +81,56 @@ def test_context_overflow_raises(model):
         model.generate(paddle.to_tensor(prompt), max_new_tokens=10)
 
 
+def test_beam_width_one_is_exactly_greedy(model):
+    """A width-1 beam IS greedy decoding: the top-1 joint candidate each
+    step is the argmax token of the single live beam — a sound invariant
+    (unlike greedy-vs-wide-beam score dominance, which pruning can
+    break).  Exercises _beam_traced directly since generate() routes
+    num_beams=1 to the cheaper greedy decoder."""
+    from paddle_tpu.nn.layer_base import functional_call, state_pytrees
+
+    prompt = rs.randint(0, 211, (2, 5)).astype(np.int32)
+    greedy = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                       max_new_tokens=5).numpy())
+    params, buffers = state_pytrees(model)
+    beam1, _ = functional_call(
+        model, params, (paddle.to_tensor(prompt), 5, 1, None),
+        buffers=buffers, mutable=False, method="_beam_traced")
+    np.testing.assert_array_equal(np.asarray(beam1), greedy)
+
+
+def test_beam_search_well_formed(model):
+    prompt = rs.randint(0, 211, (2, 5)).astype(np.int32)
+    beam = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                     max_new_tokens=5, num_beams=4).numpy())
+    assert beam.shape == (2, 10)
+    assert (beam[:, :5] == prompt).all()
+    assert (beam >= 0).all() and (beam < 211).all()
+
+
+def test_eos_pads_greedy_path(model):
+    """Set eos to the token greedy emits at the first new position: every
+    subsequent token must be eos (finished sequences emit only eos)."""
+    prompt = rs.randint(0, 211, (2, 6)).astype(np.int32)
+    base = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                     max_new_tokens=4).numpy())
+    eos = int(base[0, 6])  # row 0's first generated token
+    out = np.asarray(model.generate(paddle.to_tensor(prompt),
+                                    max_new_tokens=4,
+                                    eos_token_id=eos).numpy())
+    assert (out[0, 6:] == eos).all(), out[0]
+    # row 1 (if it never hit eos) must be unaffected by row 0 finishing
+    if eos not in base[1, 6:]:
+        np.testing.assert_array_equal(out[1], base[1])
+
+
+def test_beam_and_sampling_exclusive(model):
+    prompt = rs.randint(0, 211, (1, 3)).astype(np.int32)
+    with pytest.raises(ValueError, match="exclusive"):
+        model.generate(paddle.to_tensor(prompt), num_beams=2,
+                       do_sample=True)
+
+
 def test_training_mode_prefill_raises(model):
     model.train()
     try:
