@@ -31,13 +31,15 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     inputs.  ``configs['example_inputs']`` may carry concrete example
     arrays when input_spec holds symbolic (-1/None) dims.
 
-    The exported graph is SHAPE-SPECIALIZED at the traced sizes (Reshape/
-    Expand targets are baked), like torch.onnx.export without
-    dynamic_axes: re-export per shape if multiple are served.  The
-    StableHLO artifact (inference.save_inference_model) is the path with
-    true symbolic batch.  Matches the reference signature
-    (python/paddle/onnx/export.py:30); ``opset_version`` below 13 is
-    promoted to 13 (the emitted op set).
+    Symbolic (-1/None) InputSpec dims export as TRUE dynamic dims: the
+    forward is traced with jax shape polymorphism and every shape the
+    graph computes with (Reshape/Expand targets) is emitted as runtime
+    Shape/Gather/Concat values, so one artifact serves any size there —
+    all dynamic dims share one symbol (the batch), matching the
+    StableHLO path's contract.  Without symbolic dims the graph is
+    shape-specialized at the example sizes.  Matches the reference
+    signature (python/paddle/onnx/export.py:30); ``opset_version`` below
+    13 is promoted to 13 (the emitted op set).
     """
     import jax
 
@@ -68,6 +70,34 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
     examples = [np.asarray(e.numpy() if isinstance(e, Tensor) else e)
                 for e in examples]
 
+    # dynamic dims: trace with jax shape polymorphism; one shared symbol
+    # for every -1/None axis (independent dynamic sizes would need the
+    # model to never relate them — re-export per shape for that case)
+    input_names = [f"x{i}" for i in range(len(examples))]
+    sym_sources = {}
+    trace_args = list(examples)
+    if input_spec is not None and any(
+            not isinstance(s, (Tensor, np.ndarray))
+            and any(d is None or int(d) < 0 for d in s.shape)
+            for s in input_spec):
+        from jax import export as jexport
+
+        bsym, = jexport.symbolic_shape("b")
+        trace_args = []
+        for i, (s, ex) in enumerate(zip(input_spec, examples)):
+            if isinstance(s, (Tensor, np.ndarray)):
+                trace_args.append(ex)
+                continue
+            shape = []
+            for ax, d in enumerate(s.shape):
+                if d is None or int(d) < 0:
+                    shape.append(bsym)
+                    sym_sources.setdefault(
+                        str(bsym), (bsym, input_names[i], ax))
+                else:
+                    shape.append(int(d))
+            trace_args.append(jax.ShapeDtypeStruct(tuple(shape), ex.dtype))
+
     was_training = layer.training
     layer.eval()
     try:
@@ -81,13 +111,13 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
             return tuple(o.value if isinstance(o, Tensor) else o
                          for o in outs)
 
-        closed = jax.make_jaxpr(fwd)(*examples)
+        closed = jax.make_jaxpr(fwd)(*trace_args)
     finally:
         if was_training:
             layer.train()
 
     g = GraphBuilder()
-    input_names = [f"x{i}" for i in range(len(examples))]
+    g.sym_sources = sym_sources
     g, out_names = convert_jaxpr(closed, input_names, g)
 
     # graph outputs must be node outputs, not raw initializers/inputs
@@ -98,11 +128,14 @@ def export(layer, path, input_spec=None, opset_version=13, **configs):
         final.append(nm)
         seen.add(nm)
 
-    in_vis = [proto.value_info(nm, _widen(ex.dtype),
-                               [int(d) for d in ex.shape])
-              for nm, ex in zip(input_names, examples)]
+    def _dims(shape):
+        return [int(d) if isinstance(d, (int, np.integer)) else str(d)
+                for d in shape]
+
+    in_vis = [proto.value_info(nm, _widen(ta.dtype), _dims(ta.shape))
+              for nm, ta in zip(input_names, trace_args)]
     out_vis = [proto.value_info(nm, _widen(v.aval.dtype),
-                                [int(d) for d in v.aval.shape])
+                                _dims(v.aval.shape))
                for nm, v in zip(final, closed.jaxpr.outvars)]
 
     graph = proto.graph(g.nodes, "paddle_tpu_graph", in_vis, out_vis,

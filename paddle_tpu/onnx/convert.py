@@ -43,6 +43,13 @@ class GraphBuilder:
         self._n = 0
         self._const_cache = {}
         self.init_names = set()
+        # symbolic-dim support (dynamic batch): str(sym) -> (sym_obj,
+        # graph_input_name, axis).  Filled by export() when tracing with
+        # jax.export.symbolic_shape; _dyn_dim turns a symbol into a
+        # runtime int64[1] value via Shape+Gather on the source input.
+        self.sym_sources = {}
+        self._dyn_cache = {}
+        self._shape_vec_cache = {}
 
     def fresh(self, hint="t"):
         self._n += 1
@@ -71,6 +78,59 @@ class GraphBuilder:
 
     def i64(self, values, hint="shape"):
         return self.const(np.asarray(values, np.int64), hint)
+
+    def _dyn_dim(self, d):
+        """int64[1] runtime value for a symbolic dimension (or sym*k)."""
+        key = str(d)
+        if key in self._dyn_cache:
+            return self._dyn_cache[key]
+        src = self.sym_sources.get(key)
+        if src is not None:
+            _, inp, ax = src
+            shp = self.add("Shape", [inp])
+            out = self.add("Gather", [shp, self.i64([ax], "ax")], axis=0)
+        else:  # composite: try d == sym * k for a known symbol
+            out = None
+            for sym, _, _ in self.sym_sources.values():
+                try:
+                    k = d // sym
+                    k = int(k)
+                except Exception:  # noqa: BLE001 - not divisible/symbolic
+                    continue
+                if sym * k == d:
+                    out = self.add("Mul",
+                                   [self._dyn_dim(sym), self.i64([k], "k")])
+                    break
+            if out is None:
+                raise UnsupportedOnnxOp(
+                    f"dynamic dimension expression '{d}' (supported: a "
+                    f"traced symbol or symbol*constant)")
+        self._dyn_cache[key] = out
+        return out
+
+    def shape_vec(self, dims, hint="shape"):
+        """An int64[N] shape value: constant when every dim is static,
+        else Concat of constant runs and runtime symbolic dims."""
+        dims = list(dims)
+        if all(isinstance(d, (int, np.integer)) for d in dims):
+            return self.i64([int(d) for d in dims], hint)
+        key = tuple(str(d) for d in dims)
+        if key in self._shape_vec_cache:
+            return self._shape_vec_cache[key]
+        parts, run = [], []
+        for d in dims:
+            if isinstance(d, (int, np.integer)):
+                run.append(int(d))
+                continue
+            if run:
+                parts.append(self.i64(run, hint))
+                run = []
+            parts.append(self._dyn_dim(d))
+        if run:
+            parts.append(self.i64(run, hint))
+        out = self.add("Concat", parts, axis=0)
+        self._shape_vec_cache[key] = out
+        return out
 
 
 def convert_jaxpr(closed, input_names, builder=None):
@@ -119,6 +179,18 @@ _SIMPLE = {
     "stop_gradient": "Identity", "copy": "Identity",
     "add_any": "Add",
 }
+
+
+def _static_ints(vals, what):
+    """Require concrete ints (e.g. slice bounds): symbolic dims here must
+    fail as UnsupportedOnnxOp naming the op, not a raw jax shape error."""
+    out = []
+    for v in vals:
+        if not isinstance(v, (int, np.integer)):
+            raise UnsupportedOnnxOp(
+                f"{what} with a dynamic-dimension value ({v})")
+        out.append(int(v))
+    return out
 
 
 def _scalar_like(g, eqn_invar, value):
@@ -217,17 +289,17 @@ def _reshape(g, ins, eqn):
     if eqn.params.get("dimensions") is not None:
         perm = list(eqn.params["dimensions"])
         ins = [g.add("Transpose", ins, perm=perm)]
-    return g.add("Reshape", [ins[0], g.i64(eqn.params["new_sizes"])])
+    return g.add("Reshape", [ins[0], g.shape_vec(eqn.params["new_sizes"])])
 
 
 @_ematch("squeeze")
 def _squeeze(g, ins, eqn):
-    return g.add("Reshape", [ins[0], g.i64(eqn.outvars[0].aval.shape)])
+    return g.add("Reshape", [ins[0], g.shape_vec(eqn.outvars[0].aval.shape)])
 
 
 @_ematch("expand_dims")
 def _expand_dims(g, ins, eqn):
-    return g.add("Reshape", [ins[0], g.i64(eqn.outvars[0].aval.shape)])
+    return g.add("Reshape", [ins[0], g.shape_vec(eqn.outvars[0].aval.shape)])
 
 
 @_ematch("transpose")
@@ -245,9 +317,9 @@ def _broadcast(g, ins, eqn):
         mid[d] = in_shape[i]
     x = ins[0]
     if list(in_shape) != mid:
-        x = g.add("Reshape", [x, g.i64(mid)])
+        x = g.add("Reshape", [x, g.shape_vec(mid)])
     if mid != shape:
-        x = g.add("Expand", [x, g.i64(shape)])
+        x = g.add("Expand", [x, g.shape_vec(shape)])
     elif x == ins[0]:
         x = g.add("Identity", [x])
     return x
@@ -260,9 +332,10 @@ def _concat(g, ins, eqn):
 
 @_ematch("slice")
 def _slice(g, ins, eqn):
-    starts = list(eqn.params["start_indices"])
-    ends = list(eqn.params["limit_indices"])
-    steps = list(eqn.params["strides"] or [1] * len(starts))
+    starts = _static_ints(eqn.params["start_indices"], "slice starts")
+    ends = _static_ints(eqn.params["limit_indices"], "slice limits")
+    steps = _static_ints(eqn.params["strides"] or [1] * len(starts),
+                         "slice strides")
     axes = list(range(len(starts)))
     return g.add("Slice", [ins[0], g.i64(starts), g.i64(ends),
                            g.i64(axes), g.i64(steps)])
@@ -282,7 +355,7 @@ def _dynamic_slice(g, ins, eqn):
     # NOTE jax clamps out-of-range starts; ONNX Slice clamps ends only —
     # exported graphs must keep starts in range (true for the layer zoo).
     operand, idx = ins[0], ins[1:]
-    sizes = list(eqn.params["slice_sizes"])
+    sizes = _static_ints(eqn.params["slice_sizes"], "dynamic_slice sizes")
     parts = [g.add("Reshape",
                    [g.add("Cast", [i], to=int(proto.NP_TO_ONNX[np.dtype(np.int64)])),
                     g.i64([1])]) for i in idx]
@@ -305,7 +378,8 @@ def _pad(g, ins, eqn):
         x = g.add("Pad", [x, g.i64(pads), ins[1]], mode="constant")
     if any(v < 0 for v in los + his):  # negative padding == crop
         starts = [-min(v, 0) for v in los]
-        shape = eqn.outvars[0].aval.shape
+        shape = _static_ints(eqn.outvars[0].aval.shape,
+                             "negative pad (crop) on a dynamic dim")
         ends = [s + e for s, e in zip(starts, shape)]
         x = g.add("Slice", [x, g.i64(starts), g.i64(ends),
                             g.i64(list(range(len(starts))))])
@@ -321,10 +395,12 @@ def _iota(g, ins, eqn):
     view[dim] = shape[dim]
     # store only the 1-D arange; Expand at run time (a broadcasted (S,S)
     # causal-mask iota would otherwise bake O(S^2) bytes into the file)
+    if not isinstance(shape[dim], (int, np.integer)):
+        raise UnsupportedOnnxOp(f"iota over a dynamic dimension ({shape})")
     rng = g.const(np.arange(shape[dim], dtype=dt).reshape(view), "iota")
     if view == shape:
         return g.add("Identity", [rng])
-    return g.add("Expand", [rng, g.i64(shape)])
+    return g.add("Expand", [rng, g.shape_vec(shape)])
 
 
 @_ematch("gather")
@@ -344,10 +420,10 @@ def _gather(g, ins, eqn):
             if expect != out_shape:  # jnp.take with different offset layout
                 raise UnsupportedOnnxOp(
                     f"gather layout {dn} (out {out_shape} != {expect})")
-            idx = g.add("Reshape", [ins[1], g.i64(idx_shape or [1])])
+            idx = g.add("Reshape", [ins[1], g.shape_vec(idx_shape or [1])])
             out = g.add("Gather", [ins[0], idx], axis=int(a))
             if not idx_shape:  # scalar take: drop the kept unit dim
-                out = g.add("Reshape", [out, g.i64(out_shape)])
+                out = g.add("Reshape", [out, g.shape_vec(out_shape)])
             return out
     raise UnsupportedOnnxOp(f"general gather {dn} sizes={sizes}")
 
@@ -368,16 +444,23 @@ def _dot_general(g, ins, eqn):
     else:
         lfree = [d for d in range(len(ls)) if d not in lc and d not in lb]
         rfree = [d for d in range(len(rs)) if d not in rc and d not in rb]
-        B = int(np.prod([ls[d] for d in lb], initial=1))
-        M = int(np.prod([ls[d] for d in lfree], initial=1))
-        K = int(np.prod([ls[d] for d in lc], initial=1))
-        N = int(np.prod([rs[d] for d in rfree], initial=1))
+
+        def prod(dims):
+            out = 1
+            for d in dims:
+                out = out * d  # symbolic dims overload *
+            return out
+
+        B = prod(ls[d] for d in lb)
+        M = prod(ls[d] for d in lfree)
+        K = prod(ls[d] for d in lc)
+        N = prod(rs[d] for d in rfree)
         l2 = g.add("Transpose", [lhs], perm=list(lb) + lfree + list(lc))
-        l2 = g.add("Reshape", [l2, g.i64([B, M, K])])
+        l2 = g.add("Reshape", [l2, g.shape_vec([B, M, K])])
         r2 = g.add("Transpose", [rhs], perm=list(rb) + list(rc) + rfree)
-        r2 = g.add("Reshape", [r2, g.i64([B, K, N])])
+        r2 = g.add("Reshape", [r2, g.shape_vec([B, K, N])])
         mm = g.add("MatMul", [l2, r2])
-        out = g.add("Reshape", [mm, g.i64(out_shape)])
+        out = g.add("Reshape", [mm, g.shape_vec(out_shape)])
 
     out_dt = _widen(eqn.outvars[0].aval.dtype)
     if out_dt != _widen(l_aval.dtype):

@@ -221,6 +221,11 @@ def _where(n, c, x, y):
     return np.where(c, x, y)
 
 
+@_op("Shape")
+def _shape(n, a):
+    return np.asarray(a.shape, np.int64)
+
+
 @_op("Cast")
 def _cast(n, a):
     return a.astype(proto.ONNX_TO_NP[n.attrs["to"]])

@@ -77,6 +77,64 @@ def test_bert_model_roundtrip():
     _roundtrip(model, [ids], atol=5e-4, n_outs=2)
 
 
+def test_dynamic_batch_export(tmp_path):
+    """-1 dims in InputSpec export as true dynamic dims: one artifact
+    serves several batch sizes (runtime Shape/Gather/Concat shape
+    computation instead of baked Reshape targets)."""
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(3)
+    net = nn.Sequential(nn.Linear(8, 16), nn.ReLU(), nn.LayerNorm(16),
+                        nn.Linear(16, 4))
+    net.eval()
+    f = ponnx.export(net, str(tmp_path / "dyn"),
+                     input_spec=[InputSpec([-1, 8], "float32")])
+    m = ponnx.ONNXModel(f)
+    for B in (1, 3, 7):
+        x = rs.randn(B, 8).astype(np.float32)
+        got = m.run([x])[0]
+        want = np.asarray(net(paddle.to_tensor(x)).numpy())
+        assert got.shape == (B, 4)
+        np.testing.assert_allclose(got, want, atol=1e-5, rtol=1e-4)
+
+
+def test_dynamic_batch_bert(tmp_path):
+    from paddle_tpu.models import BertConfig, BertModel
+    from paddle_tpu.static import InputSpec
+
+    paddle.seed(11)
+    model = BertModel(BertConfig(
+        vocab_size=500, hidden_size=64, num_layers=2, num_heads=4,
+        intermediate_size=128, max_position_embeddings=64, dropout=0.0))
+    model.eval()
+    f = ponnx.export(model, str(tmp_path / "dynbert"),
+                     input_spec=[InputSpec([-1, 16], "int32")])
+    m = ponnx.ONNXModel(f)
+    for B in (2, 5):
+        ids = rs.randint(0, 500, (B, 16)).astype(np.int32)
+        got = m.run([ids])
+        want = model(paddle.to_tensor(ids))
+        want = [np.asarray(w.numpy()) for w in
+                (want if isinstance(want, (list, tuple)) else [want])]
+        for gv, wv in zip(got, want):
+            assert gv.shape == wv.shape
+            np.testing.assert_allclose(gv, wv, atol=5e-4, rtol=1e-3)
+
+
+def test_dynamic_dim_slice_raises_attributably(tmp_path):
+    """Slicing along the dynamic axis must fail as UnsupportedOnnxOp
+    naming the op, not a raw jax symbolic-shape error."""
+    from paddle_tpu.static import InputSpec
+
+    class SliceDyn(nn.Layer):
+        def forward(self, x):
+            return x[1:]  # limit depends on the dynamic batch dim
+
+    with pytest.raises(ponnx.UnsupportedOnnxOp, match="slice"):
+        ponnx.export(SliceDyn(), str(tmp_path / "s"),
+                     input_spec=[InputSpec([-1, 4], "float32")])
+
+
 def test_input_spec_path_and_return_name(tmp_path):
     from paddle_tpu.static import InputSpec
 
