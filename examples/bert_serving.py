@@ -1,0 +1,50 @@
+"""Serving: StableHLO AOT export + Predictor, plus ONNX interchange.
+
+Run: python examples/bert_serving.py   (add JAX_PLATFORMS=cpu off-TPU)
+"""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference, onnx
+from paddle_tpu.models import BertConfig, BertModel
+from paddle_tpu.static import InputSpec
+
+
+def main():
+    paddle.seed(0)
+    model = BertModel(BertConfig(vocab_size=400, hidden_size=48,
+                                 num_layers=2, num_heads=4,
+                                 intermediate_size=96,
+                                 max_position_embeddings=64, dropout=0.0))
+    model.eval()
+    rs = np.random.RandomState(0)
+    ids = rs.randint(0, 400, (4, 16)).astype(np.int32)
+    want = np.asarray(model(paddle.to_tensor(ids))[0].numpy())
+
+    with tempfile.TemporaryDirectory() as td:
+        # 1) StableHLO artifact: symbolic batch, no python model code
+        prefix = td + "/bert"
+        inference.save_inference_model(
+            prefix, model, input_spec=[InputSpec([-1, 16], "int32")],
+            example_inputs=[ids])
+        pred = inference.create_predictor(inference.Config(prefix))
+        got, *_ = pred.run([ids])
+        assert np.allclose(np.asarray(got), want, atol=1e-4)
+        one, *_ = pred.run([ids[:1]])  # symbolic batch: same artifact
+        assert np.asarray(one).shape[0] == 1
+        print("StableHLO predictor OK (batch 4 and 1 from one artifact)")
+
+        # 2) ONNX artifact with a dynamic batch dim
+        f = onnx.export(model, td + "/bert_onnx",
+                        input_spec=[InputSpec([-1, 16], "int32")],
+                        example_inputs=[ids])
+        got2 = onnx.ONNXModel(f).run([ids])[0]
+        assert np.allclose(got2, want, atol=5e-4)
+        print("ONNX round-trip OK")
+    print("OK bert_serving")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,43 @@
+"""Eager (dygraph) training: LeNet on MNIST (synthetic offline fallback).
+
+Run: python examples/mnist_dygraph.py   (add JAX_PLATFORMS=cpu off-TPU)
+Mirrors the reference dygraph MNIST example's structure: dataset ->
+DataLoader -> net -> cross_entropy -> backward -> Adam.step.
+"""
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def main(epochs=1, batches=40):
+    paddle.seed(0)
+    train = paddle.vision.datasets.MNIST(mode="train")
+    loader = paddle.io.DataLoader(train, batch_size=64, shuffle=True)
+
+    net = paddle.vision.models.LeNet()
+    opt = paddle.optimizer.Adam(learning_rate=1e-3,
+                                parameters=net.parameters())
+
+    losses = []
+    for epoch in range(epochs):
+        for i, (img, label) in enumerate(loader):
+            if i >= batches:
+                break
+            loss = F.cross_entropy(net(img), label.flatten())
+            loss.backward()
+            opt.step()
+            opt.clear_grad()
+            losses.append(float(loss))
+            if i % 10 == 0:
+                print(f"epoch {epoch} step {i} loss {float(loss):.4f}")
+
+    first, last = np.mean(losses[:5]), np.mean(losses[-5:])
+    print(f"loss {first:.4f} -> {last:.4f}")
+    assert last < first, "training did not reduce the loss"
+    print("OK mnist_dygraph")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,28 @@
+"""High-level API: paddle.Model fit/evaluate with callbacks.
+
+Run: python examples/mnist_hapi.py   (add JAX_PLATFORMS=cpu off-TPU)
+"""
+import paddle_tpu as paddle
+
+
+def main():
+    paddle.seed(0)
+    train = paddle.vision.datasets.MNIST(mode="train")
+    test = paddle.vision.datasets.MNIST(mode="test")
+
+    model = paddle.Model(paddle.vision.models.LeNet())
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=1e-3,
+                              parameters=model.network.parameters()),
+        paddle.nn.CrossEntropyLoss(),
+        paddle.metric.Accuracy())
+    model.fit(train, epochs=1, batch_size=64, num_iters=40, verbose=0)
+    result = model.evaluate(test, batch_size=64, num_samples=640, verbose=0)
+    acc = result.get("acc", result.get("acc_top1", 0.0))
+    print("eval:", result)
+    assert acc > 0.5, f"accuracy too low after a smoke epoch: {acc}"
+    print("OK mnist_hapi")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Post-training int8 quantization: calibrate -> quantize -> export.
+
+Run: python examples/ptq_int8.py   (add JAX_PLATFORMS=cpu off-TPU)
+"""
+import tempfile
+
+import numpy as np
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+from paddle_tpu.slim import PostTrainingQuantization, load_quantized_predictor
+
+
+def main():
+    paddle.seed(0)
+    net = nn.Sequential(nn.Linear(16, 64), nn.ReLU(), nn.Linear(64, 4))
+    net.eval()
+    rs = np.random.RandomState(0)
+
+    def calib_loader(n=8):
+        for _ in range(n):
+            yield paddle.to_tensor(rs.randn(32, 16).astype(np.float32))
+
+    x = rs.randn(16, 16).astype(np.float32)
+    fp32 = np.asarray(net(paddle.to_tensor(x)).numpy())
+
+    ptq = PostTrainingQuantization(net, calib_loader(), batch_nums=8,
+                                   algo="hist")
+    qnet = ptq.quantize()
+    int8 = np.asarray(qnet(paddle.to_tensor(x)).numpy())
+    rel = np.abs(int8 - fp32).max() / (np.abs(fp32).max() + 1e-8)
+    print(f"int8 vs fp32 relative error: {rel:.4f}")
+    assert rel < 0.1
+
+    with tempfile.TemporaryDirectory() as td:
+        prefix = td + "/int8_model"
+        ptq.save_quantized_model(prefix, example_inputs=[x])
+        pred = load_quantized_predictor(prefix)
+        served, = pred.run([x])
+        assert np.allclose(np.asarray(served), int8, atol=1e-5)
+        n_int8 = sum(rec["int8_weight"].size
+                     for rec in pred.quant_params.values())
+        print(f"served int8 artifact OK ({n_int8} int8 weights)")
+    print("OK ptq_int8")
+
+
+if __name__ == "__main__":
+    main()

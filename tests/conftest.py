@@ -18,6 +18,21 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def cpu_subprocess_env(repo_on_path=True):
+    """Env for spawning a python subprocess that must NEVER dial the TPU
+    tunnel: strips the axon pool IP (the sitecustomize register() dials
+    at interpreter startup when it is set — single-client tunnel, see
+    bench.py _tunnel_lock) and forces the CPU backend.  Use this instead
+    of hand-rolling the scrub in each test file."""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("PALLAS_AXON_POOL_IPS", "BENCH_POOL_IPS_STASH")}
+    env["JAX_PLATFORMS"] = "cpu"
+    if repo_on_path:
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
 @pytest.fixture(autouse=True)
 def _seed():
     import paddle_tpu as paddle

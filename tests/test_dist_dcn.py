@@ -96,10 +96,10 @@ def _free_port():
 
 
 def _base_env():
-    env = dict(os.environ)
-    env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
     env["PYTHONPATH"] = REPO
-    env["JAX_PLATFORMS"] = "cpu"
     return env
 
 

@@ -101,11 +101,11 @@ def _launch_trainers(script):
     eps = [f"127.0.0.1:{port}", f"127.0.0.1:{port + 1}"]
     procs = []
     for rank in range(2):
-        env = dict(os.environ)
-        env.pop("PALLAS_AXON_POOL_IPS", None)  # never touch the TPU tunnel
+        from conftest import cpu_subprocess_env
+
+        env = cpu_subprocess_env()
         env.pop("XLA_FLAGS", None)             # exactly 1 device/process
         env.update({
-            "JAX_PLATFORMS": "cpu",
             "PYTHONPATH": REPO,
             "PADDLE_TRAINER_ID": str(rank),
             "PADDLE_TRAINERS_NUM": "2",

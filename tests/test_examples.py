@@ -1,0 +1,24 @@
+"""Every script in examples/ must run end-to-end and print its OK
+marker — the examples are living documentation (MIGRATION.md's script
+generations) and double as user-style integration drives."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_EXAMPLES = sorted(f[:-3] for f in os.listdir(os.path.join(_REPO, "examples"))
+                   if f.endswith(".py"))
+
+
+@pytest.mark.parametrize("name", _EXAMPLES)
+def test_example_runs(name):
+    from conftest import cpu_subprocess_env
+
+    env = cpu_subprocess_env()
+    p = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "examples", name + ".py")],
+        env=env, capture_output=True, text=True, timeout=420)
+    assert p.returncode == 0, p.stderr[-2000:]
+    assert f"OK {name}" in p.stdout, p.stdout[-500:]
