@@ -14,7 +14,7 @@ from paddle_tpu.onnx import proto
 rs = np.random.RandomState(0)
 
 
-def _roundtrip(layer, inputs, atol=1e-5, n_outs=1):
+def _roundtrip(layer, inputs, atol=1e-5, rtol=1e-4, n_outs=1):
     layer.eval()
     f = ponnx.export(layer, "/tmp/onnx_test_artifact",
                      example_inputs=list(inputs))
@@ -26,7 +26,7 @@ def _roundtrip(layer, inputs, atol=1e-5, n_outs=1):
     assert len(got) == len(want) >= n_outs
     for g, w in zip(got, want):
         assert g.shape == w.shape
-        np.testing.assert_allclose(g, w, atol=atol, rtol=1e-4)
+        np.testing.assert_allclose(g, w, atol=atol, rtol=rtol)
     return m
 
 

@@ -55,15 +55,33 @@ def _random_cnn(rng):
     return nn.Sequential(*layers), shape
 
 
+def _randomize_norm_state(net, rng):
+    """Untrained norm layers are near-identity (weight=1, bias=0,
+    mean=0, var=1), which would let buffer-wiring bugs in the converter
+    slip under tolerance — draw real values for every affine/running
+    stat so BatchNormalization/LayerNorm lowering is actually checked."""
+    for sub in net.sublayers(include_self=True):
+        if isinstance(sub, (nn.BatchNorm2D, nn.LayerNorm)):
+            for pname in ("weight", "bias"):
+                p = getattr(sub, pname, None)
+                if p is not None:
+                    p.set_value(rng.uniform(
+                        0.5, 1.5, np.asarray(p.numpy()).shape)
+                        .astype(np.float32))
+        if isinstance(sub, nn.BatchNorm2D):
+            n = np.asarray(sub._mean.numpy()).shape
+            sub._mean.set_value(rng.randn(*n).astype(np.float32) * 0.3)
+            sub._variance.set_value(
+                rng.uniform(0.5, 2.0, n).astype(np.float32))
+
+
 @pytest.mark.parametrize("seed", range(12))
 def test_random_architecture_roundtrip(seed, tmp_path):
+    from tests.test_onnx_export import _roundtrip
+
     rng = np.random.RandomState(1000 + seed)
     paddle.seed(seed)
     net, shape = (_random_mlp(rng) if seed % 2 == 0 else _random_cnn(rng))
-    net.eval()
+    _randomize_norm_state(net, rng)
     x = rng.randn(*shape).astype(np.float32)
-    f = ponnx.export(net, str(tmp_path / f"fz{seed}"), example_inputs=[x])
-    got = ponnx.ONNXModel(f).run([x])[0]
-    want = np.asarray(net(paddle.to_tensor(x)).numpy())
-    assert got.shape == want.shape
-    np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+    _roundtrip(net, [x], atol=2e-4, rtol=1e-3)
