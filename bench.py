@@ -1168,7 +1168,48 @@ def body_predictor(on_tpu):
         lat_b1 = med_latency(1) if symbolic else None
         _phase("latency_done")
 
+    # serving decode: KV-cache autoregressive generation throughput (the
+    # whole prefill+scan loop is ONE compiled XLA program; reference
+    # analog = fused_multi_transformer CacheKV decode serving)
+    from paddle_tpu.models import GPTConfig, GPTForCausalLM
+
+    if on_tpu:
+        gcfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=6,
+                         num_heads=12, max_position_embeddings=512,
+                         dropout=0.0, attn_dropout=0.0)
+        gB, gS, gN = 8, 128, 128
+    else:
+        gcfg = GPTConfig(vocab_size=500, hidden_size=64, num_layers=2,
+                         num_heads=4, max_position_embeddings=64,
+                         dropout=0.0, attn_dropout=0.0)
+        gB, gS, gN = 2, 8, 8
+    decode = {"decode_tokens_per_sec": None,
+              "decode_model": f"gpt-{gcfg.num_layers}x{gcfg.hidden_size}",
+              "decode_batch": gB, "decode_prompt_len": gS, "decode_new": gN}
+    try:  # best-effort: a decode failure must not discard the measured
+        # predictor latency (the config's primary metric)
+        gpt = GPTForCausalLM(gcfg)
+        if on_tpu:
+            gpt.astype("bfloat16")
+        gpt.eval()
+        prompt = paddle.to_tensor(
+            rs.randint(0, gcfg.vocab_size, (gB, gS)).astype(np.int32))
+        t0 = time.perf_counter()
+        np.asarray(gpt.generate(prompt, max_new_tokens=gN).numpy())
+        # first call = compile + one full decode; named accordingly
+        decode["decode_first_call_seconds"] = round(
+            time.perf_counter() - t0, 1)
+        t0 = time.perf_counter()
+        np.asarray(gpt.generate(prompt, max_new_tokens=gN).numpy())
+        decode_s = time.perf_counter() - t0
+        decode["decode_tokens_per_sec"] = round(gB * gN / decode_s, 1)
+        _phase("decode_done", decode_s)
+    except Exception as e:  # noqa: BLE001
+        decode["decode_error"] = f"{type(e).__name__}: {e}"[:200]
+        _phase("decode_failed")
+
     return {
+        **decode,
         "metric": ("bert_predictor_latency_ms" if on_tpu
                    else "predictor_latency_smoke_cpu"),
         "value": round(lat_b1 if lat_b1 is not None else lat_b8, 2),
