@@ -91,3 +91,16 @@ def rng_guard(key):
 
 def in_rng_guard() -> bool:
     return bool(_state.guard_stack)
+
+
+def np_random_state():
+    """numpy RandomState chained off the framework RNG so paddle.seed()
+    reproduces host-side sampling (detection ops, image augmentation).
+    Each call advances the chain.  Single implementation — import this
+    instead of re-deriving the key->uint32 seed mapping."""
+    import jax
+    import numpy as np
+
+    key = split_key(1)
+    data = np.asarray(jax.random.key_data(key)).ravel()
+    return np.random.RandomState(data.astype(np.uint32)[-1])

@@ -1,4 +1,7 @@
 from . import download  # noqa: F401
+from . import image_util  # noqa: F401
+from . import install_check  # noqa: F401
+from . import op_version  # noqa: F401
 from . import profiler  # noqa: F401
 from ..framework import unique_name  # noqa: F401 — ref utils/__init__.py
 from .deprecated import deprecated  # noqa: F401
@@ -41,26 +44,5 @@ def load_op_library(lib_filename):
         "libraries", stacklevel=2)
 
 
-class OpLastCheckpointChecker:
-    """Op-version compatibility checker (reference utils/op_version.py).
-    The TPU build has no op-version registry — StableHLO artifacts carry
-    their own compatibility guarantees — so queries return empty."""
-
-    def check_modified(self, *a, **k):
-        return []
-
-    def check_bugfix(self, *a, **k):
-        return []
-
-
-def run_check():
-    """paddle.utils.run_check parity: verify the accelerator works."""
-    import jax
-    import jax.numpy as jnp
-
-    x = jnp.ones((8, 8))
-    y = (x @ x).sum()
-    dev = jax.devices()[0]
-    print(f"paddle_tpu works on {dev.platform} ({dev}) — matmul check "
-          f"{float(y)} == 512.0")
-    return True
+from .install_check import run_check  # noqa: F401
+from .op_version import OpLastCheckpointChecker  # noqa: F401

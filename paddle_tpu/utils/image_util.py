@@ -1,0 +1,199 @@
+"""paddle.utils.image_util — classic image preprocessing helpers.
+
+Reference: python/paddle/utils/image_util.py (resize_image, flip,
+crop_img, preprocess_img, oversample, ImageTransformer — the pre-
+paddle.vision transform toolkit used by the old image-classification
+demos).  Re-implemented over numpy (PIL only for load/decode, optional):
+same function surface, channel conventions preserved (flattened CHW
+float vectors in, like the original), no direct code reuse.
+"""
+from __future__ import annotations
+
+import io
+
+import numpy as np
+
+__all__ = [
+    "resize_image", "flip", "crop_img", "decode_jpeg", "preprocess_img",
+    "load_meta", "load_image", "oversample", "ImageTransformer",
+]
+
+
+def _to_hwc(im, color):
+    """The classic helpers carry images as flattened CHW float vectors
+    of a SQUARE image (the reference's feeding format); accept that form
+    or an H,W[,C] array."""
+    im = np.asarray(im)
+    if im.ndim == 1:
+        c = 3 if color else 1
+        side = int(round((im.size / c) ** 0.5))
+        if c * side * side != im.size:
+            raise ValueError(
+                f"flattened image of size {im.size} is not a square "
+                f"{'RGB' if color else 'gray'} CHW vector")
+        im = im.reshape(c, side, side).transpose(1, 2, 0)
+        if c == 1:
+            im = im[:, :, 0]
+    return im
+
+
+def resize_image(img, target_size):
+    """Resize so the SHORT side equals target_size (reference
+    image_util.py:20 keeps aspect ratio) — nearest-neighbor, numpy-only."""
+    im = _to_hwc(img, True)
+    h, w = im.shape[:2]
+    if h < w:
+        nh, nw = target_size, max(int(round(w * target_size / h)), 1)
+    else:
+        nh, nw = max(int(round(h * target_size / w)), 1), target_size
+    ys = np.minimum((np.arange(nh) * h / nh).astype(np.int64), h - 1)
+    xs = np.minimum((np.arange(nw) * w / nw).astype(np.int64), w - 1)
+    return im[ys][:, xs]
+
+
+def flip(im):
+    """Horizontal mirror (reference :33 flips the width axis)."""
+    im = _to_hwc(im, True)
+    return im[:, ::-1].copy()
+
+
+def crop_img(im, inner_size, color=True, test=True):
+    """Center crop when test else random crop + random mirror
+    (reference :45)."""
+    im = _to_hwc(im, color)
+    h, w = im.shape[:2]
+    ih = iw = inner_size
+    if h < ih or w < iw:
+        raise ValueError(f"image {h}x{w} smaller than crop {inner_size}")
+    if test:
+        top, left = (h - ih) // 2, (w - iw) // 2
+        out = im[top:top + ih, left:left + iw]
+    else:
+        rng = _rng()
+        top = int(rng.randint(0, max(h - ih, 0) + 1))
+        left = int(rng.randint(0, max(w - iw, 0) + 1))
+        out = im[top:top + ih, left:left + iw]
+        if rng.rand() < 0.5:
+            out = out[:, ::-1]
+    return out.copy()
+
+
+def _rng():
+    from ..framework.random import np_random_state
+
+    return np_random_state()
+
+
+def decode_jpeg(jpeg_string):
+    """Decode an encoded image buffer to an H,W,C uint8 array
+    (reference :89; PIL-backed, gated)."""
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover - PIL is in the image
+        raise RuntimeError("decode_jpeg needs Pillow") from e
+    return np.asarray(Image.open(io.BytesIO(jpeg_string)).convert("RGB"))
+
+
+def preprocess_img(im, img_mean, crop_size, is_train, color=True):
+    """Crop (+train-time mirror), subtract mean, return flattened float32
+    CHW vector — the reference's feeding format (:96)."""
+    out = crop_img(im, crop_size, color=color, test=not is_train)
+    out = out.astype(np.float32)
+    if out.ndim == 2:
+        out = out[:, :, None]
+    chw = np.transpose(out, (2, 0, 1)).reshape(-1)
+    mean = np.asarray(img_mean, np.float32).reshape(-1)
+    if mean.size == chw.size:
+        chw = chw - mean
+    elif mean.size == out.shape[2]:  # per-channel mean
+        chw = chw - np.repeat(mean, out.shape[0] * out.shape[1])
+    else:
+        raise ValueError(
+            f"img_mean size {mean.size} matches neither the flattened "
+            f"crop ({chw.size}) nor the channel count ({out.shape[2]}) "
+            f"— was the mean built for a different crop_size?")
+    return chw
+
+
+def load_meta(meta_path, mean_img_size, crop_size, color=True):
+    """Load a pickled mean image and center-crop it to crop_size
+    (reference :111)."""
+    import pickle
+
+    with open(meta_path, "rb") as f:
+        mean = pickle.load(f, encoding="latin1")
+    c = 3 if color else 1
+    mean = np.asarray(mean).reshape(c, mean_img_size, mean_img_size)
+    off = (mean_img_size - crop_size) // 2
+    mean = mean[:, off:off + crop_size, off:off + crop_size]
+    return mean.astype(np.float32).reshape(-1)
+
+
+def load_image(img_path, is_color=True):
+    """Read an image file to H,W,C uint8 (reference :133; PIL-backed)."""
+    try:
+        from PIL import Image
+    except ImportError as e:  # pragma: no cover
+        raise RuntimeError("load_image needs Pillow") from e
+    img = Image.open(img_path)
+    img = img.convert("RGB" if is_color else "L")
+    return np.asarray(img)
+
+
+def oversample(img, crop_dims):
+    """10-crop oversampling (reference :144): 4 corners + center, plus
+    mirrors, for a batch of H,W,C images."""
+    imgs = np.asarray(img)
+    if imgs.ndim == 3:
+        imgs = imgs[None]
+    n, h, w = imgs.shape[:3]
+    ch, cw = int(crop_dims[0]), int(crop_dims[1])
+    if h < ch or w < cw:
+        raise ValueError(f"image {h}x{w} smaller than crop {crop_dims}")
+    tops = [0, 0, h - ch, h - ch, (h - ch) // 2]
+    lefts = [0, w - cw, 0, w - cw, (w - cw) // 2]
+    crops = []
+    for im in imgs:
+        views = [im[t:t + ch, le:le + cw] for t, le in zip(tops, lefts)]
+        crops.extend(views)
+        crops.extend(v[:, ::-1] for v in views)
+    return np.stack(crops)
+
+
+class ImageTransformer:
+    """Channel-order/mean/scale pipeline (reference :183): configure
+    once, call transform(im) to get the feeding array."""
+
+    def __init__(self, transpose=None, channel_swap=None, mean=None,
+                 is_color=True):
+        self.transpose_order = transpose
+        self.channel_swap = channel_swap
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+        self.is_color = is_color
+
+    def set_transpose(self, order):
+        self.transpose_order = order
+
+    def set_channel_swap(self, order):
+        self.channel_swap = order
+
+    def set_mean(self, mean):
+        self.mean = None if mean is None else np.asarray(mean, np.float32)
+
+    def transformer(self, im):  # reference method name
+        return self.transform(im)
+
+    def transform(self, im):
+        out = np.asarray(im, np.float32)
+        if out.ndim == 2:
+            out = out[:, :, None]
+        if self.channel_swap is not None:
+            out = out[:, :, list(self.channel_swap)]
+        if self.transpose_order is not None:
+            out = np.transpose(out, self.transpose_order)
+        if self.mean is not None:
+            m = self.mean
+            if m.ndim == 1 and out.ndim == 3 and m.size == out.shape[0]:
+                m = m.reshape(-1, 1, 1)
+            out = out - m
+        return out

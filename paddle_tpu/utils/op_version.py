@@ -1,0 +1,62 @@
+"""paddle.utils.op_version — op version checkpoint queries.
+
+Reference: python/paddle/utils/op_version.py OpLastCheckpointChecker
+reads the C++ OpVersionRegistry (op upgrade checkpoints registered by
+REGISTER_OP_VERSION).  This build has no versioned C++ op registry — op
+semantics are pinned by COVERAGE.md and the test suite — so the checker
+serves the same query API over a static table of the ops whose observable
+behavior DIFFERS from some historical reference version (the cases a
+version-gated converter would care about).
+"""
+from __future__ import annotations
+
+__all__ = ["OpLastCheckpointChecker"]
+
+# op -> (version id, note).  Version 0 == never upgraded / original
+# semantics.  Entries mirror upgrade checkpoints the reference registers
+# that are visible in this build's op surface.
+_CHECKPOINTS = {
+    # reference REGISTER_OP_VERSION entries with behavior-visible bumps
+    "roi_align": (1, "aligned=True pixel-offset convention supported"),
+    "generate_proposals": (1, "pixel_offset attribute"),
+    "grid_sampler": (1, "align_corners/padding_mode attributes"),
+    "momentum": (1, "multi_precision / rescale_grad attributes"),
+    "adam": (1, "multi_precision master weights (amp O2)"),
+    "leaky_relu": (1, "alpha default 0.01 (was 0.02 pre-2.0)"),
+    "gaussian_random": (1, "shape tensor input form"),
+    "unique": (1, "return_index/inverse/counts form"),
+}
+
+
+class _Singleton:
+    _inst = None
+
+    def __new__(cls, *a, **k):
+        if cls._inst is None:
+            cls._inst = super().__new__(cls)
+        return cls._inst
+
+
+class OpLastCheckpointChecker(_Singleton):
+    """Query the last upgrade checkpoint of an op (reference
+    op_version.py:50).  ``get_version(op)`` -> int; unknown ops return
+    version 0 (original semantics), matching the reference's default."""
+
+    def get_version(self, op_name: str) -> int:
+        return _CHECKPOINTS.get(op_name, (0, ""))[0]
+
+    def get_note(self, op_name: str) -> str:
+        return _CHECKPOINTS.get(op_name, (0, ""))[1]
+
+    def check_upgrade(self, op_name: str, since_version: int) -> bool:
+        """True if the op has an upgrade checkpoint >= since_version."""
+        return self.get_version(op_name) >= since_version
+
+    # reference-API compat (op_version.py:50 exposes category queries
+    # over the C++ registry's change records; this build keeps version
+    # ids + notes only, so category listings are empty)
+    def check_modified(self, *a, **k):
+        return []
+
+    def check_bugfix(self, *a, **k):
+        return []

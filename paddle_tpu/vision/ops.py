@@ -1057,12 +1057,10 @@ def _np_rng():
     reproduces host-side detection sampling (advisor r04: these kernels
     drew from the GLOBAL np.random state, which paddle.seed never
     touches — the reference seeds its sampling engine from the op seed
-    attribute).  Each call advances the chain."""
-    from ..framework import random as _fr
+    attribute)."""
+    from ..framework.random import np_random_state
 
-    key = _fr.split_key(1)
-    data = np.asarray(jax.random.key_data(key)).ravel()
-    return np.random.RandomState(data.astype(np.uint32)[-1])
+    return np_random_state()
 
 
 def rpn_target_assign(anchors, gt_boxes, is_crowd=None, im_info=None,

@@ -1,0 +1,115 @@
+"""utils tail modules (reference python/paddle/utils/): install_check
+run_check, op_version checkpoint queries, image_util preprocessing."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.utils import image_util as iu
+
+
+def test_run_check_passes_and_reports(capsys):
+    assert paddle.utils.run_check() is True
+    out = capsys.readouterr().out
+    assert "installed successfully" in out
+    # conftest forces an 8-device CPU mesh, so the dp tier must run too
+    assert "works well on 8 devices" in out
+
+
+def test_op_version_checker_singleton_and_defaults():
+    a = paddle.utils.OpLastCheckpointChecker()
+    b = paddle.utils.OpLastCheckpointChecker()
+    assert a is b
+    assert a.get_version("roi_align") >= 1
+    assert a.get_version("not_an_op") == 0
+    assert a.check_upgrade("roi_align", 1)
+    assert not a.check_upgrade("not_an_op", 1)
+    assert "pixel" in a.get_note("roi_align")
+
+
+def test_resize_keeps_aspect_short_side():
+    im = np.zeros((20, 30, 3), np.uint8)
+    out = iu.resize_image(im, 10)
+    assert out.shape == (10, 15, 3)   # short side -> 10, aspect kept
+    out = iu.resize_image(np.zeros((40, 20, 3), np.uint8), 10)
+    assert out.shape == (20, 10, 3)
+
+
+def test_flip_is_involution():
+    im = np.random.RandomState(0).randint(0, 255, (6, 8, 3), np.uint8)
+    np.testing.assert_array_equal(iu.flip(iu.flip(im)), im)
+    np.testing.assert_array_equal(iu.flip(im), im[:, ::-1])
+
+
+def test_center_crop_and_seeded_random_crop():
+    im = np.arange(10 * 10).reshape(10, 10).astype(np.float32)
+    c = iu.crop_img(im, 4, test=True)
+    assert c.shape == (4, 4)
+    np.testing.assert_array_equal(c, im[3:7, 3:7])
+    paddle.seed(5)
+    r1 = iu.crop_img(im, 4, test=False)
+    paddle.seed(5)
+    r2 = iu.crop_img(im, 4, test=False)
+    np.testing.assert_array_equal(r1, r2)  # paddle.seed reproduces
+
+
+def test_preprocess_img_mean_and_layout():
+    im = np.full((8, 8, 3), 10.0, np.float32)
+    v = iu.preprocess_img(im, img_mean=[1.0, 2.0, 3.0], crop_size=4,
+                          is_train=False)
+    assert v.shape == (3 * 4 * 4,)
+    np.testing.assert_allclose(v[:16], 9.0)    # channel 0: 10 - 1
+    np.testing.assert_allclose(v[-16:], 7.0)   # channel 2: 10 - 3
+
+
+def test_flattened_chw_vector_accepted_and_bounds_raise():
+    """Reference scripts pass flattened square CHW float vectors; and
+    undersized images / mismatched means must raise, not silently
+    mis-shape."""
+    sq = np.arange(3 * 6 * 6, dtype=np.float32)  # flattened 3x6x6 CHW
+    c = iu.crop_img(sq, 4, color=True, test=True)
+    assert c.shape == (4, 4, 3)
+    with pytest.raises(ValueError, match="smaller than crop"):
+        iu.crop_img(np.zeros((3, 3)), 4)
+    with pytest.raises(ValueError, match="smaller than crop"):
+        iu.oversample(np.zeros((5, 5, 3)), (8, 8))
+    with pytest.raises(ValueError, match="img_mean"):
+        iu.preprocess_img(np.zeros((8, 8, 3)), img_mean=np.zeros(7),
+                          crop_size=4, is_train=False)
+    a = paddle.utils.OpLastCheckpointChecker()
+    assert a.check_modified("adam") == [] and a.check_bugfix("adam") == []
+
+
+def test_oversample_ten_crops():
+    im = np.random.RandomState(1).rand(12, 12, 3).astype(np.float32)
+    crops = iu.oversample(im, (8, 8))
+    assert crops.shape == (10, 8, 8, 3)
+    # 5 views + their mirrors
+    np.testing.assert_array_equal(crops[5], crops[0][:, ::-1])
+
+
+def test_image_transformer_pipeline():
+    im = np.random.RandomState(2).rand(5, 6, 3).astype(np.float32)
+    t = iu.ImageTransformer(transpose=(2, 0, 1), channel_swap=(2, 1, 0),
+                            mean=np.array([1.0, 2.0, 3.0]))
+    out = t.transform(im)
+    assert out.shape == (3, 5, 6)
+    want = np.transpose(im[:, :, [2, 1, 0]], (2, 0, 1)) \
+        - np.array([1.0, 2.0, 3.0]).reshape(-1, 1, 1)
+    np.testing.assert_allclose(out, want, rtol=1e-6)
+
+
+def test_load_image_and_decode_jpeg(tmp_path):
+    pytest.importorskip("PIL")
+    from PIL import Image
+
+    arr = np.random.RandomState(3).randint(0, 255, (9, 7, 3), np.uint8)
+    p = tmp_path / "x.png"
+    Image.fromarray(arr).save(p)
+    loaded = iu.load_image(str(p))
+    np.testing.assert_array_equal(loaded, arr)
+    import io as _io
+
+    buf = _io.BytesIO()
+    Image.fromarray(arr).save(buf, format="JPEG", quality=95)
+    dec = iu.decode_jpeg(buf.getvalue())
+    assert dec.shape == arr.shape
