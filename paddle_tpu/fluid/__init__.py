@@ -32,6 +32,7 @@ from ..static import (  # noqa: F401
     BuildStrategy, CompiledProgram, Executor, ExecutionStrategy, Program,
     create_parameter, data, default_main_program,
     default_startup_program, program_guard)
+from . import contrib  # noqa: F401
 from . import dygraph  # noqa: F401
 from . import io  # noqa: F401
 from . import layers  # noqa: F401

@@ -1,0 +1,133 @@
+"""fluid.contrib — program statistics + optimizer extension helpers.
+
+Reference surface: python/paddle/fluid/contrib/{op_frequence.py
+memory_usage_calc.py, extend_optimizer/extend_optimizer_with_weight_
+decay.py}.  The program-walking tools operate on the captured expression
+DAG (static/program.py Variables) instead of a ProgramDesc op list; the
+numbers they report are the DAG's, which is what actually compiles here.
+(quantize/slim lives at paddle_tpu.slim; mixed_precision at
+paddle_tpu.amp; decoder beam search at paddle_tpu.text.)
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+
+import numpy as np
+
+__all__ = ["op_freq_statistic", "memory_usage",
+           "extend_with_decoupled_weight_decay"]
+
+_DTYPE_BYTES = {"float64": 8, "int64": 8, "float32": 4, "int32": 4,
+                "bfloat16": 2, "float16": 2, "int16": 2, "int8": 1,
+                "uint8": 1, "bool": 1}
+
+
+def _dag_nodes(program):
+    """Unique Variables reachable from the program's roots (train loss +
+    recent fetch DAGs), depth-first."""
+    from ..static.program import Variable
+
+    roots = []
+    if program is not None:
+        if getattr(program, "_train", None) is not None:
+            roots.append(program._train[0])
+        roots.extend(getattr(program, "_captured_vars", ()))
+    seen, out, stack = set(), [], list(roots)
+    while stack:
+        v = stack.pop()
+        if not isinstance(v, Variable) or id(v) in seen:
+            continue
+        seen.add(id(v))
+        out.append(v)
+        stack.extend(a for a in getattr(v, "_args", ()))
+    return out
+
+
+def op_freq_statistic(program=None):
+    """Count op occurrences in a captured program (reference
+    op_frequence.py:23 walks program.blocks' op descs; here the DAG's
+    deferred-op nodes).  Returns an OrderedDict op_name -> count, most
+    frequent first."""
+    from ..static import default_main_program
+
+    program = program or default_main_program()
+    freq: dict[str, int] = {}
+    for v in _dag_nodes(program):
+        fn = getattr(v, "_fn", None)
+        if fn is None:
+            continue
+        # deferred nodes often hold inner closures; the enclosing op name
+        # lives in __qualname__ ("matmul.<locals>.f" -> "matmul")
+        qual = getattr(fn, "__qualname__", None) \
+            or getattr(fn, "__name__", None) or str(fn)
+        name = qual.split(".")[0] or qual
+        freq[name] = freq.get(name, 0) + 1
+    return OrderedDict(sorted(freq.items(), key=lambda kv: -kv[1]))
+
+
+def memory_usage(program=None, batch_size=1):
+    """Estimate the activation+parameter memory of a captured program at
+    ``batch_size`` (reference memory_usage_calc.py:46 sums var-desc
+    bytes with the batch dim substituted; same accounting over the DAG).
+    Returns (size, unit_str) and prints the reference-style message."""
+    from ..static import default_main_program
+
+    if batch_size is None or int(batch_size) <= 0:
+        raise ValueError(f"batch_size must be positive, got {batch_size}")
+    program = program or default_main_program()
+    total = 0
+    for v in _dag_nodes(program):
+        try:
+            shape = [int(batch_size) if (d is None or int(d) < 0) else int(d)
+                     for d in (v.shape or [])]
+            dtype = str(v.dtype or "float32")
+        except Exception:  # noqa: BLE001 - shape inference can fail on
+            continue       # feed-less symbolic vars; skip those nodes
+        total += int(np.prod(shape, initial=1)) \
+            * _DTYPE_BYTES.get(dtype, 4)
+    for unit, scale in (("GB", 1 << 30), ("MB", 1 << 20), ("KB", 1 << 10)):
+        if total >= scale:
+            size = total / scale
+            break
+    else:
+        size, unit = float(total), "B"
+    print(f"Your program requires about {size:.2f} {unit} memory at "
+          f"batch size {batch_size} (captured-DAG estimate).")
+    return size, unit
+
+
+def extend_with_decoupled_weight_decay(base_optimizer):
+    """Class factory (reference extend_optimizer_with_weight_decay.py):
+    returns a subclass of ``base_optimizer`` whose step() applies
+    DECOUPLED weight decay — p -= lr * coeff * p applied directly to the
+    weights, not folded into the gradient like the regularizer path
+    (the AdamW recipe, generalized to any optimizer)."""
+    from ..optimizer import Optimizer
+
+    if not (isinstance(base_optimizer, type)
+            and issubclass(base_optimizer, Optimizer)):
+        raise TypeError("extend_with_decoupled_weight_decay expects an "
+                        f"Optimizer subclass, got {base_optimizer!r}")
+
+    class OptimizerWithDecoupledWeightDecay(base_optimizer):
+        def __init__(self, *args, coeff=0.01, **kwargs):
+            super().__init__(*args, **kwargs)
+            self._decoupled_coeff = float(coeff)
+
+        def step(self):
+            import jax.numpy as jnp
+
+            lr = self.get_lr()
+            factor = 1.0 - lr * self._decoupled_coeff
+            for p in (self._parameter_list or ()):
+                # decay ONLY params this step trains (same condition as
+                # the base step): a param with no grad this iteration
+                # must not be silently shrunk toward zero
+                if getattr(p, "stop_gradient", False) or p.grad is None:
+                    continue
+                p._value = (p._value * jnp.asarray(factor, p._value.dtype))
+            super().step()
+
+    OptimizerWithDecoupledWeightDecay.__name__ = \
+        f"{base_optimizer.__name__}WithDecoupledWeightDecay"
+    return OptimizerWithDecoupledWeightDecay

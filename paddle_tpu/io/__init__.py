@@ -105,13 +105,9 @@ def _host_rng():
     through the GLOBAL np.random state (the reference seeds its sampler
     RNGs from op/program seeds the same way).  Each call advances the
     chain, so successive epochs draw different permutations."""
-    import jax
+    from ..framework.random import np_random_state
 
-    from ..framework import random as _fr
-
-    key = _fr.split_key(1)
-    data = np.asarray(jax.random.key_data(key)).ravel()
-    return np.random.RandomState(data.astype(np.uint32)[-1])
+    return np_random_state()
 
 
 def random_split(dataset, lengths, generator=None):

@@ -73,9 +73,9 @@ def run_check():
     import jax
 
     devs = jax.devices()
-    loss, net = _simple_step()
     print(f"Running verify PaddlePaddle(paddle_tpu) program ... "
           f"device: {devs[0].platform} x{len(devs)}")
+    loss, net = _simple_step()
     ploss = _parallel_step(net)
     if ploss is not None:
         print(f"PaddlePaddle(paddle_tpu) works well on {len(devs)} "

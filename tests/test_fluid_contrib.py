@@ -1,0 +1,67 @@
+"""fluid.contrib tools (reference fluid/contrib/): op frequency over the
+captured program DAG, memory estimation, decoupled-weight-decay
+optimizer extension."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.fluid as fluid
+from paddle_tpu.fluid.contrib import (extend_with_decoupled_weight_decay,
+                                      memory_usage, op_freq_statistic)
+
+
+def _captured_program():
+    prog = fluid.Program()
+    start = fluid.Program()
+    with paddle.static.program_guard(prog, start):
+        x = fluid.data("x", [None, 8], "float32")
+        y = fluid.data("y", [None, 1], "int64")
+        h = fluid.layers.fc(x, 16, act="relu")
+        p = fluid.layers.fc(h, 4, act="softmax")
+        loss = fluid.layers.mean(fluid.layers.cross_entropy(p, y))
+        fluid.optimizer.SGD(learning_rate=0.1).minimize(loss)
+    return prog, start, loss
+
+
+def test_op_freq_statistic_counts_dag_ops():
+    prog, start, _ = _captured_program()
+    freq = op_freq_statistic(prog)
+    assert sum(freq.values()) >= 5
+    # two fc layers -> at least two matmul-family ops; softmax/relu appear
+    names = " ".join(freq)
+    assert any(k in names for k in ("matmul", "fc", "linear")), freq
+    assert any(k in names for k in ("relu",)), freq
+    # sorted most-frequent first
+    counts = list(freq.values())
+    assert counts == sorted(counts, reverse=True)
+
+
+def test_memory_usage_scales_with_batch(capsys):
+    prog, start, _ = _captured_program()
+    s1, u1 = memory_usage(prog, batch_size=1)
+    s64, u64 = memory_usage(prog, batch_size=64)
+    def to_bytes(s, u):
+        return s * {"B": 1, "KB": 2**10, "MB": 2**20, "GB": 2**30}[u]
+    assert to_bytes(s64, u64) > to_bytes(s1, u1)
+    assert "memory" in capsys.readouterr().out
+    with pytest.raises(ValueError):
+        memory_usage(prog, batch_size=0)
+
+
+def test_extend_with_decoupled_weight_decay():
+    paddle.seed(0)
+    net = paddle.nn.Linear(4, 4)
+    DecayedSGD = extend_with_decoupled_weight_decay(paddle.optimizer.SGD)
+    assert "WithDecoupledWeightDecay" in DecayedSGD.__name__
+    opt = DecayedSGD(learning_rate=0.1, coeff=0.5,
+                     parameters=net.parameters())
+    w_before = np.asarray(net.weight.numpy()).copy()
+    # zero-grad step isolates the decay term: w <- w * (1 - lr*coeff)
+    loss = (net(paddle.to_tensor(np.zeros((2, 4), np.float32)))
+            * 0.0).sum()
+    loss.backward()
+    opt.step()
+    np.testing.assert_allclose(np.asarray(net.weight.numpy()),
+                               w_before * (1 - 0.1 * 0.5), rtol=1e-5)
+    with pytest.raises(TypeError):
+        extend_with_decoupled_weight_decay(object)
