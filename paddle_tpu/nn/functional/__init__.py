@@ -184,9 +184,33 @@ def maxout(x, groups, axis=1, name=None):
 # ---------------------------------------------------------------------------
 # linear / embedding
 # ---------------------------------------------------------------------------
+def _static_dim(t, axis):
+    """Best-effort static dim of a Tensor/array/Variable (None when
+    unknown/symbolic) for friendly pre-dispatch shape errors."""
+    shape = getattr(t, "shape", None)
+    if not shape:
+        return None
+    try:
+        d = shape[axis]
+    except (IndexError, TypeError):
+        return None
+    return int(d) if isinstance(d, (int,)) and d >= 0 else None
+
+
+def _check_dim(got, want, op, what):
+    """Raise a named ValueError instead of letting XLA emit a raw
+    dot/conv dimension error (known UX gap: wrong-shape inputs used to
+    surface as compiler messages)."""
+    if got is not None and want is not None and got != want:
+        raise ValueError(f"{op}: {what}: got {got}, expected {want}")
+
+
 def linear(x, weight, bias=None, name=None):
     """paddle convention: weight shape [in_features, out_features]."""
     from ...amp import white_cast
+
+    _check_dim(_static_dim(x, -1), _static_dim(weight, 0), "linear",
+               "input last dim vs weight in_features")
 
     if bias is None:
         return apply(lambda v, w: jnp.matmul(*white_cast(v, w)), x, weight)
@@ -199,6 +223,11 @@ def linear(x, weight, bias=None, name=None):
 
 
 def embedding(x, weight, padding_idx=None, sparse=False, name=None):
+    dt = str(getattr(x, "dtype", ""))
+    if dt.startswith("float") or dt.startswith("bfloat"):
+        raise TypeError(
+            f"embedding: ids must be an integer tensor, got dtype {dt}")
+
     def f(ids, w):
         out = jnp.take(w, ids.astype(jnp.int32), axis=0)
         if padding_idx is not None:
@@ -260,6 +289,14 @@ def _dimension_numbers(nsp, channel_last):
 def _conv(x, weight, bias, stride, padding, dilation, groups, data_format, nsp,
           transpose=False, output_padding=0):
     channel_last = data_format[-1] == "C"
+    # friendly channel check for all six conv entry points: paddle
+    # weight layouts are [out_c, in_c/groups, *k] (conv) and
+    # [in_c, out_c/groups, *k] (transpose)
+    win = _static_dim(weight, 0 if transpose else 1)
+    want = None if win is None else (win if transpose else win * groups)
+    _check_dim(_static_dim(x, -1 if channel_last else 1), want,
+               f"conv{nsp}d{'_transpose' if transpose else ''}",
+               f"input channels ({data_format}) vs weight layout")
     stride = _norm_tuple(stride, nsp)
     dilation = _norm_tuple(dilation, nsp)
     pad_spec = _conv_padding(padding, nsp)
@@ -569,6 +606,9 @@ def layer_norm(x, normalized_shape, weight=None, bias=None, epsilon=1e-5,
     ns = (normalized_shape,) if isinstance(normalized_shape, int) \
         else tuple(normalized_shape)
     naxes = len(ns)
+    for i, want in enumerate(ns):
+        _check_dim(_static_dim(x, -naxes + i), int(want), "layer_norm",
+                   f"trailing dim {-naxes + i} vs normalized_shape")
 
     from ...ops import fused as _fused
     if (flag("FLAGS_use_pallas_kernels") and naxes == 1 and weight is not None
@@ -782,6 +822,13 @@ def _reduce_loss(loss_fn_out, reduction):
 
 def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean",
                   soft_label=False, axis=-1, use_softmax=True, name=None):
+    if not soft_label:
+        ldt = str(getattr(label, "dtype", ""))
+        if ldt.startswith("float") or ldt.startswith("bfloat"):
+            raise TypeError(
+                "cross_entropy: hard labels must be integer class ids "
+                f"(got dtype {ldt}); pass soft_label=True for "
+                "probability targets")
     from ...ops import fused as _fused
     if (flag("FLAGS_use_pallas_kernels") and use_softmax and not soft_label
             and weight is None and axis in (-1, None)):

@@ -1,0 +1,92 @@
+"""Wrong-shape/dtype inputs must raise named framework errors before
+dispatch, not raw XLA dot/conv messages (the known UX gap the verify
+notes called out).  Reference analog: infer_shape PADDLE_ENFORCE
+messages (operator.cc InferShape)."""
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+import paddle_tpu.nn as nn
+import paddle_tpu.nn.functional as F
+
+
+def test_linear_dim_mismatch_named():
+    net = nn.Linear(8, 4)
+    with pytest.raises(ValueError, match="linear.*in_features"):
+        net(paddle.to_tensor(np.zeros((2, 7), np.float32)))
+
+
+def test_linear_ok_with_unknown_dims_and_valid_input():
+    net = nn.Linear(8, 4)
+    out = net(paddle.to_tensor(np.zeros((2, 8), np.float32)))
+    assert out.shape[-1] == 4
+
+
+def test_checks_skip_symbolic_dims():
+    """A symbolic (non-int) dim must be SKIPPED by the check, never
+    raise — shape-polymorphic tracing (jax.export) flows through here."""
+    import jax
+    from jax import export as jexport
+
+    b, = jexport.symbolic_shape("b")
+    net = nn.Linear(8, 4)
+
+    def fwd(x):
+        return net(paddle.to_tensor(x)).value
+
+    # trace with a symbolic leading dim; the check reads dim -1 (static
+    # 8, passes) and must tolerate the symbolic batch in the same shape
+    closed = jax.make_jaxpr(fwd)(
+        jax.ShapeDtypeStruct((b, 8), np.float32))
+    assert closed.jaxpr.invars
+
+
+def test_conv_channel_mismatch_named_all_ranks():
+    net2 = nn.Conv2D(3, 8, 3)
+    with pytest.raises(ValueError, match="conv2d.*channels"):
+        net2(paddle.to_tensor(np.zeros((1, 4, 8, 8), np.float32)))
+    net1 = nn.Conv1D(3, 8, 3)
+    with pytest.raises(ValueError, match="conv1d.*channels"):
+        net1(paddle.to_tensor(np.zeros((1, 4, 16), np.float32)))
+    net3 = nn.Conv2DTranspose(3, 8, 3)
+    with pytest.raises(ValueError, match="conv2d_transpose.*channels"):
+        net3(paddle.to_tensor(np.zeros((1, 4, 8, 8), np.float32)))
+
+
+def test_conv2d_groups_accounted():
+    net = nn.Conv2D(8, 8, 3, groups=4, padding=1)  # weight [8, 2, 3, 3]
+    out = net(paddle.to_tensor(np.zeros((1, 8, 6, 6), np.float32)))
+    assert out.shape[1] == 8
+    with pytest.raises(ValueError, match="conv2d"):
+        net(paddle.to_tensor(np.zeros((1, 4, 6, 6), np.float32)))
+
+
+def test_embedding_float_ids_named():
+    emb = nn.Embedding(10, 4)
+    with pytest.raises(TypeError, match="integer"):
+        emb(paddle.to_tensor(np.zeros((2, 3), np.float32)))
+
+
+def test_layer_norm_shape_mismatch_named():
+    ln = nn.LayerNorm(16)
+    with pytest.raises(ValueError, match="layer_norm.*normalized_shape"):
+        ln(paddle.to_tensor(np.zeros((2, 8), np.float32)))
+
+
+def test_cross_entropy_float_hard_labels_named():
+    logits = paddle.to_tensor(np.zeros((4, 3), np.float32))
+    with pytest.raises(TypeError, match="soft_label"):
+        F.cross_entropy(logits, paddle.to_tensor(
+            np.zeros((4,), np.float32)))
+    # soft labels stay allowed
+    probs = paddle.to_tensor(np.full((4, 3), 1 / 3, np.float32))
+    loss = F.cross_entropy(logits, probs, soft_label=True)
+    assert np.isfinite(float(loss))
+
+
+def test_checks_are_jit_safe():
+    """Static-shape checks must not break tracing (to_static path)."""
+    net = nn.Sequential(nn.Linear(8, 16), nn.LayerNorm(16))
+    fn = paddle.jit.to_static(lambda x: net(x))
+    out = fn(paddle.to_tensor(np.zeros((2, 8), np.float32)))
+    assert tuple(out.shape) == (2, 16)
