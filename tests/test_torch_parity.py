@@ -1,0 +1,130 @@
+"""Independent-oracle parity: paddle_tpu functional ops vs torch (CPU)
+on identical inputs.  The numpy-reference OpTests share authorship bias
+with the implementations; torch is an external oracle for the exact
+semantics the reference op library implements (its kernels are the same
+contracts torch follows: gelu erf-form, softmax, log_softmax, silu,
+layer_norm epsilon placement, conv padding, smooth_l1 beta=1, kl_div
+batchmean...)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+rs = np.random.RandomState(7)
+
+
+def _cmp(pd_out, t_out, atol=1e-5, rtol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.detach().numpy(), atol=atol,
+                               rtol=rtol)
+
+
+@pytest.mark.parametrize("name,pd,th", [
+    ("relu", F.relu, tF.relu),
+    ("sigmoid", F.sigmoid, torch.sigmoid),
+    ("tanh", paddle.tanh, torch.tanh),
+    ("silu", F.silu, tF.silu),
+    ("softplus", F.softplus, tF.softplus),
+    ("softsign", F.softsign, tF.softsign),
+    ("elu", F.elu, tF.elu),
+    ("leaky_relu", F.leaky_relu,
+     lambda t: tF.leaky_relu(t, negative_slope=0.01)),
+    ("hardtanh", F.hardtanh, tF.hardtanh),
+    ("relu6", F.relu6, tF.relu6),
+])
+def test_activation_parity(name, pd, th):
+    x = rs.randn(4, 17).astype(np.float32) * 3
+    _cmp(pd(paddle.to_tensor(x)), th(torch.tensor(x)))
+
+
+def test_gelu_both_forms():
+    x = rs.randn(3, 33).astype(np.float32) * 2
+    _cmp(F.gelu(paddle.to_tensor(x)), tF.gelu(torch.tensor(x)))
+    _cmp(F.gelu(paddle.to_tensor(x), approximate=True),
+         tF.gelu(torch.tensor(x), approximate="tanh"), atol=1e-4)
+
+
+def test_softmax_logsoftmax_parity():
+    x = rs.randn(5, 11).astype(np.float32) * 4
+    _cmp(F.softmax(paddle.to_tensor(x), axis=-1),
+         tF.softmax(torch.tensor(x), dim=-1))
+    _cmp(F.log_softmax(paddle.to_tensor(x), axis=0),
+         tF.log_softmax(torch.tensor(x), dim=0))
+
+
+def test_layer_norm_parity():
+    x = rs.randn(4, 16).astype(np.float32)
+    w = rs.rand(16).astype(np.float32) + 0.5
+    b = rs.randn(16).astype(np.float32)
+    got = F.layer_norm(paddle.to_tensor(x), 16, paddle.to_tensor(w),
+                       paddle.to_tensor(b), epsilon=1e-5)
+    want = tF.layer_norm(torch.tensor(x), (16,), torch.tensor(w),
+                         torch.tensor(b), eps=1e-5)
+    _cmp(got, want, atol=1e-5)
+
+
+def test_conv2d_parity_padding_stride_dilation_groups():
+    x = rs.randn(2, 4, 11, 9).astype(np.float32)
+    w = rs.randn(8, 2, 3, 3).astype(np.float32)  # groups=2
+    b = rs.randn(8).astype(np.float32)
+    got = F.conv2d(paddle.to_tensor(x), paddle.to_tensor(w),
+                   paddle.to_tensor(b), stride=2, padding=1, dilation=2,
+                   groups=2)
+    want = tF.conv2d(torch.tensor(x), torch.tensor(w), torch.tensor(b),
+                     stride=2, padding=1, dilation=2, groups=2)
+    _cmp(got, want, atol=1e-4)
+
+
+def test_cross_entropy_parity():
+    logits = rs.randn(6, 5).astype(np.float32)
+    labels = rs.randint(0, 5, (6,)).astype(np.int64)
+    got = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels))
+    want = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels))
+    _cmp(got, want)
+
+
+def test_smooth_l1_and_kldiv_parity():
+    a = rs.randn(4, 7).astype(np.float32)
+    b = rs.randn(4, 7).astype(np.float32)
+    got = F.smooth_l1_loss(paddle.to_tensor(a), paddle.to_tensor(b))
+    want = tF.smooth_l1_loss(torch.tensor(a), torch.tensor(b))
+    _cmp(got, want)
+    p = tF.softmax(torch.tensor(a), dim=-1)
+    logq = tF.log_softmax(torch.tensor(b), dim=-1)
+    got = F.kl_div(paddle.to_tensor(logq.numpy()),
+                   paddle.to_tensor(p.numpy()), reduction="batchmean")
+    want = tF.kl_div(logq, p, reduction="batchmean")
+    _cmp(got, want)
+
+
+def test_max_avg_pool_parity():
+    x = rs.randn(2, 3, 10, 10).astype(np.float32)
+    got = F.max_pool2d(paddle.to_tensor(x), kernel_size=3, stride=2,
+                       padding=1)
+    want = tF.max_pool2d(torch.tensor(x), 3, stride=2, padding=1)
+    _cmp(got, want)
+    got = F.avg_pool2d(paddle.to_tensor(x), kernel_size=2, stride=2)
+    want = tF.avg_pool2d(torch.tensor(x), 2, stride=2)
+    _cmp(got, want)
+
+
+def test_grad_parity_through_gelu_linear():
+    """Gradients, not just forwards: d(loss)/dx for a gelu(linear) chain
+    must match torch autograd."""
+    x = rs.randn(3, 8).astype(np.float32)
+    w = rs.randn(8, 4).astype(np.float32)
+
+    px = paddle.to_tensor(x, stop_gradient=False)
+    loss = paddle.sum(F.gelu(paddle.matmul(px, paddle.to_tensor(w))) ** 2)
+    loss.backward()
+
+    tx = torch.tensor(x, requires_grad=True)
+    tloss = (tF.gelu(tx @ torch.tensor(w)) ** 2).sum()
+    tloss.backward()
+    np.testing.assert_allclose(np.asarray(px.grad.numpy()),
+                               tx.grad.numpy(), atol=1e-4, rtol=1e-4)
