@@ -1,0 +1,95 @@
+"""Optimizer trajectory parity vs torch.optim: identical initial params
+and gradient sequences must yield matching parameter trajectories (the
+update rules' exact math, incl. bias correction and decoupled decay —
+reference analogs adam_op.cc / momentum_op.cc / sgd_op.cc / rmsprop)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+
+rs = np.random.RandomState(3)
+STEPS = 10
+
+
+def _run_paddle(opt_factory, w0, grads):
+    p = paddle.to_tensor(w0.copy(), stop_gradient=False)
+    opt = opt_factory([p])
+    for g in grads:
+        p.grad = paddle.to_tensor(g)
+        opt.step()
+        opt.clear_grad()
+    return np.asarray(p.numpy())
+
+
+def _run_torch(opt_factory, w0, grads):
+    t = torch.tensor(w0.copy(), requires_grad=True)
+    opt = opt_factory([t])
+    for g in grads:
+        t.grad = torch.tensor(g)
+        opt.step()
+        opt.zero_grad()
+    return t.detach().numpy()
+
+
+@pytest.fixture
+def problem():
+    w0 = rs.randn(5, 3).astype(np.float32)
+    grads = [rs.randn(5, 3).astype(np.float32) for _ in range(STEPS)]
+    return w0, grads
+
+
+def test_sgd_parity(problem):
+    w0, grads = problem
+    got = _run_paddle(lambda ps: paddle.optimizer.SGD(
+        learning_rate=0.1, parameters=ps), w0, grads)
+    want = _run_torch(lambda ps: torch.optim.SGD(ps, lr=0.1), w0, grads)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_momentum_parity(problem):
+    w0, grads = problem
+    got = _run_paddle(lambda ps: paddle.optimizer.Momentum(
+        learning_rate=0.05, momentum=0.9, parameters=ps), w0, grads)
+    want = _run_torch(lambda ps: torch.optim.SGD(
+        ps, lr=0.05, momentum=0.9), w0, grads)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_adam_parity(problem):
+    w0, grads = problem
+    got = _run_paddle(lambda ps: paddle.optimizer.Adam(
+        learning_rate=1e-2, beta1=0.9, beta2=0.999, epsilon=1e-8,
+        parameters=ps), w0, grads)
+    want = _run_torch(lambda ps: torch.optim.Adam(
+        ps, lr=1e-2, betas=(0.9, 0.999), eps=1e-8), w0, grads)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_adamw_parity(problem):
+    w0, grads = problem
+    got = _run_paddle(lambda ps: paddle.optimizer.AdamW(
+        learning_rate=1e-2, weight_decay=0.05, parameters=ps), w0, grads)
+    want = _run_torch(lambda ps: torch.optim.AdamW(
+        ps, lr=1e-2, weight_decay=0.05), w0, grads)
+    np.testing.assert_allclose(got, want, atol=1e-6)
+
+
+def test_rmsprop_parity(problem):
+    w0, grads = problem
+    got = _run_paddle(lambda ps: paddle.optimizer.RMSProp(
+        learning_rate=1e-2, rho=0.9, epsilon=1e-8, parameters=ps),
+        w0, grads)
+    want = _run_torch(lambda ps: torch.optim.RMSprop(
+        ps, lr=1e-2, alpha=0.9, eps=1e-8), w0, grads)
+    np.testing.assert_allclose(got, want, atol=1e-5)
+
+
+def test_adagrad_parity(problem):
+    w0, grads = problem
+    got = _run_paddle(lambda ps: paddle.optimizer.Adagrad(
+        learning_rate=0.05, epsilon=1e-10, parameters=ps), w0, grads)
+    want = _run_torch(lambda ps: torch.optim.Adagrad(
+        ps, lr=0.05, eps=1e-10), w0, grads)
+    np.testing.assert_allclose(got, want, atol=1e-6)
