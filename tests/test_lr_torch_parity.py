@@ -1,0 +1,70 @@
+"""LR-schedule curve parity vs torch.optim.lr_scheduler: paddle's
+scheduler contract evaluates the lr BEFORE the optimizer step of that
+epoch (scheduler.step() advances the epoch), so paddle lr at epoch k ==
+torch get_last_lr() after k scheduler steps."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+
+EPOCHS = 25
+
+
+def _torch_curve(sched_factory):
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=0.5)
+    sched = sched_factory(opt)
+    lrs = []
+    for _ in range(EPOCHS):
+        lrs.append(sched.get_last_lr()[0])
+        opt.step()
+        sched.step()
+    return np.asarray(lrs)
+
+
+def _paddle_curve(sched):
+    lrs = []
+    for _ in range(EPOCHS):
+        lrs.append(sched())
+        sched.step()
+    return np.asarray(lrs)
+
+
+@pytest.mark.parametrize("pd,th", [
+    (lambda: paddle.optimizer.lr.StepDecay(0.5, step_size=7, gamma=0.3),
+     lambda o: torch.optim.lr_scheduler.StepLR(o, step_size=7, gamma=0.3)),
+    (lambda: paddle.optimizer.lr.MultiStepDecay(0.5, [5, 11, 17],
+                                                gamma=0.2),
+     lambda o: torch.optim.lr_scheduler.MultiStepLR(o, [5, 11, 17],
+                                                    gamma=0.2)),
+    (lambda: paddle.optimizer.lr.ExponentialDecay(0.5, gamma=0.9),
+     lambda o: torch.optim.lr_scheduler.ExponentialLR(o, gamma=0.9)),
+    (lambda: paddle.optimizer.lr.CosineAnnealingDecay(0.5, T_max=20),
+     lambda o: torch.optim.lr_scheduler.CosineAnnealingLR(o, T_max=20)),
+    (lambda: paddle.optimizer.lr.LambdaDecay(
+        0.5, lr_lambda=lambda e: 1.0 / (1 + e)),
+     lambda o: torch.optim.lr_scheduler.LambdaLR(
+        o, lr_lambda=lambda e: 1.0 / (1 + e))),
+])
+def test_schedule_curve_parity(pd, th):
+    np.testing.assert_allclose(_paddle_curve(pd()), _torch_curve(th),
+                               rtol=1e-6, atol=1e-9)
+
+
+def test_reduce_on_plateau_parity():
+    losses = [1.0, 0.9, 0.85, 0.85, 0.85, 0.85, 0.84, 0.84, 0.84, 0.84,
+              0.84, 0.84, 0.5, 0.5, 0.5, 0.5, 0.5, 0.5, 0.5, 0.5]
+    ps = paddle.optimizer.lr.ReduceOnPlateau(0.5, factor=0.1, patience=3)
+    p = torch.nn.Parameter(torch.zeros(1))
+    opt = torch.optim.SGD([p], lr=0.5)
+    ts = torch.optim.lr_scheduler.ReduceLROnPlateau(opt, factor=0.1,
+                                                    patience=3)
+    got, want = [], []
+    for lv in losses:
+        ps.step(metrics=lv)
+        got.append(ps())
+        ts.step(lv)
+        want.append(opt.param_groups[0]["lr"])
+    np.testing.assert_allclose(got, want, rtol=1e-6)
