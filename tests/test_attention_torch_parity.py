@@ -1,0 +1,120 @@
+"""Attention-stack parity vs torch: MultiHeadAttention (self and cross,
+with and without mask) and a full TransformerEncoderLayer, weights
+copied across layouts (paddle Linear weight is [in, out]; torch packs
+qkv into in_proj_weight [3E, E] in [out, in] convention).  Pins the
+flagship BERT/GPT attention math against an external oracle."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn as nn  # noqa: E402
+
+rs = np.random.RandomState(13)
+E, H, B, S = 16, 4, 2, 7
+
+
+def _cmp(pd_out, t_out, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.detach().numpy(), atol=atol,
+                               rtol=1e-4)
+
+
+def _copy_mha(p_mha, t_mha):
+    def w(lin):  # paddle [in, out] -> torch [out, in]
+        return torch.tensor(np.asarray(lin.weight.numpy()).T.copy())
+
+    def b(lin):
+        return torch.tensor(np.asarray(lin.bias.numpy()))
+
+    with torch.no_grad():
+        t_mha.in_proj_weight.copy_(torch.cat(
+            [w(p_mha.q_proj), w(p_mha.k_proj), w(p_mha.v_proj)]))
+        t_mha.in_proj_bias.copy_(torch.cat(
+            [b(p_mha.q_proj), b(p_mha.k_proj), b(p_mha.v_proj)]))
+        t_mha.out_proj.weight.copy_(w(p_mha.out_proj))
+        t_mha.out_proj.bias.copy_(b(p_mha.out_proj))
+
+
+@pytest.fixture
+def pair():
+    paddle.seed(4)
+    p_mha = nn.MultiHeadAttention(E, H, dropout=0.0)
+    t_mha = torch.nn.MultiheadAttention(E, H, dropout=0.0,
+                                        batch_first=True)
+    _copy_mha(p_mha, t_mha)
+    p_mha.eval()
+    t_mha.eval()
+    return p_mha, t_mha
+
+
+def test_self_attention_parity(pair):
+    p_mha, t_mha = pair
+    x = rs.randn(B, S, E).astype(np.float32)
+    got = p_mha(paddle.to_tensor(x), paddle.to_tensor(x),
+                paddle.to_tensor(x))
+    want, _ = t_mha(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                    need_weights=False)
+    _cmp(got, want, atol=1e-5)
+
+
+def test_cross_attention_parity(pair):
+    p_mha, t_mha = pair
+    q = rs.randn(B, 5, E).astype(np.float32)
+    kv = rs.randn(B, S, E).astype(np.float32)
+    got = p_mha(paddle.to_tensor(q), paddle.to_tensor(kv),
+                paddle.to_tensor(kv))
+    want, _ = t_mha(torch.tensor(q), torch.tensor(kv), torch.tensor(kv),
+                    need_weights=False)
+    _cmp(got, want, atol=1e-5)
+
+
+def test_causal_mask_parity(pair):
+    p_mha, t_mha = pair
+    x = rs.randn(B, S, E).astype(np.float32)
+    causal = np.triu(np.full((S, S), -np.inf, np.float32), k=1)
+    got = p_mha(paddle.to_tensor(x), paddle.to_tensor(x),
+                paddle.to_tensor(x),
+                attn_mask=paddle.to_tensor(causal))
+    want, _ = t_mha(torch.tensor(x), torch.tensor(x), torch.tensor(x),
+                    attn_mask=torch.tensor(causal), need_weights=False)
+    _cmp(got, want, atol=1e-5)
+
+
+def test_transformer_encoder_layer_parity():
+    paddle.seed(6)
+    p_tel = nn.TransformerEncoderLayer(d_model=E, nhead=H,
+                                       dim_feedforward=32, dropout=0.0,
+                                       activation="relu")
+    t_tel = torch.nn.TransformerEncoderLayer(
+        d_model=E, nhead=H, dim_feedforward=32, dropout=0.0,
+        activation="relu", batch_first=True)
+    p_tel.eval()
+    t_tel.eval()
+    _copy_mha(p_tel.self_attn, t_tel.self_attn)
+
+    def w(lin):
+        return torch.tensor(np.asarray(lin.weight.numpy()).T.copy())
+
+    def b(lin):
+        return torch.tensor(np.asarray(lin.bias.numpy()))
+
+    with torch.no_grad():
+        t_tel.linear1.weight.copy_(w(p_tel.linear1))
+        t_tel.linear1.bias.copy_(b(p_tel.linear1))
+        t_tel.linear2.weight.copy_(w(p_tel.linear2))
+        t_tel.linear2.bias.copy_(b(p_tel.linear2))
+        t_tel.norm1.weight.copy_(torch.tensor(
+            np.asarray(p_tel.norm1.weight.numpy())))
+        t_tel.norm1.bias.copy_(torch.tensor(
+            np.asarray(p_tel.norm1.bias.numpy())))
+        t_tel.norm2.weight.copy_(torch.tensor(
+            np.asarray(p_tel.norm2.weight.numpy())))
+        t_tel.norm2.bias.copy_(torch.tensor(
+            np.asarray(p_tel.norm2.bias.numpy())))
+
+    x = rs.randn(B, S, E).astype(np.float32)
+    got = p_tel(paddle.to_tensor(x))
+    want = t_tel(torch.tensor(x))
+    _cmp(got, want, atol=1e-4)
