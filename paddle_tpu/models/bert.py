@@ -56,7 +56,13 @@ class BertEmbeddings(Layer):
 
         pos = paddle.arange(input_ids.shape[1])
         x = self.word(input_ids) + self.position(pos)
-        if token_type_ids is not None:
+        if token_type_ids is None:
+            # BERT semantics: absent segment ids mean segment 0 — the
+            # type-0 embedding row is still ADDED (HF/paddlenlp default
+            # token_type_ids=zeros), not skipped; skipping shifts every
+            # hidden state and breaks checkpoint parity
+            x = x + self.token_type.weight[0]
+        else:
             x = x + self.token_type(token_type_ids)
         return self.dropout(self.layer_norm(x))
 

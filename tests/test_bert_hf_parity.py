@@ -1,0 +1,102 @@
+"""Flagship parity: our BertModel vs HuggingFace transformers BertModel
+(torch CPU) with weights copied across — the exact post-LN BERT
+semantics (embedding sum + LN, per-layer q/k/v/out + post-LN, gelu FFN,
+tanh pooler) validated against the ecosystem-standard implementation."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models import BertConfig, BertModel  # noqa: E402
+
+V, H, L, A, I, S = 120, 32, 2, 4, 64, 16
+rs = np.random.RandomState(17)
+
+
+def _t(x):
+    return torch.tensor(np.asarray(x.numpy()))
+
+
+def _tT(lin):  # paddle Linear [in, out] -> torch [out, in]
+    return torch.tensor(np.asarray(lin.weight.numpy()).T.copy())
+
+
+def _copy_into_hf(pm, hf):
+    e = hf.embeddings
+    with torch.no_grad():
+        e.word_embeddings.weight.copy_(_t(pm.embeddings.word.weight))
+        e.position_embeddings.weight.copy_(
+            _t(pm.embeddings.position.weight))
+        e.token_type_embeddings.weight.copy_(
+            _t(pm.embeddings.token_type.weight))
+        e.LayerNorm.weight.copy_(_t(pm.embeddings.layer_norm.weight))
+        e.LayerNorm.bias.copy_(_t(pm.embeddings.layer_norm.bias))
+        for i, lay in enumerate(hf.encoder.layer):
+            pl = pm.encoder.layers[i]
+            lay.attention.self.query.weight.copy_(_tT(pl.self_attn.q_proj))
+            lay.attention.self.query.bias.copy_(_t(pl.self_attn.q_proj.bias))
+            lay.attention.self.key.weight.copy_(_tT(pl.self_attn.k_proj))
+            lay.attention.self.key.bias.copy_(_t(pl.self_attn.k_proj.bias))
+            lay.attention.self.value.weight.copy_(_tT(pl.self_attn.v_proj))
+            lay.attention.self.value.bias.copy_(_t(pl.self_attn.v_proj.bias))
+            lay.attention.output.dense.weight.copy_(
+                _tT(pl.self_attn.out_proj))
+            lay.attention.output.dense.bias.copy_(
+                _t(pl.self_attn.out_proj.bias))
+            lay.attention.output.LayerNorm.weight.copy_(_t(pl.norm1.weight))
+            lay.attention.output.LayerNorm.bias.copy_(_t(pl.norm1.bias))
+            lay.intermediate.dense.weight.copy_(_tT(pl.linear1))
+            lay.intermediate.dense.bias.copy_(_t(pl.linear1.bias))
+            lay.output.dense.weight.copy_(_tT(pl.linear2))
+            lay.output.dense.bias.copy_(_t(pl.linear2.bias))
+            lay.output.LayerNorm.weight.copy_(_t(pl.norm2.weight))
+            lay.output.LayerNorm.bias.copy_(_t(pl.norm2.bias))
+        hf.pooler.dense.weight.copy_(_tT(pm.pooler))
+        hf.pooler.dense.bias.copy_(_t(pm.pooler.bias))
+
+
+@pytest.fixture(scope="module")
+def models():
+    paddle.seed(21)
+    pm = BertModel(BertConfig(
+        vocab_size=V, hidden_size=H, num_layers=L, num_heads=A,
+        intermediate_size=I, max_position_embeddings=S, dropout=0.0))
+    pm.eval()
+    hf = transformers.BertModel(transformers.BertConfig(
+        vocab_size=V, hidden_size=H, num_hidden_layers=L,
+        num_attention_heads=A, intermediate_size=I,
+        max_position_embeddings=S, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu"))
+    hf.eval()
+    _copy_into_hf(pm, hf)
+    return pm, hf
+
+
+def test_bert_hidden_and_pooler_parity(models):
+    pm, hf = models
+    ids = rs.randint(0, V, (2, S)).astype(np.int64)
+    seq, pooled = pm(paddle.to_tensor(ids))
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids))
+    np.testing.assert_allclose(np.asarray(seq.numpy()),
+                               out.last_hidden_state.numpy(),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(pooled.numpy()),
+                               out.pooler_output.numpy(),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_bert_token_type_parity(models):
+    pm, hf = models
+    ids = rs.randint(0, V, (2, S)).astype(np.int64)
+    tt = (np.arange(S) >= S // 2).astype(np.int64)[None].repeat(2, 0)
+    seq, _ = pm(paddle.to_tensor(ids), token_type_ids=paddle.to_tensor(tt))
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids),
+                 token_type_ids=torch.tensor(tt))
+    np.testing.assert_allclose(np.asarray(seq.numpy()),
+                               out.last_hidden_state.numpy(),
+                               atol=2e-5, rtol=1e-4)
