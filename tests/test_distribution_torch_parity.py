@@ -1,0 +1,60 @@
+"""Distribution math parity vs torch.distributions: log_prob, entropy,
+and KL divergence closed forms on identical parameters."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.distributions as td  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.distribution import (Categorical, Normal, Uniform,
+                                     kl_divergence)  # noqa: E402
+
+rs = np.random.RandomState(29)
+
+
+def _cmp(pd_out, t_out, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.numpy(), atol=atol, rtol=1e-5)
+
+
+def test_normal_log_prob_entropy_kl():
+    mu = rs.randn(5).astype(np.float32)
+    sd = (rs.rand(5).astype(np.float32) + 0.3)
+    x = rs.randn(5).astype(np.float32)
+    pn = Normal(paddle.to_tensor(mu), paddle.to_tensor(sd))
+    tn = td.Normal(torch.tensor(mu), torch.tensor(sd))
+    _cmp(pn.log_prob(paddle.to_tensor(x)),
+         tn.log_prob(torch.tensor(x)))
+    _cmp(pn.entropy(), tn.entropy())
+    mu2 = rs.randn(5).astype(np.float32)
+    sd2 = (rs.rand(5).astype(np.float32) + 0.3)
+    pn2 = Normal(paddle.to_tensor(mu2), paddle.to_tensor(sd2))
+    tn2 = td.Normal(torch.tensor(mu2), torch.tensor(sd2))
+    _cmp(kl_divergence(pn, pn2), td.kl_divergence(tn, tn2))
+
+
+def test_uniform_log_prob_entropy():
+    lo = np.float32(-1.5)
+    hi = np.float32(2.5)
+    pu = Uniform(paddle.to_tensor(lo), paddle.to_tensor(hi))
+    tu = td.Uniform(torch.tensor(lo), torch.tensor(hi))
+    x = np.array([-1.0, 0.0, 2.0], np.float32)
+    _cmp(pu.log_prob(paddle.to_tensor(x)), tu.log_prob(torch.tensor(x)))
+    _cmp(pu.entropy(), tu.entropy())
+
+
+def test_categorical_log_prob_entropy_kl():
+    # reference contract: Categorical takes unnormalized LOGITS
+    # (distribution.py:640), like td.Categorical(logits=...)
+    logits = rs.randn(6).astype(np.float32)
+    pc = Categorical(paddle.to_tensor(logits))
+    tc = td.Categorical(logits=torch.tensor(logits))
+    ids = np.array([0, 3, 5], np.int64)
+    _cmp(pc.log_prob(paddle.to_tensor(ids)),
+         tc.log_prob(torch.tensor(ids)))
+    _cmp(pc.entropy(), tc.entropy())
+    logits2 = rs.randn(6).astype(np.float32)
+    pc2 = Categorical(paddle.to_tensor(logits2))
+    tc2 = td.Categorical(logits=torch.tensor(logits2))
+    _cmp(kl_divergence(pc, pc2), td.kl_divergence(tc, tc2))
