@@ -1319,6 +1319,15 @@ def temporal_shift(x, seg_num, shift_ratio=0.25, data_format="NCHW",
 
 def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
                 align_corners=True, name=None):
+    """Reference grid_sampler_op semantics (= torch.grid_sample):
+    bilinear/nearest modes, zeros/border/reflection padding; nearest
+    rounds half-to-even (nearbyint)."""
+    if mode not in ("bilinear", "nearest"):
+        raise ValueError(f"grid_sample: unknown mode '{mode}'")
+    if padding_mode not in ("zeros", "border", "reflection"):
+        raise ValueError(
+            f"grid_sample: unknown padding_mode '{padding_mode}'")
+
     def f(v, g):
         N, C, H, W = v.shape
         gx, gy = g[..., 0], g[..., 1]
@@ -1329,20 +1338,43 @@ def grid_sample(x, grid, mode="bilinear", padding_mode="zeros",
             ix = ((gx + 1) * W - 1) / 2
             iy = ((gy + 1) * H - 1) / 2
 
+        def reflect(c, size):
+            if align_corners:
+                if size <= 1:
+                    return jnp.zeros_like(c)
+                span = 2.0 * (size - 1)
+                r = jnp.mod(jnp.abs(c), span)
+                return jnp.minimum(r, span - r)
+            span = 2.0 * size
+            r = jnp.mod(jnp.abs(c + 0.5), span)
+            return jnp.minimum(r, span - r) - 0.5
+
+        if padding_mode == "border":
+            ix = jnp.clip(ix, 0, W - 1)
+            iy = jnp.clip(iy, 0, H - 1)
+        elif padding_mode == "reflection":
+            ix = jnp.clip(reflect(ix, W), 0, W - 1)
+            iy = jnp.clip(reflect(iy, H), 0, H - 1)
+        masked = padding_mode == "zeros"
+
         def sample(img, yy, xx):
+            def get(ix_, iy_):
+                ic = jnp.clip(ix_, 0, W - 1)
+                jc = jnp.clip(iy_, 0, H - 1)
+                val = img[:, jc, ic]  # [C, Ho, Wo]
+                if masked:
+                    inb = (ix_ >= 0) & (ix_ < W) & (iy_ >= 0) & (iy_ < H)
+                    val = jnp.where(inb[None], val, 0.0)
+                return val
+
+            if mode == "nearest":
+                return get(jnp.round(xx).astype(jnp.int32),
+                           jnp.round(yy).astype(jnp.int32))
             x0 = jnp.floor(xx).astype(jnp.int32)
             y0 = jnp.floor(yy).astype(jnp.int32)
             x1, y1 = x0 + 1, y0 + 1
             wx = xx - x0
             wy = yy - y0
-
-            def get(ix_, iy_):
-                inb = (ix_ >= 0) & (ix_ < W) & (iy_ >= 0) & (iy_ < H)
-                ic = jnp.clip(ix_, 0, W - 1)
-                jc = jnp.clip(iy_, 0, H - 1)
-                val = img[:, jc, ic]  # [C, Ho, Wo]
-                return jnp.where(inb[None], val, 0.0)
-
             return (get(x0, y0) * (1 - wx) * (1 - wy)
                     + get(x1, y0) * wx * (1 - wy)
                     + get(x0, y1) * (1 - wx) * wy

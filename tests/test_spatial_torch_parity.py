@@ -1,0 +1,73 @@
+"""Spatial-op parity vs torch: transposed conv (stride/padding/
+output_padding/groups), grid_sample, affine_grid, unfold — the
+geometry-sensitive ops where off-by-one conventions hide."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+rs = np.random.RandomState(37)
+
+
+def _cmp(pd_out, t_out, atol=1e-4):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.detach().numpy(), atol=atol,
+                               rtol=1e-4)
+
+
+@pytest.mark.parametrize("stride,padding,output_padding,groups", [
+    (1, 0, 0, 1), (2, 1, 0, 1), (2, 1, 1, 1), (3, 2, 1, 1), (2, 0, 0, 2),
+])
+def test_conv2d_transpose_parity(stride, padding, output_padding, groups):
+    cin, cout = 4, 6
+    x = rs.randn(2, cin, 7, 8).astype(np.float32)
+    w = rs.randn(cin, cout // groups, 3, 3).astype(np.float32)
+    b = rs.randn(cout).astype(np.float32)
+    got = F.conv2d_transpose(paddle.to_tensor(x), paddle.to_tensor(w),
+                             paddle.to_tensor(b), stride=stride,
+                             padding=padding,
+                             output_padding=output_padding,
+                             groups=groups)
+    want = tF.conv_transpose2d(torch.tensor(x), torch.tensor(w),
+                               torch.tensor(b), stride=stride,
+                               padding=padding,
+                               output_padding=output_padding,
+                               groups=groups)
+    _cmp(got, want)
+
+
+@pytest.mark.parametrize("mode", ["bilinear", "nearest"])
+@pytest.mark.parametrize("pad", ["zeros", "border", "reflection"])
+@pytest.mark.parametrize("align", [True, False])
+def test_grid_sample_parity(mode, pad, align):
+    x = rs.randn(2, 3, 6, 7).astype(np.float32)
+    # grid reaching past [-1, 1] so padding modes actually engage
+    grid = (rs.rand(2, 5, 4, 2).astype(np.float32) * 3 - 1.5)
+    got = F.grid_sample(paddle.to_tensor(x), paddle.to_tensor(grid),
+                        mode=mode, padding_mode=pad, align_corners=align)
+    want = tF.grid_sample(torch.tensor(x), torch.tensor(grid), mode=mode,
+                          padding_mode=pad, align_corners=align)
+    _cmp(got, want)
+
+
+@pytest.mark.parametrize("align", [True, False])
+def test_affine_grid_parity(align):
+    theta = rs.randn(2, 2, 3).astype(np.float32) * 0.5
+    got = F.affine_grid(paddle.to_tensor(theta), [2, 3, 5, 6],
+                        align_corners=align)
+    want = tF.affine_grid(torch.tensor(theta), [2, 3, 5, 6],
+                          align_corners=align)
+    _cmp(got, want, atol=1e-5)
+
+
+def test_unfold_parity():
+    x = rs.randn(2, 3, 8, 9).astype(np.float32)
+    got = F.unfold(paddle.to_tensor(x), kernel_sizes=3, strides=2,
+                   paddings=1, dilations=1)
+    want = tF.unfold(torch.tensor(x), kernel_size=3, stride=2, padding=1,
+                     dilation=1)
+    _cmp(got, want, atol=1e-6)
