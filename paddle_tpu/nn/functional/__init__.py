@@ -898,13 +898,29 @@ def nll_loss(input, label, weight=None, ignore_index=-100, reduction="mean",
              name=None):
     def f(logp, lbl, *w):
         lbl_i = lbl.astype(jnp.int32)
-        picked = jnp.take_along_axis(logp, lbl_i[..., None], axis=-1)
+        if logp.ndim > 2:  # [N, C, d1...] form: class axis lives at 1
+            logp = jnp.moveaxis(logp, 1, -1)
+        ign = lbl_i == ignore_index
+        safe = jnp.where(ign, 0, lbl_i)  # gather-safe index for ignored rows
+        picked = jnp.take_along_axis(logp, safe[..., None], axis=-1)
         loss = -jnp.squeeze(picked, -1)
-        if w:
-            loss = loss * jnp.take(w[0], lbl_i, axis=0)
-        return jnp.where(lbl_i == ignore_index, 0.0, loss)
+        wt = jnp.take(w[0], safe, axis=0) if w \
+            else jnp.ones(loss.shape, logp.dtype)
+        wt = jnp.where(ign, 0.0, wt)
+        # mask the PRODUCT: an ignored row with -inf log-prob would turn
+        # inf * 0 into NaN if only the weight were zeroed
+        wl = jnp.where(ign, 0.0, loss * wt)
+        if reduction == "mean":
+            # the nll_loss contract (reference nll_loss op == torch):
+            # mean divides by the TOTAL WEIGHT of non-ignored targets,
+            # not the row count; an all-ignored batch is 0/0 = NaN,
+            # exactly torch's behavior
+            return wl.sum() / wt.sum()
+        if reduction == "sum":
+            return wl.sum()
+        return wl
     args = [input, label] + ([weight] if weight is not None else [])
-    return _reduce_loss(apply(f, *args), reduction)
+    return apply(f, *args)
 
 
 def mse_loss(input, label, reduction="mean", name=None):

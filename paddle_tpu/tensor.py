@@ -308,6 +308,11 @@ def _maybe_check_nan_inf(fn, out):
     leaves = out if isinstance(out, (tuple, list)) else [out]
     for o in leaves:
         v = o._value if isinstance(o, Tensor) else o
+        if _is_tracer(v):
+            # inside an OUTER trace (e.g. make_jaxpr over functional_call)
+            # an op whose inputs are all closure constants still produces
+            # a tracer; the eager-only guard must not host-sync it
+            return
         if hasattr(v, "dtype") and jnp.issubdtype(v.dtype, jnp.floating):
             arr = _np.asarray(v)
             if not _np.isfinite(arr).all():

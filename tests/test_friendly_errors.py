@@ -84,6 +84,32 @@ def test_cross_entropy_float_hard_labels_named():
     assert np.isfinite(float(loss))
 
 
+def test_nan_check_flag_is_trace_safe():
+    """FLAGS_check_nan_inf is an eager-only guard: with it enabled, ops
+    whose inputs are all closure CONSTANTS inside an outer trace (e.g.
+    weight[0] during an export trace) still produce tracers — the guard
+    must skip them, not host-sync and crash (regression: leaked flag +
+    BERT token-type row made every ONNX bert export fail)."""
+    import jax
+
+    net = nn.Linear(4, 2)
+    paddle.set_flags({"FLAGS_check_nan_inf": True})
+    try:
+        def fwd(x):
+            # constant-only indexing inside the trace, like bert.py:64
+            row = net.weight[0]
+            return (net(paddle.to_tensor(x)) + row[0]).value
+
+        closed = jax.make_jaxpr(fwd)(np.zeros((2, 4), np.float32))
+        assert closed.jaxpr.outvars
+        # eager path still guards: a NaN input raises
+        with pytest.raises(FloatingPointError):
+            paddle.log(paddle.to_tensor(
+                np.array([-1.0], np.float32))) * 2.0
+    finally:
+        paddle.set_flags({"FLAGS_check_nan_inf": False})
+
+
 def test_checks_are_jit_safe():
     """Static-shape checks must not break tracing (to_static path)."""
     net = nn.Sequential(nn.Linear(8, 16), nn.LayerNorm(16))

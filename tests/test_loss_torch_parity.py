@@ -1,0 +1,122 @@
+"""Loss-family parity vs torch.nn.functional on identical inputs:
+weighted/ignore_index NLL, BCE (probs and logits, weighted), margin
+ranking, hinge embedding, cosine embedding, and weighted cross_entropy
+— the reduction and masking conventions where implementations drift."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as tF  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+import paddle_tpu.nn.functional as F  # noqa: E402
+
+rs = np.random.RandomState(41)
+
+
+def _cmp(pd_out, t_out, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.detach().numpy(), atol=atol,
+                               rtol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_nll_weighted_ignore_index(reduction):
+    logp = tF.log_softmax(torch.tensor(
+        rs.randn(8, 5).astype(np.float32)), dim=-1)
+    labels = rs.randint(0, 5, (8,)).astype(np.int64)
+    labels[2] = labels[6] = -100  # ignored rows
+    w = (rs.rand(5).astype(np.float32) + 0.5)
+    got = F.nll_loss(paddle.to_tensor(logp.numpy()),
+                     paddle.to_tensor(labels),
+                     weight=paddle.to_tensor(w), ignore_index=-100,
+                     reduction=reduction)
+    want = tF.nll_loss(logp, torch.tensor(labels), torch.tensor(w),
+                       ignore_index=-100, reduction=reduction)
+    _cmp(got, want)
+
+
+def test_nll_segmentation_shape_and_degenerates():
+    """[N, C, H, W] class-axis-1 form, an ignored row with -inf log-prob
+    (must not NaN), and the all-ignored batch (must NaN like torch)."""
+    logp4 = tF.log_softmax(torch.tensor(
+        rs.randn(2, 4, 3, 5).astype(np.float32)), dim=1)
+    lab4 = rs.randint(0, 4, (2, 3, 5)).astype(np.int64)
+    lab4[0, 0, 0] = -100
+    got = F.nll_loss(paddle.to_tensor(logp4.numpy()),
+                     paddle.to_tensor(lab4), ignore_index=-100)
+    want = tF.nll_loss(logp4, torch.tensor(lab4), ignore_index=-100)
+    _cmp(got, want)
+
+    # -inf log-prob on an IGNORED row stays masked, not NaN
+    logp = np.full((3, 2), -0.5, np.float32)
+    logp[1, 0] = -np.inf
+    lab = np.array([1, -100, 0], np.int64)
+    got = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(lab),
+                     ignore_index=-100)
+    assert np.isfinite(float(got))
+
+    # all-ignored batch: 0/0 == NaN, matching torch
+    lab_all = np.array([-100, -100, -100], np.int64)
+    got = F.nll_loss(paddle.to_tensor(logp), paddle.to_tensor(lab_all),
+                     ignore_index=-100)
+    want = tF.nll_loss(torch.tensor(logp), torch.tensor(lab_all),
+                       ignore_index=-100)
+    assert np.isnan(float(got)) and np.isnan(float(want))
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_bce_probs_and_logits_weighted(reduction):
+    p = rs.rand(6, 4).astype(np.float32) * 0.96 + 0.02
+    y = (rs.rand(6, 4) > 0.5).astype(np.float32)
+    w = rs.rand(6, 4).astype(np.float32) + 0.5
+    got = F.binary_cross_entropy(paddle.to_tensor(p), paddle.to_tensor(y),
+                                 weight=paddle.to_tensor(w),
+                                 reduction=reduction)
+    want = tF.binary_cross_entropy(torch.tensor(p), torch.tensor(y),
+                                   torch.tensor(w), reduction=reduction)
+    _cmp(got, want)
+    z = rs.randn(6, 4).astype(np.float32) * 3
+    got = F.binary_cross_entropy_with_logits(
+        paddle.to_tensor(z), paddle.to_tensor(y), reduction=reduction)
+    want = tF.binary_cross_entropy_with_logits(
+        torch.tensor(z), torch.tensor(y), reduction=reduction)
+    _cmp(got, want)
+
+
+def test_margin_and_embedding_losses():
+    a = rs.randn(7).astype(np.float32)
+    b = rs.randn(7).astype(np.float32)
+    y = np.sign(rs.randn(7)).astype(np.float32)
+    got = F.margin_ranking_loss(paddle.to_tensor(a), paddle.to_tensor(b),
+                                paddle.to_tensor(y), margin=0.3)
+    want = tF.margin_ranking_loss(torch.tensor(a), torch.tensor(b),
+                                  torch.tensor(y), margin=0.3)
+    _cmp(got, want)
+    x = rs.randn(7).astype(np.float32)
+    got = F.hinge_embedding_loss(paddle.to_tensor(x), paddle.to_tensor(y),
+                                 margin=1.0)
+    want = tF.hinge_embedding_loss(torch.tensor(x), torch.tensor(y),
+                                   margin=1.0)
+    _cmp(got, want)
+    u = rs.randn(5, 8).astype(np.float32)
+    v = rs.randn(5, 8).astype(np.float32)
+    yy = np.sign(rs.randn(5)).astype(np.float32)
+    got = F.cosine_embedding_loss(paddle.to_tensor(u), paddle.to_tensor(v),
+                                  paddle.to_tensor(yy), margin=0.2)
+    want = tF.cosine_embedding_loss(torch.tensor(u), torch.tensor(v),
+                                    torch.tensor(yy), margin=0.2)
+    _cmp(got, want)
+
+
+def test_cross_entropy_weighted_ignore():
+    logits = rs.randn(9, 6).astype(np.float32)
+    labels = rs.randint(0, 6, (9,)).astype(np.int64)
+    labels[4] = -100
+    w = rs.rand(6).astype(np.float32) + 0.5
+    got = F.cross_entropy(paddle.to_tensor(logits),
+                          paddle.to_tensor(labels),
+                          weight=paddle.to_tensor(w), ignore_index=-100)
+    want = tF.cross_entropy(torch.tensor(logits), torch.tensor(labels),
+                            torch.tensor(w), ignore_index=-100)
+    _cmp(got, want)

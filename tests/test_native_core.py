@@ -19,10 +19,13 @@ needs_native = pytest.mark.skipif(not core.available(),
 class TestFlagsMonitor:
     @needs_native
     def test_flag_roundtrip_and_mirror(self):
-        paddle.set_flags({"FLAGS_check_nan_inf": True})
-        assert paddle.get_flags("FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] \
-            is True
-        assert core.flag_get("FLAGS_check_nan_inf") == "True"
+        try:
+            paddle.set_flags({"FLAGS_check_nan_inf": True})
+            assert paddle.get_flags(
+                "FLAGS_check_nan_inf")["FLAGS_check_nan_inf"] is True
+            assert core.flag_get("FLAGS_check_nan_inf") == "True"
+        finally:  # leaked True slows every op and once crashed traces
+            paddle.set_flags({"FLAGS_check_nan_inf": False})
 
     def test_stats(self):
         core.stat_reset("t.x")
