@@ -7,4 +7,5 @@ the multi-chip dryrun drive.
 """
 from .gpt import GPTConfig, GPTModel, GPTForCausalLM  # noqa: F401
 from .bert import BertConfig, BertModel, BertForPretraining, ErnieModel  # noqa: F401
+from .interop import load_hf_bert, load_hf_gpt2  # noqa: F401
 from . import gpt_hybrid  # noqa: F401

@@ -1,0 +1,135 @@
+"""HuggingFace checkpoint interop for the model zoo.
+
+Load transformers BertModel / GPT2LMHeadModel weights (a live torch
+module or its state_dict) into the paddle_tpu models.  The mappings are
+the ones the parity suite verifies to ~1e-5 (tests/test_bert_hf_parity,
+test_gpt_hf_parity): paddle Linear stores [in, out] so HF's [out, in]
+Linear weights transpose on the way in, while GPT-2's Conv1D already
+matches; qkv unpack from in_proj/c_attn.
+
+Reference analog: the paddlenlp `from_pretrained` conversion tables —
+here a direct functional mapping, no hub access (zero-egress friendly:
+pass a locally loaded model/state_dict).
+"""
+from __future__ import annotations
+
+import numpy as np
+
+__all__ = ["load_hf_bert", "load_hf_gpt2"]
+
+
+def _np(t):
+    if hasattr(t, "detach"):
+        t = t.detach().cpu().numpy()
+    return np.asarray(t)
+
+
+def _state(src):
+    if hasattr(src, "state_dict"):
+        src = src.state_dict()
+    return {k: _np(v) for k, v in src.items()}
+
+
+def _set(param, value, transpose=False):
+    value = value.T if transpose else value
+    if tuple(param.shape) != tuple(value.shape):
+        raise ValueError(f"shape mismatch: model {tuple(param.shape)} vs "
+                         f"checkpoint {tuple(value.shape)}")
+    param.set_value(np.ascontiguousarray(value))
+
+
+def load_hf_bert(model, hf_source, strict=True):
+    """Load a transformers BertModel (or its state_dict) into a
+    paddle_tpu BertModel.  Returns the model."""
+    sd = _state(hf_source)
+    pre = "bert." if any(k.startswith("bert.") for k in sd) else ""
+
+    def g(name):
+        return sd[pre + name]
+
+    emb = model.embeddings
+    _set(emb.word.weight, g("embeddings.word_embeddings.weight"))
+    _set(emb.position.weight, g("embeddings.position_embeddings.weight"))
+    _set(emb.token_type.weight,
+         g("embeddings.token_type_embeddings.weight"))
+    _set(emb.layer_norm.weight, g("embeddings.LayerNorm.weight"))
+    _set(emb.layer_norm.bias, g("embeddings.LayerNorm.bias"))
+    for i, pl in enumerate(model.encoder.layers):
+        p = f"encoder.layer.{i}."
+        _set(pl.self_attn.q_proj.weight,
+             g(p + "attention.self.query.weight"), transpose=True)
+        _set(pl.self_attn.q_proj.bias, g(p + "attention.self.query.bias"))
+        _set(pl.self_attn.k_proj.weight,
+             g(p + "attention.self.key.weight"), transpose=True)
+        _set(pl.self_attn.k_proj.bias, g(p + "attention.self.key.bias"))
+        _set(pl.self_attn.v_proj.weight,
+             g(p + "attention.self.value.weight"), transpose=True)
+        _set(pl.self_attn.v_proj.bias, g(p + "attention.self.value.bias"))
+        _set(pl.self_attn.out_proj.weight,
+             g(p + "attention.output.dense.weight"), transpose=True)
+        _set(pl.self_attn.out_proj.bias,
+             g(p + "attention.output.dense.bias"))
+        _set(pl.norm1.weight, g(p + "attention.output.LayerNorm.weight"))
+        _set(pl.norm1.bias, g(p + "attention.output.LayerNorm.bias"))
+        _set(pl.linear1.weight, g(p + "intermediate.dense.weight"),
+             transpose=True)
+        _set(pl.linear1.bias, g(p + "intermediate.dense.bias"))
+        _set(pl.linear2.weight, g(p + "output.dense.weight"),
+             transpose=True)
+        _set(pl.linear2.bias, g(p + "output.dense.bias"))
+        _set(pl.norm2.weight, g(p + "output.LayerNorm.weight"))
+        _set(pl.norm2.bias, g(p + "output.LayerNorm.bias"))
+    if pre + "pooler.dense.weight" in sd:
+        _set(model.pooler.weight, g("pooler.dense.weight"), transpose=True)
+        _set(model.pooler.bias, g("pooler.dense.bias"))
+    elif strict:
+        raise KeyError("checkpoint has no pooler weights "
+                       "(pass strict=False to skip)")
+    return model
+
+
+def load_hf_gpt2(model, hf_source, strict=True):
+    """Load a transformers GPT2LMHeadModel / GPT2Model (or state_dict)
+    into a paddle_tpu GPTForCausalLM.  Returns the model.
+
+    HF GPT-2 always ties lm_head to wte, so the tied configuration is
+    exact; an untied paddle model needs a checkpoint carrying
+    lm_head.weight (raises under strict=True when absent — a silently
+    random LM head would generate garbage with no indication)."""
+    sd = _state(hf_source)
+    pre = "transformer." if any(k.startswith("transformer.")
+                                for k in sd) else ""
+
+    def g(name):
+        return sd[pre + name]
+
+    gpt = model.gpt
+    _set(gpt.wte.weight, g("wte.weight"))
+    _set(gpt.wpe.weight, g("wpe.weight"))
+    _set(gpt.ln_f.weight, g("ln_f.weight"))
+    _set(gpt.ln_f.bias, g("ln_f.bias"))
+    for i, pb in enumerate(gpt.h):
+        p = f"h.{i}."
+        _set(pb.ln_1.weight, g(p + "ln_1.weight"))
+        _set(pb.ln_1.bias, g(p + "ln_1.bias"))
+        _set(pb.ln_2.weight, g(p + "ln_2.weight"))
+        _set(pb.ln_2.bias, g(p + "ln_2.bias"))
+        # GPT-2 Conv1D stores [in, out] — the paddle convention already
+        _set(pb.attn.qkv.weight, g(p + "attn.c_attn.weight"))
+        _set(pb.attn.qkv.bias, g(p + "attn.c_attn.bias"))
+        _set(pb.attn.out.weight, g(p + "attn.c_proj.weight"))
+        _set(pb.attn.out.bias, g(p + "attn.c_proj.bias"))
+        _set(pb.mlp.fc1.weight, g(p + "mlp.c_fc.weight"))
+        _set(pb.mlp.fc1.bias, g(p + "mlp.c_fc.bias"))
+        _set(pb.mlp.fc2.weight, g(p + "mlp.c_proj.weight"))
+        _set(pb.mlp.fc2.bias, g(p + "mlp.c_proj.bias"))
+    if not model.cfg.tie_word_embeddings:
+        if "lm_head.weight" in sd:
+            _set(model.lm_head.weight, sd["lm_head.weight"],
+                 transpose=True)
+        elif strict:
+            raise KeyError(
+                "checkpoint has no lm_head.weight but the model is "
+                "untied (tie_word_embeddings=False) — the LM head would "
+                "stay randomly initialized; pass strict=False to accept")
+    return model

@@ -1,0 +1,86 @@
+"""models.interop: load HF checkpoints into the model zoo (the public
+inverse of the parity suites' copy helpers) — randomly initialized HF
+models imported through load_hf_bert / load_hf_gpt2 must reproduce the
+HF forward exactly."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import paddle_tpu as paddle  # noqa: E402
+from paddle_tpu.models import (BertConfig, BertModel, GPTConfig,
+                               GPTForCausalLM)  # noqa: E402
+from paddle_tpu.models.interop import load_hf_bert, load_hf_gpt2  # noqa: E402
+
+rs = np.random.RandomState(43)
+
+
+def test_load_hf_bert_reproduces_hf():
+    hf = transformers.BertModel(transformers.BertConfig(
+        vocab_size=90, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=20, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu"))
+    hf.eval()
+    pm = BertModel(BertConfig(
+        vocab_size=90, hidden_size=32, num_layers=2, num_heads=4,
+        intermediate_size=64, max_position_embeddings=20, dropout=0.0))
+    pm.eval()
+    load_hf_bert(pm, hf)  # live module form
+    ids = rs.randint(0, 90, (2, 12)).astype(np.int64)
+    seq, pooled = pm(paddle.to_tensor(ids))
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids))
+    np.testing.assert_allclose(np.asarray(seq.numpy()),
+                               out.last_hidden_state.numpy(),
+                               atol=2e-5, rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(pooled.numpy()),
+                               out.pooler_output.numpy(),
+                               atol=2e-5, rtol=1e-4)
+
+
+def test_load_hf_gpt2_state_dict_and_generate():
+    hf = transformers.GPT2LMHeadModel(transformers.GPT2Config(
+        vocab_size=80, n_embd=24, n_layer=2, n_head=4, n_positions=18,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        activation_function="gelu"))
+    hf.eval()
+    pm = GPTForCausalLM(GPTConfig(
+        vocab_size=80, hidden_size=24, num_layers=2, num_heads=4,
+        max_position_embeddings=18, dropout=0.0, attn_dropout=0.0,
+        tie_word_embeddings=True))
+    pm.eval()
+    load_hf_gpt2(pm, hf.state_dict())  # state_dict form
+    prompt = rs.randint(0, 80, (2, 5)).astype(np.int64)
+    got = np.asarray(pm.generate(
+        paddle.to_tensor(prompt.astype(np.int32)),
+        max_new_tokens=6).numpy())
+    with torch.no_grad():
+        want = hf.generate(torch.tensor(prompt), max_new_tokens=6,
+                           do_sample=False, pad_token_id=0).numpy()
+    np.testing.assert_array_equal(got, want)
+
+
+def test_untied_model_without_lm_head_raises():
+    hf = transformers.GPT2Model(transformers.GPT2Config(
+        vocab_size=80, n_embd=24, n_layer=1, n_head=4, n_positions=18))
+    pm = GPTForCausalLM(GPTConfig(
+        vocab_size=80, hidden_size=24, num_layers=1, num_heads=4,
+        max_position_embeddings=18, tie_word_embeddings=False))
+    with pytest.raises(KeyError, match="lm_head"):
+        load_hf_gpt2(pm, hf)
+    load_hf_gpt2(pm, hf, strict=False)  # explicit opt-in works
+
+
+def test_shape_mismatch_raises():
+    hf = transformers.BertModel(transformers.BertConfig(
+        vocab_size=90, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=20))
+    pm = BertModel(BertConfig(vocab_size=91, hidden_size=32, num_layers=2,
+                              num_heads=4, intermediate_size=64,
+                              max_position_embeddings=20))
+    with pytest.raises(ValueError, match="shape mismatch"):
+        load_hf_bert(pm, hf)
