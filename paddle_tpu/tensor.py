@@ -400,6 +400,8 @@ def _wrap_out(out, tensor_args, produced: bool, multi: bool, requires_grad: bool
         return t
 
     if isinstance(out, (tuple, list)):
-        wrapped = type(out)(mk(v) for v in out)
-        return wrapped
+        vals = [mk(v) for v in out]
+        if hasattr(out, "_fields"):  # NamedTuple (jax EighResult/QRResult
+            return type(out)(*vals)  # /SVDResult need positional args)
+        return type(out)(vals)
     return mk(out)

@@ -1,0 +1,99 @@
+"""paddle.linalg parity vs torch.linalg on identical matrices: norms
+(vector/fro/inf/axis forms), decompositions up to sign/phase
+conventions, solves, and einsum over a matrix of equations."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+
+rs = np.random.RandomState(47)
+
+
+def _cmp(pd_out, t_out, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.numpy(), atol=atol, rtol=1e-4)
+
+
+A = rs.randn(5, 5).astype(np.float32)
+SPD = (A @ A.T + 5 * np.eye(5)).astype(np.float32)
+B = rs.randn(5, 3).astype(np.float32)
+
+
+@pytest.mark.parametrize("p,axis", [
+    (2, None), ("fro", None), (1, 1), (np.inf, 1), (2, 0), (1, None),
+])
+def test_norm_forms(p, axis):
+    got = paddle.linalg.norm(paddle.to_tensor(A), p=p, axis=axis)
+    if axis is None and p in (1,):  # torch needs explicit dims for p=1
+        want = torch.linalg.vector_norm(torch.tensor(A), ord=1)
+    elif axis is None:
+        want = torch.linalg.norm(torch.tensor(A),
+                                 ord="fro" if p == "fro" else None)
+    else:
+        want = torch.linalg.vector_norm(torch.tensor(A), ord=p, dim=axis)
+    _cmp(got, want)
+
+
+def test_solve_inv_det_slogdet():
+    _cmp(paddle.linalg.solve(paddle.to_tensor(SPD), paddle.to_tensor(B)),
+         torch.linalg.solve(torch.tensor(SPD), torch.tensor(B)), atol=1e-4)
+    _cmp(paddle.linalg.inv(paddle.to_tensor(SPD)),
+         torch.linalg.inv(torch.tensor(SPD)), atol=1e-4)
+    _cmp(paddle.linalg.det(paddle.to_tensor(SPD)),
+         torch.linalg.det(torch.tensor(SPD)), atol=1e-2)
+    sign, logdet = paddle.linalg.slogdet(paddle.to_tensor(SPD))
+    tsign, tlog = torch.linalg.slogdet(torch.tensor(SPD))
+    assert float(sign) == pytest.approx(float(tsign))
+    assert float(logdet) == pytest.approx(float(tlog), abs=1e-4)
+
+
+def test_cholesky_and_reconstruction():
+    L = paddle.linalg.cholesky(paddle.to_tensor(SPD))
+    Ln = np.asarray(L.numpy())
+    np.testing.assert_allclose(Ln @ Ln.T, SPD, atol=1e-4)
+    _cmp(L, torch.linalg.cholesky(torch.tensor(SPD)), atol=1e-4)
+
+
+def test_qr_svd_up_to_convention():
+    """Decompositions compare by reconstruction + singular values (sign
+    conventions differ legitimately across backends)."""
+    q, r = paddle.linalg.qr(paddle.to_tensor(B))
+    qn, rn = np.asarray(q.numpy()), np.asarray(r.numpy())
+    np.testing.assert_allclose(qn @ rn, B, atol=1e-5)
+    np.testing.assert_allclose(qn.T @ qn, np.eye(3), atol=1e-5)
+
+    u, s, vh = paddle.linalg.svd(paddle.to_tensor(B), full_matrices=False)
+    np.testing.assert_allclose(
+        np.asarray(u.numpy()) @ np.diag(np.asarray(s.numpy()))
+        @ np.asarray(vh.numpy()), B, atol=1e-5)
+    _cmp(s, torch.linalg.svdvals(torch.tensor(B)), atol=1e-5)
+
+
+def test_eigh_matches():
+    wv, _ = np.linalg.eigh(SPD)
+    w, v = paddle.linalg.eigh(paddle.to_tensor(SPD))
+    np.testing.assert_allclose(np.asarray(w.numpy()), wv, atol=1e-4)
+    vn = np.asarray(v.numpy())
+    np.testing.assert_allclose(SPD @ vn, vn * np.asarray(w.numpy()),
+                               atol=1e-3)
+
+
+@pytest.mark.parametrize("eq,shapes", [
+    ("ij,jk->ik", [(3, 4), (4, 5)]),
+    ("bij,bjk->bik", [(2, 3, 4), (2, 4, 5)]),
+    ("ii->", [(5, 5)]),
+    ("ii->i", [(5, 5)]),
+    ("ij->ji", [(3, 4)]),
+    ("ij,ij->", [(3, 4), (3, 4)]),
+    ("bsh,hd->bsd", [(2, 3, 4), (4, 6)]),
+    ("...ij,...jk->...ik", [(2, 2, 3), (2, 3, 2)]),
+    ("ij,kj->ik", [(3, 4), (5, 4)]),
+])
+def test_einsum_matrix(eq, shapes):
+    xs = [rs.randn(*s).astype(np.float32) for s in shapes]
+    got = paddle.einsum(eq, *[paddle.to_tensor(x) for x in xs])
+    want = np.einsum(eq, *xs)
+    np.testing.assert_allclose(np.asarray(got.numpy()), want, atol=1e-5,
+                               rtol=1e-4)
