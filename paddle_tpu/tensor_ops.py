@@ -752,15 +752,30 @@ def kthvalue(x, k, axis=-1, keepdim=False, name=None):
 def mode(x, axis=-1, keepdim=False, name=None):
     def f(v):
         ax = axis % v.ndim
+        n = v.shape[ax]
         s = jnp.sort(v, axis=ax)
-        # mode = most frequent; approximate via run-length on sorted values
-        eq = jnp.concatenate([jnp.ones_like(jnp.take(s, jnp.array([0]), ax),
-                                            dtype=jnp.int32),
-                              (jnp.diff(s, axis=ax) == 0).astype(jnp.int32)], axis=ax)
-        run = jax.lax.associative_scan(lambda a, b: (a + b) * (b > 0).astype(a.dtype),
-                                       eq, axis=ax)
-        idx = jnp.argmax(run, axis=ax, keepdims=True)
-        val = jnp.take_along_axis(s, idx, axis=ax)
+        shape = [1] * v.ndim
+        shape[ax] = n
+        pos = jnp.arange(n).reshape(shape)
+        # run length ending at i == i - (start index of i's run) + 1.
+        # Run starts marked where the sorted value changes; a cumulative
+        # MAX over (start ? position : 0) is associative (the previous
+        # formulation fed a non-associative op to associative_scan and
+        # returned wrong modes — caught by the torch-oracle suite).
+        head = jnp.ones_like(jnp.take(s, jnp.array([0]), ax), bool)
+        starts = jnp.concatenate(
+            [head, jnp.diff(s, axis=ax) != 0], axis=ax)
+        start_idx = jax.lax.associative_scan(
+            jnp.maximum, jnp.where(starts, pos, 0), axis=ax)
+        run = pos - start_idx + 1
+        # argmax takes the FIRST maximal run end; sorted ascending, that
+        # is the smallest most-frequent value (torch's tie convention)
+        k = jnp.argmax(run, axis=ax, keepdims=True)
+        val = jnp.take_along_axis(s, k, axis=ax)
+        # index into the ORIGINAL input: last occurrence (torch returns
+        # the last index of the modal value)
+        idx = jnp.argmax(jnp.where(v == val, pos, -1), axis=ax,
+                         keepdims=True)
         if not keepdim:
             val, idx = jnp.squeeze(val, ax), jnp.squeeze(idx, ax)
         return val, idx.astype(jnp.int64)

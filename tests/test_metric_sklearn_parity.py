@@ -1,0 +1,65 @@
+"""Metric parity vs sklearn (reference metric op analogs: accuracy_op,
+auc_op, precision_recall): streamed updates across batches must agree
+with sklearn computed on the concatenated stream."""
+import numpy as np
+import pytest
+
+sklearn = pytest.importorskip("sklearn")
+from sklearn import metrics as sk  # noqa: E402
+
+import paddle_tpu as paddle  # noqa: E402
+
+rs = np.random.RandomState(53)
+
+
+def test_auc_streamed_matches_sklearn():
+    m = paddle.metric.Auc(num_thresholds=4095)
+    all_p, all_y = [], []
+    for _ in range(5):  # stream batches like a fluid eval loop
+        y = (rs.rand(200) > 0.6).astype(np.int64)
+        logits = rs.randn(200) * 1.2 + y * 1.5
+        p = 1 / (1 + np.exp(-logits))
+        preds = np.stack([1 - p, p], axis=1).astype(np.float32)
+        m.update(preds, y.reshape(-1, 1))
+        all_p.append(p)
+        all_y.append(y)
+    got = m.accumulate()
+    want = sk.roc_auc_score(np.concatenate(all_y), np.concatenate(all_p))
+    assert got == pytest.approx(want, abs=2e-3)  # binned AUC tolerance
+
+
+def test_accuracy_matches_sklearn():
+    m = paddle.metric.Accuracy()
+    all_pred, all_y = [], []
+    for _ in range(3):
+        y = rs.randint(0, 4, (64,)).astype(np.int64)
+        logits = rs.randn(64, 4).astype(np.float32)
+        logits[np.arange(64), y] += rs.rand(64) * 2  # some correct
+        corr = m.compute(paddle.to_tensor(logits),
+                         paddle.to_tensor(y.reshape(-1, 1)))
+        m.update(corr)
+        all_pred.append(logits.argmax(-1))
+        all_y.append(y)
+    got = float(np.asarray(m.accumulate()))
+    want = sk.accuracy_score(np.concatenate(all_y),
+                             np.concatenate(all_pred))
+    assert got == pytest.approx(want, abs=1e-6)
+
+
+def test_precision_recall_match_sklearn():
+    p_m = paddle.metric.Precision()
+    r_m = paddle.metric.Recall()
+    all_s, all_y = [], []
+    for _ in range(4):
+        y = (rs.rand(100) > 0.5).astype(np.int64)
+        s = np.clip(rs.rand(100) * 0.6 + y * 0.3, 0, 1).astype(np.float32)
+        p_m.update(s, y)
+        r_m.update(s, y)
+        all_s.append(s)
+        all_y.append(y)
+    ys = np.concatenate(all_y)
+    preds = (np.concatenate(all_s) > 0.5).astype(np.int64)
+    assert float(p_m.accumulate()) == pytest.approx(
+        sk.precision_score(ys, preds), abs=1e-6)
+    assert float(r_m.accumulate()) == pytest.approx(
+        sk.recall_score(ys, preds), abs=1e-6)

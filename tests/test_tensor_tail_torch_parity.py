@@ -1,0 +1,72 @@
+"""Order-statistic / scan tensor-op tail vs torch: median, quantile,
+kthvalue, mode, cumprod, logcumsumexp — interpolation and tie
+conventions where implementations drift."""
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import paddle_tpu as paddle  # noqa: E402
+
+rs = np.random.RandomState(59)
+X = rs.randn(4, 7).astype(np.float32)
+
+
+def _cmp(pd_out, t_out, atol=1e-5):
+    np.testing.assert_allclose(np.asarray(pd_out.numpy()),
+                               t_out.numpy(), atol=atol, rtol=1e-5)
+
+
+def test_median_axis_and_global():
+    # paddle.median averages the two middle values on even counts
+    # (reference median semantics == numpy), unlike torch's lower-median
+    got = float(paddle.median(paddle.to_tensor(X)))
+    assert got == pytest.approx(float(np.median(X)), abs=1e-6)
+    got = paddle.median(paddle.to_tensor(X), axis=1)
+    np.testing.assert_allclose(np.asarray(got.numpy()),
+                               np.median(X, axis=1), atol=1e-6)
+
+
+@pytest.mark.parametrize("q", [0.25, 0.5, [0.1, 0.9]])
+def test_quantile_matches_torch_linear(q):
+    got = paddle.quantile(paddle.to_tensor(X), q, axis=1)
+    want = torch.quantile(torch.tensor(X),
+                          torch.tensor(q, dtype=torch.float32), dim=1)
+    if isinstance(q, list):  # torch puts q first; paddle too — compare
+        assert np.asarray(got.numpy()).shape == tuple(want.shape)
+    _cmp(got, want)
+
+
+def test_kthvalue_and_mode():
+    vals, idx = paddle.kthvalue(paddle.to_tensor(X), k=3, axis=1)
+    tv, ti = torch.kthvalue(torch.tensor(X), k=3, dim=1)
+    _cmp(vals, tv)
+    np.testing.assert_array_equal(np.asarray(idx.numpy()), ti.numpy())
+    # tie-free rows: one value strictly dominates, so mode conventions
+    # (torch picks smallest on ties) cannot differ; indices too (torch
+    # returns the LAST occurrence of the modal value)
+    ints = np.stack([np.array([k] * 5 + [0, 1, 2, (k + 1) % 3])
+                     for k in range(5)]) % 3
+    mv, mi = paddle.mode(paddle.to_tensor(ints.astype(np.int64)), axis=1)
+    tmv, tmi = torch.mode(torch.tensor(ints.astype(np.int64)), dim=1)
+    np.testing.assert_array_equal(np.asarray(mv.numpy()), tmv.numpy())
+    np.testing.assert_array_equal(np.asarray(mi.numpy()), tmi.numpy())
+    # tied row: smallest most-frequent value wins, like torch
+    tie = np.array([[2, 2, 0, 0, 1]], np.int64)
+    mv, _ = paddle.mode(paddle.to_tensor(tie), axis=1)
+    tmv, _ = torch.mode(torch.tensor(tie), dim=1)
+    np.testing.assert_array_equal(np.asarray(mv.numpy()), tmv.numpy())
+
+
+def test_cumprod_logcumsumexp():
+    got = paddle.cumprod(paddle.to_tensor(X), dim=1)
+    _cmp(got, torch.cumprod(torch.tensor(X), dim=1))
+    got = paddle.logcumsumexp(paddle.to_tensor(X), axis=1)
+    _cmp(got, torch.logcumsumexp(torch.tensor(X), dim=1))
+
+
+def test_topk_sorted_matches():
+    v, i = paddle.topk(paddle.to_tensor(X), k=3, axis=1)
+    tv, ti = torch.topk(torch.tensor(X), k=3, dim=1)
+    _cmp(v, tv)
+    np.testing.assert_array_equal(np.asarray(i.numpy()), ti.numpy())
