@@ -15,7 +15,8 @@ from __future__ import annotations
 
 import numpy as np
 
-__all__ = ["load_hf_bert", "load_hf_gpt2"]
+__all__ = ["load_hf_bert", "load_hf_gpt2", "to_hf_bert_state",
+           "to_hf_gpt2_state"]
 
 
 def _np(t):
@@ -133,3 +134,72 @@ def load_hf_gpt2(model, hf_source, strict=True):
                 "untied (tie_word_embeddings=False) — the LM head would "
                 "stay randomly initialized; pass strict=False to accept")
     return model
+
+
+# --- export direction: paddle_tpu -> HF state_dict -------------------------
+
+
+def _arr(p, transpose=False):
+    a = np.asarray(p.numpy())
+    return np.ascontiguousarray(a.T) if transpose else a
+
+
+def to_hf_bert_state(model):
+    """numpy state_dict in transformers BertModel naming — load with
+    ``hf.load_state_dict({k: torch.tensor(v) for k, v in out.items()})``.
+    Round-trip verified by the interop tests."""
+    sd = {}
+    emb = model.embeddings
+    sd["embeddings.word_embeddings.weight"] = _arr(emb.word.weight)
+    sd["embeddings.position_embeddings.weight"] = _arr(
+        emb.position.weight)
+    sd["embeddings.token_type_embeddings.weight"] = _arr(
+        emb.token_type.weight)
+    sd["embeddings.LayerNorm.weight"] = _arr(emb.layer_norm.weight)
+    sd["embeddings.LayerNorm.bias"] = _arr(emb.layer_norm.bias)
+    for i, pl in enumerate(model.encoder.layers):
+        p = f"encoder.layer.{i}."
+        for hf_name, lin in [("attention.self.query", pl.self_attn.q_proj),
+                             ("attention.self.key", pl.self_attn.k_proj),
+                             ("attention.self.value", pl.self_attn.v_proj),
+                             ("attention.output.dense",
+                              pl.self_attn.out_proj),
+                             ("intermediate.dense", pl.linear1),
+                             ("output.dense", pl.linear2)]:
+            sd[p + hf_name + ".weight"] = _arr(lin.weight, transpose=True)
+            sd[p + hf_name + ".bias"] = _arr(lin.bias)
+        sd[p + "attention.output.LayerNorm.weight"] = _arr(pl.norm1.weight)
+        sd[p + "attention.output.LayerNorm.bias"] = _arr(pl.norm1.bias)
+        sd[p + "output.LayerNorm.weight"] = _arr(pl.norm2.weight)
+        sd[p + "output.LayerNorm.bias"] = _arr(pl.norm2.bias)
+    sd["pooler.dense.weight"] = _arr(model.pooler.weight, transpose=True)
+    sd["pooler.dense.bias"] = _arr(model.pooler.bias)
+    return sd
+
+
+def to_hf_gpt2_state(model):
+    """numpy state_dict in transformers GPT2Model naming (add the
+    ``transformer.`` prefix + tied ``lm_head.weight`` yourself for
+    GPT2LMHeadModel)."""
+    gpt = model.gpt
+    sd = {"wte.weight": _arr(gpt.wte.weight),
+          "wpe.weight": _arr(gpt.wpe.weight),
+          "ln_f.weight": _arr(gpt.ln_f.weight),
+          "ln_f.bias": _arr(gpt.ln_f.bias)}
+    for i, pb in enumerate(gpt.h):
+        p = f"h.{i}."
+        sd[p + "ln_1.weight"] = _arr(pb.ln_1.weight)
+        sd[p + "ln_1.bias"] = _arr(pb.ln_1.bias)
+        sd[p + "ln_2.weight"] = _arr(pb.ln_2.weight)
+        sd[p + "ln_2.bias"] = _arr(pb.ln_2.bias)
+        sd[p + "attn.c_attn.weight"] = _arr(pb.attn.qkv.weight)
+        sd[p + "attn.c_attn.bias"] = _arr(pb.attn.qkv.bias)
+        sd[p + "attn.c_proj.weight"] = _arr(pb.attn.out.weight)
+        sd[p + "attn.c_proj.bias"] = _arr(pb.attn.out.bias)
+        sd[p + "mlp.c_fc.weight"] = _arr(pb.mlp.fc1.weight)
+        sd[p + "mlp.c_fc.bias"] = _arr(pb.mlp.fc1.bias)
+        sd[p + "mlp.c_proj.weight"] = _arr(pb.mlp.fc2.weight)
+        sd[p + "mlp.c_proj.bias"] = _arr(pb.mlp.fc2.bias)
+    if not model.cfg.tie_word_embeddings:
+        sd["lm_head.weight"] = _arr(model.lm_head.weight, transpose=True)
+    return sd

@@ -74,6 +74,36 @@ def test_untied_model_without_lm_head_raises():
     load_hf_gpt2(pm, hf, strict=False)  # explicit opt-in works
 
 
+def test_export_roundtrip_to_hf():
+    """Our trained weights exported with to_hf_bert_state load into a
+    fresh HF model and reproduce OUR forward — the export direction of
+    the interop contract."""
+    from paddle_tpu.models.interop import to_hf_bert_state
+
+    paddle.seed(51)
+    pm = BertModel(BertConfig(
+        vocab_size=70, hidden_size=16, num_layers=1, num_heads=2,
+        intermediate_size=32, max_position_embeddings=12, dropout=0.0))
+    pm.eval()
+    hf = transformers.BertModel(transformers.BertConfig(
+        vocab_size=70, hidden_size=16, num_hidden_layers=1,
+        num_attention_heads=2, intermediate_size=32,
+        max_position_embeddings=12, type_vocab_size=2,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        hidden_act="gelu"))
+    hf.eval()
+    sd = {k: torch.tensor(v) for k, v in to_hf_bert_state(pm).items()}
+    missing, unexpected = hf.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    ids = rs.randint(0, 70, (2, 8)).astype(np.int64)
+    seq, _ = pm(paddle.to_tensor(ids))
+    with torch.no_grad():
+        out = hf(input_ids=torch.tensor(ids))
+    np.testing.assert_allclose(np.asarray(seq.numpy()),
+                               out.last_hidden_state.numpy(),
+                               atol=2e-5, rtol=1e-4)
+
+
 def test_shape_mismatch_raises():
     hf = transformers.BertModel(transformers.BertConfig(
         vocab_size=90, hidden_size=32, num_hidden_layers=2,
