@@ -12,6 +12,24 @@ Architecture (see SURVEY.md §7):
 """
 from __future__ import annotations
 
+# jax version compat: `shard_map` was promoted from jax.experimental to the
+# jax root; re-export it there on older installs so `from jax import
+# shard_map` (collective.py, pipeline.py, ring_attention.py, tests) works
+# against either generation.
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def _compat_shard_map(f, *args, **kwargs):
+        # newer jax renamed check_rep -> check_vma
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _shard_map(f, *args, **kwargs)
+
+    _jax.shard_map = _compat_shard_map
+del _jax
+
 from . import framework
 from .framework import (
     CPUPlace,

@@ -34,6 +34,13 @@ from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F
 from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from . import resilience  # noqa: F401
+from .resilience import (  # noqa: F401
+    PREEMPTED_EXIT_CODE,
+    ResilientRunner,
+    retry_with_backoff,
+    run_resilient,
+)
 from .pipeline import (  # noqa: F401
     pipeline_step_fn,
     spmd_pipeline,

@@ -15,11 +15,15 @@ auto-resume (`latest_step`/`restore_latest`).
 """
 from __future__ import annotations
 
+import logging
 import os
+import time
 from typing import Any
 
 import jax
 import numpy as np
+
+logger = logging.getLogger("paddle_tpu.checkpoint")
 
 __all__ = ["save_sharded", "restore_sharded", "CheckpointManager"]
 
@@ -92,8 +96,23 @@ class CheckpointManager:
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
         ocp = _ocp()
-        saved = self._mgr.save(step, args=ocp.args.StandardSave(state),
-                               force=force)
+        from ..utils import chaos
+
+        def _do():
+            chaos.on_io("checkpoint.save")
+            return self._mgr.save(step, args=ocp.args.StandardSave(state),
+                                  force=force)
+
+        try:
+            saved = _do()
+        except OSError as e:
+            # one in-place retry on transient IO error (GCS blips, fuse
+            # hiccups); persistent failures escalate to the caller's
+            # retry_with_backoff / abort
+            logger.warning("checkpoint save step=%s hit %s: %s — "
+                           "retrying once", step, type(e).__name__, e)
+            time.sleep(0.05)
+            saved = _do()
         return bool(saved)
 
     def wait(self):
@@ -115,6 +134,8 @@ class CheckpointManager:
                                  args=ocp.args.StandardRestore(target))
 
     def restore_latest(self, template: Any = None, shardings: Any = None):
+        from ..utils import chaos
+        chaos.on_io("checkpoint.restore_latest")
         step = self.latest_step()
         if step is None:
             return None, None
@@ -122,3 +143,12 @@ class CheckpointManager:
 
     def close(self):
         self._mgr.close()
+
+    # context-manager support so tests/training scripts can't leak the
+    # underlying orbax manager on an assertion failure mid-block
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False

@@ -16,14 +16,19 @@ from __future__ import annotations
 import argparse
 import logging
 import os
+import signal
 import sys
+import time
 
 from .launch_utils import (
+    TrainerFailure,
     find_free_ports,
     get_cluster,
     start_local_trainers,
+    terminate_local_procs,
     watch_local_trainers,
 )
+from .resilience import PREEMPTED_EXIT_CODE, backoff_delay
 
 logger = logging.getLogger("paddle_tpu.launch")
 
@@ -49,6 +54,18 @@ def _parse_args(argv=None):
                         help="restart the pod up to N times on trainer "
                              "failure (pairs with checkpoint auto-resume; "
                              "the reference launcher has no restart)")
+    parser.add_argument("--restart_on", choices=("any", "preempted"),
+                        default="any",
+                        help="restart policy: 'any' nonzero trainer exit, "
+                             "or only 'preempted' trainers (exit %d or "
+                             "killed by SIGTERM)" % PREEMPTED_EXIT_CODE)
+    parser.add_argument("--restart_backoff", type=float, default=1.0,
+                        help="base seconds for exponential backoff (with "
+                             "jitter) between pod restarts")
+    parser.add_argument("--grace_period", type=float, default=10.0,
+                        help="seconds between SIGTERM and SIGKILL when "
+                             "tearing trainers down (lets them write an "
+                             "emergency checkpoint)")
     parser.add_argument("training_script",
                         help="the training script to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -72,25 +89,78 @@ def get_cluster_from_args(args):
     return get_cluster(node_ips, node_ip, endpoints, n)
 
 
+def _restart_delay(attempt, base=1.0, max_delay=60.0, jitter=0.5, rng=None):
+    """Backoff before restart `attempt` (1-based) — the shared
+    resilience backoff formula, so a whole pod of launchers does not
+    stampede storage/coordination on recovery."""
+    return backoff_delay(attempt - 1, base, max_delay, jitter, rng)
+
+
+def _is_preemption(exit_code):
+    """A trainer that followed the resilience contract exits
+    PREEMPTED_EXIT_CODE; one killed directly by SIGTERM (scheduler
+    without grace plumbing) shows the negative signal number."""
+    return exit_code in (PREEMPTED_EXIT_CODE, -signal.SIGTERM)
+
+
 def launch_collective(args):
     cluster, pod = get_cluster_from_args(args)
     logger.info("launching %s", cluster.trainers_endpoints())
     attempt = 0
-    while True:
-        procs = start_local_trainers(
-            cluster, pod, args.training_script, args.training_script_args,
-            log_dir=args.log_dir, backend=args.backend,
-            envs={"PADDLE_RESTART_COUNT": str(attempt)})
+    procs = []
+
+    # Orphan fix: a SIGTERM to the launcher must tear the trainer
+    # subprocesses down (with the grace window) instead of leaving them
+    # running; watch_local_trainers only handled KeyboardInterrupt.
+    def _on_signal(signum, frame):
+        logger.warning("launcher got signal %s — terminating trainers "
+                       "(grace %.1fs)", signum, args.grace_period)
+        terminate_local_procs(procs, grace=args.grace_period)
+        sys.exit(128 + signum)
+
+    prev_handlers = {}
+    for s in (signal.SIGTERM, signal.SIGINT):
         try:
-            watch_local_trainers(procs, cluster.trainers_nranks())
-            return 0
-        except RuntimeError:
-            if attempt >= args.max_restarts:
-                raise
-            attempt += 1
-            logger.warning("pod failed — restart %s/%s (trainers should "
-                           "auto-resume from their latest checkpoint)",
-                           attempt, args.max_restarts)
+            prev_handlers[s] = signal.signal(s, _on_signal)
+        except ValueError:
+            pass  # not the main thread (embedded use) — skip
+    try:
+        while True:
+            procs[:] = start_local_trainers(
+                cluster, pod, args.training_script,
+                args.training_script_args, log_dir=args.log_dir,
+                backend=args.backend,
+                envs={"PADDLE_RESTART_COUNT": str(attempt)})
+            try:
+                watch_local_trainers(procs, cluster.trainers_nranks(),
+                                     grace=args.grace_period)
+                return 0
+            except TrainerFailure as e:
+                preempted = _is_preemption(e.exit_code)
+                reason = ("preempted" if preempted
+                          else f"crashed (exit {e.exit_code})")
+                if attempt >= args.max_restarts:
+                    logger.error("trainer rank=%s %s — restarts exhausted "
+                                 "(%d/%d)", e.rank, reason, attempt,
+                                 args.max_restarts)
+                    raise
+                if args.restart_on == "preempted" and not preempted:
+                    logger.error("trainer rank=%s %s — not restarting "
+                                 "(--restart_on=preempted)", e.rank, reason)
+                    raise
+                attempt += 1
+                delay = _restart_delay(attempt, base=args.restart_backoff)
+                logger.warning(
+                    "trainer rank=%s %s — restart %s/%s in %.2fs "
+                    "(trainers auto-resume from their latest checkpoint)",
+                    e.rank, reason, attempt, args.max_restarts, delay)
+                time.sleep(delay)
+    finally:
+        for s, prev in prev_handlers.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
 
 
 def launch(argv=None):

@@ -24,7 +24,18 @@ logger = logging.getLogger("paddle_tpu.launch")
 
 __all__ = ["Trainer", "Pod", "Cluster", "get_cluster",
            "start_local_trainers", "watch_local_trainers",
-           "terminate_local_procs", "TrainerProc", "find_free_ports"]
+           "terminate_local_procs", "TrainerProc", "TrainerFailure",
+           "find_free_ports"]
+
+
+class TrainerFailure(RuntimeError):
+    """A trainer exited nonzero; carries enough context for the launcher
+    to pick a restart policy (crash vs preemption) and log the reason."""
+
+    def __init__(self, msg, rank=None, exit_code=None):
+        super().__init__(msg)
+        self.rank = rank
+        self.exit_code = exit_code
 
 
 class Trainer:
@@ -155,14 +166,17 @@ def start_local_trainers(cluster, pod, training_script,
     return procs
 
 
-def terminate_local_procs(procs):
+def terminate_local_procs(procs, grace=10.0):
+    """SIGTERM every live trainer, give it `grace` seconds to checkpoint
+    and exit (the preemption contract — resilience.py latches the signal
+    and writes an emergency checkpoint), then SIGKILL stragglers."""
     for tp in procs:
         if tp.proc.poll() is None:
             try:
                 tp.proc.terminate()
             except OSError:
                 pass
-    deadline = time.time() + 10
+    deadline = time.time() + grace
     for tp in procs:
         try:
             tp.proc.wait(timeout=max(0.1, deadline - time.time()))
@@ -175,10 +189,12 @@ def terminate_local_procs(procs):
             tp.log_fn.close()
 
 
-def watch_local_trainers(procs, nranks=None, poll_interval=1.0):
-    """Poll until all trainers exit; on ANY failure kill the pod and raise
-    (the reference's non-elastic policy, launch_utils.py:517).
-    Returns the list of exit codes on clean completion."""
+def watch_local_trainers(procs, nranks=None, poll_interval=1.0,
+                         grace=10.0):
+    """Poll until all trainers exit; on ANY failure kill the pod (with
+    the same SIGTERM→`grace`→SIGKILL window, so surviving ranks can
+    flush an emergency checkpoint) and raise.  Returns the list of exit
+    codes on clean completion."""
     try:
         while True:
             alive = False
@@ -189,15 +205,16 @@ def watch_local_trainers(procs, nranks=None, poll_interval=1.0):
                 elif ret != 0:
                     logger.error("trainer rank=%s exited with code %s — "
                                  "terminating pod", tp.rank, ret)
-                    terminate_local_procs(procs)
-                    raise RuntimeError(
+                    terminate_local_procs(procs, grace=grace)
+                    raise TrainerFailure(
                         f"trainer {tp.rank} failed (exit {ret}); pod "
-                        f"terminated (cmd: {' '.join(tp.cmd or [])})")
+                        f"terminated (cmd: {' '.join(tp.cmd or [])})",
+                        rank=tp.rank, exit_code=ret)
             if not alive:
                 break
             time.sleep(poll_interval)
     except KeyboardInterrupt:
-        terminate_local_procs(procs)
+        terminate_local_procs(procs, grace=grace)
         raise
     codes = [tp.proc.returncode for tp in procs]
     for tp in procs:

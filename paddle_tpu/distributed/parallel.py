@@ -38,13 +38,29 @@ def init_parallel_env(mesh_shape=None):
     # initializes the XLA backend, after which initialize() is illegal
     already = jax.distributed.is_initialized()
     if env.world_size > 1 and not already:
-        # PADDLE_TRAINER_* style launch: initialize jax.distributed from env
+        # PADDLE_TRAINER_* style launch: initialize jax.distributed from env.
+        # After a pod restart the coordination service may come up a beat
+        # later than we do — retry the dial with backoff instead of dying
+        # (which would burn one of the launcher's --max_restarts).
+        from .resilience import retry_with_backoff
         coord = os.environ.get("PADDLE_MASTER",
                                (env.trainer_endpoints or [""])[0])
-        jax.distributed.initialize(
-            coordinator_address=coord or None,
-            num_processes=env.world_size,
-            process_id=env.rank)
+        def _dial():
+            # idempotent: a retry after a half-successful attempt must
+            # not mask the first failure with "already initialized"
+            if jax.distributed.is_initialized():
+                return
+            jax.distributed.initialize(
+                coordinator_address=coord or None,
+                num_processes=env.world_size,
+                process_id=env.rank)
+
+        retry_with_backoff(
+            _dial,
+            retries=int(os.environ.get("PADDLE_INIT_RETRIES", "3")),
+            base_delay=float(os.environ.get("PADDLE_INIT_RETRY_DELAY", "1")),
+            retry_on=(RuntimeError, OSError, ConnectionError),
+            label="jax.distributed.initialize")
     ensure_mesh(mesh_shape)
     _initialized = True
     return env

@@ -1,0 +1,406 @@
+"""Fault-tolerant training runtime (preemption-safe resume).
+
+The reference's recovery story is "checkpoint + relaunch" with no
+elasticity (fluid launch_utils.py:517 kills the pod on any failure), but
+a TPU-native framework lives on preemptible pods where SIGTERM with a
+grace period is the NORMAL failure mode.  This module wires the existing
+pieces — orbax `CheckpointManager` (checkpoint.py), the launcher's
+`--max_restarts` + `PADDLE_RESTART_COUNT` contract (launch.py), and the
+`FLAGS_check_nan_inf` guard — into one runtime:
+
+  * `run_resilient(step_fn, state, mgr, ...)` / `ResilientRunner` wrap a
+    training loop with: SIGTERM/SIGINT preemption handling (finish the
+    in-flight step → emergency atomic checkpoint → exit with the
+    distinct `PREEMPTED_EXIT_CODE` so the launcher restarts us),
+    auto-resume from `restore_latest` on startup, a hung-step watchdog,
+    and a NaN/Inf anomaly policy (`skip` / `halt` / `rollback`).
+  * `retry_with_backoff` — generic exponential backoff with jitter,
+    used for checkpoint IO here and for distributed-init bootstrap
+    (parallel.py).
+  * `PreemptionGuard` / `Watchdog` — the composable pieces, reused by
+    `hapi.Model.fit(fault_tolerant=True)`.
+
+Every path is exercised by deterministic fault injection
+(`paddle_tpu.utils.chaos`), not mocks — see tests/test_resilience.py.
+"""
+from __future__ import annotations
+
+import faulthandler
+import logging
+import os
+import random
+import signal
+import sys
+import threading
+import time
+from typing import Any, Callable
+
+import numpy as np
+
+from ..utils import chaos
+
+logger = logging.getLogger("paddle_tpu.resilience")
+
+__all__ = [
+    "PREEMPTED_EXIT_CODE", "WATCHDOG_EXIT_CODE", "backoff_delay",
+    "retry_with_backoff", "PreemptionGuard", "Watchdog", "ResilientRunner",
+    "run_resilient",
+]
+
+# Distinct exit codes so the launcher can tell "preempted mid-training,
+# checkpoint written, please restart me" (75 = EX_TEMPFAIL) from a real
+# crash, and a hung step (killed by its own watchdog) from either.
+PREEMPTED_EXIT_CODE = 75
+WATCHDOG_EXIT_CODE = 86
+
+
+def backoff_delay(attempt: int, base_delay: float, max_delay: float = 30.0,
+                  jitter: float = 0.5, rng=None) -> float:
+    """Delay before retry `attempt` (0-based):
+    `min(max_delay, base_delay * 2**attempt) * (1 + jitter * U[0,1))`.
+    The single backoff formula — checkpoint-IO retries and launcher pod
+    restarts both use it, so cap/jitter semantics cannot diverge."""
+    rng = rng if rng is not None else random.Random()
+    delay = min(max_delay, base_delay * (2 ** attempt))
+    return delay * (1.0 + jitter * rng.random())
+
+
+def retry_with_backoff(fn: Callable[[], Any], retries: int = 3,
+                       base_delay: float = 0.1, max_delay: float = 30.0,
+                       jitter: float = 0.5, retry_on=(OSError,),
+                       sleep=time.sleep, rng=None, label: str = None):
+    """Call `fn`; on a `retry_on` exception retry up to `retries` more
+    times, sleeping `backoff_delay(i, ...)` before retry i.
+
+    `sleep` and `rng` are injectable so tests can assert the exact delay
+    sequence.  Raises the last exception once retries are exhausted.
+    """
+    rng = rng if rng is not None else random.Random()
+    for attempt in range(retries + 1):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt >= retries:
+                raise
+            delay = backoff_delay(attempt, base_delay, max_delay, jitter,
+                                  rng)
+            logger.warning("%s failed (%s: %s) — retry %d/%d in %.2fs",
+                           label or getattr(fn, "__name__", "call"),
+                           type(e).__name__, e, attempt + 1, retries, delay)
+            sleep(delay)
+
+
+class PreemptionGuard:
+    """Latches SIGTERM/SIGINT instead of dying mid-step.
+
+    Inside the `with` block the signals set `.preempted` (the training
+    loop finishes its in-flight step, checkpoints, then exits cleanly);
+    previous handlers are restored on exit.  A second signal while one
+    is already latched falls through to the previous handler — a
+    double-SIGTERM still kills a stuck process.
+    """
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self.signals = tuple(signals)
+        self.preempted = False
+        self.signum = None
+        self._prev = {}
+
+    def _handler(self, signum, frame):
+        if self.preempted:  # second signal: escalate to the old handler
+            prev = self._prev.get(signum)
+            if callable(prev):
+                prev(signum, frame)
+            else:
+                raise KeyboardInterrupt
+            return
+        self.preempted = True
+        self.signum = signum
+        logger.warning("preemption signal %s latched — will checkpoint "
+                       "after the in-flight step", signum)
+
+    def __enter__(self):
+        for s in self.signals:
+            try:
+                self._prev[s] = signal.signal(s, self._handler)
+            except ValueError:
+                # not the main thread — latching is unavailable, but the
+                # rest of the runtime still works
+                logger.debug("cannot install handler for %s off the main "
+                             "thread", s)
+        return self
+
+    def __exit__(self, *exc):
+        for s, prev in self._prev.items():
+            try:
+                signal.signal(s, prev)
+            except ValueError:
+                pass
+        self._prev.clear()
+        return False
+
+
+class Watchdog:
+    """Hung-step watchdog: a monitor thread that aborts the process when
+    no `beat()` arrives within `timeout` seconds.
+
+    On expiry it dumps every thread's stack to stderr (so the hang is
+    attributable) then calls `on_timeout(elapsed)` — default behavior is
+    `os._exit(WATCHDOG_EXIT_CODE)`, because a stuck XLA collective can
+    not be unwound with an exception.
+    """
+
+    def __init__(self, timeout: float, on_timeout=None, poll_interval=None):
+        if timeout <= 0:
+            raise ValueError("watchdog timeout must be > 0")
+        self.timeout = float(timeout)
+        self.on_timeout = on_timeout
+        self.poll_interval = poll_interval or min(1.0, self.timeout / 4.0)
+        self._last = None
+        self._stop = threading.Event()
+        self._thread = None
+        self.fired = False
+
+    def start(self):
+        self._last = time.monotonic()
+        self._stop.clear()
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name="paddle-step-watchdog")
+        self._thread.start()
+        return self
+
+    def beat(self):
+        self._last = time.monotonic()
+
+    def stop(self):
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()
+        return False
+
+    def _run(self):
+        while not self._stop.wait(self.poll_interval):
+            elapsed = time.monotonic() - self._last
+            if elapsed > self.timeout:
+                self.fired = True
+                logger.error("watchdog: no step progress for %.1fs "
+                             "(timeout %.1fs) — dumping stacks", elapsed,
+                             self.timeout)
+                try:
+                    faulthandler.dump_traceback(file=sys.stderr,
+                                                all_threads=True)
+                except Exception:
+                    pass
+                if self.on_timeout is not None:
+                    self.on_timeout(elapsed)
+                    return
+                os._exit(WATCHDOG_EXIT_CODE)
+
+
+class ResilientRunner:
+    """Drives `step_fn(step, state) -> (new_state, loss)` from step 1 (or
+    the resumed step) through `num_steps` with crash recovery.
+
+    Config:
+      save_interval      checkpoint every N completed steps (0 = only the
+                         emergency/preemption checkpoint)
+      watchdog_timeout   per-step hang limit in seconds (None = off)
+      anomaly_policy     'halt' | 'skip' | 'rollback' — what to do when a
+                         step's loss is NaN/Inf (backed by the same
+                         contract as FLAGS_check_nan_inf)
+      max_bad_steps      consecutive bad steps tolerated before `skip`
+                         escalates to halt / `rollback` restores the last
+                         checkpoint
+      exit_on_preempt    raise SystemExit(PREEMPTED_EXIT_CODE) after the
+                         emergency checkpoint (True, the launcher
+                         contract) or return with info['preempted']=True
+      retries/base_delay backoff config for checkpoint IO
+    """
+
+    def __init__(self, save_interval: int = 0, watchdog_timeout=None,
+                 anomaly_policy: str = "halt", max_bad_steps: int = 3,
+                 exit_on_preempt: bool = True, retries: int = 3,
+                 base_delay: float = 0.1, on_watchdog_timeout=None):
+        if anomaly_policy not in ("halt", "skip", "rollback"):
+            raise ValueError(f"unknown anomaly_policy {anomaly_policy!r}")
+        self.save_interval = int(save_interval)
+        self.watchdog_timeout = watchdog_timeout
+        self.anomaly_policy = anomaly_policy
+        self.max_bad_steps = int(max_bad_steps)
+        self.exit_on_preempt = exit_on_preempt
+        self.retries = retries
+        self.base_delay = base_delay
+        self.on_watchdog_timeout = on_watchdog_timeout
+
+    # -- checkpoint IO (all through retry_with_backoff) --------------------
+    @staticmethod
+    def _io_sleep(wd):
+        """Checkpoint-IO backoff sleeps count as progress, not a hang:
+        beat the watchdog through them so a retried save is not killed
+        as a hung step (a SINGLE save attempt longer than the watchdog
+        timeout still trips it — size watchdog_timeout accordingly)."""
+        if wd is None:
+            return time.sleep
+
+        def sleep(d):
+            wd.beat()
+            time.sleep(d)
+            wd.beat()
+        return sleep
+
+    def _save(self, mgr, step, state, force=False, wd=None):
+        def _do():
+            if wd is not None:
+                wd.beat()
+            mgr.save(step, state, force=force)
+            mgr.wait()
+        retry_with_backoff(_do, retries=self.retries,
+                           base_delay=self.base_delay,
+                           sleep=self._io_sleep(wd),
+                           label=f"checkpoint save@{step}")
+
+    def _restore_latest(self, mgr, template, wd=None):
+        def _do():
+            if wd is not None:
+                wd.beat()
+            return mgr.restore_latest(template=template)
+        return retry_with_backoff(
+            _do, retries=self.retries, base_delay=self.base_delay,
+            sleep=self._io_sleep(wd), label="checkpoint restore")
+
+    # -- the loop ----------------------------------------------------------
+    def run(self, step_fn, state, mgr=None, *, num_steps: int,
+            template=None):
+        if self.anomaly_policy == "rollback" and mgr is None:
+            raise ValueError("anomaly_policy='rollback' needs a "
+                             "CheckpointManager to roll back to")
+        restart_count = int(os.environ.get("PADDLE_RESTART_COUNT", "0"))
+        info = {"resumed_step": None, "restart_count": restart_count,
+                "bad_steps": 0, "skipped_steps": 0, "rollbacks": 0,
+                "preempted": False, "last_step": 0}
+
+        start = 1
+        if mgr is not None:
+            step0, restored = self._restore_latest(mgr,
+                                                   template or state)
+            if step0 is not None:
+                state = restored
+                start = step0 + 1
+                info["resumed_step"] = step0
+                logger.warning("auto-resume (restart #%d): restored step "
+                               "%d, continuing from %d", restart_count,
+                               step0, start)
+            elif restart_count > 0:
+                logger.warning("restart #%d but no checkpoint found — "
+                               "starting from scratch", restart_count)
+
+        guard = PreemptionGuard()
+        wd = (Watchdog(self.watchdog_timeout,
+                       on_timeout=self.on_watchdog_timeout)
+              if self.watchdog_timeout else None)
+        bad_streak = 0
+        with guard:
+            if wd:
+                wd.start()
+            try:
+                step = start
+                while step <= num_steps:
+                    if wd:
+                        wd.beat()
+                    poison = chaos.on_step(step)
+                    new_state, loss = step_fn(step, state)
+                    if poison and loss is not None:
+                        loss = float("nan")
+                    bad = (loss is not None
+                           and not np.all(np.isfinite(
+                               np.asarray(loss, dtype=np.float64))))
+                    if bad:
+                        info["bad_steps"] += 1
+                        bad_streak += 1
+                        logger.warning(
+                            "non-finite loss at step %d (streak %d, "
+                            "policy=%s)", step, bad_streak,
+                            self.anomaly_policy)
+                        if self.anomaly_policy == "halt":
+                            raise FloatingPointError(
+                                f"non-finite loss at step {step} "
+                                f"(anomaly_policy='halt')")
+                        if bad_streak >= self.max_bad_steps:
+                            if self.anomaly_policy == "skip":
+                                raise FloatingPointError(
+                                    f"{bad_streak} consecutive non-finite "
+                                    f"steps (anomaly_policy='skip', "
+                                    f"max_bad_steps={self.max_bad_steps})")
+                            # rollback: restore last checkpoint, rewind
+                            step0, restored = self._restore_latest(
+                                mgr, template or state, wd=wd)
+                            if step0 is None:
+                                raise FloatingPointError(
+                                    f"rollback requested at step {step} "
+                                    f"but no checkpoint exists")
+                            info["rollbacks"] += 1
+                            logger.warning("rolling back to checkpoint "
+                                           "step %d", step0)
+                            state = restored
+                            step = step0 + 1
+                            # last_step must track the state we now hold:
+                            # a preemption right after rollback would
+                            # otherwise label the restored state with the
+                            # newer (pre-rollback) step and silently skip
+                            # the rolled-back steps on resume
+                            info["last_step"] = step0
+                            bad_streak = 0
+                            continue
+                        # tolerated: drop this update, advance
+                        info["skipped_steps"] += 1
+                        step += 1
+                    else:
+                        bad_streak = 0
+                        state = new_state
+                        info["last_step"] = step
+                        if (mgr is not None and self.save_interval
+                                and step % self.save_interval == 0):
+                            self._save(mgr, step, state, wd=wd)
+                        step += 1
+                    # a SIGTERM during the FINAL step doesn't preempt a
+                    # run that just completed — don't burn a restart
+                    if guard.preempted and step <= num_steps:
+                        self._preempt_exit(mgr, info, state, wd=wd)
+                        return state, info  # exit_on_preempt=False
+            finally:
+                if wd:
+                    wd.stop()
+        return state, info
+
+    def _preempt_exit(self, mgr, info, state, wd=None):
+        info["preempted"] = True
+        last = info["last_step"]
+        if mgr is not None and last > 0:
+            logger.warning("preempted — emergency checkpoint at step %d",
+                           last)
+            self._save(mgr, last, state, force=True, wd=wd)
+        if self.exit_on_preempt:
+            logger.warning("exiting with PREEMPTED_EXIT_CODE=%d (launcher "
+                           "will restart and auto-resume)",
+                           PREEMPTED_EXIT_CODE)
+            raise SystemExit(PREEMPTED_EXIT_CODE)
+
+
+def run_resilient(step_fn, state, mgr=None, *, num_steps: int,
+                  template=None, **config):
+    """Functional façade over ResilientRunner — see its docstring.
+
+    Returns `(final_state, info)`; exits the process with
+    `PREEMPTED_EXIT_CODE` after an emergency checkpoint if a preemption
+    signal arrives (unless `exit_on_preempt=False`).
+    """
+    runner = ResilientRunner(**config)
+    return runner.run(step_fn, state, mgr, num_steps=num_steps,
+                      template=template)

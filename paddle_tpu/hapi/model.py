@@ -174,11 +174,50 @@ class Model:
         outs, lv = self._eval_fn(params, buffers, rng, inputs, [])
         return outs, lv
 
+    # -- fault tolerance ---------------------------------------------------
+    def _ft_state(self, it_count):
+        """Checkpointable training state: trainable params + buffers +
+        optimizer slots + loop counters, as one pytree of arrays."""
+        trainable, _frozen, buffers = self._split_params()
+        opt_state = getattr(self, "_opt_state", None)
+        if opt_state is None:
+            opt_state = self._optimizer.init_pytree(trainable)
+        return {"params": trainable, "buffers": buffers, "opt": opt_state,
+                "meta": {"it": jnp.int32(it_count),
+                         "opt_steps": jnp.int32(
+                             self._optimizer._step_count)}}
+
+    def _ft_restore(self, mgr):
+        """Auto-resume: load the latest checkpoint (if any) back into the
+        live network/optimizer; returns the iteration to fast-forward to."""
+        step0, back = mgr.restore_latest(template=self._ft_state(0))
+        if step0 is None:
+            return 0
+        self._write_back(back["params"], back["buffers"])
+        self._opt_state = back["opt"]
+        self._optimizer._step_count = int(back["meta"]["opt_steps"])
+        restart = os.environ.get("PADDLE_RESTART_COUNT", "0")
+        print(f"fit: resumed from checkpoint at iteration {step0} "
+              f"(restart #{restart})", flush=True)
+        return int(back["meta"]["it"])
+
     # -- loop-level API ----------------------------------------------------
     def fit(self, train_data=None, eval_data=None, batch_size=1, epochs=1,
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
-            accumulate_grad_batches=1, num_iters=None):
+            accumulate_grad_batches=1, num_iters=None, fault_tolerant=False,
+            resume=None, checkpoint_interval=None):
+        """[fault tolerance — opt-in] `resume=<dir>` (or `resume=True`
+        with `save_dir`) auto-resumes from the newest checkpoint in that
+        directory and checkpoints every `checkpoint_interval` iterations
+        (default: each epoch end).  `fault_tolerant=True` additionally
+        latches SIGTERM/SIGINT, finishes the in-flight batch, writes an
+        emergency checkpoint, and exits with
+        `distributed.PREEMPTED_EXIT_CODE` so a launcher started with
+        `--max_restarts` relaunches and resumes — see
+        distributed/resilience.py.  Resume is bitwise-exact when data
+        order and seeding are deterministic (`shuffle=False` +
+        `paddle.seed`)."""
         from .callbacks import config_callbacks
 
         loader = train_data if isinstance(train_data, DataLoader) else \
@@ -203,10 +242,33 @@ class Model:
         # when a log step fires or a user callback might consume it
         user_cbs = any(not isinstance(c, (_PBCb, _LRCb, _CkptCb))
                        for c in cbks)
+        ft_mgr = None
+        start_it = 0
+        guard = None
+        if fault_tolerant or resume:  # resume=False/None/"" ⇒ off
+            from ..distributed import resilience as _res
+            from ..distributed.checkpoint import CheckpointManager
+            from ..utils import chaos as _chaos
+
+            ckpt_dir = resume if isinstance(resume, str) else save_dir
+            if not ckpt_dir:
+                raise ValueError("fault_tolerant/resume needs a checkpoint "
+                                 "directory: pass resume=<dir> or save_dir=")
+            ckpt_dir = os.path.join(ckpt_dir, "resilient")
+            ft_mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+            try:
+                start_it = self._ft_restore(ft_mgr)
+                if fault_tolerant:
+                    guard = _res.PreemptionGuard()
+                    guard.__enter__()
+            except BaseException:
+                ft_mgr.close()
+                raise
+
         history = {"loss": []}
         it_count = 0
-        cbks.on_train_begin({})
         try:
+            cbks.on_train_begin({})
             for epoch in range(epochs):
                 self.network.train()
                 for m in self._metrics:
@@ -214,7 +276,22 @@ class Model:
                 cbks.on_epoch_begin(epoch, {})
                 losses = []
                 for step_i, batch in enumerate(loader):
+                    if it_count < start_it:
+                        # fast-forward over already-trained batches,
+                        # consuming one rng key each to keep the stream
+                        # aligned with the uninterrupted run.  A SIGTERM
+                        # here exits immediately — nothing new to save,
+                        # the restored checkpoint is still the newest
+                        if guard is not None and guard.preempted:
+                            raise SystemExit(_res.PREEMPTED_EXIT_CODE)
+                        _random.split_key()
+                        it_count += 1
+                        continue
                     cbks.on_train_batch_begin(step_i, {})
+                    if ft_mgr is not None:
+                        # fault-injection hook (crash/preempt/slow) so the
+                        # fit() recovery paths are chaos-testable too
+                        _chaos.on_step(it_count + 1)
                     batch = _to_list(batch)
                     inputs, labels = self._split_batch(batch)
                     loss = self.train_batch(inputs, labels)
@@ -226,9 +303,27 @@ class Model:
                             logs[m._name] = np.mean(
                                 _to_list(m.accumulate()))
                     cbks.on_train_batch_end(step_i, logs)
+                    if ft_mgr is not None:
+                        if (checkpoint_interval
+                                and it_count % checkpoint_interval == 0):
+                            ft_mgr.save(it_count, self._ft_state(it_count))
+                        if guard is not None and guard.preempted:
+                            # in-flight batch done: emergency checkpoint,
+                            # then the distinct "preempted" exit so the
+                            # launcher restarts us
+                            ft_mgr.save(it_count, self._ft_state(it_count),
+                                        force=True)
+                            ft_mgr.wait()
+                            raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                     if num_iters is not None and it_count >= num_iters:
                         break
-                history["loss"].append(float(np.mean(losses)))
+                if ft_mgr is not None and not checkpoint_interval \
+                        and it_count > start_it:
+                    ft_mgr.save(it_count, self._ft_state(it_count),
+                                force=True)
+                # losses can be empty when resume fast-forwarded the epoch
+                history["loss"].append(
+                    float(np.mean(losses)) if losses else float("nan"))
                 epoch_logs = {"loss": history["loss"][-1]}
                 for m in self._metrics:
                     epoch_logs[m._name] = np.mean(_to_list(m.accumulate()))
@@ -243,6 +338,16 @@ class Model:
                                        for k, v in eval_res.items()})
                     cbks.on_eval_end(eval_res)
                 cbks.on_epoch_end(epoch, epoch_logs)
+                # SIGTERM during epoch-end eval/callbacks must still turn
+                # into a clean preempted exit (not a SIGKILL after the
+                # grace window); a final-epoch latch just finishes the run
+                if guard is not None and guard.preempted \
+                        and epoch + 1 < epochs:
+                    if it_count > start_it:
+                        ft_mgr.save(it_count, self._ft_state(it_count),
+                                    force=True)
+                        ft_mgr.wait()
+                    raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                 if self.stop_training:
                     break
                 if num_iters is not None and it_count >= num_iters:
@@ -250,6 +355,11 @@ class Model:
         finally:
             # a crash mid-fit must still flush/close callback resources
             cbks.on_train_end({})
+            if guard is not None:
+                guard.__exit__(None, None, None)
+            if ft_mgr is not None:
+                ft_mgr.wait()
+                ft_mgr.close()
         return history
 
     def _split_batch(self, batch):

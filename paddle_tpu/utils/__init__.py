@@ -1,3 +1,4 @@
+from . import chaos  # noqa: F401
 from . import download  # noqa: F401
 from . import image_util  # noqa: F401
 from . import install_check  # noqa: F401

@@ -1,0 +1,184 @@
+"""Deterministic fault injection for the fault-tolerant runtime.
+
+Every recovery path in `paddle_tpu.distributed.resilience` (preemption,
+NaN anomaly policies, hung-step watchdog, checkpoint-IO retry) is
+exercised by REAL tests through this layer rather than mocks: the
+injectors fire at exact step numbers / call counts, so a chaos test is
+bit-for-bit reproducible.
+
+Two drive modes, composable:
+
+  * env flags — set before the trainer process starts (the launcher /
+    subprocess tests use these):
+        PADDLE_CHAOS_CRASH_STEP=N     raise ChaosCrash at step N
+        PADDLE_CHAOS_NAN_STEP=N[,M..] inject a NaN loss at steps N,M,…
+        PADDLE_CHAOS_SLOW_STEP=N      stall step N
+        PADDLE_CHAOS_SLOW_SECONDS=S   …for S seconds (default 30)
+        PADDLE_CHAOS_PREEMPT_STEP=N   SIGTERM ourselves at step N
+        PADDLE_CHAOS_FAIL_IO=K        next K chaos-guarded IO calls
+                                      raise OSError
+  * `inject(...)` context manager — in-process unit tests push a chaos
+    config for the duration of a `with` block.
+
+NaN/slow/crash/preempt step injections are ONE-SHOT: once fired at step
+N they are consumed, so a `rollback` recovery that replays step N does
+not re-trip the same fault (transient-corruption semantics — exactly
+what the rollback policy exists to survive).
+
+Runtime hook points (called by resilience.py / checkpoint.py):
+    on_step(step)  -> bool   may raise/sleep/self-signal; True = poison
+                             this step's loss with NaN
+    on_io(label)             may raise OSError (decrements the budget)
+"""
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+import signal
+import threading
+import time
+
+logger = logging.getLogger("paddle_tpu.chaos")
+
+__all__ = ["ChaosCrash", "ChaosConfig", "inject", "on_step", "on_io",
+           "active_config", "reset"]
+
+
+class ChaosCrash(RuntimeError):
+    """Raised by on_step() for crash-at-step-N injection.  Deliberately
+    NOT caught by the resilient runner — it propagates and kills the
+    trainer like any unhandled exception would."""
+
+
+class ChaosConfig:
+    """Mutable fault plan.  `fail_io` counts DOWN as faults fire."""
+
+    def __init__(self, crash_at_step=None, nan_at_step=None, slow_step=None,
+                 slow_seconds=30.0, preempt_at_step=None, fail_io=0,
+                 io_error=None):
+        self.crash_at_step = crash_at_step
+        # accept a single step or an iterable of steps
+        if nan_at_step is None:
+            nan_at_step = ()
+        elif isinstance(nan_at_step, int):
+            nan_at_step = (nan_at_step,)
+        self.nan_at_steps = set(nan_at_step)
+        self.slow_step = slow_step
+        self.slow_seconds = float(slow_seconds)
+        self.preempt_at_step = preempt_at_step
+        self.fail_io = int(fail_io)
+        self.io_error = io_error or OSError(
+            "chaos: injected transient IO failure")
+        self.fired: list[str] = []  # audit trail for tests
+
+    def is_noop(self):
+        return (self.crash_at_step is None and not self.nan_at_steps
+                and self.slow_step is None and self.preempt_at_step is None
+                and self.fail_io <= 0)
+
+    @classmethod
+    def from_env(cls, environ=None):
+        env = os.environ if environ is None else environ
+
+        def _int(key):
+            v = env.get(key)
+            return int(v) if v not in (None, "") else None
+
+        nan = env.get("PADDLE_CHAOS_NAN_STEP", "")
+        nan_steps = tuple(int(s) for s in nan.split(",") if s.strip())
+        return cls(
+            crash_at_step=_int("PADDLE_CHAOS_CRASH_STEP"),
+            nan_at_step=nan_steps,
+            slow_step=_int("PADDLE_CHAOS_SLOW_STEP"),
+            slow_seconds=float(env.get("PADDLE_CHAOS_SLOW_SECONDS", "30")),
+            preempt_at_step=_int("PADDLE_CHAOS_PREEMPT_STEP"),
+            fail_io=_int("PADDLE_CHAOS_FAIL_IO") or 0,
+        )
+
+
+# stack of active configs; index 0 is the env-derived base (parsed lazily
+# so tests can mutate os.environ before first use)
+_lock = threading.Lock()
+_stack: list[ChaosConfig] = []
+
+
+def _base() -> ChaosConfig:
+    if not _stack:
+        _stack.append(ChaosConfig.from_env())
+    return _stack[0]
+
+
+def active_config() -> ChaosConfig:
+    """The innermost chaos config (env base if no inject() is active)."""
+    with _lock:
+        _base()
+        return _stack[-1]
+
+
+def reset():
+    """Drop all state; the env base is re-parsed on next use."""
+    with _lock:
+        _stack.clear()
+
+
+@contextlib.contextmanager
+def inject(**kwargs):
+    """Push a ChaosConfig for the dynamic extent of the block:
+
+        with chaos.inject(nan_at_step=(3, 4), fail_io=1):
+            run_resilient(...)
+    """
+    cfg = ChaosConfig(**kwargs)
+    with _lock:
+        _base()
+        _stack.append(cfg)
+    try:
+        yield cfg
+    finally:
+        with _lock:
+            if cfg in _stack:
+                _stack.remove(cfg)
+
+
+def on_step(step: int) -> bool:
+    """Step-boundary hook.  May raise ChaosCrash, sleep, or SIGTERM the
+    process; returns True when this step's loss should be poisoned with
+    NaN.  All step triggers are one-shot (consumed on fire)."""
+    cfg = active_config()
+    if cfg.is_noop():
+        return False
+    if cfg.crash_at_step is not None and step == cfg.crash_at_step:
+        cfg.crash_at_step = None
+        cfg.fired.append(f"crash@{step}")
+        logger.warning("chaos: crashing at step %d", step)
+        raise ChaosCrash(f"chaos: injected crash at step {step}")
+    if cfg.preempt_at_step is not None and step == cfg.preempt_at_step:
+        cfg.preempt_at_step = None
+        cfg.fired.append(f"preempt@{step}")
+        logger.warning("chaos: SIGTERM self at step %d", step)
+        os.kill(os.getpid(), signal.SIGTERM)
+    if cfg.slow_step is not None and step == cfg.slow_step:
+        cfg.slow_step = None
+        cfg.fired.append(f"slow@{step}")
+        logger.warning("chaos: stalling step %d for %.1fs", step,
+                       cfg.slow_seconds)
+        time.sleep(cfg.slow_seconds)
+    if step in cfg.nan_at_steps:
+        cfg.nan_at_steps.discard(step)
+        cfg.fired.append(f"nan@{step}")
+        logger.warning("chaos: poisoning step %d loss with NaN", step)
+        return True
+    return False
+
+
+def on_io(label: str = "io"):
+    """IO-call hook (checkpoint save/restore etc).  While the fail-IO
+    budget is positive, each call decrements it and raises OSError."""
+    cfg = active_config()
+    if cfg.fail_io > 0:
+        cfg.fail_io -= 1
+        cfg.fired.append(f"io@{label}")
+        logger.warning("chaos: failing IO call %r (%d more to fail)",
+                       label, cfg.fail_io)
+        raise type(cfg.io_error)(*cfg.io_error.args)

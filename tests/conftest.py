@@ -18,6 +18,26 @@ jax.config.update("jax_platforms", "cpu")
 import pytest  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "slow: multi-process / long-running test, excluded from "
+        "tier-1 (`-m 'not slow'`)")
+    config.addinivalue_line(
+        "markers", "chaos: deterministic fault-injection test of the "
+        "resilience runtime (run via tools/chaos.sh)")
+
+
+@pytest.fixture(autouse=True)
+def _chaos_reset():
+    """Chaos state is process-global; never let one test's fault plan
+    leak into the next."""
+    from paddle_tpu.utils import chaos
+
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
 def cpu_subprocess_env(repo_on_path=True):
     """Env for spawning a python subprocess that must NEVER dial the TPU
     tunnel: strips the axon pool IP (the sitecustomize register() dials

@@ -1,0 +1,128 @@
+"""Chaos injector unit tests (paddle_tpu/utils/chaos.py).
+
+The injectors themselves must be deterministic and one-shot — they are
+the instrument every resilience test relies on, so they get their own
+direct coverage here.
+"""
+import os
+import signal
+import time
+
+import pytest
+
+from paddle_tpu.distributed.resilience import PreemptionGuard
+from paddle_tpu.utils import chaos
+
+
+pytestmark = pytest.mark.chaos
+
+
+class TestCrashInjector:
+    def test_crashes_exactly_at_step(self):
+        with chaos.inject(crash_at_step=3) as cfg:
+            assert chaos.on_step(1) is False
+            assert chaos.on_step(2) is False
+            with pytest.raises(chaos.ChaosCrash, match="step 3"):
+                chaos.on_step(3)
+            # one-shot: consumed after firing (rollback replay survives)
+            assert chaos.on_step(3) is False
+            assert cfg.fired == ["crash@3"]
+
+    def test_other_steps_unaffected(self):
+        with chaos.inject(crash_at_step=100):
+            for s in range(1, 10):
+                assert chaos.on_step(s) is False
+
+
+class TestNanInjector:
+    def test_poisons_listed_steps_once(self):
+        with chaos.inject(nan_at_step=(2, 4)) as cfg:
+            assert chaos.on_step(1) is False
+            assert chaos.on_step(2) is True
+            assert chaos.on_step(2) is False  # consumed
+            assert chaos.on_step(3) is False
+            assert chaos.on_step(4) is True
+            assert cfg.fired == ["nan@2", "nan@4"]
+
+    def test_single_int_accepted(self):
+        with chaos.inject(nan_at_step=5):
+            assert chaos.on_step(5) is True
+
+
+class TestSlowInjector:
+    def test_stalls_only_the_target_step(self):
+        with chaos.inject(slow_step=2, slow_seconds=0.3):
+            t0 = time.monotonic()
+            chaos.on_step(1)
+            assert time.monotonic() - t0 < 0.2
+            t0 = time.monotonic()
+            chaos.on_step(2)
+            assert time.monotonic() - t0 >= 0.3
+            t0 = time.monotonic()
+            chaos.on_step(2)  # one-shot
+            assert time.monotonic() - t0 < 0.2
+
+
+class TestPreemptInjector:
+    def test_self_sigterm_latched_by_guard(self):
+        with PreemptionGuard() as g:
+            with chaos.inject(preempt_at_step=2):
+                chaos.on_step(1)
+                assert not g.preempted
+                chaos.on_step(2)
+                assert g.preempted and g.signum == signal.SIGTERM
+
+
+class TestFailIOInjector:
+    def test_budget_counts_down(self):
+        with chaos.inject(fail_io=2) as cfg:
+            with pytest.raises(OSError, match="chaos"):
+                chaos.on_io("save")
+            with pytest.raises(OSError, match="chaos"):
+                chaos.on_io("save")
+            chaos.on_io("save")  # budget exhausted — passes
+            assert cfg.fired == ["io@save", "io@save"]
+
+    def test_custom_error_type(self):
+        with chaos.inject(fail_io=1, io_error=TimeoutError("slow disk")):
+            with pytest.raises(TimeoutError, match="slow disk"):
+                chaos.on_io("x")
+
+
+class TestConfigPlumbing:
+    def test_env_parsing(self):
+        env = {
+            "PADDLE_CHAOS_CRASH_STEP": "7",
+            "PADDLE_CHAOS_NAN_STEP": "3,5",
+            "PADDLE_CHAOS_SLOW_STEP": "4",
+            "PADDLE_CHAOS_SLOW_SECONDS": "1.5",
+            "PADDLE_CHAOS_PREEMPT_STEP": "9",
+            "PADDLE_CHAOS_FAIL_IO": "2",
+        }
+        cfg = chaos.ChaosConfig.from_env(env)
+        assert cfg.crash_at_step == 7
+        assert cfg.nan_at_steps == {3, 5}
+        assert cfg.slow_step == 4 and cfg.slow_seconds == 1.5
+        assert cfg.preempt_at_step == 9
+        assert cfg.fail_io == 2
+
+    def test_empty_env_is_noop(self):
+        cfg = chaos.ChaosConfig.from_env({})
+        assert cfg.is_noop()
+
+    def test_env_base_is_lazy(self, monkeypatch):
+        chaos.reset()
+        monkeypatch.setenv("PADDLE_CHAOS_NAN_STEP", "11")
+        try:
+            assert chaos.on_step(11) is True
+        finally:
+            chaos.reset()
+
+    def test_inject_nests_and_restores(self):
+        base = chaos.active_config()
+        with chaos.inject(fail_io=1) as outer:
+            assert chaos.active_config() is outer
+            with chaos.inject(nan_at_step=1) as inner:
+                assert chaos.active_config() is inner
+            assert chaos.active_config() is outer
+        assert chaos.active_config() is base

@@ -1,10 +1,15 @@
 """Sharded checkpointing + restart policy + nan/inf guard tests
 (SURVEY.md §5: checkpoint/resume replaces the reference's nonexistent
-elasticity; FLAGS_check_nan_inf is the runtime correctness guard)."""
+elasticity; FLAGS_check_nan_inf is the runtime correctness guard) — plus
+the end-to-end preemption contract: SIGTERM mid-training → emergency
+checkpoint → relaunch → bitwise-identical final parameters."""
 import os
+import re
+import signal
 import subprocess
 import sys
 import textwrap
+import time
 
 import jax
 import jax.numpy as jnp
@@ -19,6 +24,8 @@ from paddle_tpu.distributed.checkpoint import (
     save_sharded,
 )
 from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.resilience import PREEMPTED_EXIT_CODE
+from paddle_tpu.utils import chaos
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
@@ -54,19 +61,21 @@ class TestShardedCheckpoint:
         np.testing.assert_array_equal(np.asarray(back["w"]), np.asarray(w))
 
     def test_manager_rolls_and_resumes(self, tmp_path):
-        mgr = CheckpointManager(str(tmp_path / "run"), max_to_keep=2)
-        assert mgr.restore_latest()[0] is None
-        for step in (1, 2, 3):
-            state = {"w": jnp.full((4,), float(step)),
-                     "step": jnp.int32(step)}
-            assert mgr.save(step, state, force=True)
-        mgr.wait()
-        assert mgr.latest_step() == 3
-        assert mgr.all_steps() == [2, 3]  # rolled: keeps newest 2
-        step, back = mgr.restore_latest(template=state)
-        assert step == 3
-        np.testing.assert_array_equal(np.asarray(back["w"]), np.full(4, 3.0))
-        mgr.close()
+        # context-manager form: an assertion failure mid-block no longer
+        # leaks the underlying orbax manager
+        with CheckpointManager(str(tmp_path / "run"), max_to_keep=2) as mgr:
+            assert mgr.restore_latest()[0] is None
+            for step in (1, 2, 3):
+                state = {"w": jnp.full((4,), float(step)),
+                         "step": jnp.int32(step)}
+                assert mgr.save(step, state, force=True)
+            mgr.wait()
+            assert mgr.latest_step() == 3
+            assert mgr.all_steps() == [2, 3]  # rolled: keeps newest 2
+            step, back = mgr.restore_latest(template=state)
+            assert step == 3
+            np.testing.assert_array_equal(np.asarray(back["w"]),
+                                          np.full(4, 3.0))
 
     def test_train_resume_equivalence(self, tmp_path):
         """Train 4 steps, checkpoint the full functional training state
@@ -95,17 +104,30 @@ class TestShardedCheckpoint:
         p, s = w0, opt.init_pytree(w0)
         for t, x in enumerate(data[:2], 1):
             p, s = step(p, s, t, x)
-        mgr = CheckpointManager(str(tmp_path / "resume"))
-        mgr.save(2, {"params": p, "opt": s}, force=True)
-        mgr.wait()
+        with CheckpointManager(str(tmp_path / "resume")) as mgr:
+            mgr.save(2, {"params": p, "opt": s}, force=True)
+            mgr.wait()
 
-        t0, back = mgr.restore_latest(
-            template={"params": p, "opt": s})
-        p2, s2 = back["params"], back["opt"]
-        for t, x in enumerate(data[2:], t0 + 1):
-            p2, s2 = step(p2, s2, t, x)
-        np.testing.assert_array_equal(np.asarray(p2["w"]), ref)
-        mgr.close()
+            t0, back = mgr.restore_latest(
+                template={"params": p, "opt": s})
+            p2, s2 = back["params"], back["opt"]
+            for t, x in enumerate(data[2:], t0 + 1):
+                p2, s2 = step(p2, s2, t, x)
+            np.testing.assert_array_equal(np.asarray(p2["w"]), ref)
+
+    @pytest.mark.chaos
+    def test_save_retries_once_on_transient_io_error(self, tmp_path):
+        """A single injected IO fault is absorbed by save()'s built-in
+        retry; two consecutive faults escalate to the caller."""
+        state = {"w": jnp.ones((3,))}
+        with CheckpointManager(str(tmp_path / "retry")) as mgr:
+            with chaos.inject(fail_io=1):
+                assert mgr.save(1, state, force=True)
+            mgr.wait()
+            assert mgr.latest_step() == 1
+            with chaos.inject(fail_io=2):
+                with pytest.raises(OSError, match="chaos"):
+                    mgr.save(2, state, force=True)
 
 
 class TestNanInfGuard:
@@ -122,6 +144,254 @@ class TestNanInfGuard:
         x = paddle.to_tensor(np.array([-1.0], "f"))
         out = paddle.log(x)  # nan, but unchecked
         assert np.isnan(np.asarray(out.numpy())).all()
+
+
+# One deterministic trainer used by every end-to-end test below: 8 Adam
+# steps on a fixed-seed problem, checkpointing through the resilient
+# runner.  Writes per-step progress (so tests can SIGTERM mid-run) and
+# the final params (so runs can be compared bitwise).
+TRAINER_SRC = """
+    import os, sys, time
+    sys.path.insert(0, %r)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+    import jax, jax.numpy as jnp
+    import paddle_tpu as paddle
+    from paddle_tpu.distributed.checkpoint import CheckpointManager
+    from paddle_tpu.distributed.resilience import run_resilient
+
+    out, ckpt = sys.argv[1], sys.argv[2]
+    step_sleep = float(os.environ.get("TRAIN_STEP_SLEEP", "0"))
+    rs = np.random.RandomState(0)
+    w0 = {"w": jnp.asarray(rs.randn(4, 4) * 0.3, jnp.float32)}
+    data = [jnp.asarray(rs.randn(8, 4), jnp.float32) for _ in range(8)]
+    opt = paddle.optimizer.Adam(learning_rate=0.01)
+
+    def loss_fn(p, x):
+        return jnp.mean((x @ p["w"] - 1.0) ** 2)
+
+    @jax.jit
+    def train(p, s, t, x):
+        l, g = jax.value_and_grad(loss_fn)(p, x)
+        p2, s2 = opt.apply_pytree(p, g, s, step=t)
+        return p2, s2, l
+
+    def step_fn(step, st):
+        p, s, l = train(st["params"], st["opt"], step, data[step - 1])
+        with open(os.path.join(out, "progress"), "w") as f:
+            f.write(str(step))
+        if step_sleep:
+            time.sleep(step_sleep)
+        return {"params": p, "opt": s}, float(l)
+
+    with CheckpointManager(ckpt) as mgr:
+        state, info = run_resilient(
+            step_fn, {"params": w0, "opt": opt.init_pytree(w0)}, mgr,
+            num_steps=8, save_interval=2)
+    np.save(os.path.join(out, "final.npy"),
+            np.asarray(state["params"]["w"]))
+""" % REPO
+
+
+def _run_trainer(script, out_dir, ckpt_dir, env_extra=None, timeout=180):
+    from conftest import cpu_subprocess_env
+    os.makedirs(out_dir, exist_ok=True)
+    env = cpu_subprocess_env()
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, str(script), str(out_dir), str(ckpt_dir)],
+        env=env, capture_output=True, text=True, timeout=timeout)
+
+
+def _wait_for_progress(out_dir, step, timeout=120):
+    path = os.path.join(str(out_dir), "progress")
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        try:
+            if int(open(path).read()) >= step:
+                return
+        except (OSError, ValueError):
+            pass
+        time.sleep(0.05)
+    raise AssertionError(f"trainer never reached step {step}")
+
+
+@pytest.fixture(scope="session")
+def preempt_script(tmp_path_factory):
+    d = tmp_path_factory.mktemp("preempt")
+    script = d / "trainer.py"
+    script.write_text(textwrap.dedent(TRAINER_SRC))
+    return script
+
+
+@pytest.fixture(scope="session")
+def uninterrupted_params(preempt_script, tmp_path_factory):
+    """Final params of one clean 8-step run — the bitwise oracle."""
+    d = tmp_path_factory.mktemp("clean")
+    r = _run_trainer(preempt_script, d / "out", d / "ckpt")
+    assert r.returncode == 0, r.stderr
+    return np.load(str(d / "out" / "final.npy"))
+
+
+@pytest.mark.slow
+@pytest.mark.chaos
+class TestEndToEndPreemption:
+    def test_sigterm_resume_bitwise_identical(self, preempt_script,
+                                              uninterrupted_params,
+                                              tmp_path):
+        """The acceptance path: SIGTERM a live trainer mid-run → it
+        finishes the in-flight step, writes an emergency checkpoint and
+        exits PREEMPTED_EXIT_CODE → a relaunch auto-resumes and reaches
+        final params bitwise-identical to the uninterrupted run."""
+        from conftest import cpu_subprocess_env
+        out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+        os.makedirs(out)
+        env = cpu_subprocess_env()
+        env["TRAIN_STEP_SLEEP"] = "0.3"  # keep the run alive to kill it
+        proc = subprocess.Popen(
+            [sys.executable, str(preempt_script), str(out), str(ckpt)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        try:
+            _wait_for_progress(out, 3)
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=120)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == PREEMPTED_EXIT_CODE, proc.stderr.read()
+        assert not (out / "final.npy").exists()
+
+        # relaunch (as the launcher would, with PADDLE_RESTART_COUNT=1)
+        r = _run_trainer(preempt_script, out, ckpt,
+                         env_extra={"PADDLE_RESTART_COUNT": "1"})
+        assert r.returncode == 0, r.stderr
+        assert "auto-resume" in r.stderr
+        resumed = np.load(str(out / "final.npy"))
+        np.testing.assert_array_equal(resumed, uninterrupted_params)
+
+    def test_launcher_chaos_preemption_roundtrip(self, preempt_script,
+                                                 uninterrupted_params,
+                                                 tmp_path):
+        """Full-stack chaos drill: the trainer SIGTERMs itself at step 3
+        (chaos injector), the hardened launcher sees the distinct
+        preempted exit, backs off, restarts, and the resumed run ends
+        bitwise-identical to the clean one — all under
+        --restart_on=preempted."""
+        from conftest import cpu_subprocess_env
+        out, ckpt = tmp_path / "out", tmp_path / "ckpt"
+        os.makedirs(out)
+        env = cpu_subprocess_env()
+        env["PADDLE_CHAOS_PREEMPT_STEP"] = "3"
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=1", "--max_restarts=2",
+             "--restart_on=preempted", "--restart_backoff=0.1",
+             str(preempt_script), str(out), str(ckpt)],
+            env=env, capture_output=True, text=True, timeout=300)
+        assert r.returncode == 0, r.stderr
+        assert "preempted — restart 1/2" in r.stderr
+        final = np.load(str(out / "final.npy"))
+        np.testing.assert_array_equal(final, uninterrupted_params)
+
+
+class TestHardenedLauncher:
+    """Restart policy, backoff, and orphan handling — plain scripts, no
+    jax in the trainer, so these stay in tier-1."""
+
+    def _launch(self, script, tmp_path, *extra, timeout=120, env=None):
+        full_env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        full_env.update(env or {})
+        return subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=1", *extra, str(script)],
+            env=full_env, capture_output=True, text=True, timeout=timeout)
+
+    @pytest.mark.chaos
+    def test_restart_on_preempted_restarts_preempted_trainer(self,
+                                                             tmp_path):
+        script = tmp_path / "pre.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            if os.environ["PADDLE_RESTART_COUNT"] == "0":
+                sys.exit(75)   # the resilience preempted exit code
+            print("resumed fine")
+        """))
+        r = self._launch(script, tmp_path, "--max_restarts=2",
+                         "--restart_on=preempted",
+                         "--restart_backoff=0.05")
+        assert r.returncode == 0, r.stderr
+        assert "preempted — restart 1/2" in r.stderr
+
+    @pytest.mark.chaos
+    def test_restart_on_preempted_does_not_mask_crashes(self, tmp_path):
+        script = tmp_path / "crash.py"
+        script.write_text("import sys; sys.exit(1)\n")
+        r = self._launch(script, tmp_path, "--max_restarts=3",
+                         "--restart_on=preempted",
+                         "--restart_backoff=0.05")
+        assert r.returncode != 0
+        assert "not restarting" in r.stderr
+        # a crash with restart_on=preempted must fail FAST, not burn
+        # three restart attempts
+        assert "restart 1/3" not in r.stderr
+
+    @pytest.mark.chaos
+    def test_restart_backoff_logged_and_bounded(self, tmp_path):
+        script = tmp_path / "flaky.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            if os.environ["PADDLE_RESTART_COUNT"] == "0":
+                sys.exit(1)
+        """))
+        r = self._launch(script, tmp_path, "--max_restarts=1",
+                         "--restart_backoff=0.2")
+        assert r.returncode == 0, r.stderr
+        m = re.search(r"restart 1/1 in (\d+\.\d+)s", r.stderr)
+        assert m, r.stderr
+        # base * [1, 1 + jitter); upper bound padded for %.2f rounding
+        assert 0.2 <= float(m.group(1)) <= 0.3
+
+    def test_launcher_sigterm_reaps_trainers(self, tmp_path):
+        """Orphan fix: SIGTERM to the launcher must tear down the
+        trainer subprocesses (previously only KeyboardInterrupt did)."""
+        script = tmp_path / "sleeper.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys, time
+            open(os.path.join(%r, "pid"), "w").write(str(os.getpid()))
+            time.sleep(300)
+        """ % str(tmp_path)))
+        env = dict(os.environ, PYTHONPATH=REPO, JAX_PLATFORMS="cpu")
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=1", "--grace_period=5", str(script)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True)
+        try:
+            pid_file = tmp_path / "pid"
+            deadline = time.time() + 120
+            while not pid_file.exists() and time.time() < deadline:
+                time.sleep(0.05)
+            assert pid_file.exists(), "trainer never started"
+            trainer_pid = int(pid_file.read_text())
+            proc.send_signal(signal.SIGTERM)
+            rc = proc.wait(timeout=60)
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+        assert rc == 128 + signal.SIGTERM
+        # the trainer must be gone (SIGTERM'd within the grace window)
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            try:
+                os.kill(trainer_pid, 0)
+            except ProcessLookupError:
+                break
+            time.sleep(0.1)
+        else:
+            os.kill(trainer_pid, signal.SIGKILL)
+            raise AssertionError(
+                f"trainer {trainer_pid} orphaned after launcher SIGTERM")
 
 
 class TestLauncherRestart:

@@ -1,0 +1,368 @@
+"""Fault-tolerant runtime unit tests (distributed/resilience.py).
+
+Every recovery path is driven by deterministic fault injection
+(paddle_tpu.utils.chaos) — no mocks: the NaN policies see real NaN
+losses, the watchdog sees a real stalled step, preemption is a real
+SIGTERM latched by a real handler.
+"""
+import os
+import random
+import signal
+import subprocess
+import sys
+import textwrap
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+from paddle_tpu.distributed.launch import _restart_delay
+from paddle_tpu.distributed.resilience import (
+    PREEMPTED_EXIT_CODE,
+    WATCHDOG_EXIT_CODE,
+    PreemptionGuard,
+    Watchdog,
+    retry_with_backoff,
+    run_resilient,
+)
+from paddle_tpu.utils import chaos
+
+from conftest import cpu_subprocess_env
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class TestRetryWithBackoff:
+    def test_success_first_try_no_sleep(self):
+        sleeps = []
+        out = retry_with_backoff(lambda: 42, sleep=sleeps.append)
+        assert out == 42 and sleeps == []
+
+    def test_fails_then_succeeds(self):
+        sleeps, calls = [], []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        out = retry_with_backoff(flaky, retries=5, base_delay=0.1,
+                                 jitter=0.0, sleep=sleeps.append)
+        assert out == "ok"
+        assert len(calls) == 3
+        assert sleeps == [pytest.approx(0.1), pytest.approx(0.2)]
+
+    def test_sleep_monotonic_and_capped(self):
+        """Without jitter the delay sequence is exactly exponential,
+        monotonically non-decreasing, and capped at max_delay."""
+        sleeps = []
+
+        def always_fails():
+            raise OSError("nope")
+
+        with pytest.raises(OSError):
+            retry_with_backoff(always_fails, retries=6, base_delay=0.1,
+                               max_delay=1.0, jitter=0.0,
+                               sleep=sleeps.append)
+        assert len(sleeps) == 6
+        assert all(b >= a for a, b in zip(sleeps, sleeps[1:]))
+        np.testing.assert_allclose(
+            sleeps, [0.1, 0.2, 0.4, 0.8, 1.0, 1.0], rtol=1e-9)
+
+    def test_jitter_bounds(self):
+        """With jitter=j every delay lands in [d, d*(1+j))."""
+        sleeps = []
+
+        def always_fails():
+            raise OSError("nope")
+
+        with pytest.raises(OSError):
+            retry_with_backoff(always_fails, retries=8, base_delay=0.1,
+                               max_delay=100.0, jitter=0.5,
+                               rng=random.Random(1234),
+                               sleep=sleeps.append)
+        for i, s in enumerate(sleeps):
+            lo = 0.1 * (2 ** i)
+            assert lo <= s < lo * 1.5, (i, s)
+
+    def test_gives_up_raises_last_error(self):
+        errs = [OSError("a"), OSError("b"), OSError("final")]
+
+        def failing():
+            raise errs[len(sleeps)]
+
+        sleeps = []
+        with pytest.raises(OSError, match="final"):
+            retry_with_backoff(failing, retries=2, base_delay=0.0,
+                               jitter=0.0, sleep=lambda d: sleeps.append(d))
+
+    def test_unmatched_exception_not_retried(self):
+        sleeps, calls = [], []
+
+        def bad():
+            calls.append(1)
+            raise ValueError("logic bug, not transient")
+
+        with pytest.raises(ValueError):
+            retry_with_backoff(bad, retries=5, retry_on=(OSError,),
+                               sleep=sleeps.append)
+        assert len(calls) == 1 and sleeps == []
+
+
+class TestPreemptionGuard:
+    def test_latches_sigterm_and_restores_handler(self):
+        before = signal.getsignal(signal.SIGTERM)
+        with PreemptionGuard() as g:
+            assert not g.preempted
+            os.kill(os.getpid(), signal.SIGTERM)
+            # delivery is synchronous at the next bytecode boundary
+            assert g.preempted
+            assert g.signum == signal.SIGTERM
+        assert signal.getsignal(signal.SIGTERM) is before
+
+    def test_latches_sigint(self):
+        with PreemptionGuard() as g:
+            os.kill(os.getpid(), signal.SIGINT)
+            assert g.preempted and g.signum == signal.SIGINT
+
+
+class TestWatchdog:
+    def test_fires_on_hang(self):
+        fired = []
+        wd = Watchdog(0.2, on_timeout=fired.append, poll_interval=0.05)
+        wd.start()
+        time.sleep(0.6)  # no beat() — a hung step
+        wd.stop()
+        assert wd.fired and fired and fired[0] > 0.2
+
+    def test_beats_prevent_firing(self):
+        fired = []
+        with Watchdog(0.5, on_timeout=fired.append,
+                      poll_interval=0.05) as wd:
+            for _ in range(8):
+                time.sleep(0.1)
+                wd.beat()
+        assert not wd.fired and not fired
+
+    def test_rejects_nonpositive_timeout(self):
+        with pytest.raises(ValueError):
+            Watchdog(0.0)
+
+
+def _counting_step(step, state):
+    """Toy step: one update per good step, constant finite loss."""
+    return {"n": state["n"] + 1}, 0.5
+
+
+class TestAnomalyPolicies:
+    @pytest.mark.chaos
+    def test_skip_drops_bad_steps(self):
+        with chaos.inject(nan_at_step=(2, 4)):
+            state, info = run_resilient(
+                _counting_step, {"n": 0}, num_steps=5,
+                anomaly_policy="skip", max_bad_steps=3)
+        assert state["n"] == 3  # steps 2 and 4 skipped
+        assert info["bad_steps"] == 2 and info["skipped_steps"] == 2
+
+    @pytest.mark.chaos
+    def test_skip_escalates_after_max_consecutive(self):
+        with chaos.inject(nan_at_step=(2, 3, 4)):
+            with pytest.raises(FloatingPointError, match="consecutive"):
+                run_resilient(_counting_step, {"n": 0}, num_steps=6,
+                              anomaly_policy="skip", max_bad_steps=3)
+
+    @pytest.mark.chaos
+    def test_halt_raises_immediately(self):
+        with chaos.inject(nan_at_step=3):
+            with pytest.raises(FloatingPointError, match="step 3"):
+                run_resilient(_counting_step, {"n": 0}, num_steps=5,
+                              anomaly_policy="halt")
+
+    @pytest.mark.chaos
+    def test_rollback_restores_checkpoint_and_replays(self, tmp_path):
+        def step_fn(step, state):
+            return {"n": state["n"] + 1.0}, 0.5
+
+        with CheckpointManager(str(tmp_path / "rb")) as mgr:
+            # nan at steps 3 and 4 → streak hits max_bad_steps=2 at step
+            # 4 → roll back to the step-2 checkpoint and replay (the
+            # injections are one-shot, like transient data corruption)
+            with chaos.inject(nan_at_step=(3, 4)):
+                state, info = run_resilient(
+                    step_fn, {"n": jnp.float32(0)}, mgr, num_steps=6,
+                    anomaly_policy="rollback", max_bad_steps=2,
+                    save_interval=2)
+        assert float(state["n"]) == 6.0
+        assert info["rollbacks"] == 1 and info["bad_steps"] == 2
+
+    def test_rollback_requires_manager(self):
+        with pytest.raises(ValueError, match="rollback"):
+            run_resilient(_counting_step, {"n": 0}, num_steps=2,
+                          anomaly_policy="rollback")
+
+
+class TestResumeAndPreemption:
+    def test_auto_resume_from_latest(self, tmp_path):
+        def step_fn(step, state):
+            return {"n": state["n"] + 1.0}, None
+
+        with CheckpointManager(str(tmp_path / "ar")) as mgr:
+            mgr.save(3, {"n": jnp.float32(3)}, force=True)
+            mgr.wait()
+            state, info = run_resilient(step_fn, {"n": jnp.float32(0)},
+                                        mgr, num_steps=5)
+        assert info["resumed_step"] == 3
+        assert float(state["n"]) == 5.0  # only steps 4 and 5 ran
+
+    @pytest.mark.chaos
+    def test_preemption_checkpoints_and_reports(self, tmp_path):
+        def step_fn(step, state):
+            return {"n": state["n"] + 1.0}, 0.1
+
+        with CheckpointManager(str(tmp_path / "pre")) as mgr:
+            with chaos.inject(preempt_at_step=2):
+                state, info = run_resilient(
+                    step_fn, {"n": jnp.float32(0)}, mgr, num_steps=50,
+                    exit_on_preempt=False)
+            assert info["preempted"] and info["last_step"] == 2
+            assert mgr.latest_step() == 2  # the emergency checkpoint
+
+    @pytest.mark.chaos
+    def test_preemption_exits_with_distinct_code(self, tmp_path):
+        def step_fn(step, state):
+            return {"n": state["n"] + 1.0}, 0.1
+
+        with CheckpointManager(str(tmp_path / "px")) as mgr:
+            with chaos.inject(preempt_at_step=1):
+                with pytest.raises(SystemExit) as ei:
+                    run_resilient(step_fn, {"n": jnp.float32(0)}, mgr,
+                                  num_steps=50)
+            assert ei.value.code == PREEMPTED_EXIT_CODE
+
+    @pytest.mark.chaos
+    def test_watchdog_detects_chaos_slow_step(self):
+        fired = []
+        with chaos.inject(slow_step=2, slow_seconds=0.8):
+            state, info = run_resilient(
+                _counting_step, {"n": 0}, num_steps=3,
+                watchdog_timeout=0.3,
+                on_watchdog_timeout=fired.append)
+        assert fired and fired[0] > 0.3
+        assert state["n"] == 3  # custom on_timeout lets the run finish
+
+
+class TestLauncherBackoff:
+    def test_restart_delay_exponential_and_jittered(self):
+        rng = random.Random(7)
+        base = [_restart_delay(a, base=0.5, jitter=0.0) for a in (1, 2, 3, 4)]
+        np.testing.assert_allclose(base, [0.5, 1.0, 2.0, 4.0])
+        for a in (1, 2, 3):
+            lo = 0.5 * (2 ** (a - 1))
+            for _ in range(50):
+                d = _restart_delay(a, base=0.5, jitter=0.5, rng=rng)
+                assert lo <= d < lo * 1.5
+
+    def test_restart_delay_capped(self):
+        assert _restart_delay(50, base=1.0, max_delay=60.0,
+                              jitter=0.0) == 60.0
+
+
+class TestHapiFaultTolerance:
+    """Model.fit(resume=/fault_tolerant=) — the high-level API gets the
+    same crash-recovery contract as run_resilient."""
+
+    def _model_and_data(self):
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi import Model
+
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        rs = np.random.RandomState(0)
+        x = rs.randn(32, 4).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        ds = paddle.io.TensorDataset([paddle.to_tensor(x),
+                                      paddle.to_tensor(y)])
+        model = Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        return model, ds
+
+    @staticmethod
+    def _weights(model):
+        return {k: np.asarray(p._value)
+                for k, p in model.network.named_parameters()}
+
+    def test_fit_resume_bitwise_identical(self, tmp_path):
+        # oracle: 4 uninterrupted epochs
+        ma, ds = self._model_and_data()
+        ma.fit(ds, batch_size=8, epochs=4, shuffle=False, verbose=0)
+        ref = self._weights(ma)
+
+        # phase 1: 2 epochs, checkpointing each epoch end
+        mb, ds = self._model_and_data()
+        mb.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               resume=str(tmp_path))
+        # phase 2: a FRESH process-equivalent model resumes and finishes
+        mc, ds = self._model_and_data()
+        mc.fit(ds, batch_size=8, epochs=4, shuffle=False, verbose=0,
+               resume=str(tmp_path))
+        got = self._weights(mc)
+        assert set(got) == set(ref)
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+    @pytest.mark.chaos
+    def test_fit_preemption_emergency_checkpoint(self, tmp_path):
+        model, ds = self._model_and_data()
+        with chaos.inject(preempt_at_step=3):
+            with pytest.raises(SystemExit) as ei:
+                model.fit(ds, batch_size=8, epochs=4, shuffle=False,
+                          verbose=0, fault_tolerant=True,
+                          resume=str(tmp_path))
+        assert ei.value.code == PREEMPTED_EXIT_CODE
+        with CheckpointManager(
+                os.path.join(str(tmp_path), "resilient")) as mgr:
+            assert mgr.latest_step() == 3  # in-flight batch finished
+
+    def test_fit_requires_directory(self):
+        model, ds = self._model_and_data()
+        with pytest.raises(ValueError, match="directory"):
+            model.fit(ds, batch_size=8, epochs=1, verbose=0,
+                      fault_tolerant=True)
+
+
+@pytest.mark.chaos
+class TestWatchdogSubprocess:
+    def test_hung_step_aborts_with_watchdog_code(self, tmp_path):
+        """A truly hung step (chaos slow-step >> timeout) must kill the
+        process with the distinct watchdog exit code, not hang the pod."""
+        script = tmp_path / "hung.py"
+        script.write_text(textwrap.dedent("""
+            import os, sys
+            sys.path.insert(0, %r)
+            os.environ.setdefault("JAX_PLATFORMS", "cpu")
+            from paddle_tpu.distributed.resilience import run_resilient
+
+            def step_fn(step, state):
+                return state, 0.1
+
+            run_resilient(step_fn, {"n": 0}, num_steps=10,
+                          watchdog_timeout=1.0)
+            print("UNREACHABLE")
+        """ % REPO))
+        env = cpu_subprocess_env()
+        env["PADDLE_CHAOS_SLOW_STEP"] = "2"
+        env["PADDLE_CHAOS_SLOW_SECONDS"] = "300"
+        r = subprocess.run([sys.executable, str(script)], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == WATCHDOG_EXIT_CODE, (r.returncode, r.stderr)
+        assert "UNREACHABLE" not in r.stdout
+        # the stack dump makes the hang attributable
+        assert "watchdog" in r.stderr.lower() or "Thread" in r.stderr

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Run the chaos / fault-injection suite (resilience runtime coverage) on
+# the CPU backend.  Includes the `slow`-marked multi-process tests that
+# tier-1 skips: preemption-resume bitwise equivalence, launcher backoff,
+# watchdog abort.  Extra args are passed through to pytest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+exec env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m chaos \
+    -p no:cacheprovider -p no:randomly "$@"
