@@ -444,7 +444,8 @@ def _time_scan_loop(step, carry, xs, iters, n_timed):
     t0 = time.perf_counter()
     carry, loss = loop_j(carry, *xs)   # compile + warmup
     loss = float(loss)
-    _phase("compile_done", time.perf_counter() - t0)
+    compile_s = time.perf_counter() - t0
+    _phase("compile_done", compile_s)
     best = float("inf")
     for _ in range(n_timed):
         t0 = time.perf_counter()
@@ -452,7 +453,10 @@ def _time_scan_loop(step, carry, xs, iters, n_timed):
         loss = float(l_last)
         best = min(best, time.perf_counter() - t0)
     _phase("timed_runs_done", best)
-    return max(best - rt, 1e-9) / iters, loss
+    # compile_s is carried into each config's result line so the
+    # persistent-compile-cache win (FLAGS_jit_cache_dir) is measurable
+    # process-over-process — tools/perf_smoke.sh asserts on it
+    return max(best - rt, 1e-9) / iters, loss, compile_s
 
 
 def _encoder_model(L, H, A, I, S, V):
@@ -542,7 +546,8 @@ def _encoder_bench(name, on_tpu, amp_o2_scaler=False):
                  (_jnp.float32(2.0 ** 15), _jnp.int32(0), _jnp.int32(0)))
     else:
         carry = (params, opt_state)
-    dt, loss = _time_scan_loop(step, carry, (ids, labels), iters, n_timed)
+    dt, loss, compile_s = _time_scan_loop(step, carry, (ids, labels),
+                                          iters, n_timed)
 
     n_params = sum(int(np.prod(v.shape))
                    for v in jax.tree_util.tree_leaves(params))
@@ -558,6 +563,7 @@ def _encoder_bench(name, on_tpu, amp_o2_scaler=False):
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
         "mfu": round(mfu, 4),
         "step_time_ms": round(dt * 1e3, 2),
+        "compile_seconds": round(compile_s, 2),
         "params": n_params,
         "loss": float(loss),
     }
@@ -749,8 +755,8 @@ def body_resnet50(on_tpu):
     dt_ = jnp.bfloat16 if on_tpu else jnp.float32
     images = jnp.asarray(rs.randn(B, 3, HW, HW), dt_)
     labels = jnp.asarray(rs.randint(0, 1000, (B,)), jnp.int32)
-    dt, loss = _time_scan_loop(step, (params, opt_state), (images, labels),
-                               iters, n_timed)
+    dt, loss, compile_s = _time_scan_loop(step, (params, opt_state),
+                                          (images, labels), iters, n_timed)
     # ResNet-50 fwd ~4.1 GFLOPs/image at 224^2; train ~3x fwd
     flops = 3 * 4.1e9 * (HW / 224.0) ** 2 * B
     peak = peak_flops_per_chip()
@@ -812,6 +818,7 @@ def body_resnet50(on_tpu):
                            "mfu_0.40" if on_tpu else "cpu_smoke"),
         "mfu": round(mfu, 4),
         "step_time_ms": round(dt * 1e3, 2),
+        "compile_seconds": round(compile_s, 2),
         "loss": float(loss),
         "s2d_stem": bool(on_tpu),
         "batch": B,
@@ -880,25 +887,28 @@ def body_gpt13b(on_tpu):
 
         rs = np.random.RandomState(0)
         ids = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
-        dt, loss = _time_scan_loop(step, (params, opt_state), (ids,),
-                                   iters, n_timed)
+        dt, loss, compile_s = _time_scan_loop(step, (params, opt_state),
+                                              (ids,), iters, n_timed)
         n_params = sum(int(np.prod(v.shape))
                        for v in jax.tree_util.tree_leaves(params))
-        return dt, loss, n_params
+        return dt, loss, n_params, compile_s
 
     if on_tpu:
         try:
             _phase("full_1p3b_measure_start")
-            dt, loss, n_params = build_and_time(24, use_remat=True)
+            dt, loss, n_params, compile_s = build_and_time(24,
+                                                           use_remat=True)
             full_measured = True
         except Exception as e:  # noqa: BLE001 - OOM/compile: fall back
             fallback_err = str(e)[-300:]
             sys.stderr.write(f"[bench] full 1.3B measure failed, falling "
                              f"back to 4-layer: {fallback_err}\n")
             L_meas = 4
-            dt, loss, n_params = build_and_time(4, use_remat=False)
+            dt, loss, n_params, compile_s = build_and_time(
+                4, use_remat=False)
     else:
-        dt, loss, n_params = build_and_time(L_meas, use_remat=False)
+        dt, loss, n_params, compile_s = build_and_time(L_meas,
+                                                       use_remat=False)
 
     tokens = B * S
     # 6ND + attention FLOPs (the model-FLOPs convention: remat's extra
@@ -953,6 +963,7 @@ def body_gpt13b(on_tpu):
         "vs_baseline": round(mfu / 0.40, 4) if on_tpu else 0.0,
         "mfu": round(mfu, 4),
         "step_time_ms": round(dt * 1e3, 2),
+        "compile_seconds": round(compile_s, 2),
         "measured_layers": L_meas,
         "full_1p3b_measured": full_measured,
         "full_1p3b_compile_ok": full_compile_ok,

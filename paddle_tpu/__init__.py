@@ -31,6 +31,14 @@ if not hasattr(_jax, "shard_map"):
 del _jax
 
 from . import framework
+
+# Persistent XLA compilation cache (FLAGS_jit_cache_dir, on by default
+# under ~/.cache/paddle_tpu/xla): compiled executables are reused across
+# PROCESSES, so the second run of the same model skips XLA compilation.
+# Disable with FLAGS_JIT_CACHE_DIR="" in the environment or
+# paddle.set_flags({"FLAGS_jit_cache_dir": ""}).
+framework.flags.apply_jit_cache()
+
 from .framework import (
     CPUPlace,
     CUDAPinnedPlace,

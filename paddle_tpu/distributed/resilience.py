@@ -43,9 +43,28 @@ logger = logging.getLogger("paddle_tpu.resilience")
 
 __all__ = [
     "PREEMPTED_EXIT_CODE", "WATCHDOG_EXIT_CODE", "backoff_delay",
-    "retry_with_backoff", "PreemptionGuard", "Watchdog", "ResilientRunner",
-    "run_resilient",
+    "materialize", "retry_with_backoff", "PreemptionGuard", "Watchdog",
+    "ResilientRunner", "run_resilient",
 ]
+
+
+def materialize(tree):
+    """Block on and copy a pytree of (possibly device-resident) arrays to
+    host numpy.
+
+    Emergency/interval checkpoints of the donated training engine MUST go
+    through this: orbax saves asynchronously, and the engine invalidates
+    its state buffers (donate_argnums) on the very next dispatch — handing
+    orbax live device arrays would race the donation.  The copy runs under
+    an explicit transfer-guard "allow" scope, so checkpointing works even
+    inside a `jax.transfer_guard_device_to_host("disallow")` fit loop
+    (checkpoints are a sanctioned sync)."""
+    import jax
+
+    from ..framework.transfer import host_fetch
+
+    with host_fetch():
+        return jax.tree_util.tree_map(np.asarray, tree)
 
 # Distinct exit codes so the launcher can tell "preempted mid-training,
 # checkpoint written, please restart me" (75 = EX_TEMPFAIL) from a real

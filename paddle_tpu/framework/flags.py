@@ -29,6 +29,14 @@ def define_flag(name: str, default: Any, help_: str = ""):
 
 
 # Mirrors of the reference's commonly used flags (platform/flags.cc:33-565).
+define_flag("FLAGS_jit_cache_dir",
+            os.path.join("~", ".cache", "paddle_tpu", "xla"),
+            "persistent XLA compilation cache directory; '' disables. "
+            "Compiled executables are reused ACROSS processes, so the "
+            "second run of the same model skips XLA compilation entirely")
+define_flag("FLAGS_jit_cache_min_compile_secs", 0.5,
+            "only persist executables whose compile took at least this "
+            "long (0 caches everything)")
 define_flag("FLAGS_check_nan_inf", False, "per-op nan/inf checks in debug mode")
 define_flag("FLAGS_benchmark", False, "sync after each op for timing")
 define_flag("FLAGS_eager_delete_tensor_gb", 0.0, "inert: XLA owns memory")
@@ -43,6 +51,9 @@ define_flag("FLAGS_selected_gpus", "", "inert; device selection via set_device")
 def set_flags(flags: dict[str, Any]):
     for k, v in flags.items():
         _REGISTRY[k] = v
+    if "FLAGS_jit_cache_dir" in flags \
+            or "FLAGS_jit_cache_min_compile_secs" in flags:
+        apply_jit_cache(force=True)
     # mirror into the native runtime core so C++ components see the same
     # registry (platform/flags.cc role; no-op without the native lib)
     try:
@@ -52,6 +63,46 @@ def set_flags(flags: dict[str, Any]):
                 _native.flag_set(k, v)
     except Exception:
         pass
+
+
+_jit_cache_dir_applied = None
+
+
+def apply_jit_cache(force: bool = False):
+    """Point jax's persistent compilation cache at FLAGS_jit_cache_dir.
+
+    Called once at paddle_tpu import (and again from set_flags when the
+    flag changes).  With the cache on, every process that compiles the
+    same jitted step (same HLO, same backend) after the first reads the
+    executable from disk instead of re-running XLA — this is what takes
+    `decode_first_call_seconds` / fit's first-step compile from seconds
+    to milliseconds on the second run.  Returns the resolved directory,
+    or None when disabled/unavailable."""
+    global _jit_cache_dir_applied
+
+    d = _REGISTRY.get("FLAGS_jit_cache_dir") or ""
+    d = os.path.expanduser(d) if d else ""
+    if not force and d == _jit_cache_dir_applied:
+        return d or None
+    try:
+        import jax
+
+        if not d:
+            jax.config.update("jax_compilation_cache_dir", None)
+            _jit_cache_dir_applied = ""
+            return None
+        os.makedirs(d, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", d)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            float(_REGISTRY.get("FLAGS_jit_cache_min_compile_secs", 0.5)))
+        # no size floor: tiny-but-slow-to-compile entries still count
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        _jit_cache_dir_applied = d
+        return d
+    except Exception:  # noqa: BLE001 - cache is an optimization, never fatal
+        _jit_cache_dir_applied = None
+        return None
 
 
 def get_flags(keys):

@@ -1,0 +1,327 @@
+"""Device-resident async training engine behind Model.fit/evaluate.
+
+Why this exists (the framework tax the old hot loop paid per step):
+  * `_split_params()` + `dict(named_parameters())` rebuilt python dicts
+    from the Layer tree every batch;
+  * the jitted step had no `donate_argnums`, so XLA allocated fresh
+    output buffers for params/buffers/opt-state (a full copy of ~3x the
+    model per step) instead of updating in place;
+  * `float(loss_val)` forced a host round-trip each step, serializing
+    dispatch against device execution (no async overlap);
+  * every array was written back into Layer `_value`s each batch; and
+  * `jnp.asarray(lr)` / `jnp.asarray(step)` re-uploaded host scalars.
+
+The engine removes all of it.  On `begin()` the whole training state —
+`(trainable, frozen, buffers, opt_state, lr, step)` — is snapshotted ONCE
+into a single pytree that stays on device for the whole run.  The jitted
+step takes that pytree with `donate_argnums=(0,)` (XLA aliases every
+input buffer onto the matching output, reusing memory in place — the
+reference gets the same effect from fluid's inplace op buffers), and the
+fit loop dispatches steps without ever blocking: loss scalars stay in
+flight inside `_LossRing` and are fetched in one batched `device_get`
+only at `log_freq` boundaries, epoch ends, and checkpoints.  Write-back
+into Layer `_value`s happens only at epoch boundaries / checkpoints /
+`fit()` exit, so dygraph-style inspection between epochs (and the
+single-call `Model.train_batch` contract) still works.
+
+Every DELIBERATE device→host fetch goes through `host_fetch()`, which
+opens an explicit `jax.transfer_guard_device_to_host("allow")` scope —
+so a fit loop runs clean under `jax.transfer_guard_device_to_host(
+"disallow")` and any hidden sync that sneaks into the step path fails
+loudly (tests/test_train_engine.py pins this).
+"""
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..framework import random as _random
+from ..framework.transfer import fetch_floats, host_fetch, in_host_fetch
+from ..nn.layer_base import functional_call
+from ..tensor import Tensor
+
+__all__ = ["TrainEngine", "build_pure_train_step", "host_fetch",
+           "in_host_fetch", "fetch_floats"]
+
+
+def _to_list(x):
+    if x is None:
+        return []
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class _LossRing:
+    """In-flight device loss scalars awaiting a batched fetch.
+
+    Append never blocks (the scalar is an async XLA computation result);
+    `drain()` performs ONE device_get for everything pending and returns
+    python floats in step order."""
+
+    def __init__(self):
+        self._pending = []
+
+    def append(self, dev_scalar):
+        self._pending.append(dev_scalar)
+
+    def __len__(self):
+        return len(self._pending)
+
+    def drain(self):
+        out = fetch_floats(self._pending)
+        self._pending = []
+        return out
+
+
+def _copy_tree(tree):
+    # device-side copies (async, once per fit/epoch — NOT per step): the
+    # engine donates its state buffers, so anything the Layer tree keeps
+    # referencing must be a distinct buffer or the next dispatch would
+    # invalidate it under the user's feet
+    return jax.tree_util.tree_map(lambda a: jnp.array(a, copy=True), tree)
+
+
+def _tree_deleted(tree):
+    """True when any leaf is a donated-and-consumed (deleted) jax array —
+    the state a failed dispatch leaves behind."""
+    for a in jax.tree_util.tree_leaves(tree):
+        if getattr(a, "is_deleted", None) is not None and a.is_deleted():
+            return True
+    return False
+
+
+def build_pure_train_step(network, loss_layer, opt):
+    """THE train-step math, as one pure function
+    `(trainable, frozen, buffers, opt_state, lr, t, rng, inputs, labels)
+    -> (new_params, new_buffers, new_opt_state, loss, outs)`.
+
+    Single source of truth: `Model._build_train_step` jits it as-is (the
+    eager `train_batch` contract) and `TrainEngine` wraps it in the
+    donated state-pytree step — the engine's bitwise-equivalence
+    guarantee to `train_batch` holds by construction, not by keeping two
+    hand-synced copies of the loss/grad/update body."""
+
+    def step(trainable, frozen, buffers, opt_state, lr, t, rng, inputs,
+             labels):
+        def loss_fn(tr):
+            all_params = {**tr, **frozen}
+            outs, new_buffers = functional_call(
+                network, all_params, tuple(inputs), {}, buffers=buffers,
+                rng=rng)
+            outs_l = _to_list(outs)
+            if callable(loss_layer):
+                lv = loss_layer(*(outs_l + list(labels)))
+            else:
+                raise RuntimeError("prepare() a loss before fit()")
+            lv = lv.value if isinstance(lv, Tensor) else jnp.asarray(lv)
+            return jnp.mean(lv), (outs, new_buffers)
+
+        (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(trainable)
+        new_params, new_opt_state = opt.apply_pytree(
+            trainable, grads, opt_state, lr=lr, step=t)
+        return new_params, new_buffers, new_opt_state, loss_val, outs
+
+    return step
+
+
+class TrainEngine:
+    """Owns the device-resident state for one Model across fit() runs.
+
+    Lifecycle: `begin()` snapshots Layer state → N x `step()` (donated,
+    sync-free) → `write_back()` at epoch/checkpoint boundaries →
+    `finish()` at fit exit.  The compiled step function is cached on the
+    instance, and the instance is cached on the Model, so repeated fit()
+    calls (and the persistent XLA compilation cache across processes —
+    FLAGS_jit_cache_dir) skip recompilation.
+    """
+
+    def __init__(self, model):
+        self.model = model
+        self.state = None
+        self.ring = _LossRing()
+        self._step_fn = None
+        self._param_refs = None
+        self._buffer_refs = None
+        self._lr_host = None
+        self._host_step = 0
+
+    @property
+    def active(self):
+        return self.state is not None
+
+    # -- lifecycle ---------------------------------------------------------
+    def begin(self):
+        m = self.model
+        if m._optimizer is None or m._loss is None:
+            raise RuntimeError("prepare() an optimizer and a loss before "
+                               "fit()")
+        trainable, frozen, buffers = m._split_params()
+        opt_state = getattr(m, "_opt_state", None)
+        if opt_state is None:
+            opt_state = m._optimizer.init_pytree(trainable)
+        self._param_refs = dict(m.network.named_parameters())
+        self._buffer_refs = dict(m.network.named_buffers())
+        self._lr_host = float(m._optimizer.get_lr())
+        self._host_step = int(m._optimizer._step_count)
+        # copy ONCE per fit: the Layer tree keeps its own buffers, the
+        # engine exclusively owns (and donates) these
+        self.state = _copy_tree({
+            "trainable": trainable,
+            "frozen": frozen,
+            "buffers": buffers,
+            "opt": opt_state,
+            "lr": jnp.asarray(self._lr_host, jnp.float32),
+            "step": jnp.asarray(self._host_step, jnp.int32),
+        })
+        self._record_synced_ids()
+        self.ring = _LossRing()
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        return self
+
+    def _record_synced_ids(self):
+        # the array OBJECT each Layer slot held when the engine last
+        # synced with it — a later `is` mismatch means user code
+        # (callback, set_value) wrote the slot and the device state must
+        # be refreshed.  Holding the object (not a bare id()) matters:
+        # a freed array's id can be reused by a later allocation (ABA),
+        # which would silently mask a double mutation between syncs
+        self._synced = {k: p._value for k, p in self._param_refs.items()}
+        self._synced.update((f"buffer::{k}", b._value)
+                            for k, b in self._buffer_refs.items())
+
+    def refresh_from_layers(self):
+        """Fold user writes to Layer params/buffers (SWA/EMA write-back,
+        weight clipping, pruning masks — anything via `set_value`) back
+        into the device-resident state.  Identity comparison only: costs
+        a dict scan per call, uploads only dirty entries (as copies — the
+        engine still donates its own buffers).  Returns the number of
+        refreshed slots."""
+        if self.state is None:
+            return 0
+        dirty = 0
+        st = self.state
+        for k, p in self._param_refs.items():
+            if p._value is not self._synced.get(k):
+                v = jnp.array(p._value, copy=True)
+                tgt = ("trainable" if k in st["trainable"] else "frozen")
+                st[tgt][k] = v
+                self._synced[k] = p._value
+                dirty += 1
+        for k, b in self._buffer_refs.items():
+            if b._value is not self._synced.get(f"buffer::{k}"):
+                st["buffers"][k] = jnp.array(b._value, copy=True)
+                self._synced[f"buffer::{k}"] = b._value
+                dirty += 1
+        return dirty
+
+    def _build_step(self):
+        m = self.model
+        pure = build_pure_train_step(m.network, m._loss, m._optimizer)
+
+        @partial(jax.jit, donate_argnums=(0,))
+        def step(state, rng, inputs, labels):
+            t = state["step"] + 1
+            new_params, new_buffers, new_opt, loss_val, outs = pure(
+                state["trainable"], state["frozen"], state["buffers"],
+                state["opt"], state["lr"], t, rng, inputs, labels)
+            # every input leaf reappears structurally in the output so
+            # XLA's input-output aliasing consumes ALL donated buffers
+            # (params/opt in place, frozen/lr pass through)
+            new_state = {"trainable": new_params, "frozen": state["frozen"],
+                         "buffers": new_buffers, "opt": new_opt,
+                         "lr": state["lr"], "step": t}
+            return new_state, loss_val, outs
+
+        return step
+
+    def step(self, inputs, labels):
+        """Dispatch one donated train step WITHOUT syncing.  The loss
+        lands in the ring; returns the (device-resident) model outputs
+        for metric computation."""
+        opt = self.model._optimizer
+        lr = opt.get_lr()
+        if lr != self._lr_host:
+            # host-side LRScheduler advanced: refresh the device scalar
+            # (an async host→device upload, not a sync)
+            self._lr_host = lr
+            self.state["lr"] = jnp.asarray(lr, jnp.float32)
+        rng = _random.split_key()
+        self.state, loss_val, outs = self._step_fn(self.state, rng,
+                                                   inputs, labels)
+        self.ring.append(loss_val)
+        self._host_step += 1
+        opt._step_count = self._host_step  # host mirror of state["step"]
+        return outs
+
+    def drain(self):
+        """Batched fetch of every pending loss (the sanctioned sync)."""
+        return self.ring.drain()
+
+    # -- state egress ------------------------------------------------------
+    def write_back(self, copy=True, sync_opt=True):
+        """Re-bind the device-resident state into the Layer tree (and the
+        optimizer's opt-state slot).  With copy=True (mid-run epoch
+        boundaries) the Layer tree receives device-side COPIES so the
+        engine can keep donating its own buffers; copy=False hands over
+        the buffers themselves (fit exit — no further donation).
+
+        User writes since the last sync (e.g. a weight-clip after the
+        LAST batch of an epoch) are folded into the state first, so a
+        boundary write-back can never clobber them.
+
+        sync_opt=False skips the opt-state copy/rebind (the dominant
+        bytes for Adam-family slots): the per-batch write-back of the
+        custom-callback path uses it, since callbacks observe
+        params/buffers — `model._opt_state` stays at its last
+        epoch/checkpoint value until the next full sync, and fault-
+        tolerance checkpoints read the live engine state directly."""
+        st = self.state
+        if st is None:
+            return
+        self.refresh_from_layers()
+        trainable, buffers = st["trainable"], st["buffers"]
+        if copy:
+            trainable, buffers = _copy_tree((trainable, buffers))
+        for k, v in trainable.items():
+            self._param_refs[k]._value = v
+        for k, v in buffers.items():
+            self._buffer_refs[k]._value = v
+        m = self.model
+        if sync_opt:
+            m._opt_state = _copy_tree(st["opt"]) if copy else st["opt"]
+        m._optimizer._step_count = self._host_step
+        self._record_synced_ids()
+
+    def ft_state(self, it_count):
+        """Checkpointable snapshot of the device-resident state,
+        MATERIALIZED to host numpy.  Materialization matters twice over:
+        orbax saves asynchronously, and the engine donates these exact
+        buffers on the next dispatch — handing orbax live device arrays
+        would race the donation."""
+        from ..distributed.resilience import materialize
+
+        st = self.state
+        return {"params": materialize(st["trainable"]),
+                "buffers": materialize(st["buffers"]),
+                "opt": materialize(st["opt"]),
+                "meta": {"it": np.asarray(it_count, np.int32),
+                         "opt_steps": np.asarray(self._host_step,
+                                                 np.int32)}}
+
+    def finish(self):
+        """Final write-back at fit() exit; deactivates the engine (the
+        next fit re-snapshots from the Layer tree).
+
+        If a dispatch failed AFTER donating the state (XLA runtime
+        error, OOM), the engine holds deleted buffers — rebinding those
+        would clobber the valid epoch-boundary copies the Layer tree
+        still has, so a poisoned state is dropped instead."""
+        if self.state is None:
+            return
+        if not _tree_deleted(self.state):
+            self.write_back(copy=False)
+        self.state = None

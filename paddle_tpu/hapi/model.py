@@ -27,6 +27,8 @@ from ..io import DataLoader, Dataset
 from ..metric import Metric
 from ..nn.layer_base import Layer, functional_call, state_pytrees
 from ..tensor import Tensor, unwrap
+from .engine import (TrainEngine, build_pure_train_step, fetch_floats,
+                     host_fetch)
 
 
 def _to_list(x):
@@ -49,6 +51,7 @@ class Model:
         self._metrics = []
         self._train_step_fn = None
         self._eval_fn = None
+        self._engine = None
         self.stop_training = False
 
     # -- setup -------------------------------------------------------------
@@ -60,6 +63,7 @@ class Model:
                          if isinstance(m, Metric)]
         self._train_step_fn = None
         self._eval_fn = None
+        self._engine = None
         return self
 
     # -- compiled steps ----------------------------------------------------
@@ -72,31 +76,11 @@ class Model:
         return trainable, frozen, buffers
 
     def _build_train_step(self):
-        network, loss_layer, opt = self.network, self._loss, self._optimizer
-
-        @jax.jit
-        def step(trainable, frozen, buffers, opt_state, lr, t, rng, inputs,
-                 labels):
-            def loss_fn(tr):
-                all_params = {**tr, **frozen}
-                outs, new_buffers = functional_call(
-                    network, all_params, tuple(inputs), {}, buffers=buffers,
-                    rng=rng)
-                outs_l = _to_list(outs)
-                if callable(loss_layer):
-                    lv = loss_layer(*(outs_l + list(labels)))
-                else:
-                    raise RuntimeError("prepare() a loss before fit()")
-                lv = lv if isinstance(lv, Tensor) else _as_tensor(lv)
-                return jnp.mean(lv.value), (outs, new_buffers)
-
-            (loss_val, (outs, new_buffers)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True)(trainable)
-            new_params, new_opt_state = opt.apply_pytree(
-                trainable, grads, opt_state, lr=lr, step=t)
-            return new_params, new_buffers, new_opt_state, loss_val, outs
-
-        return step
+        # the step MATH lives in engine.build_pure_train_step — one body
+        # shared with the donated TrainEngine, so the engine's bitwise
+        # equivalence to this eager path holds by construction
+        return jax.jit(build_pure_train_step(self.network, self._loss,
+                                             self._optimizer))
 
     def _build_eval_step(self):
         network, loss_layer = self.network, self._loss
@@ -162,8 +146,7 @@ class Model:
     def predict_batch(self, inputs):
         self.network.eval()
         inputs = [_as_tensor(x) for x in _to_list(inputs)]
-        with jax.disable_jit() if False else _noop():
-            outs, _ = self.eval_batch_no_loss(inputs)
+        outs, _ = self.eval_batch_no_loss(inputs)
         return outs
 
     def eval_batch_no_loss(self, inputs):
@@ -177,7 +160,14 @@ class Model:
     # -- fault tolerance ---------------------------------------------------
     def _ft_state(self, it_count):
         """Checkpointable training state: trainable params + buffers +
-        optimizer slots + loop counters, as one pytree of arrays."""
+        optimizer slots + loop counters, as one pytree of arrays.  When
+        the device-resident engine is live its state is authoritative
+        (the Layer tree is only synced at epoch boundaries) and must be
+        MATERIALIZED to host — the engine donates those buffers on the
+        next dispatch, which would race orbax's async save."""
+        eng = self._engine
+        if eng is not None and eng.active:
+            return eng.ft_state(it_count)
         trainable, _frozen, buffers = self._split_params()
         opt_state = getattr(self, "_opt_state", None)
         if opt_state is None:
@@ -265,6 +255,23 @@ class Model:
                 ft_mgr.close()
                 raise
 
+        # Device-resident engine (hapi/engine.py): ONE state snapshot per
+        # fit, donated buffers, no per-step host sync.  When user
+        # callbacks or metrics need fresh per-batch values the loop
+        # drains the loss ring every step (same observable behavior as
+        # the old train_batch loop); otherwise losses are fetched in one
+        # batch at log_freq boundaries and epoch ends.
+        from ..utils.profiler import StepTimers
+
+        if self._engine is None:
+            self._engine = TrainEngine(self)
+        engine = self._engine
+        engine.begin()
+        eager_sync = user_cbs or bool(self._metrics)
+        timers = StepTimers()
+        self._last_fit_timers = timers
+        _END = object()
+
         history = {"loss": []}
         it_count = 0
         try:
@@ -274,8 +281,19 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 cbks.on_epoch_begin(epoch, {})
+                # fold user writes to Layer params/buffers (epoch-end
+                # callbacks: SWA/EMA write-back, re-init, pruning) back
+                # into the device-resident state
+                engine.refresh_from_layers()
                 losses = []
-                for step_i, batch in enumerate(loader):
+                data_iter = iter(loader)
+                step_i = -1
+                while True:
+                    with timers.scope("data"):
+                        batch = next(data_iter, _END)
+                    if batch is _END:
+                        break
+                    step_i += 1
                     if it_count < start_it:
                         # fast-forward over already-trained batches,
                         # consuming one rng key each to keep the stream
@@ -294,11 +312,35 @@ class Model:
                         _chaos.on_step(it_count + 1)
                     batch = _to_list(batch)
                     inputs, labels = self._split_batch(batch)
-                    loss = self.train_batch(inputs, labels)
-                    losses.append(loss if np.isscalar(loss) else loss[0])
+                    inputs = [_as_tensor(x) for x in inputs]
+                    labels = [_as_tensor(x) for x in labels]
+                    if user_cbs:
+                        # per-batch weight mutations (WGAN-style clipping
+                        # callbacks) only possible with user callbacks —
+                        # identity-scan for them before dispatching
+                        engine.refresh_from_layers()
+                    with timers.scope("dispatch"):
+                        outs = engine.step(inputs, labels)
                     it_count += 1
-                    logs = {"loss": losses[-1], "batch_size": batch_size}
-                    if user_cbs or (log_freq and step_i % log_freq == 0):
+                    log_step = bool(log_freq) and step_i % log_freq == 0
+                    if eager_sync or log_step:
+                        with timers.scope("sync"):
+                            losses.extend(engine.drain())
+                    if user_cbs:
+                        # full eager semantics for custom callbacks: they
+                        # see CURRENT weights in on_train_batch_end (the
+                        # old loop wrote back every batch; vanilla runs
+                        # keep the async no-copy path).  Opt slots sync
+                        # only at boundaries — callbacks observe weights
+                        engine.write_back(copy=True, sync_opt=False)
+                    if self._metrics:
+                        with host_fetch():
+                            for m in self._metrics:
+                                m.update(unwrap(m.compute(
+                                    *(_to_list(outs) + labels))))
+                    logs = {"loss": losses[-1] if losses else float("nan"),
+                            "batch_size": batch_size}
+                    if user_cbs or log_step:
                         for m in self._metrics:
                             logs[m._name] = np.mean(
                                 _to_list(m.accumulate()))
@@ -317,6 +359,12 @@ class Model:
                             raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                     if num_iters is not None and it_count >= num_iters:
                         break
+                with timers.scope("sync"):
+                    losses.extend(engine.drain())
+                # epoch-boundary write-back: the Layer tree gets device
+                # COPIES so checkpoints/eval/user inspection see current
+                # values while the engine keeps donating its own buffers
+                engine.write_back(copy=True)
                 if ft_mgr is not None and not checkpoint_interval \
                         and it_count > start_it:
                     ft_mgr.save(it_count, self._ft_state(it_count),
@@ -353,6 +401,20 @@ class Model:
                 if num_iters is not None and it_count >= num_iters:
                     break
         finally:
+            # final write-back: the engine's device-resident state becomes
+            # the Layer tree's state again (single source of truth for
+            # train_batch/save/parameters after fit returns) — even when
+            # fit is unwinding on an exception/preemption
+            import sys as _sys
+            if _sys.exc_info()[0] is None:
+                # success path: a failed final write-back means the Layer
+                # tree holds stale weights — that must surface, not pass
+                engine.finish()
+            else:
+                try:
+                    engine.finish()
+                except Exception:  # noqa: BLE001 - don't mask the real error
+                    pass
             # a crash mid-fit must still flush/close callback resources
             cbks.on_train_end({})
             if guard is not None:
@@ -375,15 +437,28 @@ class Model:
                        num_workers=num_workers)
         for m in self._metrics:
             m.reset()
-        losses = []
+        # hoisted once per evaluate (the old loop re-split the Layer tree
+        # and synced float(loss) on every batch); losses stay on device
+        # and are fetched in one batched transfer at the end
+        self.network.eval()
+        if self._eval_fn is None:
+            self._eval_fn = self._build_eval_step()
+        params, buffers = state_pytrees(self.network)
+        losses_dev = []
         for batch in loader:
             batch = _to_list(batch)
             inputs, labels = self._split_batch(batch)
-            outs, loss = self.eval_batch(inputs, labels)
-            losses.append(loss)
-            for m in self._metrics:
-                m.update(unwrap(m.compute(*( _to_list(outs) +
-                                             [_as_tensor(l) for l in labels]))))
+            inputs = [_as_tensor(x) for x in inputs]
+            labels = [_as_tensor(x) for x in labels]
+            rng = _random.split_key()
+            outs, loss = self._eval_fn(params, buffers, rng, inputs, labels)
+            losses_dev.append(loss)
+            if self._metrics:
+                with host_fetch():
+                    for m in self._metrics:
+                        m.update(unwrap(m.compute(*(_to_list(outs) +
+                                                    labels))))
+        losses = fetch_floats(losses_dev)
         res = {"loss": float(np.mean(losses)) if losses else 0.0}
         for m in self._metrics:
             res[m._name] = m.accumulate()
@@ -442,13 +517,6 @@ class Model:
     def summary(self, input_size=None, dtype=None):
         """Parameter summary (hapi Model.summary)."""
         return summary(self.network, input_size, dtype)
-
-
-import contextlib as _ctx
-
-
-def _noop():
-    return _ctx.nullcontext()
 
 
 def summary(net, input_size=None, dtypes=None):

@@ -155,7 +155,19 @@ class Optimizer:
     def apply_pytree(self, params: dict, grads: dict, state: dict,
                      lr=None, step=None):
         """Pure update over {name: array} pytrees. Returns (params, state).
-        Call inside jit; lr/step may be traced scalars."""
+        Call inside jit; lr/step may be traced scalars.
+
+        In-place state contract (the device-resident engine relies on
+        it): the returned (params, state) pytrees have EXACTLY the input
+        treedefs — same names, same slot keys, same shapes/dtypes leaf
+        for leaf.  That is what lets a caller jit the step with
+        `donate_argnums` on params/opt-state and have XLA alias every
+        input buffer onto its output (a true in-place update, the
+        reference's fluid inplace op buffers) instead of allocating a
+        fresh copy of the model + slots each step.  `_update`
+        implementations therefore must not add, drop, rename, or
+        re-dtype slots based on traced values; params without a grad
+        pass through as the SAME leaves (aliasing, zero cost)."""
         lr = self.get_lr() if lr is None else lr
         t = (self._step_count + 1) if step is None else step
         if self._grad_clip is not None:
@@ -170,6 +182,12 @@ class Optimizer:
             g = self._apply_decay(p, g.astype(p.dtype))
             new_params[name], new_state[name] = self._update(
                 p, g, state[name], lr, t)
+            if set(new_state[name]) != set(state[name]):
+                raise RuntimeError(
+                    f"{type(self).__name__}._update changed opt-state "
+                    f"slots for {name!r}: {sorted(state[name])} -> "
+                    f"{sorted(new_state[name])}; this breaks buffer "
+                    "donation (apply_pytree in-place state contract)")
         return new_params, new_state
 
     # -- checkpointing ----------------------------------------------------

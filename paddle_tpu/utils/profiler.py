@@ -44,6 +44,38 @@ class RecordEvent:
         return False
 
 
+class StepTimers:
+    """Per-step phase timing for the fit hot loop.
+
+    Each `scope(name)` is a RecordEvent — so `data` / `dispatch` / `sync`
+    phases appear as named spans inside jax.profiler / host chrome traces
+    — plus a host-side accumulator cheap enough to run every step, so
+    `summary()` answers "where does step time go" without a trace viewer.
+    Note that under the async engine `dispatch` measures enqueue cost
+    only; device execution overlaps and is paid for inside `sync`."""
+
+    def __init__(self):
+        self.totals: dict[str, float] = {}
+        self.counts: dict[str, int] = {}
+
+    @contextlib.contextmanager
+    def scope(self, name: str):
+        ev = RecordEvent(f"paddle.fit/{name}")
+        with ev:
+            yield
+        self.totals[name] = self.totals.get(name, 0.0) + ev.elapsed
+        self.counts[name] = self.counts.get(name, 0) + 1
+
+    def summary(self) -> dict:
+        """{phase: {total_s, count, mean_ms}} for every recorded phase."""
+        return {
+            name: {"total_s": round(t, 6),
+                   "count": self.counts[name],
+                   "mean_ms": round(t / self.counts[name] * 1e3, 4)}
+            for name, t in self.totals.items()
+        }
+
+
 _trace_dir = None
 
 

@@ -25,6 +25,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "chaos: deterministic fault-injection test of the "
         "resilience runtime (run via tools/chaos.sh)")
+    config.addinivalue_line(
+        "markers", "perf: performance regression test (persistent compile "
+        "cache, step-time) — run via tools/perf_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

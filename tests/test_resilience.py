@@ -337,6 +337,80 @@ class TestHapiFaultTolerance:
             model.fit(ds, batch_size=8, epochs=1, verbose=0,
                       fault_tolerant=True)
 
+    def test_fit_resume_bitwise_with_mid_epoch_checkpoint(self, tmp_path):
+        """checkpoint_interval checkpoints come straight from the
+        device-resident engine state mid-epoch; resuming from one is
+        still bitwise-exact vs the uninterrupted run."""
+        ma, ds = self._model_and_data()
+        ma.fit(ds, batch_size=8, epochs=4, shuffle=False, verbose=0)
+        ref = self._weights(ma)
+
+        # phase 1: 2 epochs (8 steps), checkpointing every 3 iterations —
+        # the newest checkpoint lands MID-epoch at iteration 6
+        mb, ds = self._model_and_data()
+        mb.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               resume=str(tmp_path), checkpoint_interval=3)
+        with CheckpointManager(
+                os.path.join(str(tmp_path), "resilient")) as mgr:
+            assert mgr.latest_step() == 6
+        # phase 2: fresh process-equivalent resumes at 6 and finishes
+        mc, ds = self._model_and_data()
+        mc.fit(ds, batch_size=8, epochs=4, shuffle=False, verbose=0,
+               resume=str(tmp_path), checkpoint_interval=3)
+        got = self._weights(mc)
+        assert set(got) == set(ref)
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+    @pytest.mark.chaos
+    def test_fit_preempt_resume_bitwise(self, tmp_path):
+        """The emergency checkpoint written on preemption materializes
+        the donated engine state; a restart resumes from it to the same
+        bits as a never-preempted run."""
+        ma, ds = self._model_and_data()
+        ma.fit(ds, batch_size=8, epochs=3, shuffle=False, verbose=0)
+        ref = self._weights(ma)
+
+        mb, ds = self._model_and_data()
+        with chaos.inject(preempt_at_step=5):
+            with pytest.raises(SystemExit) as ei:
+                mb.fit(ds, batch_size=8, epochs=3, shuffle=False,
+                       verbose=0, fault_tolerant=True, resume=str(tmp_path))
+        assert ei.value.code == PREEMPTED_EXIT_CODE
+        chaos.reset()
+        mc, ds = self._model_and_data()
+        mc.fit(ds, batch_size=8, epochs=3, shuffle=False, verbose=0,
+               resume=str(tmp_path))
+        got = self._weights(mc)
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+
+    def test_ft_state_materializes_engine_state(self):
+        """While the engine is live, _ft_state must return HOST numpy
+        arrays (orbax saves async; the engine donates its device buffers
+        on the next dispatch — handing it live device arrays would
+        race), and the snapshot must survive a subsequent step."""
+        import jax
+
+        import paddle_tpu as paddle
+        from paddle_tpu.hapi.engine import TrainEngine
+
+        model, ds = self._model_and_data()
+        eng = TrainEngine(model).begin()
+        model._engine = eng
+        snap = model._ft_state(7)
+        leaves = jax.tree_util.tree_leaves(snap)
+        assert leaves and all(
+            isinstance(v, (np.ndarray, np.generic)) for v in leaves)
+        assert int(snap["meta"]["it"]) == 7
+        frozen = {k: np.array(v) for k, v in snap["params"].items()}
+        rs = np.random.RandomState(0)
+        eng.step([paddle.to_tensor(rs.randn(8, 4).astype("float32"))],
+                 [paddle.to_tensor(rs.randint(0, 2, (8,))
+                                   .astype("int64"))])
+        for k in frozen:  # snapshot unaffected by the donated step
+            np.testing.assert_array_equal(snap["params"][k], frozen[k])
+
 
 @pytest.mark.chaos
 class TestWatchdogSubprocess:

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Perf smoke: proves the persistent XLA compilation cache
+# (FLAGS_jit_cache_dir) works process-over-process, then runs the
+# perf-marked pytest suite.
+#
+# Runs the bert and ernie CPU smoke benches TWICE each in fresh
+# processes against a fresh cache directory and asserts the second
+# process's compile time drops (the first process pays XLA, the second
+# reads the executable from disk).  Exits non-zero on any regression.
+# Extra args are passed through to pytest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+CACHE_DIR="$(mktemp -d /tmp/paddle_perf_cache.XXXXXX)"
+OUT_DIR="$(mktemp -d /tmp/paddle_perf_out.XXXXXX)"
+trap 'rm -rf "$CACHE_DIR" "$OUT_DIR"' EXIT
+export FLAGS_JIT_CACHE_DIR="$CACHE_DIR"       # flags.py env override
+export FLAGS_JIT_CACHE_MIN_COMPILE_SECS=0     # cache every executable
+
+compile_seconds() {  # run one bench config, print its compile_seconds
+    local out="$OUT_DIR/bench_$1_$RANDOM.out"
+    python bench.py --config "$1" > "$out"
+    python - "$out" <<'EOF'
+import json, sys
+last = None
+for line in open(sys.argv[1]):
+    line = line.strip()
+    if line.startswith("{") and '"compile_seconds"' in line:
+        last = json.loads(line)
+if last is None:
+    sys.exit("no compile_seconds in bench output")
+print(last["compile_seconds"])
+EOF
+}
+
+fail=0
+for cfg in bert ernie; do
+    c1=$(compile_seconds "$cfg")
+    c2=$(compile_seconds "$cfg")
+    echo "[perf_smoke] $cfg compile: first=${c1}s second=${c2}s"
+    python - "$cfg" "$c1" "$c2" <<'EOF' || fail=1
+import sys
+cfg, c1, c2 = sys.argv[1], float(sys.argv[2]), float(sys.argv[3])
+# the second process must at least not pay the full compile again; the
+# 0.8 factor absorbs trace/dispatch noise on tiny CPU smoke graphs
+if not (c2 < c1 and c2 < c1 * 0.8):
+    sys.exit(f"{cfg}: persistent compile cache did not help "
+             f"({c1:.2f}s -> {c2:.2f}s)")
+print(f"{cfg}: cache hit OK ({c1:.2f}s -> {c2:.2f}s)")
+EOF
+done
+[ "$(ls -A "$CACHE_DIR")" ] || { echo "cache dir is empty"; fail=1; }
+[ "$fail" -eq 0 ] || { echo "[perf_smoke] FAILED"; exit 1; }
+
+exec python -m pytest tests/ -q -m perf \
+    -p no:cacheprovider -p no:randomly "$@"
