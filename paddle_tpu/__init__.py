@@ -118,6 +118,7 @@ from . import text  # noqa: E402
 from . import hapi  # noqa: E402
 from . import utils  # noqa: E402
 from . import inference  # noqa: E402
+from . import serving  # noqa: E402
 from . import core  # noqa: E402
 from . import distribution  # noqa: E402
 from . import regularizer  # noqa: E402

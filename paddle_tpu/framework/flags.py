@@ -46,6 +46,19 @@ define_flag("FLAGS_cudnn_deterministic", False, "inert; XLA is deterministic")
 define_flag("FLAGS_sort_sum_gradient", False, "grad accumulation order")
 define_flag("FLAGS_max_inplace_grad_add", 0, "inert")
 define_flag("FLAGS_selected_gpus", "", "inert; device selection via set_device")
+# -- serving (paddle_tpu.serving adaptive batcher) ------------------------
+define_flag("FLAGS_serving_max_batch", 8,
+            "largest batch the serving engine coalesces (upper bucket)")
+define_flag("FLAGS_serving_timeout_ms", 5.0,
+            "adaptive-batch flush deadline: a partial batch is dispatched "
+            "once its oldest request has waited this long")
+define_flag("FLAGS_serving_queue_depth", 256,
+            "bounded request queue; submit() raises QueueFullError beyond "
+            "this (backpressure, not unbounded buffering)")
+define_flag("FLAGS_serving_buckets", "",
+            "serving shape-bucket grid, 'B1,B2,...' or 'B1,B2xS1,S2,...' "
+            "(batch x sequence); '' = powers of two up to "
+            "FLAGS_serving_max_batch, no sequence bucketing")
 
 
 def set_flags(flags: dict[str, Any]):

@@ -511,6 +511,40 @@ class Model:
                     jnp.asarray, payload["opt_state"])
         return self
 
+    def serve(self, host="127.0.0.1", port=8866, *, input_spec=None,
+              max_batch_size=None, batch_timeout_ms=None, buckets=None,
+              queue_depth=None, blocking=True,
+              install_signal_handlers=True):
+        """Serve this model over HTTP with adaptive batching
+        (paddle_tpu.serving): concurrent /predict requests are coalesced
+        into padded shape-bucket batches, every bucket is AOT-warmed
+        before the port opens, and SIGTERM drains gracefully.
+
+        `input_spec` (or the Model's constructor `inputs`) provides the
+        per-input (shape, dtype) used for warmup — dims of -1/None are
+        serving-variable (batch, and sequence when `buckets` carries a
+        seq grid).  With `blocking=False` returns the started
+        `ServingServer` (use `.url`, `.shutdown()`); otherwise blocks
+        until SIGTERM and returns the drain exit code (0 = clean).
+        """
+        from ..serving import ServingEngine, ServingServer
+
+        self.network.eval()
+        spec = input_spec if input_spec is not None else self._inputs
+        engine = ServingEngine(
+            self.network, max_batch_size=max_batch_size,
+            batch_timeout_ms=batch_timeout_ms, buckets=buckets,
+            queue_depth=queue_depth,
+            input_specs=_to_list(spec) if spec is not None else None)
+        server = ServingServer(
+            engine, host=host, port=port,
+            install_signal_handlers=install_signal_handlers).start()
+        if blocking:
+            print(f"serving on {server.url} (SIGTERM drains gracefully)",
+                  flush=True)
+            return server.wait()
+        return server
+
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
 

@@ -16,6 +16,7 @@ models with python-side control flow loadable.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import pickle
 
@@ -24,8 +25,15 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+try:  # newer jax exposes jax.export lazily; older needs the submodule import
+    import jax.export  # noqa: F401
+except ImportError:  # pragma: no cover - very old jax
+    pass
+
 from ..framework.dtype import convert_dtype
 from ..tensor import Tensor
+
+logger = logging.getLogger("paddle_tpu.inference")
 
 __all__ = ["Config", "Predictor", "create_predictor",
            "save_inference_model", "load_inference_model", "PrecisionType",
@@ -69,6 +77,19 @@ def _natural_key(name):
             for p in re.split(r"(\d+)", str(name))]
 
 
+# Inert-knob warnings fire ONCE per process per knob (serving loops call
+# these from config templates; per-call spam would drown real logs).
+_warned_inert: set[str] = set()
+
+
+def _warn_inert(knob: str, detail: str):
+    if knob not in _warned_inert:
+        _warned_inert.add(knob)
+        logger.warning(
+            "inference.Config.%s is accepted but INERT on this backend — "
+            "%s (XLA is the engine; see MIGRATION.md §4)", knob, detail)
+
+
 class Config:
     """AnalysisConfig parity (api/analysis_config.cc)."""
 
@@ -90,26 +111,38 @@ class Config:
     def model_dir(self):
         return self._path_prefix
 
-    # device knobs — TPU is the target; CUDA knobs accepted, inert
+    # device knobs — TPU is the target; CUDA knobs accepted, inert (and
+    # say so once, so serving users aren't misled into thinking a GPU /
+    # TensorRT / MKLDNN path is active)
     def enable_use_gpu(self, memory_pool_init_size_mb=100, device_id=0):
+        _warn_inert("enable_use_gpu", "no CUDA path exists; the model "
+                    "serves from the TPU/CPU XLA backend")
         self._switches["use_gpu"] = True
 
     def disable_gpu(self):
         self._switches["use_gpu"] = False
 
     def enable_xpu(self, *a, **k):
+        _warn_inert("enable_xpu", "no XPU path exists")
         self._switches["use_xpu"] = True
 
     def enable_tpu(self):
         self._use_tpu = True
+        self._switches["use_tpu"] = True
+
+    def use_tpu(self) -> bool:
+        return self._use_tpu
 
     def set_cpu_math_library_num_threads(self, n):
         self._switches["cpu_threads"] = n
 
     def enable_mkldnn(self):
+        _warn_inert("enable_mkldnn", "MKLDNN is a documented non-goal")
         self._switches["mkldnn"] = True
 
     def enable_tensorrt_engine(self, *a, **k):
+        _warn_inert("enable_tensorrt_engine",
+                    "TensorRT is a documented non-goal")
         self._switches["tensorrt"] = True  # inert: XLA is the engine
 
     def enable_memory_optim(self):
@@ -130,6 +163,10 @@ class Config:
     def summary(self):
         return json.dumps({"path": self._path_prefix,
                            "switches": self._switches}, indent=2)
+
+
+_MISSING = object()  # bucket-cache sentinel: None = "compile failed, use
+                     # per-call dispatch" is itself a cached outcome
 
 
 class _Handle:
@@ -153,16 +190,38 @@ class _Handle:
 
 
 class Predictor:
-    """AnalysisPredictor parity: named handles + Run loop."""
+    """AnalysisPredictor parity: named handles + Run loop.
+
+    Serving addition: a bucket-aware callable cache — every distinct
+    input-shape signature ("bucket") is AOT-lowered and compiled ONCE
+    (`warm()` does it ahead of traffic), and subsequent `run` calls on
+    that bucket go straight to the compiled executable with zero
+    retracing/recompilation.  `compile_count` exposes the number of
+    bucket compiles so serving tests can tripwire recompile storms.
+    """
 
     def __init__(self, config: Config):
         if isinstance(config, str):
             config = Config(config)
         self.config = config
+        self._bucket_cache = {}
+        self.compile_count = 0
         prefix = config.model_dir()
         if prefix is None:
             raise ValueError("Config has no model path")
         self._load(prefix)
+
+    @classmethod
+    def from_layer(cls, layer):
+        """Serve an in-memory Layer through the same Predictor surface
+        (bucket cache included) without an export round-trip."""
+        self = cls.__new__(cls)
+        self.config = None
+        self._bucket_cache = {}
+        self.compile_count = 0
+        self._input_specs = None
+        self._init_from_layer(layer)
+        return self
 
     # -- loading ----------------------------------------------------------
     def _load(self, prefix):
@@ -175,6 +234,7 @@ class Predictor:
                 self._exported = jax.export.deserialize(f.read())
             self._input_names = manifest["input_names"]
             self._output_names = manifest["output_names"]
+            self._input_specs = manifest.get("input_specs")
             params = {}
             aot_params = prefix + ".pdaotparams"
             with open(aot_params if os.path.exists(aot_params)
@@ -187,7 +247,10 @@ class Predictor:
             return
         # fallback: pickled Layer artifact (paddle_tpu.jit.save format)
         from .. import jit as _jit
-        layer = _jit.load(prefix)
+        self._input_specs = None
+        self._init_from_layer(_jit.load(prefix))
+
+    def _init_from_layer(self, layer):
         layer.eval()
         from ..nn.layer_base import functional_call, state_pytrees
         params, buffers = state_pytrees(layer)
@@ -205,6 +268,55 @@ class Predictor:
         self._input_names = None  # discovered at first run
         self._output_names = None
         self._mode = "jit"
+
+    # -- bucket-aware callable cache --------------------------------------
+    @staticmethod
+    def _bucket_key(arrays):
+        return tuple((tuple(int(d) for d in a.shape),
+                      str(np.dtype(a.dtype))) for a in arrays)
+
+    def _get_bucket(self, arrays):
+        """Compiled executable for this exact input signature (compiling
+        it on first sight), or None when AOT lowering is unavailable for
+        it — callers then take the legacy dispatch path."""
+        key = self._bucket_key(arrays)
+        fn = self._bucket_cache.get(key, _MISSING)
+        if fn is not _MISSING:
+            return fn
+        try:
+            specs = [jax.ShapeDtypeStruct(shape, np.dtype(dt))
+                     for shape, dt in key]
+            if self._mode == "aot":
+                exported = self._exported
+
+                def call(params, *xs):
+                    return exported.call(*jax.tree.leaves(params), *xs)
+
+                fn = jax.jit(call).lower(self._params, *specs).compile()
+            else:
+                fn = self._jitted.lower(self._params, *specs).compile()
+            self.compile_count += 1
+        except Exception as e:  # noqa: BLE001 - bucket cache is an optimization
+            logger.debug("bucket compile failed for %s (%s: %s) — using "
+                         "per-call dispatch", key, type(e).__name__, e)
+            fn = None
+        self._bucket_cache[key] = fn
+        return fn
+
+    def warm(self, shapes, dtypes=None):
+        """AOT-compile the bucket for `shapes` (one shape tuple per
+        input, batch dim included) ahead of traffic.  Returns True when
+        the bucket is servable without further compilation."""
+        if dtypes is None:
+            dtypes = [s["dtype"] for s in (self._input_specs or [])] \
+                or ["float32"] * len(shapes)
+        arrays = [np.zeros(tuple(shape), np.dtype(dt))
+                  for shape, dt in zip(shapes, dtypes)]
+        fn = self._get_bucket(arrays)
+        if fn is not None and self._mode == "jit" \
+                and self._input_names is None:
+            self.run(arrays)  # discover input/output names once
+        return fn is not None
 
     # -- handle API (reference get_input_handle/get_output_handle) --------
     def get_input_names(self):
@@ -225,23 +337,32 @@ class Predictor:
 
     def run(self, inputs=None):
         """Run with positional numpy inputs (returns list of numpy), or
-        with bound handles when inputs is None (ZeroCopyRun path)."""
+        with bound handles when inputs is None (ZeroCopyRun path).
+
+        Dispatch goes through the bucket cache: the first call on a new
+        input signature AOT-compiles it, every later call reuses the
+        compiled executable (zero retrace/recompile — the property the
+        serving engine's warmup relies on)."""
         if inputs is None:
             # Natural-sort fallback: lexicographic sorted() would bind x10
             # before x2 for models with 11+ inputs (advisor r1/r2 finding).
             names = self._input_names or sorted(
                 getattr(self, "_in_handles", {}), key=_natural_key)
             inputs = [self._in_handles[n]._value for n in names]
-        arrays = [jnp.asarray(np.asarray(
-            x.numpy() if isinstance(x, Tensor) else x)) for x in inputs]
-        if self._mode == "aot":
+        arrays = [np.asarray(x.numpy() if isinstance(x, Tensor) else x)
+                  for x in inputs]
+        fn = self._get_bucket(arrays)
+        if fn is not None:
+            outs = fn(self._params, *arrays)
+        elif self._mode == "aot":
             outs = self._exported.call(*jax.tree.leaves(self._params),
-                                       *arrays)
+                                       *(jnp.asarray(a) for a in arrays))
         else:
             outs = self._jitted(self._params, *arrays)
-            if self._input_names is None:
-                self._input_names = [f"x{i}" for i in range(len(arrays))]
-                self._output_names = [f"out{i}" for i in range(len(outs))]
+        if self._input_names is None:
+            self._input_names = [f"x{i}" for i in range(len(arrays))]
+            self._output_names = [f"out{i}" for i in range(
+                len(outs) if isinstance(outs, (tuple, list)) else 1)]
         outs = [np.asarray(o) for o in (outs if isinstance(outs, (tuple, list))
                                         else [outs])]
         for i, n in enumerate(self._output_names or []):

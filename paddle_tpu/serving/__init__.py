@@ -1,0 +1,35 @@
+"""paddle_tpu.serving — adaptive-batching TPU serving engine.
+
+The runtime layer between the AOT Predictor (paddle_tpu.inference, the
+AnalysisPredictor parity surface) and "heavy traffic": concurrent
+requests are coalesced into padded fixed-shape batches drawn from a
+finite bucket grid, every bucket is AOT-warmed at startup so
+steady-state serving never compiles, and a dependency-free HTTP front
+end exposes /predict, /healthz, and Prometheus /metrics with graceful
+SIGTERM drain.
+
+    from paddle_tpu import serving
+    engine = serving.ServingEngine("export/model",
+                                   buckets="1,2,4,8x64,128")
+    with serving.ServingServer(engine, port=8866) as srv:
+        srv.wait()          # until SIGTERM → drain → clean exit
+
+or one-shot from the high-level API: ``paddle.Model(net).serve(...)``.
+"""
+from .engine import (BucketSpec, DeadlineExceededError, EngineStoppedError,
+                     QueueFullError, ServingEngine)
+from .metrics import ServingMetrics
+
+__all__ = ["ServingEngine", "ServingServer", "ServingClient", "BucketSpec",
+           "ServingMetrics", "QueueFullError", "DeadlineExceededError",
+           "EngineStoppedError"]
+
+
+def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
+    if name == "ServingServer":     # / .client runnable without runpy's
+        from .server import ServingServer   # double-import warning
+        return ServingServer
+    if name == "ServingClient":
+        from .client import ServingClient
+        return ServingClient
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

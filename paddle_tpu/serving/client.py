@@ -1,0 +1,132 @@
+"""Minimal stdlib client for the serving HTTP API.
+
+`ServingClient` wraps /predict, /healthz, and /metrics with
+urllib.request (no dependencies — usable from any host that can reach
+the server).  The __main__ entry is the load generator
+tools/serve_smoke.sh drives: N requests from K threads, then a one-line
+JSON summary on stdout.
+"""
+from __future__ import annotations
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+
+__all__ = ["ServingClient", "ServingHTTPError"]
+
+
+class ServingHTTPError(RuntimeError):
+    def __init__(self, status: int, message: str):
+        super().__init__(f"HTTP {status}: {message}")
+        self.status = status
+
+
+class ServingClient:
+    def __init__(self, url: str, timeout: float = 30.0):
+        self.base = url.rstrip("/")
+        self.timeout = timeout
+
+    def _request(self, path: str, body=None):
+        req = urllib.request.Request(
+            self.base + path,
+            data=(json.dumps(body).encode() if body is not None else None),
+            headers={"Content-Type": "application/json"},
+            method="POST" if body is not None else "GET")
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout) as r:
+                return r.status, r.read()
+        except urllib.error.HTTPError as e:  # non-2xx still carries a body
+            return e.code, e.read()
+
+    def predict(self, inputs, dtypes=None, deadline_ms=None):
+        """inputs: list of single-sample arrays/nested lists (no batch
+        dim).  Returns list of numpy outputs; raises ServingHTTPError on
+        backpressure (429), draining (503), deadline (504)."""
+        body = {"inputs": [np.asarray(x).tolist() for x in inputs]}
+        if dtypes:
+            body["dtypes"] = [str(d) for d in dtypes]
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        status, raw = self._request("/predict", body)
+        if status != 200:
+            # status decides FIRST: a proxy's non-JSON 502/504 body must
+            # surface as ServingHTTPError, not a JSONDecodeError
+            try:
+                detail = json.loads(raw or b"{}").get("error", "?")
+            except ValueError:
+                detail = (raw or b"").decode(errors="replace")[:200]
+            raise ServingHTTPError(status, detail)
+        payload = json.loads(raw or b"{}")
+        return [np.asarray(o, dtype=np.dtype(dt)) for o, dt in
+                zip(payload["outputs"], payload["dtypes"])]
+
+    def healthz(self) -> dict:
+        status, raw = self._request("/healthz")
+        return {"status_code": status, **json.loads(raw or b"{}")}
+
+    def metrics(self) -> str:
+        status, raw = self._request("/metrics")
+        if status != 200:
+            raise ServingHTTPError(status, raw.decode(errors="replace"))
+        return raw.decode()
+
+
+def main(argv=None):
+    import argparse
+    import threading
+
+    parser = argparse.ArgumentParser(description="serving load generator")
+    parser.add_argument("--url", required=True)
+    parser.add_argument("--requests", type=int, default=20)
+    parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--shape", default="8",
+                        help="comma-separated SAMPLE shape, e.g. '16' or "
+                             "'16,8' (no batch dim)")
+    parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    shape = tuple(int(d) for d in args.shape.split(",") if d.strip())
+    client = ServingClient(args.url)
+    results = {"ok": 0, "backpressure": 0, "errors": 0}
+    lock = threading.Lock()
+
+    def worker(wid: int, n: int):
+        rs = np.random.RandomState(args.seed + wid)
+        for _ in range(n):
+            x = (rs.randint(0, 100, shape) if "int" in args.dtype
+                 else rs.randn(*shape)).astype(args.dtype)
+            try:
+                client.predict([x])
+                key = "ok"
+            except ServingHTTPError as e:
+                key = "backpressure" if e.status == 429 else "errors"
+            except Exception:  # noqa: BLE001
+                key = "errors"
+            with lock:
+                results[key] += 1
+
+    per = [args.requests // args.concurrency] * args.concurrency
+    for i in range(args.requests % args.concurrency):
+        per[i] += 1
+    t0 = time.perf_counter()
+    threads = [threading.Thread(target=worker, args=(i, n))
+               for i, n in enumerate(per) if n]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    results["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    results["client_qps"] = round(results["ok"] /
+                                  max(results["elapsed_s"], 1e-9), 1)
+    print(json.dumps(results), flush=True)
+    return 0 if results["errors"] == 0 else 1
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

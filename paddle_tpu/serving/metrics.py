@@ -1,0 +1,202 @@
+"""Serving metrics: counters, histograms, and a Prometheus text endpoint.
+
+Dependency-free (no prometheus_client): the exposition format is a few
+lines of text (https://prometheus.io/docs/instrumenting/exposition_formats/)
+and the serving engine needs exactly counters, histograms, and gauges.
+Everything is guarded by one lock — the batcher thread, N HTTP handler
+threads, and the /metrics scraper all touch the same state.
+
+Quantiles (p50/p99) come from a bounded reservoir of recent request
+latencies rather than histogram interpolation, so a smoke test scraping
+`paddle_serving_p99_ms` reads an exact order statistic over the last
+window instead of a bucket-boundary estimate.
+"""
+from __future__ import annotations
+
+import bisect
+import collections
+import threading
+import time
+
+__all__ = ["Histogram", "ServingMetrics"]
+
+
+class Histogram:
+    """Cumulative-bucket histogram (Prometheus `histogram` type)."""
+
+    def __init__(self, name: str, help_: str, buckets):
+        self.name = name
+        self.help = help_
+        self.uppers = sorted(float(b) for b in buckets)
+        self.counts = [0] * len(self.uppers)  # per-bucket (non-cumulative)
+        self.total = 0
+        self.sum = 0.0
+
+    def observe(self, value: float):
+        self.total += 1
+        self.sum += value
+        i = bisect.bisect_left(self.uppers, value)
+        if i < len(self.counts):
+            self.counts[i] += 1
+
+    def render(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} histogram"]
+        cum = 0
+        for upper, c in zip(self.uppers, self.counts):
+            cum += c
+            le = f"{upper:g}"
+            lines.append(f'{self.name}_bucket{{le="{le}"}} {cum}')
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.total}')
+        lines.append(f"{self.name}_sum {self.sum:g}")
+        lines.append(f"{self.name}_count {self.total}")
+        return lines
+
+
+class ServingMetrics:
+    """All engine/server observability state, rendered as Prometheus text.
+
+    Exposes (scraped by tools/serve_smoke.sh and read by bench.py):
+      paddle_serving_qps                    completions/s over the window
+      paddle_serving_p50_ms / _p99_ms       request latency order stats
+      paddle_serving_batch_size             batch-size histogram
+      paddle_serving_queue_latency_ms       submit→dispatch wait histogram
+      paddle_serving_padding_waste_ratio    padded slots / total slots
+      paddle_serving_requests_total{...}    accepted/rejected/… counters
+      paddle_serving_compile_count          predictor bucket compiles
+    """
+
+    QPS_WINDOW_S = 60.0
+    RESERVOIR = 4096
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.started_at = time.monotonic()
+        self.counters = collections.Counter()
+        self.batch_size_hist = Histogram(
+            "paddle_serving_batch_size",
+            "requests coalesced per dispatched batch",
+            [1, 2, 4, 8, 16, 32, 64, 128])
+        self.queue_latency_hist = Histogram(
+            "paddle_serving_queue_latency_ms",
+            "milliseconds a request waited in the batch queue",
+            [0.5, 1, 2, 5, 10, 20, 50, 100, 250, 500, 1000, 5000])
+        self.request_latency_hist = Histogram(
+            "paddle_serving_request_latency_ms",
+            "end-to-end request latency in milliseconds",
+            [1, 2, 5, 10, 20, 50, 100, 250, 500, 1000, 5000])
+        self._latencies = collections.deque(maxlen=self.RESERVOIR)
+        self._completions = collections.deque()  # monotonic stamps
+        self.batch_slots_total = 0
+        self.padded_slots_total = 0
+        self.compile_count = 0
+
+    # -- recording hooks (engine/server threads) ---------------------------
+    def count(self, name: str, n: int = 1):
+        with self._lock:
+            self.counters[name] += n
+
+    def observe_batch(self, n_requests: int, bucket_batch: int,
+                      real_elems: int = None, total_elems: int = None):
+        """Waste is counted in input ELEMENTS when provided (covers both
+        batch-slot padding and sequence padding); falls back to
+        slot-level accounting otherwise."""
+        if total_elems is None:
+            real_elems, total_elems = n_requests, bucket_batch
+        with self._lock:
+            self.batch_size_hist.observe(n_requests)
+            self.batch_slots_total += total_elems
+            self.padded_slots_total += total_elems - real_elems
+
+    def observe_queue_wait(self, seconds: float):
+        with self._lock:
+            self.queue_latency_hist.observe(seconds * 1e3)
+
+    def observe_completion(self, latency_s: float):
+        now = time.monotonic()
+        with self._lock:
+            self.counters["responses"] += 1
+            self.request_latency_hist.observe(latency_s * 1e3)
+            self._latencies.append(latency_s * 1e3)
+            self._completions.append(now)
+            cutoff = now - self.QPS_WINDOW_S
+            while self._completions and self._completions[0] < cutoff:
+                self._completions.popleft()
+
+    def set_compile_count(self, n: int):
+        with self._lock:
+            self.compile_count = int(n)
+
+    # -- derived values ----------------------------------------------------
+    def _quantile_locked(self, q: float):
+        if not self._latencies:
+            return 0.0
+        xs = sorted(self._latencies)
+        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        return xs[idx]
+
+    def _qps_locked(self, now=None):
+        now = time.monotonic() if now is None else now
+        if not self._completions:
+            return 0.0
+        span = max(1e-9, min(now - self.started_at, self.QPS_WINDOW_S))
+        # ignore stamps older than the window (popped on observe, but the
+        # deque can go stale when traffic stops)
+        live = sum(1 for t in self._completions
+                   if t >= now - self.QPS_WINDOW_S)
+        return live / span
+
+    def snapshot(self) -> dict:
+        """Programmatic view (bench.py serving fields, tests)."""
+        with self._lock:
+            waste = (self.padded_slots_total / self.batch_slots_total
+                     if self.batch_slots_total else 0.0)
+            return {
+                "qps": round(self._qps_locked(), 2),
+                "p50_ms": round(self._quantile_locked(0.50), 3),
+                "p99_ms": round(self._quantile_locked(0.99), 3),
+                "padding_waste_ratio": round(waste, 4),
+                "batches": self.batch_size_hist.total,
+                "mean_batch_size": round(
+                    self.batch_size_hist.sum / self.batch_size_hist.total, 2)
+                    if self.batch_size_hist.total else 0.0,
+                "compile_count": self.compile_count,
+                **{k: v for k, v in sorted(self.counters.items())},
+            }
+
+    def prometheus_text(self) -> str:
+        with self._lock:
+            lines = []
+            lines.append("# HELP paddle_serving_qps completed requests per "
+                         "second over the trailing window")
+            lines.append("# TYPE paddle_serving_qps gauge")
+            lines.append(f"paddle_serving_qps {self._qps_locked():g}")
+            for q, name in ((0.50, "p50"), (0.99, "p99")):
+                lines.append(f"# HELP paddle_serving_{name}_ms request "
+                             f"latency {name} in milliseconds")
+                lines.append(f"# TYPE paddle_serving_{name}_ms gauge")
+                lines.append(f"paddle_serving_{name}_ms "
+                             f"{self._quantile_locked(q):g}")
+            waste = (self.padded_slots_total / self.batch_slots_total
+                     if self.batch_slots_total else 0.0)
+            lines.append("# HELP paddle_serving_padding_waste_ratio padded "
+                         "input elements / dispatched input elements "
+                         "(batch-slot AND sequence padding)")
+            lines.append("# TYPE paddle_serving_padding_waste_ratio gauge")
+            lines.append(f"paddle_serving_padding_waste_ratio {waste:g}")
+            lines.append("# HELP paddle_serving_compile_count predictor "
+                         "shape-bucket compilations since start")
+            lines.append("# TYPE paddle_serving_compile_count gauge")
+            lines.append(f"paddle_serving_compile_count {self.compile_count}")
+            lines.append("# HELP paddle_serving_requests_total request "
+                         "outcomes by result")
+            lines.append("# TYPE paddle_serving_requests_total counter")
+            for key in ("accepted", "responses", "rejected_queue_full",
+                        "rejected_draining", "deadline_expired",
+                        "cancelled", "errors"):
+                lines.append(f'paddle_serving_requests_total'
+                             f'{{result="{key}"}} {self.counters[key]}')
+            lines.extend(self.batch_size_hist.render())
+            lines.extend(self.queue_latency_hist.render())
+            lines.extend(self.request_latency_hist.render())
+            return "\n".join(lines) + "\n"

@@ -1,0 +1,300 @@
+"""Dependency-free HTTP front end for the ServingEngine.
+
+stdlib ``http.server.ThreadingHTTPServer`` only — one handler thread per
+connection, all of them funneling into the engine's bounded queue, so
+the adaptive batcher (not the HTTP layer) is the concurrency boundary.
+
+Endpoints:
+  POST /predict   {"inputs": [nested-list, ...], "dtypes"?, "deadline_ms"?}
+                  → {"outputs": [...], "dtypes": [...], "latency_ms": t}
+                  429 on queue-full backpressure, 503 while draining,
+                  504 on deadline expiry
+  GET  /healthz   200 {"status": "ok"} | 503 {"status": "draining"}
+  GET  /metrics   Prometheus text (qps, p50/p99, batch-size and
+                  queue-latency histograms, padding-waste ratio)
+
+Graceful shutdown reuses the resilience latch pattern
+(distributed/resilience.py PreemptionGuard): SIGTERM/SIGINT is LATCHED,
+new work is rejected (healthz flips to draining), every queued and
+in-flight request completes, then the listener closes and ``wait()``
+returns 0 — the serving analog of "finish the in-flight step, then exit
+clean".
+"""
+from __future__ import annotations
+
+import concurrent.futures
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from ..distributed.resilience import PreemptionGuard
+from .engine import (DeadlineExceededError, EngineStoppedError,
+                     QueueFullError, ServingEngine)
+
+logger = logging.getLogger("paddle_tpu.serving")
+
+__all__ = ["ServingServer"]
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    # stdlib default listen backlog is 5 — a thundering herd of clients
+    # gets TCP resets before the engine's queue (the REAL admission
+    # control) ever sees them.  Backpressure must come from HTTP 429,
+    # not the kernel.
+    request_queue_size = 128
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    # ``self.server`` is the ThreadingHTTPServer; the ServingServer
+    # attaches itself as ``.owner``.
+    def _send(self, code: int, body: bytes, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        if code in (429, 503):
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj):
+        self._send(code, json.dumps(obj).encode())
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        owner = self.server.owner
+        if self.path == "/healthz":
+            if owner.draining:
+                self._send_json(503, {"status": "draining"})
+            else:
+                self._send_json(200, {"status": "ok"})
+        elif self.path == "/metrics":
+            self._send(200, owner.engine.metrics.prometheus_text().encode(),
+                       ctype="text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        owner = self.server.owner
+        # always drain the declared body FIRST: an early error response
+        # on a keep-alive connection would otherwise leave the body
+        # bytes to be misparsed as the next request line
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        if self.path != "/predict":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        t0 = time.monotonic()
+        try:
+            payload = json.loads(raw or b"{}")
+            inputs = payload["inputs"]
+            if not isinstance(inputs, list) or not inputs:
+                raise ValueError("'inputs' must be a non-empty list")
+            arrays = owner._decode(inputs, payload.get("dtypes"))
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        # admission errors (the client's fault / state) get 4xx-503 —
+        # separately from execution errors, so a server-side ValueError
+        # out of the model can never masquerade as "bad request"
+        try:
+            fut = owner.engine.submit(
+                arrays, deadline_ms=payload.get("deadline_ms"))
+        except ValueError as e:  # shape/spec mismatch caught at submit
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        except QueueFullError as e:
+            self._send_json(429, {"error": str(e)})
+            return
+        except EngineStoppedError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        try:
+            # bounded wait: a stalled model execution must release the
+            # handler thread (queued-phase deadlines are the engine's
+            # job; this is the dispatched-phase backstop)
+            outs = fut.result(timeout=owner.request_timeout_s)
+        except DeadlineExceededError as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except concurrent.futures.TimeoutError:
+            fut.cancel()
+            self._send_json(504, {"error": "request timed out in "
+                                  f"{owner.request_timeout_s:g}s"})
+            return
+        except Exception as e:  # noqa: BLE001 - model failure → 500
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send_json(200, {
+            "outputs": [np.asarray(o).tolist() for o in outs],
+            "dtypes": [str(np.asarray(o).dtype) for o in outs],
+            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+        })
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class ServingServer:
+    """HTTP server + engine lifecycle + SIGTERM drain.
+
+    ``start()`` warms the engine and begins serving; ``wait()`` blocks
+    until a latched SIGTERM/SIGINT (or ``shutdown()``) finishes the
+    graceful drain, and returns 0 on a clean exit.  Signal handlers are
+    installed when running on the main thread (the PreemptionGuard
+    pattern); off the main thread only programmatic shutdown works.
+    """
+
+    def __init__(self, engine: ServingEngine, host="127.0.0.1", port=8866,
+                 install_signal_handlers=True, drain_timeout_s=60.0,
+                 request_timeout_s=120.0):
+        self.engine = engine
+        self._host = host
+        self._requested_port = int(port)
+        self._install_signals = install_signal_handlers
+        self.drain_timeout_s = drain_timeout_s
+        self.request_timeout_s = request_timeout_s
+        self._httpd = None
+        self._guard = None
+        self._threads = []
+        self._done = threading.Event()
+        self._drain_clean = None
+        self._shutdown_once = threading.Lock()
+
+    # -- input decode ------------------------------------------------------
+    def _decode(self, inputs, dtypes=None):
+        specs = self.engine._input_specs
+        arrays = []
+        for i, x in enumerate(inputs):
+            if dtypes and i < len(dtypes):
+                dt = np.dtype(dtypes[i])
+            elif specs and i < len(specs):
+                dt = np.dtype(specs[i][1])
+            else:
+                dt = None
+            a = np.asarray(x) if dt is None else np.asarray(x, dtype=dt)
+            if a.dtype == object:
+                raise ValueError(f"inputs[{i}] is ragged/non-numeric")
+            arrays.append(a)
+        return arrays
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def draining(self) -> bool:
+        return self.engine.draining or self._done.is_set()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd \
+            else self._requested_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "ServingServer":
+        self.engine.start()
+        self._httpd = _HTTPServer((self._host, self._requested_port),
+                                  _Handler)
+        self._httpd.owner = self
+        if self._install_signals:
+            # latch, don't die: the handler only sets .preempted — the
+            # watcher thread performs the drain (same latch→finish→exit
+            # contract as the training runtime)
+            self._guard = PreemptionGuard()
+            self._guard.__enter__()
+        t_serve = threading.Thread(target=self._httpd.serve_forever,
+                                   kwargs={"poll_interval": 0.05},
+                                   daemon=True, name="paddle-serving-http")
+        t_watch = threading.Thread(target=self._watch, daemon=True,
+                                   name="paddle-serving-sigwatch")
+        self._threads = [t_serve, t_watch]
+        t_serve.start()
+        t_watch.start()
+        logger.info("serving on %s (%s)", self.url, self.engine.buckets)
+        return self
+
+    def _watch(self):
+        while not self._done.wait(0.05):
+            if self._guard is not None and self._guard.preempted:
+                logger.warning("signal %s latched — draining serving "
+                               "engine", self._guard.signum)
+                self.shutdown()
+                return
+
+    def shutdown(self) -> bool:
+        """Graceful drain: reject new work, finish queued + in-flight
+        requests, close the listener.  Idempotent; returns True when the
+        drain completed cleanly."""
+        with self._shutdown_once:
+            if self._drain_clean is not None:
+                return self._drain_clean
+            clean = self.engine.drain(timeout=self.drain_timeout_s)
+            self.engine.stop()
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            if self._guard is not None:
+                self._guard.__exit__(None, None, None)
+                self._guard = None
+            self._drain_clean = clean
+            self._done.set()
+            logger.info("serving drain %s", "clean" if clean else "TIMED OUT")
+            return clean
+
+    def wait(self, timeout=None) -> int:
+        """Block until shutdown completes; 0 = clean drain."""
+        if not self._done.wait(timeout):
+            return -1
+        for t in self._threads:
+            t.join(5.0)
+        return 0 if self._drain_clean else 1
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu serving server (adaptive batching over an "
+                    "AOT-exported artifact)")
+    parser.add_argument("--model", required=True,
+                        help="export path prefix (save_inference_model)")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=8866,
+                        help="0 picks a free port (printed on stdout)")
+    parser.add_argument("--max-batch", type=int, default=None)
+    parser.add_argument("--timeout-ms", type=float, default=None)
+    parser.add_argument("--buckets", default=None,
+                        help='e.g. "1,2,4,8" or "1,2,4,8x16,32"')
+    parser.add_argument("--queue-depth", type=int, default=None)
+    parser.add_argument("--seq-axis", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    engine = ServingEngine(args.model, max_batch_size=args.max_batch,
+                           batch_timeout_ms=args.timeout_ms,
+                           buckets=args.buckets,
+                           queue_depth=args.queue_depth,
+                           seq_axis=args.seq_axis)
+    server = ServingServer(engine, host=args.host, port=args.port).start()
+    # parse-friendly readiness line (tools/serve_smoke.sh greps it)
+    print(f"paddle_tpu.serving listening on {server.url}", flush=True)
+    return server.wait()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -28,6 +28,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "perf: performance regression test (persistent compile "
         "cache, step-time) — run via tools/perf_smoke.sh")
+    config.addinivalue_line(
+        "markers", "serving: adaptive-batching serving engine test "
+        "(paddle_tpu.serving) — run via tools/serve_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

@@ -109,6 +109,65 @@ class TestPredictorAPI:
         c.switch_ir_optim(True)
         assert "switches" in c.summary()
 
+    def test_inert_knobs_warn_once(self, caplog):
+        """CUDA/MKLDNN/TensorRT knobs are silently inert no more: one
+        warning per knob per process (not per call — serving loops build
+        Configs in bulk)."""
+        import logging
+
+        from paddle_tpu import inference as _inf
+
+        _inf._warned_inert.clear()
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.inference"):
+            c = Config("/nonexistent/prefix")
+            c.enable_mkldnn()
+            c.enable_mkldnn()          # repeated call: no second record
+            Config("/other").enable_mkldnn()  # other instance: still once
+            c.enable_tensorrt_engine()
+            c.enable_use_gpu()
+            c.enable_xpu()
+        inert = [r.getMessage() for r in caplog.records
+                 if "INERT" in r.getMessage()]
+        assert len(inert) == 4
+        assert sum("enable_mkldnn" in m for m in inert) == 1
+        assert any("enable_tensorrt_engine" in m for m in inert)
+
+    def test_enable_tpu(self, caplog):
+        """enable_tpu is the real path — honored, recorded, no warning."""
+        import logging
+
+        from paddle_tpu import inference as _inf
+
+        _inf._warned_inert.clear()
+        c = Config("/nonexistent/prefix")
+        with caplog.at_level(logging.WARNING, logger="paddle_tpu.inference"):
+            c.enable_tpu()
+        assert c.use_tpu() is True
+        assert '"use_tpu": true' in c.summary()
+        assert not [r for r in caplog.records if "INERT" in r.getMessage()]
+
+    def test_bucket_cache_compiles_once_per_shape(self, tmp_path):
+        """The serving-facing contract: warm() AOT-compiles a shape
+        bucket once; repeated run() calls on it never compile again."""
+        from paddle_tpu.jit import InputSpec
+        net = _trained_mlp()
+        net.eval()
+        prefix = str(tmp_path / "mbkt")
+        save_inference_model(prefix, net,
+                             input_spec=[InputSpec([-1, 8], "float32")])
+        pred = load_inference_model(prefix)
+        assert pred.compile_count == 0
+        assert pred.warm([(4, 8)]) is True
+        assert pred.compile_count == 1
+        x = np.random.RandomState(0).randn(4, 8).astype(np.float32)
+        ref = pred.run([x])
+        for _ in range(3):
+            pred.run([x])
+        assert pred.compile_count == 1          # cache hit, no recompile
+        pred.run([x[:2]])
+        assert pred.compile_count == 2          # new bucket: one compile
+        np.testing.assert_array_equal(pred.run([x])[0], ref[0])
+
     def test_missing_model_raises(self):
         with pytest.raises((FileNotFoundError, ValueError)):
             Predictor(Config("/nonexistent/prefix"))

@@ -1,0 +1,104 @@
+#!/usr/bin/env bash
+# Serving smoke: proves the paddle_tpu.serving stack end-to-end on CPU —
+# export a model, start the HTTP server, fire concurrent requests via
+# serving/client.py, scrape /metrics and assert the qps and p99 fields
+# are present and sane, then SIGTERM the server and require a clean
+# graceful drain (exit 0).  Finishes by running the serving-marked
+# pytest suite.  Extra args are passed through to pytest.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+export JAX_PLATFORMS=cpu
+WORK="$(mktemp -d /tmp/paddle_serve_smoke.XXXXXX)"
+SERVER_PID=""
+cleanup() {
+    [ -n "$SERVER_PID" ] && kill -9 "$SERVER_PID" 2>/dev/null || true
+    rm -rf "$WORK"
+}
+trap cleanup EXIT
+
+echo "[serve_smoke] exporting model..."
+python - "$WORK" <<'EOF'
+import sys
+
+import numpy as np
+
+import paddle_tpu as paddle
+from paddle_tpu import inference
+from paddle_tpu.static import InputSpec
+
+paddle.seed(0)
+net = paddle.nn.Sequential(paddle.nn.Linear(8, 32), paddle.nn.ReLU(),
+                           paddle.nn.Linear(32, 4))
+net.eval()
+inference.save_inference_model(
+    sys.argv[1] + "/mlp", net,
+    input_spec=[InputSpec([-1, 8], "float32")],
+    example_inputs=[np.zeros((2, 8), np.float32)])
+print("exported", sys.argv[1] + "/mlp")
+EOF
+
+echo "[serve_smoke] starting server..."
+python -m paddle_tpu.serving.server --model "$WORK/mlp" --port 0 \
+    --max-batch 8 --timeout-ms 3 > "$WORK/server.log" 2>&1 &
+SERVER_PID=$!
+
+URL=""
+for _ in $(seq 1 200); do
+    URL=$(sed -n 's/.*listening on \(http[^ ]*\).*/\1/p' "$WORK/server.log" \
+          | head -1)
+    [ -n "$URL" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null \
+        || { echo "server died:"; cat "$WORK/server.log"; exit 1; }
+    sleep 0.1
+done
+[ -n "$URL" ] || { echo "server never came up"; cat "$WORK/server.log"; exit 1; }
+echo "[serve_smoke] server up at $URL"
+
+echo "[serve_smoke] firing load..."
+python -m paddle_tpu.serving.client --url "$URL" --requests 40 \
+    --concurrency 4 --shape 8 --dtype float32
+
+echo "[serve_smoke] scraping /metrics..."
+python - "$URL" <<'EOF'
+import sys
+import urllib.request
+
+text = urllib.request.urlopen(sys.argv[1] + "/metrics",
+                              timeout=10).read().decode()
+needed = ["paddle_serving_qps", "paddle_serving_p99_ms",
+          "paddle_serving_p50_ms", "paddle_serving_batch_size_bucket",
+          "paddle_serving_queue_latency_ms_bucket",
+          "paddle_serving_padding_waste_ratio"]
+missing = [n for n in needed if n not in text]
+assert not missing, f"missing metrics: {missing}"
+
+
+def value(name):
+    line = [l for l in text.splitlines() if l.startswith(name + " ")][0]
+    return float(line.split()[1])
+
+
+qps, p99 = value("paddle_serving_qps"), value("paddle_serving_p99_ms")
+assert qps > 0, f"qps not positive: {qps}"
+assert p99 > 0, f"p99 not positive: {p99}"
+compiles = value("paddle_serving_compile_count")
+print(f"metrics OK: qps={qps:g} p99_ms={p99:g} bucket_compiles={compiles:g}")
+EOF
+
+echo "[serve_smoke] SIGTERM -> graceful drain..."
+kill -TERM "$SERVER_PID"
+rc=0
+wait "$SERVER_PID" || rc=$?
+SERVER_PID=""
+if [ "$rc" -ne 0 ]; then
+    echo "[serve_smoke] server exit code $rc (want 0 = clean drain)"
+    cat "$WORK/server.log"
+    exit 1
+fi
+grep -q "serving drain clean" "$WORK/server.log" \
+    || { echo "no clean-drain marker in server log"; cat "$WORK/server.log"; exit 1; }
+echo "[serve_smoke] clean drain OK"
+
+exec python -m pytest tests/ -q -m serving \
+    -p no:cacheprovider -p no:randomly "$@"
