@@ -24,9 +24,21 @@ P = PartitionSpec
 class _MeshState(threading.local):
     def __init__(self):
         self.mesh: Mesh | None = None
+        self.guard_depth = 0  # explicit mesh_guard scopes on this thread
 
 
 _state = _MeshState()
+
+
+def in_mesh_guard() -> bool:
+    """True while the calling thread is inside an EXPLICIT mesh_guard
+    scope.  Distinguishes a deliberately-scoped ambient mesh from a
+    leftover global one (set_mesh / ensure_mesh — eager collectives
+    call the latter as a side effect): consumers that change behavior
+    on an ambient mesh (Model.fit's SPMD pickup) only honor the scoped
+    kind, so an unrelated collective can never silently reshard a
+    later fit."""
+    return _state.guard_depth > 0
 
 
 def build_mesh(mesh_shape: dict[str, int] | None = None,
@@ -72,12 +84,49 @@ def ensure_mesh(mesh_shape=None) -> Mesh:
 def mesh_guard(mesh: Mesh):
     prev = _state.mesh
     _state.mesh = mesh
+    _state.guard_depth += 1
     try:
         with mesh:
             yield mesh
     finally:
+        _state.guard_depth -= 1
         _state.mesh = prev
 
 
 def named_sharding(*spec) -> NamedSharding:
     return NamedSharding(ensure_mesh(), P(*spec))
+
+
+def parse_mesh_shape(s) -> dict | None:
+    """Parse a FLAGS_mesh_shape-style string into a build_mesh shape
+    dict: `"dp=8"`, `"dp:2,mp:4"`, or a bare axis name (`"dp"`) meaning
+    the -1 wildcard (all remaining devices).  Empty/None → None.
+    Dicts pass through untouched so callers can accept either form."""
+    if isinstance(s, dict):
+        return s or None
+    if not s or not str(s).strip():
+        return None
+    out: dict[str, int] = {}
+    for part in str(s).replace(";", ",").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        for sep in ("=", ":"):
+            if sep in part:
+                name, dim = part.split(sep, 1)
+                try:
+                    dim = int(dim)
+                except ValueError:
+                    raise ValueError(
+                        f"bad mesh shape entry {part!r} in {s!r} "
+                        f"(FLAGS_mesh_shape / fit(mesh=...)): dimension "
+                        f"must be an int or -1") from None
+                if dim == 0 or dim < -1:
+                    raise ValueError(
+                        f"bad mesh shape entry {part!r} in {s!r}: "
+                        f"dimension must be positive or the -1 wildcard")
+                out[name.strip()] = dim
+                break
+        else:
+            out[part] = -1
+    return out or None

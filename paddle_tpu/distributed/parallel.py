@@ -16,6 +16,7 @@ TPU-native: there are no buckets, no comm streams, no TCP bootstrap.
 from __future__ import annotations
 
 import os
+import warnings
 
 import jax
 
@@ -26,6 +27,27 @@ from .env import ParallelEnv
 from .mesh import build_mesh, ensure_mesh, get_mesh, set_mesh
 
 _initialized = False
+_mesh_subsumed_warned = False
+
+
+def _warn_mesh_subsumes_dp_once():
+    global _mesh_subsumed_warned
+    if _mesh_subsumed_warned:
+        return
+    _mesh_subsumed_warned = True
+    warnings.warn(
+        "an ambient mesh is set: DataParallel.scale_loss / "
+        "apply_collective_grads now route through its 'dp' axis, and "
+        "Model.fit(mesh=...) subsumes DataParallel entirely (XLA inserts "
+        "the grad all-reduces from the sharded step) — migrate to the "
+        "sharded fit path (README 'Scaling', MIGRATION §5).",
+        DeprecationWarning, stacklevel=3)
+
+
+def _mesh_dp_degree(mesh) -> int:
+    """Size of the data-parallel axis of a mesh: the 'dp' axis when it
+    exists, else every axis (a bare unnamed-dp mesh)."""
+    return int(mesh.shape.get("dp", mesh.size))
 
 
 def init_parallel_env(mesh_shape=None):
@@ -87,20 +109,32 @@ class DataParallel(Layer):
 
     def scale_loss(self, loss):
         # reference scales by 1/nranks before backward (parallel.py:303);
-        # with psum-of-mean semantics we keep it for API parity
-        n = ParallelEnv().world_size
+        # with psum-of-mean semantics we keep it for API parity.  When an
+        # ambient mesh is set, the dp degree comes from ITS 'dp' axis so
+        # this legacy path and the mesh-driven fit can never disagree
+        # about the data-parallel world size
+        mesh = get_mesh()
+        if mesh is not None and mesh.size > 1:
+            _warn_mesh_subsumes_dp_once()
+            n = _mesh_dp_degree(mesh)
+        else:
+            n = ParallelEnv().world_size
         if n <= 1:
             return loss
         return loss / n
 
     def apply_collective_grads(self):
-        """Eager grad sync (the Reducer path, reducer.cc:398-525)."""
+        """Eager grad sync (the Reducer path, reducer.cc:398-525) — over
+        the ambient mesh's 'dp' axis when one is set (mesh-driven fit
+        subsumes this; kept for dygraph migration parity)."""
         mesh = get_mesh()
         if mesh is None or mesh.size <= 1:
             return
+        _warn_mesh_subsumes_dp_once()
+        group = "dp" if "dp" in mesh.axis_names else None
         for p in self._layers.parameters():
             if p.grad is not None:
-                collective.all_reduce(p.grad)
+                collective.all_reduce(p.grad, group=group)
 
     # delegate everything stateful to the wrapped layer
     def parameters(self, include_sublayers=True):

@@ -58,13 +58,30 @@ def materialize(tree):
     orbax live device arrays would race the donation.  The copy runs under
     an explicit transfer-guard "allow" scope, so checkpointing works even
     inside a `jax.transfer_guard_device_to_host("disallow")` fit loop
-    (checkpoints are a sanctioned sync)."""
+    (checkpoints are a sanctioned sync).
+
+    Mesh-sharded state (the SPMD fit path) gathers to host: a fully-
+    addressable array (replicated/sharded within one process) goes
+    straight through np.asarray; on a multi-host pod, arrays whose
+    shards live on other processes are all-gathered first, so every
+    host writes a complete checkpoint and restore re-shards from host
+    numpy (TrainEngine.begin device_puts the restored tree back onto
+    the mesh)."""
     import jax
 
     from ..framework.transfer import host_fetch
 
+    def to_host(a):
+        if (isinstance(a, jax.Array)
+                and not getattr(a, "is_fully_addressable", True)):
+            from jax.experimental import multihost_utils
+
+            return np.asarray(
+                multihost_utils.process_allgather(a, tiled=True))
+        return np.asarray(a)
+
     with host_fetch():
-        return jax.tree_util.tree_map(np.asarray, tree)
+        return jax.tree_util.tree_map(to_host, tree)
 
 # Distinct exit codes so the launcher can tell "preempted mid-training,
 # checkpoint written, please restart me" (75 = EX_TEMPFAIL) from a real

@@ -1,4 +1,4 @@
-"""Sanctioned device→host transfer scopes.
+"""Sanctioned device→host transfer scopes + sharded host→device placement.
 
 The async training engine (hapi/engine.py) promises that the fit hot
 loop never blocks on the device outside EXPLICIT fetch points (loss-ring
@@ -22,7 +22,7 @@ import threading
 
 import jax
 
-__all__ = ["host_fetch", "in_host_fetch", "fetch_floats"]
+__all__ = ["host_fetch", "in_host_fetch", "fetch_floats", "shard_batch"]
 
 _local = threading.local()
 
@@ -49,3 +49,34 @@ def fetch_floats(device_scalars):
         return []
     with host_fetch():
         return [float(v) for v in jax.device_get(list(device_scalars))]
+
+
+def shard_batch(tree, mesh, axis: str = "dp"):
+    """Place a batch pytree onto `mesh`: every array leaf is device_put
+    with its leading dim split over the named mesh `axis`
+    (`NamedSharding(mesh, P(axis))`); leaves whose leading dim doesn't
+    divide by the axis size — and scalars — replicate instead.  Tensor
+    leaves are rebuilt around the sharded array (Tensor is a registered
+    pytree node).
+
+    This is the sharded analog of the buffered_reader device prefetch:
+    `device_put` is ASYNC (a non-blocking host→device enqueue), so when
+    the DataLoader prefetch thread calls it (io.DataLoader.placement)
+    the transfer of global batch N+1 overlaps device compute of batch N.
+    Placing an array that already carries the target sharding is free
+    (device_put short-circuits), which also makes this idempotent."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    size = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+
+    def place(v):
+        shape = getattr(v, "shape", None)
+        if shape is None:  # python scalars in exotic collate outputs
+            return v
+        divisible = (len(shape) >= 1 and shape[0] > 0
+                     and shape[0] % size == 0)
+        spec = (PartitionSpec(axis) if size > 1 and divisible
+                else PartitionSpec())
+        return jax.device_put(v, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(place, tree)

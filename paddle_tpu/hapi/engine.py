@@ -29,6 +29,23 @@ opens an explicit `jax.transfer_guard_device_to_host("allow")` scope —
 so a fit loop runs clean under `jax.transfer_guard_device_to_host(
 "disallow")` and any hidden sync that sneaks into the step path fails
 loudly (tests/test_train_engine.py pins this).
+
+SPMD sharding (GSPMD, Xu et al.): `begin(mesh=...)` makes the SAME
+donated step mesh-aware — params/buffers/opt-state are placed with
+`NamedSharding` (replicated over `dp`; optionally split over `mp` via a
+per-param sharding rule or `distributed.annotate` dist_specs), the
+global batch is split over the `dp` axis, and XLA's partitioner inserts
+the grad all-reduces the reference hand-rolled in
+`DataParallel.apply_collective_grads` (fluid/dygraph/parallel.py:314).
+Every single-chip contract survives: donation (out_shardings are pinned
+to the in shardings so XLA aliases every state buffer), the sync-free
+loss ring, the persistent compile cache, and callback write-back (the
+Layer tree always receives SINGLE-device arrays, so eval/train_batch/
+save after a sharded fit stay mesh-free).  Numerics: a `dp=1` mesh is
+bitwise-identical to the unsharded engine, and resume-at-the-same-dp is
+bitwise round-trip; across DIFFERENT dp degrees XLA reassociates batch
+reductions (partial sums + all-reduce), so dp=1 vs dp=8 agree to
+float32 ULP, not bit-for-bit (tests/test_spmd_fit.py pins both).
 """
 from __future__ import annotations
 
@@ -37,14 +54,56 @@ from functools import partial
 import jax
 import jax.numpy as jnp
 import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
 
+from ..framework import flags as _flags
 from ..framework import random as _random
-from ..framework.transfer import fetch_floats, host_fetch, in_host_fetch
+from ..framework.transfer import (fetch_floats, host_fetch, in_host_fetch,
+                                  shard_batch)
 from ..nn.layer_base import functional_call
 from ..tensor import Tensor
 
 __all__ = ["TrainEngine", "build_pure_train_step", "host_fetch",
-           "in_host_fetch", "fetch_floats"]
+           "in_host_fetch", "fetch_floats", "resolve_mesh"]
+
+
+def resolve_mesh(mesh=None):
+    """fit()'s mesh resolution chain: explicit argument (a Mesh or a
+    `{"dp": 8}`-style shape dict) → ambient mesh from an ACTIVE
+    `mesh_guard` scope (honored only when it spans >1 device; a global
+    mesh left behind by `set_mesh`/`ensure_mesh` — eager collectives
+    call the latter as a side effect — is deliberately ignored, so
+    unrelated code can never silently reshard a fit) →
+    `FLAGS_mesh_shape` → None (single-device engine, the PR-2 fast
+    path, bit-for-bit unchanged)."""
+    from ..distributed.mesh import (build_mesh, get_mesh, in_mesh_guard,
+                                    parse_mesh_shape)
+
+    def from_shape(shape):
+        # a concrete shape smaller than the machine takes the leading
+        # device prefix ({"dp": 1} on an 8-device host is a valid —
+        # and parity-testable — degenerate mesh)
+        dims = [int(v) for v in shape.values()]
+        if -1 not in dims:
+            n = int(np.prod(dims))
+            if n <= len(jax.devices()):
+                return build_mesh(shape, devices=jax.devices()[:n])
+        return build_mesh(shape)
+
+    if isinstance(mesh, dict):
+        return from_shape(mesh)
+    if mesh is not None:
+        return mesh
+    if in_mesh_guard() and get_mesh() is not None:
+        # an ACTIVE guard always outranks the flag — including a
+        # deliberate 1-device guard (force-single-device debugging must
+        # not be resharded by a launcher's FLAGS_mesh_shape)
+        ambient = get_mesh()
+        return ambient if ambient.size > 1 else None
+    shape = parse_mesh_shape(_flags.flag("FLAGS_mesh_shape"))
+    if shape:
+        return from_shape(shape)
+    return None
 
 
 def _to_list(x):
@@ -147,13 +206,17 @@ class TrainEngine:
         self._buffer_refs = None
         self._lr_host = None
         self._host_step = 0
+        self.mesh = None
+        self._sharding_rule = None
+        self._state_sharding = None
+        self._step_key = None  # (mesh, rule) the cached jit was built for
 
     @property
     def active(self):
         return self.state is not None
 
     # -- lifecycle ---------------------------------------------------------
-    def begin(self):
+    def begin(self, mesh=None, sharding_rule=None):
         m = self.model
         if m._optimizer is None or m._loss is None:
             raise RuntimeError("prepare() an optimizer and a loss before "
@@ -166,21 +229,129 @@ class TrainEngine:
         self._buffer_refs = dict(m.network.named_buffers())
         self._lr_host = float(m._optimizer.get_lr())
         self._host_step = int(m._optimizer._step_count)
-        # copy ONCE per fit: the Layer tree keeps its own buffers, the
-        # engine exclusively owns (and donates) these
-        self.state = _copy_tree({
+        self.mesh = resolve_mesh(mesh)
+        self._sharding_rule = sharding_rule
+        raw = {
             "trainable": trainable,
             "frozen": frozen,
             "buffers": buffers,
             "opt": opt_state,
             "lr": jnp.asarray(self._lr_host, jnp.float32),
             "step": jnp.asarray(self._host_step, jnp.int32),
-        })
+        }
+        # copy ONCE per fit: the Layer tree keeps its own buffers, the
+        # engine exclusively owns (and donates) these.  The copy must
+        # come BEFORE device_put: device_put onto an equal sharding can
+        # return the SAME buffer, and donating an aliased buffer would
+        # invalidate the Layer tree's arrays under the user's feet.
+        if self.mesh is None:
+            self._state_sharding = None
+            self.state = _copy_tree(raw)
+            step_key = None
+        else:
+            self._state_sharding = self._build_state_sharding(raw)
+            self.state = jax.device_put(_copy_tree(raw),
+                                        self._state_sharding)
+            self._warn_if_mesh_unused()
+            # key on the RESOLVED sharding tree, not the rule object:
+            # a dist_spec annotated between fits changes the placement
+            # under the same (mesh, rule) — the cached jit's pinned
+            # out_shardings would silently force the old layout — and
+            # conversely a fresh-but-identical lambda rule must not
+            # bust the cache and retrace
+            leaves, treedef = jax.tree_util.tree_flatten(
+                self._state_sharding)
+            step_key = (self.mesh, treedef, tuple(leaves))
         self._record_synced_ids()
         self.ring = _LossRing()
-        if self._step_fn is None:
+        if self._step_fn is None or step_key != self._step_key:
             self._step_fn = self._build_step()
+            self._step_key = step_key
         return self
+
+    def _warn_if_mesh_unused(self):
+        """A mesh whose axes shard NOTHING (no `dp` axis for the batch,
+        no rule/annotation sharding a param) replicates the whole
+        computation: every device runs the identical step at N× the
+        chip cost while losses look perfectly healthy.  Almost always a
+        typo'd axis name (FLAGS_mesh_shape='data=8') — say so."""
+        if "dp" in self.mesh.axis_names:
+            return
+        shardings = [*self._state_sharding["trainable"].values(),
+                     *self._state_sharding["frozen"].values()]
+        if any(s.spec != PartitionSpec() for s in shardings):
+            return
+        import warnings
+
+        warnings.warn(
+            f"fit(mesh=...) got a mesh with axes "
+            f"{tuple(self.mesh.axis_names)} but no 'dp' axis and no "
+            "sharding_rule/dist_spec shards any param: every device "
+            "will replicate the full computation (no speedup). Name "
+            "the data-parallel axis 'dp', or provide a sharding_rule.",
+            UserWarning, stacklevel=3)
+
+    # -- sharding ----------------------------------------------------------
+    def _param_spec(self, name) -> PartitionSpec:
+        """PartitionSpec for one named param: the fit(sharding_rule=)
+        hook wins, then a `distributed.annotate` dist_spec on the
+        Parameter, else replicated.  Axis names outside the mesh are
+        dropped (same leniency as meta_parallel.shard_constraint), so an
+        mp-annotated model still fits on a pure-dp mesh."""
+        p = self._param_refs.get(name)
+        spec = None
+        if self._sharding_rule is not None:
+            spec = self._sharding_rule(name, p)
+        if spec is None and p is not None:
+            spec = getattr(p, "dist_spec", None)
+        if spec is None:
+            return PartitionSpec()
+        axes = self.mesh.axis_names
+
+        def known(entry):
+            # a spec entry may be an axis name OR a tuple of axis names
+            # (P(("dp", "mp")) shards one dim over both axes)
+            if isinstance(entry, (tuple, list)):
+                return all(a in axes for a in entry)
+            return entry in axes
+
+        return PartitionSpec(*[a if (a is None or known(a)) else None
+                               for a in spec])
+
+    def _build_state_sharding(self, raw):
+        """NamedSharding pytree mirroring the state: params follow
+        `_param_spec`, each opt slot inherits its param's spec when the
+        shapes match (Adam-family moments) and replicates otherwise
+        (scalar slots), everything else replicates."""
+        mesh = self.mesh
+        rep = NamedSharding(mesh, PartitionSpec())
+
+        def psh(name):
+            return NamedSharding(mesh, self._param_spec(name))
+
+        opt_sh = {}
+        for name, slots in raw["opt"].items():
+            if not isinstance(slots, dict):
+                # wrapper optimizers (Lookahead/EMA/ModelAverage) keep
+                # non-per-param entries (scalars, nested trees) at the
+                # top level: replicate them — a sharding is a valid
+                # pytree PREFIX, so `rep` covers whole subtrees too
+                opt_sh[name] = rep
+                continue
+            ref = raw["trainable"].get(name)
+            ps = psh(name)
+            opt_sh[name] = {
+                slot: (ps if ref is not None
+                       and getattr(v, "shape", None) == ref.shape else rep)
+                for slot, v in slots.items()}
+        return {
+            "trainable": {k: psh(k) for k in raw["trainable"]},
+            "frozen": {k: psh(k) for k in raw["frozen"]},
+            "buffers": {k: rep for k in raw["buffers"]},
+            "opt": opt_sh,
+            "lr": rep,
+            "step": rep,
+        }
 
     def _record_synced_ids(self):
         # the array OBJECT each Layer slot held when the engine last
@@ -204,16 +375,27 @@ class TrainEngine:
             return 0
         dirty = 0
         st = self.state
+        sh = self._state_sharding
+
+        def place(v, tgt, k):
+            # mesh mode: re-shard the fresh copy onto the state's own
+            # sharding — a committed single-device upload mixed into the
+            # mesh-resident state would fail the next dispatch
+            if sh is not None:
+                return jax.device_put(v, sh[tgt][k])
+            return v
+
         for k, p in self._param_refs.items():
             if p._value is not self._synced.get(k):
                 v = jnp.array(p._value, copy=True)
                 tgt = ("trainable" if k in st["trainable"] else "frozen")
-                st[tgt][k] = v
+                st[tgt][k] = place(v, tgt, k)
                 self._synced[k] = p._value
                 dirty += 1
         for k, b in self._buffer_refs.items():
             if b._value is not self._synced.get(f"buffer::{k}"):
-                st["buffers"][k] = jnp.array(b._value, copy=True)
+                st["buffers"][k] = place(jnp.array(b._value, copy=True),
+                                         "buffers", k)
                 self._synced[f"buffer::{k}"] = b._value
                 dirty += 1
         return dirty
@@ -222,7 +404,6 @@ class TrainEngine:
         m = self.model
         pure = build_pure_train_step(m.network, m._loss, m._optimizer)
 
-        @partial(jax.jit, donate_argnums=(0,))
         def step(state, rng, inputs, labels):
             t = state["step"] + 1
             new_params, new_buffers, new_opt, loss_val, outs = pure(
@@ -236,7 +417,19 @@ class TrainEngine:
                          "lr": state["lr"], "step": t}
             return new_state, loss_val, outs
 
-        return step
+        if self.mesh is None:
+            return jax.jit(step, donate_argnums=(0,))
+        # mesh mode: ONE global jitted step, partitioned by XLA.  Output
+        # shardings are PINNED to the input state shardings — that is
+        # what (a) keeps donation aliasing every state buffer (in/out
+        # shardings must match for XLA to alias) and (b) prevents the
+        # partitioner from drifting the state layout between steps,
+        # which would force a re-trace on the second dispatch.  The loss
+        # lands replicated; model outputs stay wherever propagation puts
+        # them (batch-sharded over dp).
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return jax.jit(step, donate_argnums=(0,),
+                       out_shardings=(self._state_sharding, rep, None))
 
     def step(self, inputs, labels):
         """Dispatch one donated train step WITHOUT syncing.  The loss
@@ -248,14 +441,52 @@ class TrainEngine:
             # host-side LRScheduler advanced: refresh the device scalar
             # (an async host→device upload, not a sync)
             self._lr_host = lr
-            self.state["lr"] = jnp.asarray(lr, jnp.float32)
+            new_lr = jnp.asarray(lr, jnp.float32)
+            if self._state_sharding is not None:
+                new_lr = jax.device_put(new_lr, self._state_sharding["lr"])
+            self.state["lr"] = new_lr
         rng = _random.split_key()
-        self.state, loss_val, outs = self._step_fn(self.state, rng,
-                                                   inputs, labels)
+        if self.mesh is not None:
+            # the DataLoader prefetch thread normally pre-shards batches
+            # (io.DataLoader.placement); this is the idempotent fallback
+            # for direct engine callers and odd-sized tail batches
+            # (device_put onto the sharding an array already has is free)
+            inputs = shard_batch(inputs, self.mesh)
+            labels = shard_batch(labels, self.mesh)
+            from ..distributed.mesh import mesh_guard
+
+            # ambient mesh during trace/dispatch so in-model
+            # shard_constraint / eager collectives resolve axis names
+            with mesh_guard(self.mesh):
+                self.state, loss_val, outs = self._step_fn(
+                    self.state, rng, inputs, labels)
+        else:
+            self.state, loss_val, outs = self._step_fn(self.state, rng,
+                                                       inputs, labels)
         self.ring.append(loss_val)
         self._host_step += 1
         opt._step_count = self._host_step  # host mirror of state["step"]
         return outs
+
+    def lower_step(self, inputs, labels):
+        """Lower (but do not execute) the jitted step for the engine's
+        current state — XLA cost-analysis / HLO introspection without
+        consuming a donation.  `lowered.compile().cost_analysis()` gives
+        PER-DEVICE numbers for SPMD modules, which is what the dp
+        scaling tests and bench assert on."""
+        rng = jax.random.PRNGKey(0)
+        if self.mesh is not None:
+            inputs = shard_batch(inputs, self.mesh)
+            labels = shard_batch(labels, self.mesh)
+            from ..distributed.mesh import mesh_guard
+
+            # same ambient scope as step(): in-model shard_constraint /
+            # axis-name resolution must see the mesh the step will
+            # actually run under, or the lowered program (and its cost
+            # analysis) describes a different computation
+            with mesh_guard(self.mesh):
+                return self._step_fn.lower(self.state, rng, inputs, labels)
+        return self._step_fn.lower(self.state, rng, inputs, labels)
 
     def drain(self):
         """Batched fetch of every pending loss (the sanctioned sync)."""
@@ -278,13 +509,33 @@ class TrainEngine:
         custom-callback path uses it, since callbacks observe
         params/buffers — `model._opt_state` stays at its last
         epoch/checkpoint value until the next full sync, and fault-
-        tolerance checkpoints read the live engine state directly."""
+        tolerance checkpoints read the live engine state directly.
+
+        Mesh mode always DE-SHARDS: the Layer tree receives single-
+        device arrays (one replica pulled off the mesh — a gather for
+        mp-split params), so evaluate/train_batch/save and user
+        callbacks after or between sharded epochs never see a
+        multi-device committed array.  The cross-sharding device_put is
+        a fresh buffer by construction, so donation stays safe even
+        with copy=False."""
         st = self.state
         if st is None:
             return
         self.refresh_from_layers()
         trainable, buffers = st["trainable"], st["buffers"]
-        if copy:
+        if self.mesh is not None:
+            dev0 = self.mesh.devices.flat[0]
+
+            def de_shard(a):
+                # device_put onto dev0 ALIASES the replica already living
+                # there (no copy) — and the engine donates that buffer on
+                # the next dispatch, which would mutate the Layer tree's
+                # array in place.  Force a real copy after the de-shard.
+                return jnp.array(jax.device_put(a, dev0), copy=True)
+
+            unshard = partial(jax.tree_util.tree_map, de_shard)
+            trainable, buffers = unshard((trainable, buffers))
+        elif copy:
             trainable, buffers = _copy_tree((trainable, buffers))
         for k, v in trainable.items():
             self._param_refs[k]._value = v
@@ -292,7 +543,10 @@ class TrainEngine:
             self._buffer_refs[k]._value = v
         m = self.model
         if sync_opt:
-            m._opt_state = _copy_tree(st["opt"]) if copy else st["opt"]
+            if self.mesh is not None:
+                m._opt_state = unshard(st["opt"])
+            else:
+                m._opt_state = _copy_tree(st["opt"]) if copy else st["opt"]
         m._optimizer._step_count = self._host_step
         self._record_synced_ids()
 

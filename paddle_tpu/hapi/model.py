@@ -196,7 +196,8 @@ class Model:
             eval_freq=1, log_freq=10, save_dir=None, save_freq=1, verbose=2,
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, fault_tolerant=False,
-            resume=None, checkpoint_interval=None):
+            resume=None, checkpoint_interval=None, mesh=None,
+            sharding_rule=None):
         """[fault tolerance — opt-in] `resume=<dir>` (or `resume=True`
         with `save_dir`) auto-resumes from the newest checkpoint in that
         directory and checkpoints every `checkpoint_interval` iterations
@@ -207,7 +208,20 @@ class Model:
         `--max_restarts` relaunches and resumes — see
         distributed/resilience.py.  Resume is bitwise-exact when data
         order and seeding are deterministic (`shuffle=False` +
-        `paddle.seed`)."""
+        `paddle.seed`).
+
+        [SPMD scaling — opt-in] `mesh=` a `jax.sharding.Mesh`, a shape
+        dict like `{"dp": 8}`, or nothing: an ambient
+        `distributed.mesh_guard` (or `FLAGS_mesh_shape`) is picked up
+        automatically.  The engine then compiles ONE global step with
+        NamedSharding in/out shardings: params/opt-state replicated over
+        `dp` (per-param placement via `sharding_rule(name, param) ->
+        PartitionSpec` or `distributed.annotate` for an `mp` axis), the
+        global batch split over `dp`, XLA inserting the collectives
+        (GSPMD) — so `batch_size` is the GLOBAL batch and throughput
+        scales with the dp degree.  All single-chip fit contracts
+        (donation, sync-free stepping, compile cache, checkpoints,
+        callbacks) are preserved; see README "Scaling"."""
         from .callbacks import config_callbacks
 
         loader = train_data if isinstance(train_data, DataLoader) else \
@@ -266,7 +280,17 @@ class Model:
         if self._engine is None:
             self._engine = TrainEngine(self)
         engine = self._engine
-        engine.begin()
+        engine.begin(mesh=mesh, sharding_rule=sharding_rule)
+        prev_placement = None
+        if engine.mesh is not None:
+            # the prefetch thread device-puts each global batch straight
+            # to its dp sharding, overlapping host→device transfer of
+            # batch N+1 with device compute of batch N
+            from functools import partial as _partial
+
+            from ..framework.transfer import shard_batch
+            prev_placement = loader.placement
+            loader.placement = _partial(shard_batch, mesh=engine.mesh)
         eager_sync = user_cbs or bool(self._metrics)
         timers = StepTimers()
         self._last_fit_timers = timers
@@ -415,6 +439,8 @@ class Model:
                     engine.finish()
                 except Exception:  # noqa: BLE001 - don't mask the real error
                     pass
+            if engine.mesh is not None:
+                loader.placement = prev_placement
             # a crash mid-fit must still flush/close callback resources
             cbks.on_train_end({})
             if guard is not None:

@@ -344,6 +344,12 @@ class DataLoader:
         self.use_buffer_reader = use_buffer_reader
         self.timeout = timeout
         self.worker_init_fn = worker_init_fn
+        # optional per-batch placement hook (framework.transfer.
+        # shard_batch partial): runs on the PREFETCH THREAD, so the
+        # async device_put of the next global batch onto its target
+        # sharding overlaps device compute of the current one.  Set by
+        # Model.fit(mesh=...) for the duration of the fit.
+        self.placement = None
         self._iterable_mode = isinstance(dataset, IterableDataset)
         if batch_sampler is not None:
             self.batch_sampler = batch_sampler
@@ -362,6 +368,17 @@ class DataLoader:
         if self._iterable_mode:
             raise TypeError("IterableDataset has no fixed length")
         return len(self.batch_sampler)
+
+    @staticmethod
+    def _placed(gen, place):
+        """Apply the placement hook inside the producing generator so it
+        executes on whichever thread drives `gen` (the prefetch thread
+        when use_buffer_reader is on)."""
+        try:
+            for item in gen:
+                yield place(item)
+        finally:
+            gen.close()
 
     def _produce(self):
         if self._iterable_mode:
@@ -552,6 +569,9 @@ class DataLoader:
 
     def __iter__(self):
         gen = self._produce()
+        place = self.placement
+        if place is not None:
+            gen = self._placed(gen, place)
         if not self.use_buffer_reader:
             yield from gen
             return

@@ -282,7 +282,22 @@ def _tensor_flatten(t: Tensor):
 
 def _tensor_unflatten(aux, children):
     stop_gradient, name = aux
-    return Tensor(children[0], stop_gradient=stop_gradient, name=name)
+    value = children[0]
+    if not isinstance(value, (Tensor, jax.Array, jax.core.Tracer, np.ndarray,
+                              int, float, complex, bool, list, tuple)):
+        # jax pytree plumbing unflattens with NON-array placeholders:
+        # prefix broadcasting (e.g. a None leaf in jit out_shardings
+        # spanning a Tensor subtree) and treedef.unflatten over
+        # sentinels.  Skip __init__'s value coercion for those — the
+        # placeholder Tensor only exists to be re-flattened.
+        t = object.__new__(Tensor)
+        t._value = value
+        t.stop_gradient = stop_gradient
+        t._grad = None
+        t._produced_by_op = False
+        t.name = name
+        return t
+    return Tensor(value, stop_gradient=stop_gradient, name=name)
 
 
 jax.tree_util.register_pytree_node(Tensor, _tensor_flatten, _tensor_unflatten)

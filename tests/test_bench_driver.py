@@ -62,5 +62,31 @@ def test_configs_cover_all_baseline_targets():
     # every BASELINE config + kernels/longseq/serving evidence, bert last
     assert bench.CONFIGS[-1] == "bert"
     for cfg in ("mnist", "resnet50", "ernie", "gpt13b", "kernels",
-                "longseq", "predictor"):
+                "longseq", "predictor", "dp8"):
         assert cfg in bench.CONFIGS, cfg
+
+
+def test_dp8_config_never_dials_tpu(monkeypatch):
+    """The dp-scaling config always runs on an 8-virtual-device CPU
+    mesh: _run_config must build a CPU env with the forced device count
+    and reuse the existing line on the late-TPU pass instead of
+    re-running."""
+    calls = []
+
+    def fake_run(args, env, timeout):
+        calls.append((args, env))
+        import json
+        return 0, json.dumps({"metric": "dp8_samples_per_sec",
+                              "value": 1.0, "unit": "samples/s",
+                              "vs_baseline": 1.0}), ""
+
+    monkeypatch.setattr(bench, "_run", fake_run)
+    line = bench._run_config("dp8", on_tpu=True)
+    assert line["metric"] == "dp8_samples_per_sec"
+    (_, env), = calls
+    assert env.get("JAX_PLATFORMS") == "cpu"
+    assert "xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    # late-TPU pass: the backend-independent line is reused verbatim
+    again = bench._run_config("dp8", on_tpu=True, cpu_fallback=line)
+    assert again is line
+    assert len(calls) == 1
