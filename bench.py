@@ -40,7 +40,8 @@ CONFIG_TIMEOUT_CPU_S = 900   # gpt13b's exact-1.3B CPU grad compile ≈ 382s
 # longer AND emit phase-partial lines so a timeout is attributable).
 CONFIG_TIMEOUT_TPU = {"bert": 1500, "gpt13b": 1800, "ernie": 1200}
 
-CONFIGS = ("mnist", "kernels", "longseq", "resnet50", "dp8", "predictor",
+CONFIGS = ("mnist", "kernels", "longseq", "resnet50", "dp8", "ckpt",
+           "predictor",
            "ernie", "gpt13b", "bert")
            # bert last among configs = headline; the aggregate summary
            # line prints after it.  dp8 = SPMD dp-scaling shape on 8
@@ -741,6 +742,84 @@ def body_mnist(on_tpu):
         "epochs": epochs_used,
         "steps": epochs_used * steps_per_epoch,
         "synthetic_data": bool(getattr(train, "synthetic", False)),
+    }
+
+
+def body_ckpt(on_tpu):
+    """Durable-checkpoint overhead (distributed/checkpoint.py): wall
+    time of a full manifest+fsync save and a verified restore of a
+    ~16 MB training state, and the per-checkpoint STALL a training step
+    sees — blocking (host snapshot + disk write on the training thread)
+    vs async (host snapshot only; the AsyncCheckpointer writes in the
+    background).  The async stall is the double-buffer host copy, which
+    donation makes unavoidable; everything else must be off-thread."""
+    import shutil as _shutil
+    import tempfile as _tempfile
+    import time as _time
+
+    import jax as _jax
+    import jax.numpy as _jnp
+    import numpy as _np
+
+    from paddle_tpu.distributed.checkpoint import (AsyncCheckpointer,
+                                                   CheckpointManager)
+    from paddle_tpu.distributed.resilience import materialize
+
+    rs = _np.random.RandomState(0)
+    state = {f"layer{i}": {
+        "w": _jnp.asarray(rs.randn(512, 512), _jnp.float32),
+        "m": _jnp.asarray(rs.randn(512, 512), _jnp.float32)}
+        for i in range(8)}  # ~16 MB of f32
+    nbytes = sum(a.size * 4 for a in _jax.tree_util.tree_leaves(state))
+
+    def median(xs):
+        return sorted(xs)[len(xs) // 2]
+
+    root = _tempfile.mkdtemp(prefix="paddle_ckpt_bench_")
+    try:
+        with CheckpointManager(os.path.join(root, "gen"),
+                               max_to_keep=2) as mgr:
+            save_ms, restore_ms = [], []
+            for rep in range(1, 4):
+                t0 = _time.perf_counter()
+                mgr.save(rep, state, force=True)
+                save_ms.append((_time.perf_counter() - t0) * 1e3)
+            template = _jax.tree_util.tree_map(_np.asarray, state)
+            for _ in range(3):
+                t0 = _time.perf_counter()
+                step, back = mgr.restore_latest(template=template)
+                restore_ms.append((_time.perf_counter() - t0) * 1e3)
+                assert step is not None
+
+            # per-checkpoint step stall: blocking save vs async submit
+            blocking_ms, async_ms = [], []
+            for rep in range(4, 7):
+                t0 = _time.perf_counter()
+                snap = materialize(state)
+                mgr.save(rep, snap, force=True, assume_host=True)
+                blocking_ms.append((_time.perf_counter() - t0) * 1e3)
+            with AsyncCheckpointer(mgr) as saver:
+                for rep in range(7, 10):
+                    t0 = _time.perf_counter()
+                    snap = materialize(state)  # the double buffer
+                    saver.submit(rep, snap, force=True)
+                    async_ms.append((_time.perf_counter() - t0) * 1e3)
+                    saver.flush(timeout=60)
+    finally:
+        _shutil.rmtree(root, ignore_errors=True)
+
+    return {
+        "metric": "ckpt_save_ms",
+        "value": round(median(save_ms), 2),
+        "unit": "ms",
+        "vs_baseline": 0.0,
+        "ckpt_save_ms": round(median(save_ms), 2),
+        "ckpt_restore_ms": round(median(restore_ms), 2),
+        "ckpt_step_stall_ms": round(median(async_ms), 2),
+        "ckpt_step_stall_blocking_ms": round(median(blocking_ms), 2),
+        "ckpt_async_overlap_ratio": round(
+            1.0 - median(async_ms) / max(median(blocking_ms), 1e-9), 4),
+        "state_mb": round(nbytes / 1e6, 1),
     }
 
 
@@ -1450,7 +1529,8 @@ def body_config(name):
     body = {"bert": body_bert, "ernie": body_ernie, "resnet50": body_resnet50,
             "gpt13b": body_gpt13b, "kernels": body_kernels,
             "mnist": body_mnist, "longseq": body_longseq,
-            "predictor": body_predictor, "dp8": body_dp8}[name]
+            "predictor": body_predictor, "dp8": body_dp8,
+            "ckpt": body_ckpt}[name]
     r = body(on_tpu)
     r["platform"] = jax.devices()[0].device_kind if on_tpu else "cpu"
     print(json.dumps(r), flush=True)

@@ -34,8 +34,15 @@ from .parallel import DataParallel, init_parallel_env, is_initialized  # noqa: F
 from .spawn import spawn  # noqa: F401
 from . import fleet  # noqa: F401
 from . import checkpoint  # noqa: F401
+from .checkpoint import (  # noqa: F401
+    AsyncCheckpointer,
+    CheckpointCorruption,
+    CheckpointManager,
+    CheckpointTemplateMismatch,
+)
 from . import resilience  # noqa: F401
 from .resilience import (  # noqa: F401
+    DURABILITY_EXIT_CODE,
     PREEMPTED_EXIT_CODE,
     ResilientRunner,
     retry_with_backoff,

@@ -28,7 +28,8 @@ from .launch_utils import (
     terminate_local_procs,
     watch_local_trainers,
 )
-from .resilience import PREEMPTED_EXIT_CODE, backoff_delay
+from .resilience import (DURABILITY_EXIT_CODE, PREEMPTED_EXIT_CODE,
+                         backoff_delay)
 
 logger = logging.getLogger("paddle_tpu.launch")
 
@@ -137,8 +138,24 @@ def launch_collective(args):
                 return 0
             except TrainerFailure as e:
                 preempted = _is_preemption(e.exit_code)
-                reason = ("preempted" if preempted
-                          else f"crashed (exit {e.exit_code})")
+                if preempted:
+                    reason = "preempted"
+                elif e.exit_code == DURABILITY_EXIT_CODE:
+                    # NOT a crash: training was healthy but checkpoint
+                    # writes kept failing — restarting onto the same
+                    # broken storage just replays the failure, so exit
+                    # 91 NEVER consumes the restart budget: fail fast
+                    # and loudly, an operator has to look at the
+                    # disk/quota.
+                    logger.error(
+                        "trainer rank=%s lost checkpoint durability "
+                        "(exit %d: consecutive checkpoint generations "
+                        "failed) — NOT restarting; check disk space / "
+                        "permissions on the checkpoint path", e.rank,
+                        DURABILITY_EXIT_CODE)
+                    raise
+                else:
+                    reason = f"crashed (exit {e.exit_code})"
                 if attempt >= args.max_restarts:
                     logger.error("trainer rank=%s %s — restarts exhausted "
                                  "(%d/%d)", e.rank, reason, attempt,

@@ -4,7 +4,7 @@ The reference's recovery story is "checkpoint + relaunch" with no
 elasticity (fluid launch_utils.py:517 kills the pod on any failure), but
 a TPU-native framework lives on preemptible pods where SIGTERM with a
 grace period is the NORMAL failure mode.  This module wires the existing
-pieces — orbax `CheckpointManager` (checkpoint.py), the launcher's
+pieces — the durable `CheckpointManager` (checkpoint.py), the launcher's
 `--max_restarts` + `PADDLE_RESTART_COUNT` contract (launch.py), and the
 `FLAGS_check_nan_inf` guard — into one runtime:
 
@@ -25,6 +25,7 @@ Every path is exercised by deterministic fault injection
 """
 from __future__ import annotations
 
+import errno
 import faulthandler
 import logging
 import os
@@ -42,31 +43,44 @@ from ..utils import chaos
 logger = logging.getLogger("paddle_tpu.resilience")
 
 __all__ = [
-    "PREEMPTED_EXIT_CODE", "WATCHDOG_EXIT_CODE", "backoff_delay",
-    "materialize", "retry_with_backoff", "PreemptionGuard", "Watchdog",
+    "PREEMPTED_EXIT_CODE", "WATCHDOG_EXIT_CODE", "DURABILITY_EXIT_CODE",
+    "backoff_delay", "is_transient_io_error", "materialize",
+    "retry_with_backoff", "PreemptionGuard", "Watchdog",
     "ResilientRunner", "run_resilient",
 ]
 
 
-def materialize(tree):
+def materialize(tree, copy: bool = True):
     """Block on and copy a pytree of (possibly device-resident) arrays to
-    host numpy.
+    host numpy.  `copy=False` returns zero-copy host VIEWS instead —
+    only safe when the bytes are consumed before the source buffers can
+    be donated/freed (the synchronous checkpoint-write path); every
+    snapshot that outlives the call must keep the default.
 
     Emergency/interval checkpoints of the donated training engine MUST go
-    through this: orbax saves asynchronously, and the engine invalidates
-    its state buffers (donate_argnums) on the very next dispatch — handing
-    orbax live device arrays would race the donation.  The copy runs under
+    through this: the async checkpointer writes on a background thread,
+    and the engine invalidates its state buffers (donate_argnums) on the
+    very next dispatch — handing it live device arrays would race the
+    donation.  The copy runs under
     an explicit transfer-guard "allow" scope, so checkpointing works even
     inside a `jax.transfer_guard_device_to_host("disallow")` fit loop
     (checkpoints are a sanctioned sync).
 
     Mesh-sharded state (the SPMD fit path) gathers to host: a fully-
     addressable array (replicated/sharded within one process) goes
-    straight through np.asarray; on a multi-host pod, arrays whose
+    straight through np.array; on a multi-host pod, arrays whose
     shards live on other processes are all-gathered first, so every
     host writes a complete checkpoint and restore re-shards from host
     numpy (TrainEngine.begin device_puts the restored tree back onto
-    the mesh)."""
+    the mesh).
+
+    The copy is `np.array(..., copy=True)`, NOT np.asarray: on the CPU
+    backend np.asarray of a jax array is ZERO-COPY (a view of the XLA
+    buffer), so a "materialized" snapshot would alias the very buffer
+    the engine donates on its next dispatch — XLA then updates it in
+    place and the checkpoint silently records post-step values
+    (allocation-order dependent, which is why the bug surfaced as a
+    flaky test rather than a deterministic one)."""
     import jax
 
     from ..framework.transfer import host_fetch
@@ -78,7 +92,7 @@ def materialize(tree):
 
             return np.asarray(
                 multihost_utils.process_allgather(a, tiled=True))
-        return np.asarray(a)
+        return np.array(a, copy=True) if copy else np.asarray(a)
 
     with host_fetch():
         return jax.tree_util.tree_map(to_host, tree)
@@ -86,8 +100,36 @@ def materialize(tree):
 # Distinct exit codes so the launcher can tell "preempted mid-training,
 # checkpoint written, please restart me" (75 = EX_TEMPFAIL) from a real
 # crash, and a hung step (killed by its own watchdog) from either.
+# DURABILITY_EXIT_CODE is the third distinct state: training itself is
+# healthy but K consecutive checkpoint generations failed to persist —
+# the degrade-then-escalate policy aborts so the launcher/operator can
+# alert instead of letting a job train for days with no recovery point.
 PREEMPTED_EXIT_CODE = 75
 WATCHDOG_EXIT_CODE = 86
+DURABILITY_EXIT_CODE = 91
+
+
+# OSError errnos that no amount of retrying fixes on the same path: a
+# full / read-only / permission-denied filesystem stays that way on the
+# backoff timescale.  Everything else (EIO, network-filesystem blips,
+# plain OSError("...") with no errno — the GCS-client shape) is
+# transient and worth the retry budget.
+_PERSISTENT_IO_ERRNOS = frozenset(
+    getattr(errno, name) for name in
+    ("ENOSPC", "EDQUOT", "EROFS", "EACCES", "EPERM", "ENOTDIR", "EISDIR",
+     "ENAMETOOLONG")
+    if hasattr(errno, name))
+
+
+def is_transient_io_error(exc) -> bool:
+    """errno split for checkpoint-IO retry policy: True for blips worth
+    retrying (EIO, timeouts, errno-less OSErrors), False for persistent
+    conditions (ENOSPC, EROFS, EACCES…) that must escalate immediately —
+    retrying ENOSPC identically to EIO just burns the backoff budget
+    while the job's durability window silently closes."""
+    if not isinstance(exc, OSError):
+        return False
+    return exc.errno not in _PERSISTENT_IO_ERRNOS
 
 
 def backoff_delay(attempt: int, base_delay: float, max_delay: float = 30.0,
@@ -104,10 +146,14 @@ def backoff_delay(attempt: int, base_delay: float, max_delay: float = 30.0,
 def retry_with_backoff(fn: Callable[[], Any], retries: int = 3,
                        base_delay: float = 0.1, max_delay: float = 30.0,
                        jitter: float = 0.5, retry_on=(OSError,),
-                       sleep=time.sleep, rng=None, label: str = None):
+                       sleep=time.sleep, rng=None, label: str = None,
+                       should_retry=None):
     """Call `fn`; on a `retry_on` exception retry up to `retries` more
     times, sleeping `backoff_delay(i, ...)` before retry i.
 
+    `should_retry(exc) -> bool`, when given, further filters caught
+    exceptions: a False verdict re-raises immediately (the errno split —
+    pass `is_transient_io_error` so ENOSPC escalates while EIO retries).
     `sleep` and `rng` are injectable so tests can assert the exact delay
     sequence.  Raises the last exception once retries are exhausted.
     """
@@ -116,6 +162,12 @@ def retry_with_backoff(fn: Callable[[], Any], retries: int = 3,
         try:
             return fn()
         except retry_on as e:
+            if should_retry is not None and not should_retry(e):
+                logger.error("%s failed (%s: %s) — not retryable, "
+                             "escalating immediately",
+                             label or getattr(fn, "__name__", "call"),
+                             type(e).__name__, e)
+                raise
             if attempt >= retries:
                 raise
             delay = backoff_delay(attempt, base_delay, max_delay, jitter,
@@ -295,11 +347,15 @@ class ResilientRunner:
         def _do():
             if wd is not None:
                 wd.beat()
-            mgr.save(step, state, force=force)
+            # transient_retry=False: THIS retry_with_backoff loop is the
+            # retry policy for this path — the manager's internal
+            # one-retry on top of it would multiply worst-case stall
+            mgr.save(step, state, force=force, transient_retry=False)
             mgr.wait()
         retry_with_backoff(_do, retries=self.retries,
                            base_delay=self.base_delay,
                            sleep=self._io_sleep(wd),
+                           should_retry=is_transient_io_error,
                            label=f"checkpoint save@{step}")
 
     def _restore_latest(self, mgr, template, wd=None):

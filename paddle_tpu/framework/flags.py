@@ -64,6 +64,15 @@ define_flag("FLAGS_serving_buckets", "",
             "serving shape-bucket grid, 'B1,B2,...' or 'B1,B2xS1,S2,...' "
             "(batch x sequence); '' = powers of two up to "
             "FLAGS_serving_max_batch, no sequence bucketing")
+# -- durable checkpointing (distributed/checkpoint.py) --------------------
+define_flag("FLAGS_ckpt_async", True,
+            "fit(resume=/fault_tolerant=) writes interval/epoch "
+            "checkpoints on a background thread (host snapshot on the "
+            "training thread, disk IO off it); False = synchronous saves")
+define_flag("FLAGS_ckpt_max_failures", 3,
+            "consecutive failed checkpoint generations tolerated before "
+            "fit aborts with resilience.DURABILITY_EXIT_CODE (degrade-"
+            "then-escalate: warn and keep training until then)")
 
 
 def set_flags(flags: dict[str, Any]):

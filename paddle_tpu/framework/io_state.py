@@ -2,7 +2,8 @@
 
 Reference parity: python/paddle/framework/io.py (save:200 / load:269).
 Tensors are stored as numpy arrays; nested dict/list structures round-trip.
-Sharded multi-host checkpoints live in paddle_tpu.utils.checkpoint (orbax).
+Sharded multi-host checkpoints live in paddle_tpu.distributed.checkpoint
+(durable manifest-verified format).
 """
 from __future__ import annotations
 

@@ -64,7 +64,19 @@ from ..nn.layer_base import functional_call
 from ..tensor import Tensor
 
 __all__ = ["TrainEngine", "build_pure_train_step", "host_fetch",
-           "in_host_fetch", "fetch_floats", "resolve_mesh"]
+           "in_host_fetch", "fetch_floats", "resolve_mesh", "mesh_meta"]
+
+
+def mesh_meta(mesh):
+    """JSON-serializable description of a mesh for checkpoint manifests:
+    the elastic-resume path reads it back to log the dp transition it is
+    performing (saved at dp=N → restoring onto dp=M)."""
+    if mesh is None:
+        return {"dp": 1, "devices": 1, "axes": {}}
+    axes = {str(name): int(size)
+            for name, size in zip(mesh.axis_names, mesh.devices.shape)}
+    return {"dp": int(axes.get("dp", 1)), "devices": int(mesh.size),
+            "axes": axes}
 
 
 def resolve_mesh(mesh=None):
@@ -552,10 +564,11 @@ class TrainEngine:
 
     def ft_state(self, it_count):
         """Checkpointable snapshot of the device-resident state,
-        MATERIALIZED to host numpy.  Materialization matters twice over:
-        orbax saves asynchronously, and the engine donates these exact
-        buffers on the next dispatch — handing orbax live device arrays
-        would race the donation."""
+        MATERIALIZED (copied) to host numpy.  The copy matters twice
+        over: the AsyncCheckpointer writes it to disk on a background
+        thread, and the engine donates these exact buffers on the next
+        dispatch — handing the writer live device arrays would race the
+        donation."""
         from ..distributed.resilience import materialize
 
         st = self.state
@@ -565,6 +578,68 @@ class TrainEngine:
                 "meta": {"it": np.asarray(it_count, np.int32),
                          "opt_steps": np.asarray(self._host_step,
                                                  np.int32)}}
+
+    def ft_restore_shardings(self, template):
+        """NamedSharding pytree mirroring an `ft_state`-shaped template,
+        built from THIS engine's resolved state shardings — the elastic
+        hook: a checkpoint saved at any dp degree device_puts straight
+        onto the CURRENT mesh's placements (params keep their rule/
+        dist_spec specs, everything else replicates).  None on a
+        single-device engine."""
+        if self._state_sharding is None:
+            return None
+        sh = self._state_sharding
+        rep = NamedSharding(self.mesh, PartitionSpec())
+
+        def expand(node, s):
+            # mirror the template's nesting; `s` may be a single
+            # sharding standing for a whole subtree (wrapper-optimizer
+            # slots) — broadcast it down
+            if isinstance(node, dict):
+                return {k: expand(v, s[k] if isinstance(s, dict)
+                                  and k in s else
+                                  (s if not isinstance(s, dict) else rep))
+                        for k, v in node.items()}
+            if isinstance(node, (list, tuple)):
+                items = [expand(v, s[i] if isinstance(s, (list, tuple))
+                                else s) for i, v in enumerate(node)]
+                return tuple(items) if isinstance(node, tuple) else items
+            return s if not isinstance(s, (dict, list, tuple)) else rep
+
+        return {
+            "params": expand(template["params"],
+                             {**sh["trainable"], **sh["frozen"]}),
+            "buffers": expand(template["buffers"], sh["buffers"]),
+            "opt": expand(template["opt"], sh["opt"]),
+            "meta": expand(template["meta"], rep),
+        }
+
+    def adopt_ft_state(self, snap):
+        """Install a restored checkpoint snapshot into the live
+        device-resident state (the elastic-resume landing): leaves are
+        already device_put onto this engine's shardings by the restore
+        (ft_restore_shardings), so the cached jitted step — whose
+        out_shardings are pinned to the in shardings — keeps hitting
+        without a retrace, and donation consumes the new buffers exactly
+        like the ones begin() created.  Reconciles the step counter both
+        on device (state['step']) and on host (_host_step /
+        optimizer._step_count); call write_back afterwards to sync the
+        Layer tree."""
+        st = self.state
+        for k, v in snap["params"].items():
+            tgt = "trainable" if k in st["trainable"] else "frozen"
+            st[tgt][k] = v
+        for k, v in snap["buffers"].items():
+            st["buffers"][k] = v
+        st["opt"] = snap["opt"]
+        opt_steps = int(snap["meta"]["opt_steps"])
+        step_dev = jnp.asarray(opt_steps, jnp.int32)
+        if self._state_sharding is not None:
+            step_dev = jax.device_put(step_dev,
+                                      self._state_sharding["step"])
+        st["step"] = step_dev
+        self._host_step = opt_steps
+        self.model._optimizer._step_count = opt_steps
 
     def finish(self):
         """Final write-back at fit() exit; deactivates the engine (the

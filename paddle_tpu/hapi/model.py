@@ -14,6 +14,7 @@ works between batches).
 """
 from __future__ import annotations
 
+import logging
 import os
 from typing import Any
 
@@ -29,6 +30,8 @@ from ..nn.layer_base import Layer, functional_call, state_pytrees
 from ..tensor import Tensor, unwrap
 from .engine import (TrainEngine, build_pure_train_step, fetch_floats,
                      host_fetch)
+
+logger = logging.getLogger("paddle_tpu.hapi")
 
 
 def _to_list(x):
@@ -164,29 +167,196 @@ class Model:
         the device-resident engine is live its state is authoritative
         (the Layer tree is only synced at epoch boundaries) and must be
         MATERIALIZED to host — the engine donates those buffers on the
-        next dispatch, which would race orbax's async save."""
+        next dispatch, which would race an async save.  This host copy
+        IS the async checkpointer's double buffer: it happens on the
+        training thread, the disk write does not."""
         eng = self._engine
         if eng is not None and eng.active:
-            return eng.ft_state(it_count)
-        trainable, _frozen, buffers = self._split_params()
-        opt_state = getattr(self, "_opt_state", None)
-        if opt_state is None:
-            opt_state = self._optimizer.init_pytree(trainable)
-        return {"params": trainable, "buffers": buffers, "opt": opt_state,
-                "meta": {"it": jnp.int32(it_count),
-                         "opt_steps": jnp.int32(
-                             self._optimizer._step_count)}}
+            snap = eng.ft_state(it_count)
+        else:
+            trainable, _frozen, buffers = self._split_params()
+            opt_state = getattr(self, "_opt_state", None)
+            if opt_state is None:
+                opt_state = self._optimizer.init_pytree(trainable)
+            snap = {"params": trainable, "buffers": buffers,
+                    "opt": opt_state,
+                    "meta": {"it": jnp.int32(it_count),
+                             "opt_steps": jnp.int32(
+                                 self._optimizer._step_count)}}
+        sched = self._optimizer._lr_scheduler
+        if sched is not None:
+            # lr-schedule reconciliation on (elastic) resume: the
+            # scheduler's epoch counter travels with the checkpoint
+            snap["meta"]["lr_last_epoch"] = np.asarray(
+                int(sched.last_epoch), np.int32)
+        return snap
+
+    def _ft_template(self):
+        """Structure-only mirror of `_ft_state` (None leaves): restore
+        matches checkpoint leaves BY KEYPATH and takes dtype/shape from
+        the manifest, so the template never needs values — building it
+        from the live state would device→host copy the whole model just
+        to throw the bytes away."""
+        def none_of(tree):
+            return jax.tree_util.tree_map(lambda _: None, tree)
+        eng = self._engine
+        if eng is not None and eng.active:
+            st = eng.state
+            snap = {"params": {k: None for k in st["trainable"]},
+                    "buffers": {k: None for k in st["buffers"]},
+                    "opt": none_of(st["opt"])}
+        else:
+            trainable, _frozen, buffers = self._split_params()
+            opt_state = getattr(self, "_opt_state", None)
+            if opt_state is None:
+                opt_state = self._optimizer.init_pytree(trainable)
+            snap = {"params": {k: None for k in trainable},
+                    "buffers": {k: None for k in buffers},
+                    "opt": none_of(opt_state)}
+        snap["meta"] = {"it": None, "opt_steps": None}
+        if self._optimizer._lr_scheduler is not None:
+            snap["meta"]["lr_last_epoch"] = None
+        return snap
+
+    def _ft_save(self, mgr, saver, it_count, force=False, sync=False):
+        """One durable checkpoint of the current training state.  With
+        an AsyncCheckpointer the host snapshot is taken here (training
+        thread — donation makes that mandatory) and the write happens in
+        the background; emergency/final saves pass sync=True."""
+        from .engine import mesh_meta
+
+        eng = self._engine
+        meta = {"mesh": mesh_meta(eng.mesh if eng is not None else None)}
+        sched = self._optimizer._lr_scheduler
+        if sched is not None:
+            # full scheduler state rides in the (JSON) manifest: stateful
+            # schedulers like ReduceOnPlateau keep decision state
+            # (best/num_bad_epochs/last_lr) that a bare epoch counter
+            # cannot reconstruct
+            meta["lr_sched"] = sched.state_dict()
+        if saver is not None and not sync:
+            saver.submit(it_count, self._ft_state(it_count), force=force,
+                         meta=meta)
+        else:
+            skip_disk_write = False
+            if saver is not None:
+                # never race a background write of the same generation
+                # with a synchronous emergency save — but BOUND the
+                # wait: a writer stalled on a dead mount must not eat
+                # the whole SIGTERM grace window (the newest durable
+                # generation then stands as the recovery point)
+                if not saver.flush(timeout=30.0):
+                    logger.error(
+                        "emergency checkpoint skipped: background "
+                        "writer stalled >30s; resuming from the latest "
+                        "durable generation instead")
+                    if jax.process_count() == 1:
+                        return
+                    # multi-host: a stalled process returning here
+                    # while its peers (whose writers drained instantly
+                    # — non-writer saves are no-ops) proceed into
+                    # _ft_state's allgather would deadlock the pod.
+                    # Join the collective below, but do NOT touch the
+                    # manager: its lock is held by the stalled writer
+                    # and would block past the grace window.
+                    skip_disk_write = True
+            if sync and jax.process_count() == 1 \
+                    and mgr.latest_step() == it_count:
+                # this step is already durably committed (an interval
+                # save this same iteration, or the flushed async write
+                # above): a force-save would re-write the committed
+                # generation — spending the SIGTERM grace window on a
+                # duplicate.  Single-process only: latest_step reads
+                # shared storage, and on a multi-host pod a process
+                # skipping here while its peers enter _ft_state's
+                # allgather would deadlock the pod (the duplicate
+                # write is the cheaper failure mode).
+                return
+            snap = self._ft_state(it_count)
+            if skip_disk_write:
+                return
+            try:
+                mgr.save(it_count, snap, force=force, meta=meta)
+                self._ft_sync_failures = 0
+            except OSError as e:
+                # degrade-then-escalate for the SYNCHRONOUS path, the
+                # mirror of AsyncCheckpointer's policy: a failed
+                # generation must not crash fit with a raw OSError (the
+                # launcher would see a generic crash and burn restarts
+                # on a full disk) — warn, keep training, and let the
+                # fit loop escalate with the distinct durability code
+                # after K consecutive failures
+                if sync:
+                    # emergency save on the way to a preempted exit: the
+                    # newest durable generation is the recovery point,
+                    # and a failed save must never mask the distinct
+                    # preempted exit code
+                    logger.error(
+                        "emergency checkpoint failed (%s: %s) — the "
+                        "latest durable generation stands as the "
+                        "recovery point", type(e).__name__, e)
+                    return
+                self._ft_sync_failures += 1
+                logger.warning(
+                    "checkpoint generation %s failed (%s: %s) — "
+                    "training continues WITHOUT durability (%d/%d "
+                    "consecutive failures before escalation)", it_count,
+                    type(e).__name__, e, self._ft_sync_failures,
+                    self._ft_max_failures)
 
     def _ft_restore(self, mgr):
-        """Auto-resume: load the latest checkpoint (if any) back into the
-        live network/optimizer; returns the iteration to fast-forward to."""
-        step0, back = mgr.restore_latest(template=self._ft_state(0))
+        """Auto-resume from the newest VALID generation (the corruption
+        cascade lives in CheckpointManager.restore_latest).  When the
+        device-resident engine is live, the saved state is routed
+        through `restore(shardings=)` with the CURRENT mesh's
+        NamedShardings — a checkpoint saved at dp=N lands directly on a
+        dp=M mesh (elastic resume).  Returns the iteration to
+        fast-forward to."""
+        template = self._ft_template()
+        eng = self._engine if (self._engine is not None
+                               and self._engine.active) else None
+        shardings = (eng.ft_restore_shardings(template)
+                     if eng is not None else None)
+        step0, back = mgr.restore_latest(template=template,
+                                         shardings=shardings)
         if step0 is None:
             return 0
-        self._write_back(back["params"], back["buffers"])
-        self._opt_state = back["opt"]
-        self._optimizer._step_count = int(back["meta"]["opt_steps"])
+        if eng is not None:
+            eng.adopt_ft_state(back)
+            # Layer tree + model._opt_state follow the restored state
+            # (single-device de-shard), so callbacks/eval between epochs
+            # observe the resumed weights, not the fresh init
+            eng.write_back(copy=True)
+        else:
+            self._write_back(back["params"], back["buffers"])
+            self._opt_state = back["opt"]
+            self._optimizer._step_count = int(back["meta"]["opt_steps"])
+        sched = self._optimizer._lr_scheduler
+        man = mgr.last_restore_manifest or {}
+        sched_state = (man.get("meta") or {}).get("lr_sched")
+        if sched is not None and sched_state:
+            # full state from the manifest (covers stateful schedulers:
+            # ReduceOnPlateau's best/num_bad_epochs/last_lr survive)
+            sched.set_state_dict(sched_state)
+        elif sched is not None and "lr_last_epoch" in back["meta"]:
+            # older checkpoints: step(epoch=) rather than assigning
+            # last_epoch — it also recomputes last_lr, which __call__
+            # serves from cache; assignment alone would train at the
+            # fresh-init lr until the next scheduler step
+            sched.step(epoch=int(back["meta"]["lr_last_epoch"]))
         restart = os.environ.get("PADDLE_RESTART_COUNT", "0")
+        saved_mesh = (man.get("meta") or {}).get("mesh") or {}
+        saved_dp = saved_mesh.get("dp")
+        cur_dp = 1
+        if eng is not None and eng.mesh is not None:
+            from .engine import mesh_meta
+
+            cur_dp = mesh_meta(eng.mesh)["dp"]
+        if saved_dp is not None and int(saved_dp) != cur_dp:
+            print(f"fit: ELASTIC resume — checkpoint saved at "
+                  f"dp={saved_dp}, restoring onto dp={cur_dp} "
+                  f"(reconciled step={int(back['meta']['opt_steps'])})",
+                  flush=True)
         print(f"fit: resumed from checkpoint at iteration {step0} "
               f"(restart #{restart})", flush=True)
         return int(back["meta"]["it"])
@@ -246,12 +416,30 @@ class Model:
         # when a log step fires or a user callback might consume it
         user_cbs = any(not isinstance(c, (_PBCb, _LRCb, _CkptCb))
                        for c in cbks)
+        # Device-resident engine (hapi/engine.py): ONE state snapshot per
+        # fit, donated buffers, no per-step host sync.  When user
+        # callbacks or metrics need fresh per-batch values the loop
+        # drains the loss ring every step (same observable behavior as
+        # the old train_batch loop); otherwise losses are fetched in one
+        # batch at log_freq boundaries and epoch ends.  The engine
+        # begins BEFORE any checkpoint restore so an elastic resume can
+        # land the saved state directly on the resolved mesh.
+        from ..utils.profiler import StepTimers
+
+        if self._engine is None:
+            self._engine = TrainEngine(self)
+        engine = self._engine
+        engine.begin(mesh=mesh, sharding_rule=sharding_rule)
+
         ft_mgr = None
+        ft_saver = None
         start_it = 0
         guard = None
         if fault_tolerant or resume:  # resume=False/None/"" ⇒ off
+            from ..framework import flags as _fl
             from ..distributed import resilience as _res
-            from ..distributed.checkpoint import CheckpointManager
+            from ..distributed.checkpoint import (AsyncCheckpointer,
+                                                  CheckpointManager)
             from ..utils import chaos as _chaos
 
             ckpt_dir = resume if isinstance(resume, str) else save_dir
@@ -260,27 +448,32 @@ class Model:
                                  "directory: pass resume=<dir> or save_dir=")
             ckpt_dir = os.path.join(ckpt_dir, "resilient")
             ft_mgr = CheckpointManager(ckpt_dir, max_to_keep=2)
+            # degrade-then-escalate bookkeeping for the SYNC save path
+            # (FLAGS_ckpt_async=False); the async path's lives in the
+            # AsyncCheckpointer
+            self._ft_sync_failures = 0
+            self._ft_max_failures = int(
+                _fl.flag("FLAGS_ckpt_max_failures"))
             try:
                 start_it = self._ft_restore(ft_mgr)
+                if _fl.flag("FLAGS_ckpt_async"):
+                    # non-blocking durable saves: host snapshot on the
+                    # training thread, disk IO on a background writer
+                    ft_saver = AsyncCheckpointer(
+                        ft_mgr, max_failures=self._ft_max_failures)
                 if fault_tolerant:
                     guard = _res.PreemptionGuard()
                     guard.__enter__()
             except BaseException:
+                if ft_saver is not None:
+                    ft_saver.close()
                 ft_mgr.close()
                 raise
 
-        # Device-resident engine (hapi/engine.py): ONE state snapshot per
-        # fit, donated buffers, no per-step host sync.  When user
-        # callbacks or metrics need fresh per-batch values the loop
-        # drains the loss ring every step (same observable behavior as
-        # the old train_batch loop); otherwise losses are fetched in one
-        # batch at log_freq boundaries and epoch ends.
-        from ..utils.profiler import StepTimers
-
-        if self._engine is None:
-            self._engine = TrainEngine(self)
-        engine = self._engine
-        engine.begin(mesh=mesh, sharding_rule=sharding_rule)
+        # the placement hook goes on LAST: everything above can still
+        # raise (missing ckpt dir, restore errors), and an exception
+        # there must not leak a mesh-bound placement onto the user's
+        # DataLoader — only the main try/finally below restores it
         prev_placement = None
         if engine.mesh is not None:
             # the prefetch thread device-puts each global batch straight
@@ -298,6 +491,11 @@ class Model:
 
         history = {"loss": []}
         it_count = 0
+        # local completion sentinel — sys.exc_info() is THREAD-wide, so
+        # a caller running fit inside an `except` block would make it
+        # non-None for the whole call and silently disable every
+        # success-path-only branch in the finally below
+        fit_ok = False
         try:
             cbks.on_train_begin({})
             for epoch in range(epochs):
@@ -372,13 +570,23 @@ class Model:
                     if ft_mgr is not None:
                         if (checkpoint_interval
                                 and it_count % checkpoint_interval == 0):
-                            ft_mgr.save(it_count, self._ft_state(it_count))
+                            self._ft_save(ft_mgr, ft_saver, it_count)
+                        if ((ft_saver is not None and ft_saver.fatal)
+                                or self._ft_sync_failures
+                                >= max(1, self._ft_max_failures)):
+                            # degrade-then-escalate: K consecutive failed
+                            # generations means the job has been training
+                            # WITHOUT durability — abort with the
+                            # distinct code so the launcher alerts
+                            # instead of restarting blindly
+                            raise SystemExit(_res.DURABILITY_EXIT_CODE)
                         if guard is not None and guard.preempted:
-                            # in-flight batch done: emergency checkpoint,
-                            # then the distinct "preempted" exit so the
+                            # in-flight batch done: emergency checkpoint
+                            # (synchronous — we are about to exit), then
+                            # the distinct "preempted" exit so the
                             # launcher restarts us
-                            ft_mgr.save(it_count, self._ft_state(it_count),
-                                        force=True)
+                            self._ft_save(ft_mgr, ft_saver, it_count,
+                                          force=True, sync=True)
                             ft_mgr.wait()
                             raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                     if num_iters is not None and it_count >= num_iters:
@@ -391,8 +599,7 @@ class Model:
                 engine.write_back(copy=True)
                 if ft_mgr is not None and not checkpoint_interval \
                         and it_count > start_it:
-                    ft_mgr.save(it_count, self._ft_state(it_count),
-                                force=True)
+                    self._ft_save(ft_mgr, ft_saver, it_count, force=True)
                 # losses can be empty when resume fast-forwarded the epoch
                 history["loss"].append(
                     float(np.mean(losses)) if losses else float("nan"))
@@ -416,21 +623,21 @@ class Model:
                 if guard is not None and guard.preempted \
                         and epoch + 1 < epochs:
                     if it_count > start_it:
-                        ft_mgr.save(it_count, self._ft_state(it_count),
-                                    force=True)
+                        self._ft_save(ft_mgr, ft_saver, it_count,
+                                      force=True, sync=True)
                         ft_mgr.wait()
                     raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                 if self.stop_training:
                     break
                 if num_iters is not None and it_count >= num_iters:
                     break
+            fit_ok = True
         finally:
             # final write-back: the engine's device-resident state becomes
             # the Layer tree's state again (single source of truth for
             # train_batch/save/parameters after fit returns) — even when
             # fit is unwinding on an exception/preemption
-            import sys as _sys
-            if _sys.exc_info()[0] is None:
+            if fit_ok:
                 # success path: a failed final write-back means the Layer
                 # tree holds stale weights — that must surface, not pass
                 engine.finish()
@@ -445,9 +652,46 @@ class Model:
             cbks.on_train_end({})
             if guard is not None:
                 guard.__exit__(None, None, None)
+            if ft_saver is not None:
+                # drain the background writer so every submitted
+                # generation is durably on disk before fit returns —
+                # with a budget matched to HOW fit is exiting: patient
+                # on a clean return (a large final generation on a slow
+                # disk is a healthy write, not a stall), zero on a
+                # preemption unwind (the emergency save already spent
+                # its bounded 30s wait, and the SIGTERM grace window
+                # must reach the distinct exit code before SIGKILL),
+                # bounded on a crash unwind.  A drain that times out
+                # logs an error inside close() and the newest durable
+                # generation stands.
+                if fit_ok:
+                    drain_s = 300.0
+                elif guard is not None and guard.preempted:
+                    drain_s = 0.0
+                else:
+                    drain_s = 30.0
+                ft_saver.close(timeout=drain_s)
+                if ft_saver.fatal:
+                    logger.error(
+                        "fit: checkpoint durability was LOST during this "
+                        "run (%d consecutive failed generations; last: "
+                        "%s)", ft_saver.consecutive_failures,
+                        ft_saver.last_error)
             if ft_mgr is not None:
                 ft_mgr.wait()
                 ft_mgr.close()
+            durability_lost = (
+                (ft_saver is not None and ft_saver.fatal)
+                or (ft_mgr is not None and self._ft_sync_failures
+                    >= max(1, self._ft_max_failures)))
+            if durability_lost and fit_ok:
+                # the K-th consecutive failure can land during the final
+                # drain (async) or the epoch-end save (sync), after the
+                # in-loop check: the run must STILL exit with the
+                # distinct durability code, not a clean 0 — but never
+                # mask an exception already unwinding (_res is bound
+                # whenever ft_mgr is)
+                raise SystemExit(_res.DURABILITY_EXIT_CODE)
         return history
 
     def _split_batch(self, batch):

@@ -380,7 +380,26 @@ class DataLoader:
         finally:
             gen.close()
 
-    def _produce(self):
+    def _epoch_batches(self):
+        """Materialize this epoch's batch indices ON THE CALLING THREAD.
+
+        The sampler draws its shuffle permutation from the framework RNG
+        chain, which is THREAD-LOCAL (framework/random.py): iterating
+        the sampler lazily inside the buffered-reader prefetch thread
+        would pull the permutation from that thread's own never-seeded
+        chain, so `paddle.seed()` silently stopped controlling shuffle
+        order (and buffered vs unbuffered loaders shuffled differently).
+        Drawing here — the consumer's thread, before the prefetch thread
+        exists — restores the seeded, thread-agnostic contract.
+
+        Only the framework's own BatchSampler (incl. subclasses) is
+        materialized this way: it is the sampler that draws from the
+        framework chain, and it is len-bounded by construction.  A
+        user-supplied batch_sampler may be generator-backed or infinite,
+        so it keeps its lazy streaming contract (see __iter__)."""
+        return [list(b) for b in self.batch_sampler]
+
+    def _produce(self, idx_batches=None):
         if self._iterable_mode:
             batch = []
             for item in self.dataset:
@@ -392,9 +411,14 @@ class DataLoader:
                 yield self.collate_fn(batch)
             return
         if self.num_workers > 0:
-            yield from self._produce_multiprocess()
+            # worker dispatch needs the full index list up front (round-
+            # robin + reorder) — same as before the RNG fix
+            yield from self._produce_multiprocess(
+                idx_batches if idx_batches is not None
+                else [list(b) for b in self.batch_sampler])
             return
-        for idx_batch in self.batch_sampler:
+        for idx_batch in (idx_batches if idx_batches is not None
+                          else self.batch_sampler):
             yield self.collate_fn([self.dataset[i] for i in idx_batch])
 
     def _pick_start_method(self):
@@ -456,7 +480,7 @@ class DataLoader:
         self._mp_start_cache = method
         return method
 
-    def _produce_multiprocess(self):
+    def _produce_multiprocess(self, idx_batches):
         """Multi-process map-style loading (reference:
         fluid/reader.py dataloader_iter.py _DataLoaderIterMultiProcess:478 —
         worker pool + result reordering).  Workers do numpy-only work
@@ -494,7 +518,7 @@ class DataLoader:
                 else:
                     os.environ[k] = v
         try:
-            batches = list(self.batch_sampler)
+            batches = idx_batches
             # dispatch round-robin, keep prefetch_factor per worker in flight
             next_send = 0
             max_inflight = self.num_workers * self.prefetch_factor
@@ -568,7 +592,22 @@ class DataLoader:
                     w.terminate()
 
     def __iter__(self):
-        gen = self._produce()
+        # sampler permutation drawn HERE (the thread CALLING iter(),
+        # i.e. the seeded consumer) — never lazily on the prefetch
+        # thread; see _epoch_batches.  A plain method (not a generator
+        # function) so the draw happens at iter() time, not deferred to
+        # the first next(), which a prefetch wrapper could run on an
+        # unseeded thread.  User-supplied batch_samplers stay lazy:
+        # they may be generator-backed/infinite, and they don't draw
+        # from the framework chain, so eager materialization would only
+        # break them without fixing anything.
+        idx_batches = (self._epoch_batches()
+                       if isinstance(self.batch_sampler, BatchSampler)
+                       else None)
+        return self._iter_impl(idx_batches)
+
+    def _iter_impl(self, idx_batches):
+        gen = self._produce(idx_batches)
         place = self.placement
         if place is not None:
             gen = self._placed(gen, place)

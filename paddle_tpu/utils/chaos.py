@@ -17,6 +17,19 @@ Two drive modes, composable:
         PADDLE_CHAOS_PREEMPT_STEP=N   SIGTERM ourselves at step N
         PADDLE_CHAOS_FAIL_IO=K        next K chaos-guarded IO calls
                                       raise OSError
+        PADDLE_CHAOS_CKPT_TORN=K      next K checkpoint commits die AFTER
+                                      the generation dir is renamed into
+                                      place but BEFORE the COMMIT marker
+                                      (a SIGKILL mid-save, in-process)
+        PADDLE_CHAOS_CKPT_BITFLIP=K   flip one bit in a payload file of
+                                      the next K COMMITTED generations
+                                      (silent at-rest corruption)
+        PADDLE_CHAOS_CKPT_ENOSPC=K    next K checkpoint saves raise
+                                      OSError(ENOSPC) — the persistent,
+                                      non-retryable errno class
+        PADDLE_CHAOS_CKPT_SLOW_IO=S   every checkpoint IO call stalls S
+                                      seconds while active (async-save
+                                      stall / overlap measurements)
   * `inject(...)` context manager — in-process unit tests push a chaos
     config for the duration of a `with` block.
 
@@ -28,7 +41,10 @@ what the rollback policy exists to survive).
 Runtime hook points (called by resilience.py / checkpoint.py):
     on_step(step)  -> bool   may raise/sleep/self-signal; True = poison
                              this step's loss with NaN
-    on_io(label)             may raise OSError (decrements the budget)
+    on_io(label, path=None)  may raise OSError/ChaosTorn, stall, or (for
+                             the bitflip injector, given a committed
+                             generation `path`) corrupt a payload file
+                             in place and return normally
 """
 from __future__ import annotations
 
@@ -41,8 +57,8 @@ import time
 
 logger = logging.getLogger("paddle_tpu.chaos")
 
-__all__ = ["ChaosCrash", "ChaosConfig", "inject", "on_step", "on_io",
-           "active_config", "reset"]
+__all__ = ["ChaosCrash", "ChaosTorn", "ChaosConfig", "inject", "on_step",
+           "on_io", "active_config", "reset"]
 
 
 class ChaosCrash(RuntimeError):
@@ -51,12 +67,24 @@ class ChaosCrash(RuntimeError):
     trainer like any unhandled exception would."""
 
 
+class ChaosTorn(RuntimeError):
+    """Raised by on_io('checkpoint.commit') for torn-write injection:
+    the save dies AFTER the generation directory landed on disk but
+    BEFORE its COMMIT marker was written — the in-process equivalent of
+    a SIGKILL between rename and marker.  Deliberately NOT an OSError:
+    the save path's transient-IO retry must not catch it and re-commit
+    the generation cleanly (that would erase the torn state the test —
+    and reality — just produced)."""
+
+
 class ChaosConfig:
-    """Mutable fault plan.  `fail_io` counts DOWN as faults fire."""
+    """Mutable fault plan.  `fail_io`/`ckpt_*` budgets count DOWN as
+    faults fire (except `ckpt_slow_io`, a stall applied while active)."""
 
     def __init__(self, crash_at_step=None, nan_at_step=None, slow_step=None,
                  slow_seconds=30.0, preempt_at_step=None, fail_io=0,
-                 io_error=None):
+                 io_error=None, ckpt_torn=0, ckpt_bitflip=0, ckpt_enospc=0,
+                 ckpt_slow_io=0.0):
         self.crash_at_step = crash_at_step
         # accept a single step or an iterable of steps
         if nan_at_step is None:
@@ -70,12 +98,18 @@ class ChaosConfig:
         self.fail_io = int(fail_io)
         self.io_error = io_error or OSError(
             "chaos: injected transient IO failure")
+        self.ckpt_torn = int(ckpt_torn)
+        self.ckpt_bitflip = int(ckpt_bitflip)
+        self.ckpt_enospc = int(ckpt_enospc)
+        self.ckpt_slow_io = float(ckpt_slow_io)
         self.fired: list[str] = []  # audit trail for tests
 
     def is_noop(self):
         return (self.crash_at_step is None and not self.nan_at_steps
                 and self.slow_step is None and self.preempt_at_step is None
-                and self.fail_io <= 0)
+                and self.fail_io <= 0 and self.ckpt_torn <= 0
+                and self.ckpt_bitflip <= 0 and self.ckpt_enospc <= 0
+                and self.ckpt_slow_io <= 0)
 
     @classmethod
     def from_env(cls, environ=None):
@@ -94,6 +128,10 @@ class ChaosConfig:
             slow_seconds=float(env.get("PADDLE_CHAOS_SLOW_SECONDS", "30")),
             preempt_at_step=_int("PADDLE_CHAOS_PREEMPT_STEP"),
             fail_io=_int("PADDLE_CHAOS_FAIL_IO") or 0,
+            ckpt_torn=_int("PADDLE_CHAOS_CKPT_TORN") or 0,
+            ckpt_bitflip=_int("PADDLE_CHAOS_CKPT_BITFLIP") or 0,
+            ckpt_enospc=_int("PADDLE_CHAOS_CKPT_ENOSPC") or 0,
+            ckpt_slow_io=float(env.get("PADDLE_CHAOS_CKPT_SLOW_IO", "0")),
         )
 
 
@@ -172,10 +210,74 @@ def on_step(step: int) -> bool:
     return False
 
 
-def on_io(label: str = "io"):
-    """IO-call hook (checkpoint save/restore etc).  While the fail-IO
-    budget is positive, each call decrements it and raises OSError."""
+def _flip_one_bit(gen_dir: str):
+    """Deterministic at-rest corruption: XOR one bit in the middle of
+    the first payload file (sorted order) of a committed generation."""
+    leaves_dir = os.path.join(gen_dir, "leaves")
+    root = leaves_dir if os.path.isdir(leaves_dir) else gen_dir
+    files = sorted(
+        f for f in os.listdir(root)
+        if os.path.isfile(os.path.join(root, f)) and f != "COMMIT")
+    if not files:
+        return None
+    target = os.path.join(root, files[0])
+    size = os.path.getsize(target)
+    if size == 0:
+        return None
+    offset = size // 2
+    with open(target, "r+b") as f:
+        f.seek(offset)
+        byte = f.read(1)
+        f.seek(offset)
+        f.write(bytes([byte[0] ^ 0x01]))
+    return target
+
+
+def on_io(label: str = "io", path: str = None):
+    """IO-call hook (checkpoint save/restore etc).
+
+    While the fail-IO budget is positive, each call decrements it and
+    raises OSError.  Checkpoint-specific injectors key on the label the
+    durable save protocol passes:
+
+      * ``checkpoint.save``      — ENOSPC budget raises the persistent
+        errno (never retried by the errno-split save path); slow-IO
+        stalls here too.
+      * ``checkpoint.commit``    — torn budget raises ChaosTorn after
+        the generation dir is in place but before its COMMIT marker.
+      * ``checkpoint.committed`` — bitflip budget corrupts one bit of a
+        payload file under `path` and returns normally (the save looks
+        like it succeeded — only the manifest crc can tell).
+    """
     cfg = active_config()
+    is_ckpt = label.startswith("checkpoint")
+    if is_ckpt and cfg.ckpt_slow_io > 0:
+        logger.warning("chaos: stalling IO call %r for %.2fs", label,
+                       cfg.ckpt_slow_io)
+        time.sleep(cfg.ckpt_slow_io)
+    if label == "checkpoint.commit" and cfg.ckpt_torn > 0:
+        cfg.ckpt_torn -= 1
+        cfg.fired.append(f"torn@{label}")
+        logger.warning("chaos: tearing checkpoint commit (%d more)",
+                       cfg.ckpt_torn)
+        raise ChaosTorn("chaos: injected torn write — generation left "
+                        "on disk without its COMMIT marker")
+    if label == "checkpoint.committed" and cfg.ckpt_bitflip > 0 and path:
+        cfg.ckpt_bitflip -= 1
+        flipped = _flip_one_bit(path)
+        cfg.fired.append(f"bitflip@{flipped or path}")
+        logger.warning("chaos: flipped one bit in %s (%d more)", flipped,
+                       cfg.ckpt_bitflip)
+        return
+    if label == "checkpoint.save" and cfg.ckpt_enospc > 0:
+        import errno as _errno
+
+        cfg.ckpt_enospc -= 1
+        cfg.fired.append(f"enospc@{label}")
+        logger.warning("chaos: injecting ENOSPC on %r (%d more)", label,
+                       cfg.ckpt_enospc)
+        raise OSError(_errno.ENOSPC,
+                      "chaos: injected ENOSPC (persistent IO failure)")
     if cfg.fail_io > 0:
         cfg.fail_io -= 1
         cfg.fired.append(f"io@{label}")

@@ -98,6 +98,10 @@ class TestConfigPlumbing:
             "PADDLE_CHAOS_SLOW_SECONDS": "1.5",
             "PADDLE_CHAOS_PREEMPT_STEP": "9",
             "PADDLE_CHAOS_FAIL_IO": "2",
+            "PADDLE_CHAOS_CKPT_TORN": "1",
+            "PADDLE_CHAOS_CKPT_BITFLIP": "2",
+            "PADDLE_CHAOS_CKPT_ENOSPC": "3",
+            "PADDLE_CHAOS_CKPT_SLOW_IO": "0.25",
         }
         cfg = chaos.ChaosConfig.from_env(env)
         assert cfg.crash_at_step == 7
@@ -105,6 +109,22 @@ class TestConfigPlumbing:
         assert cfg.slow_step == 4 and cfg.slow_seconds == 1.5
         assert cfg.preempt_at_step == 9
         assert cfg.fail_io == 2
+        assert cfg.ckpt_torn == 1 and cfg.ckpt_bitflip == 2
+        assert cfg.ckpt_enospc == 3 and cfg.ckpt_slow_io == 0.25
+        assert not cfg.is_noop()
+
+    def test_ckpt_injectors_are_checkpoint_scoped(self):
+        """The checkpoint injectors key on the durable-save protocol's
+        labels — generic IO calls pass through untouched."""
+        with chaos.inject(ckpt_enospc=1, ckpt_torn=1) as cfg:
+            chaos.on_io("some.other.io")       # no label match: passes
+            with pytest.raises(chaos.ChaosTorn):
+                chaos.on_io("checkpoint.commit")
+            with pytest.raises(OSError):
+                chaos.on_io("checkpoint.save")
+            chaos.on_io("checkpoint.save")     # budget exhausted
+            assert cfg.fired == ["torn@checkpoint.commit",
+                                 "enospc@checkpoint.save"]
 
     def test_empty_env_is_noop(self):
         cfg = chaos.ChaosConfig.from_env({})

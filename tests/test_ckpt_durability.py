@@ -1,0 +1,1063 @@
+"""Durable-checkpoint corruption matrix (distributed/checkpoint.py).
+
+Every way a checkpoint can rot on disk — torn write (SIGKILL between
+rename and COMMIT marker), bit-flip at rest, missing manifest, missing
+leaf, truncated leaf, ENOSPC at save time — crossed with every restore
+path: fresh `restore_latest`, mid-cascade (newest TWO generations bad),
+and all-generations-bad → clean `(None, None)` fresh start.  Plus the
+non-blocking AsyncCheckpointer (depth-1 newest-wins queue, degrade-then-
+escalate failure policy) and elastic resume (dp8-saved checkpoint onto a
+dp1 mesh) at both the checkpoint and the Model.fit level.
+
+All corruption is injected deterministically through the chaos layer
+(PADDLE_CHAOS_CKPT_TORN / _BITFLIP / _ENOSPC / _SLOW_IO) or direct file
+surgery — no mocks; the bytes on disk are really wrong.
+"""
+import errno
+import json
+import os
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed.checkpoint import (
+    COMMIT_NAME,
+    MANIFEST_NAME,
+    AsyncCheckpointer,
+    CheckpointCorruption,
+    CheckpointManager,
+    restore_sharded,
+    save_sharded,
+)
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.distributed.resilience import (
+    DURABILITY_EXIT_CODE,
+    is_transient_io_error,
+    retry_with_backoff,
+)
+from paddle_tpu.utils import chaos
+
+pytestmark = pytest.mark.chaos
+
+
+def _state(val: float):
+    return {"w": jnp.full((4, 4), float(val), jnp.float32),
+            "opt": {"m": jnp.full((4, 4), float(val) * 0.5, jnp.float32)},
+            "step": jnp.int32(int(val))}
+
+
+def _save_gens(mgr, vals):
+    for v in vals:
+        assert mgr.save(int(v), _state(v), force=True)
+
+
+def _gen_dir(mgr, step):
+    return os.path.join(mgr.directory, str(step))
+
+
+def _assert_restores(mgr, expect_step):
+    step, back = mgr.restore_latest(template=_state(0))
+    assert step == expect_step
+    np.testing.assert_array_equal(np.asarray(back["w"]),
+                                  np.full((4, 4), float(expect_step), "f"))
+    return back
+
+
+class TestAtomicCommitProtocol:
+    def test_generation_layout_and_manifest(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            mgr.save(3, _state(3), force=True,
+                     meta={"mesh": {"dp": 8, "devices": 8}})
+            gen = _gen_dir(mgr, 3)
+            assert os.path.exists(os.path.join(gen, COMMIT_NAME))
+            man = json.load(open(os.path.join(gen, MANIFEST_NAME)))
+            assert man["format"] == "paddle_tpu.ckpt.v1"
+            assert man["framework_version"] == paddle.__version__
+            assert man["meta"]["mesh"]["dp"] == 8
+            by_key = {e["key"]: e for e in man["leaves"]}
+            assert set(by_key) == {"/w", "/opt/m", "/step"}
+            e = by_key["/w"]
+            assert e["dtype"] == "float32" and e["shape"] == [4, 4]
+            raw = open(os.path.join(gen, e["file"]), "rb").read()
+            import zlib
+            assert (zlib.crc32(raw) & 0xFFFFFFFF) == e["crc32"]
+
+    def test_no_tmp_dirs_left_behind(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            assert not [n for n in os.listdir(mgr.directory)
+                        if n.startswith(".tmp-")]
+
+    def test_manifest_api(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            mgr.save(5, _state(5), force=True, meta={"note": "x"})
+            assert mgr.manifest(5)["meta"]["note"] == "x"
+            assert mgr.manifest(99) is None
+
+
+class TestCorruptionMatrix:
+    """Injector × restore-path grid.  Every bad generation must be
+    quarantined (with the true reason) and the cascade must land on the
+    newest VALID generation bitwise."""
+
+    def test_torn_write_chaos_cascades(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1])
+            with chaos.inject(ckpt_torn=1) as cfg:
+                with pytest.raises(chaos.ChaosTorn):
+                    mgr.save(2, _state(2), force=True)
+            assert cfg.fired == ["torn@checkpoint.commit"]
+            # the torn generation IS on disk — visible, but unmarked
+            assert os.path.isdir(_gen_dir(mgr, 2))
+            assert not os.path.exists(
+                os.path.join(_gen_dir(mgr, 2), COMMIT_NAME))
+            assert mgr.latest_step() == 1  # torn gen not "committed"
+            _assert_restores(mgr, 1)
+            names = [n for n, _ in mgr.quarantined()]
+            assert any(n.startswith("2.torn-write") for n in names), names
+
+    def test_bitflip_chaos_cascades(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1])
+            with chaos.inject(ckpt_bitflip=1) as cfg:
+                mgr.save(2, _state(2), force=True)  # "succeeds"
+            assert cfg.fired and cfg.fired[0].startswith("bitflip@")
+            assert mgr.latest_step() == 2  # committed — only crc knows
+            _assert_restores(mgr, 1)
+            assert any("crc-mismatch" in n for n, _ in mgr.quarantined())
+
+    def test_bitflip_direct_file_surgery(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            leaf = os.path.join(_gen_dir(mgr, 2), "leaves", "0.bin")
+            blob = bytearray(open(leaf, "rb").read())
+            blob[len(blob) // 2] ^= 0x10
+            open(leaf, "wb").write(bytes(blob))
+            _assert_restores(mgr, 1)
+
+    def test_missing_manifest_cascades(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            os.remove(os.path.join(_gen_dir(mgr, 2), MANIFEST_NAME))
+            _assert_restores(mgr, 1)
+            assert any("missing-manifest" in n
+                       for n, _ in mgr.quarantined())
+
+    def test_missing_leaf_cascades(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            os.remove(os.path.join(_gen_dir(mgr, 2), "leaves", "1.bin"))
+            _assert_restores(mgr, 1)
+            assert any("missing-leaf" in n for n, _ in mgr.quarantined())
+
+    def test_truncated_leaf_cascades(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            leaf = os.path.join(_gen_dir(mgr, 2), "leaves", "0.bin")
+            blob = open(leaf, "rb").read()
+            open(leaf, "wb").write(blob[:len(blob) // 2])
+            _assert_restores(mgr, 1)
+
+    def test_missing_commit_marker_cascades(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            os.remove(os.path.join(_gen_dir(mgr, 2), COMMIT_NAME))
+            _assert_restores(mgr, 1)
+
+    def test_mid_cascade_two_bad_generations(self, tmp_path):
+        """Newest gen torn AND second-newest bit-flipped: the cascade
+        walks through BOTH and lands on the oldest, still bounded by
+        max_to_keep."""
+        with CheckpointManager(str(tmp_path), max_to_keep=3) as mgr:
+            _save_gens(mgr, [1, 2, 3])
+            os.remove(os.path.join(_gen_dir(mgr, 3), COMMIT_NAME))
+            leaf = os.path.join(_gen_dir(mgr, 2), "leaves", "0.bin")
+            blob = bytearray(open(leaf, "rb").read())
+            blob[0] ^= 0xFF
+            open(leaf, "wb").write(bytes(blob))
+            _assert_restores(mgr, 1)
+            assert len(mgr.quarantined()) == 2
+
+    def test_all_generations_bad_fresh_start(self, tmp_path):
+        with CheckpointManager(str(tmp_path), max_to_keep=2) as mgr:
+            _save_gens(mgr, [1, 2])
+            for s in (1, 2):
+                os.remove(os.path.join(_gen_dir(mgr, s), COMMIT_NAME))
+            step, state = mgr.restore_latest(template=_state(0))
+            assert (step, state) == (None, None)
+            assert len(mgr.quarantined()) == 2
+            # the manager still works after total loss: a new save and
+            # restore round-trips (recovery, not a crash loop)
+            mgr.save(7, _state(7), force=True)
+            _assert_restores(mgr, 7)
+
+    def test_explicit_restore_raises_instead_of_cascading(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            os.remove(os.path.join(_gen_dir(mgr, 2), COMMIT_NAME))
+            with pytest.raises(CheckpointCorruption, match="torn-write"):
+                mgr.restore(2, template=_state(0))
+            # the explicit path must NOT quarantine behind the caller
+            assert os.path.isdir(_gen_dir(mgr, 2))
+
+    def test_quarantine_preserves_evidence(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            os.remove(os.path.join(_gen_dir(mgr, 2), MANIFEST_NAME))
+            mgr.restore_latest(template=_state(0))
+            (_, qpath), = [q for q in mgr.quarantined()]
+            # payload bytes still there for the post-mortem
+            assert os.path.exists(os.path.join(qpath, "leaves", "0.bin"))
+
+
+class TestErrnoSplit:
+    def test_classification(self):
+        assert is_transient_io_error(OSError(errno.EIO, "io"))
+        assert is_transient_io_error(OSError("gcs blip, no errno"))
+        assert is_transient_io_error(TimeoutError("slow"))  # OSError kin
+        assert not is_transient_io_error(OSError(errno.ENOSPC, "full"))
+        assert not is_transient_io_error(OSError(errno.EROFS, "ro"))
+        assert not is_transient_io_error(OSError(errno.EACCES, "perm"))
+        assert not is_transient_io_error(ValueError("not io at all"))
+
+    def test_save_does_not_retry_enospc(self, tmp_path):
+        """The satellite fix: ENOSPC escalates on the FIRST attempt —
+        were it retried like EIO, the second attempt would find the
+        chaos budget exhausted and 'succeed', masking the condition."""
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with chaos.inject(ckpt_enospc=1) as cfg:
+                with pytest.raises(OSError) as ei:
+                    mgr.save(1, _state(1), force=True)
+            assert ei.value.errno == errno.ENOSPC
+            assert cfg.fired == ["enospc@checkpoint.save"]
+            assert mgr.latest_step() is None
+
+    def test_save_still_retries_transient_once(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with chaos.inject(fail_io=1):
+                assert mgr.save(1, _state(1), force=True)
+            assert mgr.latest_step() == 1
+
+    def test_save_transient_retry_can_be_disabled(self, tmp_path):
+        """transient_retry=False hands the FIRST transient failure to
+        the caller: ResilientRunner owns its own backoff loop, and two
+        stacked retry layers would multiply the worst-case stall."""
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with chaos.inject(fail_io=1):
+                with pytest.raises(OSError):
+                    mgr.save(1, _state(1), force=True,
+                             transient_retry=False)
+            assert mgr.latest_step() is None
+
+    def test_retry_with_backoff_predicate_stops_immediately(self):
+        sleeps, calls = [], []
+
+        def always_enospc():
+            calls.append(1)
+            raise OSError(errno.ENOSPC, "full")
+
+        with pytest.raises(OSError):
+            retry_with_backoff(always_enospc, retries=5,
+                               should_retry=is_transient_io_error,
+                               sleep=sleeps.append)
+        assert len(calls) == 1 and sleeps == []
+
+
+class TestAsyncCheckpointer:
+    def test_submit_lands_durably(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with AsyncCheckpointer(mgr) as saver:
+                saver.submit(1, jax.tree_util.tree_map(np.asarray,
+                                                       _state(1)))
+                assert saver.flush(timeout=30)
+            assert mgr.latest_step() == 1
+            assert saver.saved_generations == 1
+
+    def test_submit_never_blocks_on_slow_io(self, tmp_path):
+        """The non-blocking contract: with every checkpoint IO stalled
+        0.4s, submit() still returns in microseconds — the stall lands
+        on the writer thread, not the training thread."""
+        host = jax.tree_util.tree_map(np.asarray, _state(1))
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with chaos.inject(ckpt_slow_io=0.4):
+                with AsyncCheckpointer(mgr) as saver:
+                    t0 = time.monotonic()
+                    saver.submit(1, host)
+                    elapsed = time.monotonic() - t0
+                    assert elapsed < 0.2, elapsed
+                    assert saver.flush(timeout=30)
+            assert mgr.latest_step() == 1
+
+    def test_newest_wins_depth_one(self, tmp_path):
+        """Three rapid submits against a stalled disk: the queue holds
+        ONE pending generation, intermediate ones are dropped, the
+        newest survives."""
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with chaos.inject(ckpt_slow_io=0.3):
+                with AsyncCheckpointer(mgr) as saver:
+                    for v in (1, 2, 3):
+                        saver.submit(v, jax.tree_util.tree_map(
+                            np.asarray, _state(v)))
+                    assert saver.flush(timeout=30)
+            assert saver.dropped >= 1
+            assert mgr.latest_step() == 3
+
+    def test_degrade_then_escalate(self, tmp_path):
+        """K consecutive failed generations flip .fatal and fire
+        on_fatal; a success in between resets the streak."""
+        fatal_errs = []
+        with CheckpointManager(str(tmp_path)) as mgr:
+            saver = AsyncCheckpointer(mgr, max_failures=2,
+                                      on_fatal=fatal_errs.append)
+            host = jax.tree_util.tree_map(np.asarray, _state(1))
+            with chaos.inject(ckpt_enospc=1):
+                saver.submit(1, host)
+                saver.flush(timeout=30)
+            assert saver.consecutive_failures == 1 and not saver.fatal
+            # success resets the streak (degrade, not escalate)
+            saver.submit(2, host)
+            saver.flush(timeout=30)
+            assert saver.consecutive_failures == 0
+            with chaos.inject(ckpt_enospc=4):
+                saver.submit(3, host)
+                saver.flush(timeout=30)
+                saver.submit(4, host)
+                saver.flush(timeout=30)
+            assert saver.fatal
+            assert fatal_errs and fatal_errs[0].errno == errno.ENOSPC
+            # post-fatal submits are refused, not buffered
+            assert saver.submit(5, host) is False
+            saver.close()
+
+
+def _model_and_data(n=32):
+    paddle.seed(0)
+    net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                               paddle.nn.ReLU(),
+                               paddle.nn.Linear(8, 2))
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 4).astype("float32")
+    y = (x.sum(1) > 0).astype("int64")
+    ds = paddle.io.TensorDataset([paddle.to_tensor(x),
+                                  paddle.to_tensor(y)])
+    from paddle_tpu.hapi import Model
+
+    model = Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=0.01,
+                              parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss())
+    return model, ds
+
+
+def _weights(model):
+    return {k: np.asarray(p._value)
+            for k, p in model.network.named_parameters()}
+
+
+class TestFitDurability:
+    def test_fit_escalates_after_k_failed_generations(self, tmp_path):
+        """Training itself stays healthy while saves fail (degrade);
+        after FLAGS_ckpt_max_failures consecutive failed generations fit
+        aborts with the distinct durability exit code so the launcher
+        can alert."""
+        model, ds = _model_and_data()
+        with chaos.inject(ckpt_enospc=99):
+            with pytest.raises(SystemExit) as ei:
+                model.fit(ds, batch_size=8, epochs=8, shuffle=False,
+                          verbose=0, resume=str(tmp_path),
+                          checkpoint_interval=1)
+        assert ei.value.code == DURABILITY_EXIT_CODE
+
+    def test_fit_escalates_sync_path(self, tmp_path):
+        """Degrade-then-escalate holds with SYNCHRONOUS saves too
+        (FLAGS_ckpt_async=False): failed generations warn and training
+        continues, the K-th consecutive failure exits with the
+        durability code — never a raw OSError out of fit (the launcher
+        would treat that as a crash and burn restarts on a full
+        disk)."""
+        paddle.set_flags({"FLAGS_ckpt_async": False})
+        try:
+            model, ds = _model_and_data()
+            with chaos.inject(ckpt_enospc=99):
+                with pytest.raises(SystemExit) as ei:
+                    model.fit(ds, batch_size=8, epochs=8, shuffle=False,
+                              verbose=0, resume=str(tmp_path),
+                              checkpoint_interval=1)
+            assert ei.value.code == DURABILITY_EXIT_CODE
+        finally:
+            paddle.set_flags({"FLAGS_ckpt_async": True})
+
+    def test_max_failures_zero_does_not_spuriously_escalate(self,
+                                                            tmp_path):
+        """FLAGS_ckpt_max_failures=0 (zero tolerance) must still mean
+        'escalate on the first FAILURE' — not 'exit 91 with zero
+        failures on the first healthy batch' (0 >= 0)."""
+        paddle.set_flags({"FLAGS_ckpt_max_failures": 0})
+        try:
+            model, ds = _model_and_data()
+            model.fit(ds, batch_size=8, epochs=1, shuffle=False,
+                      verbose=0, resume=str(tmp_path),
+                      checkpoint_interval=1)
+        finally:
+            paddle.set_flags({"FLAGS_ckpt_max_failures": 3})
+        with CheckpointManager(os.path.join(str(tmp_path),
+                                            "resilient")) as mgr:
+            assert mgr.latest_step() == 4
+
+    def test_fit_inside_exception_handler_completes(self, tmp_path):
+        """sys.exc_info() is THREAD-wide, not frame-local: a caller
+        retry loop (`except: model.fit(...)`) must not silently disable
+        fit's success-path finally branches (final write-back,
+        durability escalation)."""
+        model, ds = _model_and_data()
+        try:
+            raise RuntimeError("ambient exception in the caller")
+        except RuntimeError:
+            h = model.fit(ds, batch_size=8, epochs=1, shuffle=False,
+                          verbose=0, resume=str(tmp_path),
+                          checkpoint_interval=2)
+        assert len(h["loss"]) == 1
+        with CheckpointManager(os.path.join(str(tmp_path),
+                                            "resilient")) as mgr:
+            assert mgr.latest_step() == 4
+
+    def test_preempted_exit_survives_failed_emergency_save(self,
+                                                           tmp_path):
+        """A failed emergency checkpoint (disk died after the last
+        durable generation) must not mask the preempted exit code: the
+        launcher still sees exit 75 and restarts, resuming from the
+        newest durable generation."""
+        from paddle_tpu.distributed.resilience import PREEMPTED_EXIT_CODE
+
+        model, ds = _model_and_data()
+        with chaos.inject(preempt_at_step=2, ckpt_enospc=99):
+            with pytest.raises(SystemExit) as ei:
+                model.fit(ds, batch_size=8, epochs=4, shuffle=False,
+                          verbose=0, fault_tolerant=True,
+                          resume=str(tmp_path))
+        assert ei.value.code == PREEMPTED_EXIT_CODE
+
+    def test_emergency_save_skips_already_durable_generation(
+            self, tmp_path, monkeypatch):
+        """Preemption landing on the same iteration as an interval save
+        must NOT force-rewrite the just-committed generation: the
+        rewrite would spend SIGTERM-grace-window time on a duplicate
+        write while transiently TEARING the very generation that is the
+        recovery point (force = rmtree-then-rewrite)."""
+        import paddle_tpu.distributed.checkpoint as ckpt
+        from paddle_tpu.distributed.resilience import PREEMPTED_EXIT_CODE
+
+        writes = []
+        real = ckpt._write_generation
+
+        def counting(final_dir, state, meta=None, step=None):
+            writes.append(os.path.basename(final_dir))
+            return real(final_dir, state, meta=meta, step=step)
+
+        monkeypatch.setattr(ckpt, "_write_generation", counting)
+        model, ds = _model_and_data()
+        with chaos.inject(preempt_at_step=2):
+            with pytest.raises(SystemExit) as ei:
+                model.fit(ds, batch_size=8, epochs=2, shuffle=False,
+                          verbose=0, fault_tolerant=True,
+                          resume=str(tmp_path), checkpoint_interval=2)
+        assert ei.value.code == PREEMPTED_EXIT_CODE
+        assert writes.count("2") == 1  # interval save only, no rewrite
+        with CheckpointManager(os.path.join(str(tmp_path),
+                                            "resilient")) as mgr:
+            assert mgr.latest_step() == 2
+
+    def test_fit_resumes_through_corrupted_latest(self, tmp_path):
+        """End-to-end cascade: phase 1 checkpoints at iterations 4 and
+        8; the newest generation is torn (COMMIT removed); a fresh
+        process-equivalent resume quarantines it, restores iteration 4,
+        replays, and ends bitwise-identical to the uninterrupted run."""
+        ma, ds = _model_and_data()
+        ma.fit(ds, batch_size=8, epochs=4, shuffle=False, verbose=0)
+        ref = _weights(ma)
+
+        mb, ds = _model_and_data()
+        mb.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               resume=str(tmp_path), checkpoint_interval=4)
+        ckdir = os.path.join(str(tmp_path), "resilient")
+        with CheckpointManager(ckdir) as mgr:
+            assert mgr.latest_step() == 8
+        os.remove(os.path.join(ckdir, "8", COMMIT_NAME))
+
+        mc, ds = _model_and_data()
+        mc.fit(ds, batch_size=8, epochs=4, shuffle=False, verbose=0,
+               resume=str(tmp_path), checkpoint_interval=4)
+        got = _weights(mc)
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k], err_msg=k)
+        with CheckpointManager(ckdir) as mgr:
+            assert any(n.startswith("8.torn-write")
+                       for n, _ in mgr.quarantined())
+
+    def test_fit_async_saves_match_sync_bitwise(self, tmp_path):
+        """FLAGS_ckpt_async must be invisible to training numerics: the
+        same run with background and synchronous saves produces
+        bitwise-identical checkpoints."""
+        import paddle_tpu.framework.flags as fl
+
+        ma, ds = _model_and_data()
+        ma.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               resume=str(tmp_path / "async"))
+        paddle.set_flags({"FLAGS_ckpt_async": False})
+        try:
+            mb, ds = _model_and_data()
+            mb.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+                   resume=str(tmp_path / "sync"))
+        finally:
+            paddle.set_flags({"FLAGS_ckpt_async": True})
+        wa, wb = _weights(ma), _weights(mb)
+        for k in wa:
+            np.testing.assert_array_equal(wa[k], wb[k], err_msg=k)
+        for sub in ("async", "sync"):
+            with CheckpointManager(os.path.join(str(tmp_path), sub,
+                                                "resilient")) as mgr:
+                assert mgr.latest_step() == 8
+
+
+@pytest.mark.dp
+class TestElasticResume:
+    """dp-degree elasticity: a checkpoint saved on a dp=8 mesh restores
+    and continues on dp=1 (and vice versa)."""
+
+    def test_manager_level_reshard(self, tmp_path):
+        mesh8 = build_mesh({"dp": 8})
+        w = jnp.arange(64.0, dtype=jnp.float32).reshape(8, 8)
+        state = {"w": jax.device_put(w, NamedSharding(mesh8, P("dp")))}
+        with CheckpointManager(str(tmp_path)) as mgr:
+            mgr.save(1, state, force=True,
+                     meta={"mesh": {"dp": 8, "devices": 8}})
+            mesh4 = build_mesh({"dp": 4}, devices=jax.devices()[:4])
+            sh = {"w": NamedSharding(mesh4, P("dp"))}
+            step, back = mgr.restore_latest(template={"w": w},
+                                            shardings=sh)
+        assert step == 1
+        assert back["w"].sharding == sh["w"]
+        np.testing.assert_array_equal(np.asarray(back["w"]),
+                                      np.asarray(w))
+        assert mgr.last_restore_manifest["meta"]["mesh"]["dp"] == 8
+
+    def test_dp8_save_dp1_restore_bitwise_at_restore_point(self, tmp_path,
+                                                           capsys):
+        """The restore itself is lossless across meshes: weights right
+        after a dp8→dp1 elastic resume equal the dp8-saved weights
+        bit for bit."""
+        ma, ds = _model_and_data()
+        ma.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               mesh={"dp": 8}, resume=str(tmp_path))
+        w8 = _weights(ma)
+
+        mb, ds = _model_and_data()
+        mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               mesh={"dp": 1}, resume=str(tmp_path))
+        out = capsys.readouterr().out
+        assert "ELASTIC resume" in out and "dp=8" in out
+        got = _weights(mb)
+        for k in w8:
+            np.testing.assert_array_equal(got[k], w8[k], err_msg=k)
+
+    def test_dp8_save_dp1_continue_training_ulp(self, tmp_path):
+        """Continued training after the elastic restore agrees with a
+        dp1-throughout run to f32 ULP (PR 4's documented reassociation
+        bound — XLA re-associates batch reductions across dp degrees,
+        so bitwise equality across dp is unattainable by construction)."""
+        ma, ds = _model_and_data()
+        ma.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               mesh={"dp": 1})
+        ref = _weights(ma)
+
+        mb, ds = _model_and_data()
+        mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               mesh={"dp": 8}, resume=str(tmp_path))
+        mc, ds = _model_and_data()
+        mc.fit(ds, batch_size=8, epochs=2, shuffle=False, verbose=0,
+               mesh={"dp": 1}, resume=str(tmp_path))
+        got = _weights(mc)
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+    def test_dp1_save_dp8_restore(self, tmp_path, capsys):
+        """Elasticity is symmetric: scale UP from dp1 to dp8 too."""
+        ma, ds = _model_and_data()
+        ma.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               resume=str(tmp_path))
+        w1 = _weights(ma)
+
+        mb, ds = _model_and_data()
+        mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               mesh={"dp": 8}, resume=str(tmp_path))
+        out = capsys.readouterr().out
+        assert "ELASTIC resume" in out
+        got = _weights(mb)
+        for k in w1:
+            np.testing.assert_array_equal(got[k], w1[k], err_msg=k)
+
+
+class TestReviewHardening:
+    """Regressions pinned after review: template drift must not
+    quarantine valid bytes, legacy orbax generations must still resume,
+    the lr schedule must be LIVE after resume, the launcher must not
+    burn restarts on durability loss, and a failed fit-setup must not
+    leak the mesh placement hook onto the user's DataLoader."""
+
+    def test_template_mismatch_propagates_without_quarantine(self,
+                                                             tmp_path):
+        from paddle_tpu.distributed.checkpoint import (
+            CheckpointTemplateMismatch)
+
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1])
+            bad_template = dict(_state(0), extra=jnp.zeros((2,)))
+            with pytest.raises(CheckpointTemplateMismatch,
+                               match="absent from checkpoint"):
+                mgr.restore_latest(template=bad_template)
+            # the intact generation is STILL there, not quarantined
+            assert mgr.latest_step() == 1
+            assert mgr.quarantined() == []
+            _assert_restores(mgr, 1)
+
+    def test_restore_sharded_without_template_applies_shardings(
+            self, tmp_path):
+        """The template-less restore path must honor `shardings` — the
+        docstring sells it as the elastic-resume routing with no
+        template requirement, so silently landing everything on the
+        default device would be a lie with an OOM attached."""
+        mesh = build_mesh({"dp": jax.device_count()})
+        path = str(tmp_path / "gen")
+        state = {"w": jnp.arange(16, dtype=jnp.float32).reshape(8, 2),
+                 "b": jnp.float32(7)}
+        save_sharded(state, path)
+        sh = {"w": NamedSharding(mesh, P("dp")),
+              "b": NamedSharding(mesh, P())}
+        back = restore_sharded(path, shardings=sh)
+        assert back["w"].sharding == sh["w"]
+        assert back["b"].sharding == sh["b"]
+        np.testing.assert_array_equal(
+            np.asarray(back["w"]),
+            np.arange(16, dtype="f").reshape(8, 2))
+
+    def test_restore_sharded_missing_manifest_is_corruption(
+            self, tmp_path):
+        """A generation with native artifacts (COMMIT, leaves/) but no
+        manifest is corrupted-NATIVE, not legacy orbax: the functional
+        API must raise the designed CheckpointCorruption, not hand the
+        dir to orbax for an opaque format error."""
+        path = str(tmp_path / "gen")
+        save_sharded(_state(3), path)
+        os.remove(os.path.join(path, MANIFEST_NAME))
+        with pytest.raises(CheckpointCorruption, match="missing-manifest"):
+            restore_sharded(path, template=_state(0))
+
+    def test_save_rejects_colliding_keypaths(self, tmp_path):
+        """A dict key containing '/' can flatten to the same keypath as
+        genuine nesting; restoring such a manifest would silently hand
+        both slots the same bytes — the save must fail loudly."""
+        state = {"a": {"b": jnp.ones((2,), jnp.float32)},
+                 "a/b": jnp.zeros((2,), jnp.float32)}
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with pytest.raises(ValueError, match="colliding"):
+                mgr.save(1, state, force=True)
+            assert mgr.latest_step() is None
+
+    def test_save_rejects_object_dtype_leaves(self, tmp_path):
+        """np.asarray(None).tobytes() would 'save' 8 pointer bytes the
+        manifest faithfully crcs — verification passes forever, restore
+        ALWAYS fails (frombuffer cannot build object arrays).  Reject at
+        save time, where the caller can still see why."""
+        state = {"w": jnp.ones((2,), jnp.float32), "rng": None}
+        with CheckpointManager(str(tmp_path)) as mgr:
+            with pytest.raises(ValueError, match="object dtype"):
+                mgr.save(1, state, force=True)
+            assert mgr.latest_step() is None
+
+    def test_read_error_cascades_without_quarantine(self, tmp_path,
+                                                    monkeypatch):
+        """An OSError READING a verified generation's payload (EIO
+        blip, a leaf vanishing between verify's stat and the open) must
+        cascade past the generation — never crash auto-resume into the
+        launcher's restart budget — and must NOT quarantine bytes
+        nothing proved bad."""
+        import paddle_tpu.distributed.checkpoint as ckpt
+
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            real = ckpt._read_leaf
+
+            def flaky(gen_dir, entry):
+                if gen_dir.endswith(os.sep + "2"):
+                    raise OSError(errno.EIO, "injected read blip")
+                return real(gen_dir, entry)
+
+            monkeypatch.setattr(ckpt, "_read_leaf", flaky)
+            _assert_restores(mgr, 1)
+            assert mgr.quarantined() == []
+            assert os.path.exists(os.path.join(_gen_dir(mgr, 2),
+                                               COMMIT_NAME))
+
+    def test_async_close_timeout_logs_loudly(self, tmp_path, caplog):
+        """AsyncCheckpointer.close() abandoning an undrained write must
+        say so — silently dropping the newest generation while fit's
+        comment promises durability would be the worst kind of lie."""
+        import logging
+
+        with CheckpointManager(str(tmp_path)) as mgr:
+            saver = AsyncCheckpointer(mgr)
+            with chaos.inject(ckpt_slow_io=2.0):
+                saver.submit(1, _state(1), force=True)
+                time.sleep(0.1)  # let the writer pick the job up
+                with caplog.at_level(logging.ERROR,
+                                     logger="paddle_tpu.checkpoint"):
+                    saver.close(timeout=0.2)
+        assert "not drained" in caplog.text
+
+    def test_legacy_orbax_generation_restores(self, tmp_path):
+        import orbax.checkpoint as ocp
+
+        state = {"w": jnp.full((3,), 9.0, jnp.float32)}
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(str(tmp_path), "4"), state)
+        ckptr.wait_until_finished()
+        with CheckpointManager(str(tmp_path)) as mgr:
+            step, back = mgr.restore_latest(template=state)
+            assert step == 4
+            np.testing.assert_array_equal(np.asarray(back["w"]),
+                                          np.full(3, 9.0, "f"))
+            assert mgr.quarantined() == []
+
+    def test_legacy_orbax_with_structure_only_template(self, tmp_path):
+        """The fit resume path passes a None-leaf template; jax.tree.map
+        treats None as an EMPTY pytree, so a naive orbax fallback would
+        silently echo the Nones back as the 'restored' state — the
+        fallback must restore the REAL arrays instead."""
+        import orbax.checkpoint as ocp
+
+        state = {"params": {"w": jnp.full((3,), 9.0, jnp.float32)},
+                 "meta": {"it": jnp.int32(4)}}
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(os.path.join(str(tmp_path), "4"), state)
+        ckptr.wait_until_finished()
+        with CheckpointManager(str(tmp_path)) as mgr:
+            step, back = mgr.restore_latest(
+                template={"params": {"w": None}, "meta": {"it": None}})
+            assert step == 4
+            assert back["params"]["w"] is not None
+            np.testing.assert_array_equal(np.asarray(back["params"]["w"]),
+                                          np.full(3, 9.0, "f"))
+            assert int(np.asarray(back["meta"]["it"])) == 4
+
+    def test_reduce_on_plateau_state_survives_resume(self, tmp_path):
+        """ReduceOnPlateau's decision state (best / num_bad_epochs /
+        the already-reduced last_lr) rides in the manifest meta —
+        step(epoch=) alone is a silent no-op for it."""
+        from paddle_tpu.optimizer.lr import ReduceOnPlateau
+
+        def build(sched):
+            paddle.seed(0)
+            net = paddle.nn.Sequential(paddle.nn.Linear(4, 2))
+            from paddle_tpu.hapi import Model
+            m = Model(net)
+            m.prepare(paddle.optimizer.Adam(
+                learning_rate=sched, parameters=net.parameters()),
+                paddle.nn.CrossEntropyLoss())
+            return m
+
+        rs = np.random.RandomState(0)
+        ds = paddle.io.TensorDataset(
+            [paddle.to_tensor(rs.randn(16, 4).astype("float32")),
+             paddle.to_tensor(rs.randint(0, 2, (16,)).astype("int64"))])
+
+        sa = ReduceOnPlateau(learning_rate=0.1, patience=0)
+        # drive the plateau logic: two non-improving metrics cut the lr
+        sa.step(metrics=1.0)
+        sa.step(metrics=2.0)
+        sa.step(metrics=3.0)
+        assert sa.last_lr < 0.1
+        ma = build(sa)
+        ma.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+               resume=str(tmp_path))
+
+        sb = ReduceOnPlateau(learning_rate=0.1, patience=0)
+        mb = build(sb)
+        from paddle_tpu.hapi.engine import TrainEngine
+        mb._engine = TrainEngine(mb).begin()
+        with CheckpointManager(os.path.join(str(tmp_path),
+                                            "resilient")) as mgr:
+            mb._ft_restore(mgr)
+        assert sb.last_lr == pytest.approx(sa.last_lr)
+        assert sb.best == pytest.approx(sa.best)
+
+    def test_lr_schedule_live_after_resume(self, tmp_path):
+        """sched.step(epoch=) on restore recomputes last_lr: the
+        resumed optimizer serves the epoch-N lr immediately, not the
+        fresh-init lr."""
+        def build(lr_sched):
+            paddle.seed(0)
+            net = paddle.nn.Sequential(paddle.nn.Linear(4, 4))
+            from paddle_tpu.hapi import Model
+            m = Model(net)
+            m.prepare(paddle.optimizer.Adam(
+                learning_rate=lr_sched, parameters=net.parameters()),
+                paddle.nn.CrossEntropyLoss())
+            return m
+
+        rs = np.random.RandomState(0)
+        ds = paddle.io.TensorDataset(
+            [paddle.to_tensor(rs.randn(16, 4).astype("float32")),
+             paddle.to_tensor(rs.randint(0, 2, (16,)).astype("int64"))])
+
+        from paddle_tpu.optimizer.lr import StepDecay
+        from paddle_tpu.hapi.callbacks import LRScheduler as LRCb
+
+        ma = build(StepDecay(learning_rate=0.1, step_size=1, gamma=0.5))
+        ma.fit(ds, batch_size=8, epochs=3, shuffle=False, verbose=0,
+               resume=str(tmp_path), callbacks=[LRCb()])
+        lr_after = ma._optimizer.get_lr()
+
+        mb = build(StepDecay(learning_rate=0.1, step_size=1, gamma=0.5))
+        mb._engine = None
+        from paddle_tpu.hapi.engine import TrainEngine
+        mb._engine = TrainEngine(mb).begin()
+        with CheckpointManager(os.path.join(str(tmp_path),
+                                            "resilient")) as mgr:
+            mb._ft_restore(mgr)
+        assert mb._optimizer.get_lr() == pytest.approx(lr_after)
+
+    def test_launcher_does_not_restart_on_durability_exit(self, tmp_path):
+        import subprocess
+        import sys
+        import textwrap
+
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        script = tmp_path / "lost.py"
+        script.write_text(textwrap.dedent(f"""
+            import sys
+            sys.exit({DURABILITY_EXIT_CODE})
+        """))
+        env = dict(os.environ, PYTHONPATH=repo, JAX_PLATFORMS="cpu")
+        r = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.distributed.launch",
+             "--nproc_per_node=1", "--max_restarts=3",
+             "--restart_backoff=0.05", str(script)],
+            env=env, capture_output=True, text=True, timeout=120)
+        assert r.returncode != 0
+        assert "lost checkpoint durability" in r.stderr
+        assert "restart 1/3" not in r.stderr  # budget untouched
+
+    def test_failed_ft_setup_does_not_leak_placement(self, tmp_path):
+        from paddle_tpu.io import DataLoader
+
+        model, ds = _model_and_data()
+        loader = DataLoader(ds, batch_size=8, shuffle=False)
+        with pytest.raises(ValueError, match="directory"):
+            model.fit(loader, epochs=1, verbose=0, mesh={"dp": 8},
+                      fault_tolerant=True)  # no dir -> raises in setup
+        assert loader.placement is None
+
+
+class TestSecondReviewHardening:
+    """Regressions pinned after the second review pass: shared-path
+    mutations are writer-only, the close() drain budget is honored for
+    stalled writers, mixed-type dict keys reach the requires-template
+    fallback instead of a TypeError, and legacy orbax generations are
+    reclaimed once native coverage fills the retention window."""
+
+    def test_non_writer_process_never_quarantines(self, tmp_path):
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1, 2])
+            # tear generation 2 the way a racing writer mid-save looks
+            os.remove(os.path.join(_gen_dir(mgr, 2), "COMMIT"))
+            mgr._is_writer_process = False
+            _assert_restores(mgr, 1)  # cascades past the torn gen
+            # ...but the shared dir was NOT mutated out from under the
+            # writer process that owns it
+            assert os.path.isdir(_gen_dir(mgr, 2))
+            assert mgr.quarantined() == []
+
+    def test_close_skips_join_when_drain_budget_blown(self, tmp_path):
+        import threading
+        import time
+
+        from paddle_tpu.distributed.checkpoint import AsyncCheckpointer
+
+        release = threading.Event()
+
+        class StallingMgr(CheckpointManager):
+            def save(self, *a, **kw):
+                release.wait(timeout=30.0)
+                return super().save(*a, **kw)
+
+        with StallingMgr(str(tmp_path)) as mgr:
+            saver = AsyncCheckpointer(mgr)
+            saver.submit(1, {"w": np.ones((2,), np.float32)})
+            t0 = time.monotonic()
+            saver.close(timeout=0.0)  # the preemption path's budget
+            elapsed = time.monotonic() - t0
+            release.set()
+            assert elapsed < 2.0, (
+                f"close() with a zero drain budget blocked {elapsed:.1f}s "
+                "joining a stalled writer")
+
+    def test_mixed_type_dict_keys_roundtrip_with_template(self, tmp_path):
+        state = {"w": np.ones((3,), np.float32),
+                 0: np.zeros((2,), np.float32)}
+        with CheckpointManager(str(tmp_path)) as mgr:
+            # assume_host: the async-writer path, which skips jax's own
+            # (also mixed-key-intolerant) pytree sort in _host_view
+            assert mgr.save(1, state, force=True, assume_host=True)
+            step, back = mgr.restore_latest(template={"w": None, 0: None})
+        assert step == 1
+        np.testing.assert_array_equal(back["w"], state["w"])
+        np.testing.assert_array_equal(back[0], state[0])
+
+    def test_legacy_generation_pruned_after_native_window_fills(
+            self, tmp_path):
+        legacy = str(tmp_path / "0")
+        os.makedirs(legacy)
+        with open(os.path.join(legacy, "checkpoint"), "w") as f:
+            f.write("orbax-era payload")
+        with CheckpointManager(str(tmp_path), max_to_keep=2) as mgr:
+            _save_gens(mgr, [1])
+            # window not yet full: the legacy dir is still a potential
+            # recovery point and must survive
+            assert os.path.isdir(legacy)
+            _save_gens(mgr, [2, 3])
+            # native coverage now fills max_to_keep: reclaimed
+            assert not os.path.exists(legacy)
+            assert mgr.all_steps() == [2, 3]
+
+
+class TestThirdReviewHardening:
+    """Regressions pinned after the third review pass: NamedTuple nodes
+    round-trip as their own type, the functional restore API gets the
+    same structure-only-template guard as the manager path, a forced
+    overwrite of a committed generation can no longer destroy it, and
+    the DataLoader permutation is drawn at iter() time, not first
+    next()."""
+
+    def test_namedtuple_roundtrips_with_template(self, tmp_path):
+        import collections
+
+        from paddle_tpu.distributed.checkpoint import (restore_sharded,
+                                                       save_sharded)
+
+        AdamState = collections.namedtuple("AdamState", "count mu nu")
+        state = {"opt": AdamState(np.int32(3),
+                                  np.ones((2,), np.float32),
+                                  np.full((2,), 2.0, np.float32))}
+        path = str(tmp_path / "gen")
+        save_sharded(state, path)
+        back = restore_sharded(path, template=state)
+        assert isinstance(back["opt"], AdamState)
+        assert int(back["opt"].count) == 3
+        np.testing.assert_array_equal(np.asarray(back["opt"].mu),
+                                      np.asarray(state["opt"].mu))
+
+    def test_restore_sharded_none_leaf_template_on_legacy_dir(
+            self, tmp_path):
+        import orbax.checkpoint as ocp
+
+        from paddle_tpu.distributed.checkpoint import restore_sharded
+
+        state = {"w": np.arange(4, dtype=np.float32)}
+        path = str(tmp_path / "legacy")
+        ckptr = ocp.StandardCheckpointer()
+        ckptr.save(path, state)
+        ckptr.wait_until_finished()
+        back = restore_sharded(path, template={"w": None})
+        assert back["w"] is not None, (
+            "structure-only template echoed back as 'restored' state")
+        np.testing.assert_array_equal(np.asarray(back["w"]), state["w"])
+
+    def test_forced_overwrite_preserves_committed_generation(
+            self, tmp_path):
+        qdir = tmp_path / "quarantine"
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1])
+            # a SIGKILL lands between the rename-aside and the new
+            # generation's COMMIT marker (the torn injector fires in
+            # exactly that window)
+            with chaos.inject(ckpt_torn=1):
+                with pytest.raises(chaos.ChaosTorn):
+                    mgr.save(1, _state(7), force=True)
+            # the superseded committed bytes survived the crash
+            aside = [n for n in os.listdir(qdir)
+                     if n.startswith("1.superseded-")]
+            assert aside, "old committed generation destroyed by " \
+                          "forced overwrite crash"
+            assert os.path.exists(
+                os.path.join(qdir, aside[0], "COMMIT"))
+            # a SUCCESSFUL forced overwrite leaves no aside residue
+            assert mgr.save(2, _state(2), force=True)
+            assert mgr.save(2, _state(9), force=True)
+            assert not [n for n in os.listdir(qdir)
+                        if n.startswith("2.superseded-")]
+            step, back = mgr.restore_latest(template=_state(0))
+            assert step == 2
+            np.testing.assert_array_equal(
+                np.asarray(back["w"]), np.full((4, 4), 9.0, "f"))
+
+    def test_dataloader_permutation_drawn_at_iter_time(self):
+        import threading
+
+        from paddle_tpu.io import DataLoader
+
+        class DS:
+            def __len__(self):
+                return 16
+
+            def __getitem__(self, i):
+                return np.float32(i)
+
+        def batches(loader, consume_on_thread):
+            it = iter(loader)  # called on the seeded main thread
+            out = []
+            if consume_on_thread:
+                def drain():
+                    out.extend(np.asarray(b).tolist() for b in it)
+                t = threading.Thread(target=drain)
+                t.start()
+                t.join(timeout=30)
+            else:
+                out.extend(np.asarray(b).tolist() for b in it)
+            return out
+
+        paddle.seed(1234)
+        main = batches(DataLoader(DS(), batch_size=4, shuffle=True,
+                                  use_buffer_reader=False), False)
+        paddle.seed(1234)
+        threaded = batches(DataLoader(DS(), batch_size=4, shuffle=True,
+                                      use_buffer_reader=False), True)
+        assert main == threaded, (
+            "shuffle permutation drawn on the consuming (unseeded) "
+            "thread instead of at iter() time")
+
+    def test_failed_overwrite_rolls_superseded_generation_back(
+            self, tmp_path):
+        from paddle_tpu.distributed.checkpoint import _write_generation
+
+        with CheckpointManager(str(tmp_path)) as mgr:
+            _save_gens(mgr, [1])
+            # a transient disk error lands between the rename-aside and
+            # the new COMMIT marker (fail_io raises plain OSError at the
+            # checkpoint.commit hook, unlike ChaosTorn which simulates
+            # SIGKILL and must NOT trigger the rollback)
+            with chaos.inject(fail_io=1):
+                with pytest.raises(OSError):
+                    _write_generation(_gen_dir(mgr, 1),
+                                      {"w": np.zeros((2,), np.float32)})
+            # the superseded generation is back in its slot, committed,
+            # and nothing leaked into quarantine/
+            _assert_restores(mgr, 1)
+            qdir = os.path.join(str(tmp_path), "quarantine")
+            assert not os.path.isdir(qdir) or not os.listdir(qdir)

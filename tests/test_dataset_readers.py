@@ -105,3 +105,61 @@ def test_reader_composes_with_paddle_batch():
     batched = paddle.batch(paddle.dataset.mnist.train(), batch_size=32)
     first = next(iter(batched()))
     assert len(first) == 32 and first[0][0].shape == (784,)
+
+
+def test_buffered_loader_shuffle_is_seeded_and_thread_agnostic():
+    """The buffered-reader prefetch thread must NOT draw the shuffle
+    permutation from its own (never-seeded, thread-local) RNG chain:
+    the epoch's batch indices are materialized on the consumer thread,
+    so `paddle.seed` controls shuffle order identically with and
+    without the prefetch thread (this once made an e2e loss-decrease
+    test order-sensitive across the suite)."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    ds = TensorDataset([paddle.to_tensor(
+        np.arange(64, dtype="float32").reshape(64, 1))])
+
+    def epoch_order(use_buffer_reader):
+        paddle.seed(777)
+        loader = DataLoader(ds, batch_size=8, shuffle=True,
+                            use_buffer_reader=use_buffer_reader)
+        return [tuple(np.asarray(b[0].numpy()).ravel().astype(int))
+                for b in loader]
+
+    buffered = epoch_order(True)
+    unbuffered = epoch_order(False)
+    assert buffered == unbuffered          # thread placement irrelevant
+    assert epoch_order(True) == buffered   # reseeding reproduces
+    paddle.seed(123)
+    loader = DataLoader(ds, batch_size=8, shuffle=True)
+    other = [tuple(np.asarray(b[0].numpy()).ravel().astype(int))
+             for b in loader]
+    assert other != buffered               # seed actually controls it
+
+
+def test_user_batch_sampler_stays_lazy():
+    """Only the framework's own BatchSampler is materialized eagerly for
+    the RNG fix above — a user-supplied batch_sampler may be generator-
+    backed (even infinite), so iter(loader) must not consume it up
+    front."""
+    from paddle_tpu.io import DataLoader, TensorDataset
+
+    ds = TensorDataset([paddle.to_tensor(
+        np.arange(64, dtype="float32").reshape(64, 1))])
+
+    class InfiniteSampler:
+        batch_size = 4
+
+        def __iter__(self):
+            i = 0
+            while True:  # never exhausts — eager materialization hangs
+                yield [(i + j) % 64 for j in range(4)]
+                i += 4
+
+    for buffered in (False, True):
+        loader = DataLoader(ds, batch_sampler=InfiniteSampler(),
+                            use_buffer_reader=buffered)
+        it = iter(loader)
+        got = [np.asarray(next(it)[0].numpy()).ravel() for _ in range(3)]
+        assert [tuple(g.astype(int)) for g in got] == [
+            (0, 1, 2, 3), (4, 5, 6, 7), (8, 9, 10, 11)]
