@@ -458,6 +458,40 @@ def peak_flops_per_chip():
     return 275e12  # default to v4 per BASELINE.md
 
 
+def _obs_fields(step_times_s=None, dt=None, mfu=None, flops_per_step=None):
+    """Observability fields EVERY config's JSON line carries (ISSUE 6:
+    the bench trajectory records efficiency, not just throughput):
+    step-time order stats over the per-step estimates, MFU, and device
+    peak memory (0.0 when the backend has no memory stats — CPU)."""
+    times_ms = sorted(t * 1e3 for t in
+                      (step_times_s or ([dt] if dt else [])) if t)
+
+    def q(p):
+        if not times_ms:
+            return 0.0
+        return times_ms[min(len(times_ms) - 1,
+                            max(0, int(round(p * (len(times_ms) - 1)))))]
+
+    if mfu is None:
+        mfu = (flops_per_step / dt / peak_flops_per_chip()
+               if flops_per_step and dt else 0.0)
+    mem_mb = 0.0
+    try:
+        from paddle_tpu.monitor import device_memory_stats
+
+        mem = device_memory_stats()
+        if mem and "peak_bytes_in_use" in mem:
+            mem_mb = round(mem["peak_bytes_in_use"] / 1048576, 1)
+    except Exception:  # noqa: BLE001 - a meter, never a bench failure
+        pass
+    return {
+        "mfu": round(float(mfu), 4),
+        "step_time_p50_ms": round(q(0.50), 3),
+        "step_time_p99_ms": round(q(0.99), 3),
+        "device_mem_peak_mb": mem_mb,
+    }
+
+
 def _roundtrip():
     """Median host<->device roundtrip latency of a trivial jitted call
     (the remote-TPU tunnel adds tens of ms; subtract it from timings)."""
@@ -496,16 +530,19 @@ def _time_scan_loop(step, carry, xs, iters, n_timed):
     compile_s = time.perf_counter() - t0
     _phase("compile_done", compile_s)
     best = float("inf")
+    per_step = []  # per-step estimate from EACH timed call (p50/p99)
     for _ in range(n_timed):
         t0 = time.perf_counter()
         carry, l_last = loop_j(carry, *xs)
         loss = float(l_last)
-        best = min(best, time.perf_counter() - t0)
+        t = time.perf_counter() - t0
+        best = min(best, t)
+        per_step.append(max(t - rt, 1e-9) / iters)
     _phase("timed_runs_done", best)
     # compile_s is carried into each config's result line so the
     # persistent-compile-cache win (FLAGS_jit_cache_dir) is measurable
     # process-over-process — tools/perf_smoke.sh asserts on it
-    return max(best - rt, 1e-9) / iters, loss, compile_s
+    return max(best - rt, 1e-9) / iters, loss, compile_s, per_step
 
 
 def _encoder_model(L, H, A, I, S, V):
@@ -595,8 +632,9 @@ def _encoder_bench(name, on_tpu, amp_o2_scaler=False):
                  (_jnp.float32(2.0 ** 15), _jnp.int32(0), _jnp.int32(0)))
     else:
         carry = (params, opt_state)
-    dt, loss, compile_s = _time_scan_loop(step, carry, (ids, labels),
-                                          iters, n_timed)
+    dt, loss, compile_s, step_ts = _time_scan_loop(step, carry,
+                                                   (ids, labels),
+                                                   iters, n_timed)
 
     n_params = sum(int(np.prod(v.shape))
                    for v in jax.tree_util.tree_leaves(params))
@@ -605,6 +643,7 @@ def _encoder_bench(name, on_tpu, amp_o2_scaler=False):
     flops = 6.0 * n_params * tokens + attn_flops
     mfu = flops / dt / peak_flops_per_chip() if on_tpu else 0.0
     return {
+        **_obs_fields(step_times_s=step_ts, dt=dt, mfu=mfu),
         "metric": f"{name}_samples_per_sec_per_chip" if on_tpu
                   else f"{name}_smoke_samples_per_sec_cpu",
         "value": round(B / dt, 2),
@@ -731,7 +770,9 @@ def body_mnist(on_tpu):
     # convergence run (VERDICT r04 weak #5: the r04 CPU line read as
     # BASELINE config 1 failing while the TPU session line showed 0.9922).
     smoke = (not on_tpu) and acc < 0.97
+    steps_done = max(1, epochs_used * steps_per_epoch)
     return {
+        **_obs_fields(dt=fit_s / steps_done),
         "metric": ("mnist_lenet_convergence_cpu_smoke" if smoke
                    else "mnist_lenet_convergence"),
         "value": round(acc, 4),
@@ -809,6 +850,7 @@ def body_ckpt(on_tpu):
         _shutil.rmtree(root, ignore_errors=True)
 
     return {
+        **_obs_fields(),
         "metric": "ckpt_save_ms",
         "value": round(median(save_ms), 2),
         "unit": "ms",
@@ -882,8 +924,8 @@ def body_resnet50(on_tpu):
     dt_ = jnp.bfloat16 if on_tpu else jnp.float32
     images = jnp.asarray(rs.randn(B, 3, HW, HW), dt_)
     labels = jnp.asarray(rs.randint(0, 1000, (B,)), jnp.int32)
-    dt, loss, compile_s = _time_scan_loop(step, (params, opt_state),
-                                          (images, labels), iters, n_timed)
+    dt, loss, compile_s, step_ts = _time_scan_loop(
+        step, (params, opt_state), (images, labels), iters, n_timed)
     # ResNet-50 fwd ~4.1 GFLOPs/image at 224^2; train ~3x fwd
     flops = 3 * 4.1e9 * (HW / 224.0) ** 2 * B
     peak = peak_flops_per_chip()
@@ -936,6 +978,7 @@ def body_resnet50(on_tpu):
     else:
         vs = 0.0
     out = {
+        **_obs_fields(step_times_s=step_ts, dt=dt, mfu=mfu),
         "metric": "resnet50_samples_per_sec_per_chip" if on_tpu
                   else "resnet50_smoke_samples_per_sec_cpu",
         "value": round(B / dt, 2),
@@ -980,7 +1023,8 @@ def body_dp8(on_tpu):
     from paddle_tpu.vision.models import resnet18
 
     if jax.device_count() < 8:
-        return {"metric": "dp8_samples_per_sec", "value": 0.0,
+        return {**_obs_fields(),
+                "metric": "dp8_samples_per_sec", "value": 0.0,
                 "unit": "error", "vs_baseline": 0.0,
                 "error": f"needs 8 devices, have {jax.device_count()}"}
 
@@ -1032,6 +1076,7 @@ def body_dp8(on_tpu):
     _phase("dp8_fit_done", warm + dt)
     sps = B * STEPS / dt
     return {
+        **_obs_fields(dt=dt / STEPS),
         "metric": "dp8_samples_per_sec",
         "value": round(sps, 2),
         "unit": "samples/s",
@@ -1107,28 +1152,28 @@ def body_gpt13b(on_tpu):
 
         rs = np.random.RandomState(0)
         ids = jnp.asarray(rs.randint(0, V, (B, S)), jnp.int32)
-        dt, loss, compile_s = _time_scan_loop(step, (params, opt_state),
-                                              (ids,), iters, n_timed)
+        dt, loss, compile_s, step_ts = _time_scan_loop(
+            step, (params, opt_state), (ids,), iters, n_timed)
         n_params = sum(int(np.prod(v.shape))
                        for v in jax.tree_util.tree_leaves(params))
-        return dt, loss, n_params, compile_s
+        return dt, loss, n_params, compile_s, step_ts
 
     if on_tpu:
         try:
             _phase("full_1p3b_measure_start")
-            dt, loss, n_params, compile_s = build_and_time(24,
-                                                           use_remat=True)
+            dt, loss, n_params, compile_s, step_ts = build_and_time(
+                24, use_remat=True)
             full_measured = True
         except Exception as e:  # noqa: BLE001 - OOM/compile: fall back
             fallback_err = str(e)[-300:]
             sys.stderr.write(f"[bench] full 1.3B measure failed, falling "
                              f"back to 4-layer: {fallback_err}\n")
             L_meas = 4
-            dt, loss, n_params, compile_s = build_and_time(
+            dt, loss, n_params, compile_s, step_ts = build_and_time(
                 4, use_remat=False)
     else:
-        dt, loss, n_params, compile_s = build_and_time(L_meas,
-                                                       use_remat=False)
+        dt, loss, n_params, compile_s, step_ts = build_and_time(
+            L_meas, use_remat=False)
 
     tokens = B * S
     # 6ND + attention FLOPs (the model-FLOPs convention: remat's extra
@@ -1175,6 +1220,7 @@ def body_gpt13b(on_tpu):
             sys.stderr.write(f"[bench] gpt13b full compile failed: {e}\n")
 
     out = {
+        **_obs_fields(step_times_s=step_ts, dt=dt, mfu=mfu),
         "metric": ("gpt13b_full_tokens_per_sec_per_chip" if full_measured
                    else "gpt13b_layout_tokens_per_sec_per_chip" if on_tpu
                    else "gpt13b_smoke_tokens_per_sec_cpu"),
@@ -1255,6 +1301,7 @@ def body_kernels(on_tpu):
 
     ok = fwd_err < 2e-2 and bwd_err < 2e-2 and ln_err < 1e-3
     return {
+        **_obs_fields(),
         "metric": "pallas_kernels_validated_on_tpu" if on_tpu
                   else "pallas_kernels_validated_cpu_interpret",
         "value": 1.0 if ok else 0.0,
@@ -1315,6 +1362,9 @@ def body_longseq(on_tpu):
     flops = 0.5 * 3.5 * 4.0 * B * H * S * S * D
     achieved = flops / t_flash
     return {
+        **_obs_fields(step_times_s=[t_flash],
+                      mfu=(achieved / peak_flops_per_chip()
+                           if on_tpu else 0.0)),
         "metric": ("longseq_flash_attn_speedup_vs_xla" if on_tpu
                    else "longseq_smoke_cpu"),
         "value": round(t_ref / t_flash, 3),
@@ -1496,6 +1546,7 @@ def body_predictor(on_tpu):
         _phase("decode_failed")
 
     return {
+        **_obs_fields(dt=lat_b8 / 1e3),
         **decode,
         **serving_stats,
         "metric": ("bert_predictor_latency_ms" if on_tpu

@@ -65,6 +65,47 @@ import numpy as np
 
 logger = logging.getLogger("paddle_tpu.checkpoint")
 
+# Durability counters in the shared runtime registry (utils/metrics.py,
+# scraped via monitor.MonitorServer /metrics).  Registry increments are
+# pure-python dict work under the registry lock — safe from the async
+# writer thread, which must stay jax-free.
+from ..utils.metrics import default_registry as _default_registry  # noqa: E402
+
+_REG = _default_registry()
+_m_saves = _REG.counter(
+    "paddle_ckpt_saves_total",
+    "durable checkpoint generation writes by result", label="result",
+    preset=("ok", "failed"))
+_m_save_ms = _REG.histogram(
+    "paddle_ckpt_save_ms",
+    "wall time of one durable generation write (fsyncs included; runs "
+    "on the background writer under async saves)",
+    [5, 10, 25, 50, 100, 250, 500, 1000, 5000, 30000, 120000])
+_m_restore_ms = _REG.histogram(
+    "paddle_ckpt_restore_ms",
+    "wall time of restore_latest (verify + read + device placement)",
+    [5, 10, 25, 50, 100, 250, 500, 1000, 5000, 30000, 120000])
+_m_retries = _REG.counter(
+    "paddle_ckpt_retries_total",
+    "in-place save retries after a transient IO error")
+_m_quarantines = _REG.counter(
+    "paddle_ckpt_quarantines_total",
+    "corrupt generations moved to quarantine/ by the restore cascade")
+_m_cascade_depth = _REG.gauge(
+    "paddle_ckpt_cascade_depth",
+    "generations rejected before the most recent successful restore")
+_m_superseded_rb = _REG.counter(
+    "paddle_ckpt_superseded_rollbacks_total",
+    "failed force-overwrites whose superseded generation was rolled "
+    "back into its slot")
+_m_async_dropped = _REG.counter(
+    "paddle_ckpt_async_dropped_total",
+    "generations superseded in the depth-1 async queue before being "
+    "written (newest-wins)")
+_m_async_stalls = _REG.counter(
+    "paddle_ckpt_async_stalls_total",
+    "flush/drain waits that timed out on a stalled writer")
+
 __all__ = ["save_sharded", "restore_sharded", "CheckpointManager",
            "AsyncCheckpointer", "CheckpointCorruption",
            "CheckpointTemplateMismatch", "FORMAT_VERSION"]
@@ -312,6 +353,7 @@ def _write_generation(final_dir: str, state, meta=None, step=None):
             if not os.path.exists(final_dir):
                 try:
                     os.rename(aside, final_dir)
+                    _m_superseded_rb.inc()
                 except OSError:
                     pass  # bytes stay visible in quarantine/ at least
         raise
@@ -744,24 +786,35 @@ class CheckpointManager:
             return _write_generation(self._gen_dir(step), host_state,
                                      meta=meta, step=step)
 
+        t0 = time.monotonic()
         with self._lock:
             self._sweep_tmp()
             try:
-                _do()
-            except OSError as e:
-                if not is_transient_io_error(e):
-                    logger.error(
-                        "checkpoint save step=%s hit persistent %s "
-                        "(errno=%s): %s — NOT retrying, escalating",
-                        step, type(e).__name__, e.errno, e)
-                    raise
-                if not transient_retry:
-                    raise
-                logger.warning("checkpoint save step=%s hit transient "
-                               "%s: %s — retrying once", step,
-                               type(e).__name__, e)
-                time.sleep(0.05)
-                _do()
+                try:
+                    _do()
+                except OSError as e:
+                    if not is_transient_io_error(e):
+                        logger.error(
+                            "checkpoint save step=%s hit persistent %s "
+                            "(errno=%s): %s — NOT retrying, escalating",
+                            step, type(e).__name__, e.errno, e)
+                        raise
+                    if not transient_retry:
+                        raise
+                    logger.warning("checkpoint save step=%s hit "
+                                   "transient %s: %s — retrying once",
+                                   step, type(e).__name__, e)
+                    _m_retries.inc()
+                    time.sleep(0.05)
+                    _do()
+            except BaseException:
+                # BaseException: chaos injectors deliberately raise
+                # non-OSError (ChaosTorn) — a failed generation is a
+                # failed generation either way
+                _m_saves.inc("failed")
+                raise
+            _m_saves.inc("ok")
+            _m_save_ms.observe((time.monotonic() - t0) * 1e3)
             self._prune()
         return True
 
@@ -861,6 +914,8 @@ class CheckpointManager:
         does not silently restart long runs from scratch."""
         from ..utils import chaos
         chaos.on_io("checkpoint.restore_latest")
+        t0 = time.monotonic()
+        rejected = 0
         with self._lock:
             for step in self._candidate_steps():
                 gen = self._gen_dir(step)
@@ -868,8 +923,12 @@ class CheckpointManager:
                 if manifest is None:
                     if _is_pre_manifest(gen):
                         try:
-                            return step, self._legacy_restore(
+                            state = self._legacy_restore(
                                 step, template, shardings)
+                            _m_cascade_depth.set(rejected)
+                            _m_restore_ms.observe(
+                                (time.monotonic() - t0) * 1e3)
+                            return step, state
                         except CheckpointTemplateMismatch:
                             raise  # caller's template, never quarantine
                         except Exception as e:  # noqa: BLE001
@@ -884,14 +943,17 @@ class CheckpointManager:
                                 "(%s: %s) — leaving it in place, "
                                 "cascading past it", step,
                                 type(e).__name__, e)
+                            rejected += 1
                             continue
                     self._quarantine(step, reason)
+                    rejected += 1
                     continue
                 try:
                     state = _load_generation(gen, manifest, template,
                                              shardings)
                 except CheckpointCorruption as e:
                     self._quarantine(step, e.reason)
+                    rejected += 1
                     continue
                 except OSError as e:
                     # an IO error READING the payload (EIO blip, a leaf
@@ -903,9 +965,13 @@ class CheckpointManager:
                         "generation %d could not be read (%s: %s) — "
                         "leaving it in place, cascading past it",
                         step, type(e).__name__, e)
+                    rejected += 1
                     continue
                 self.last_restore_manifest = manifest
+                _m_cascade_depth.set(rejected)
+                _m_restore_ms.observe((time.monotonic() - t0) * 1e3)
                 return step, state
+        _m_cascade_depth.set(rejected)
         return None, None
 
     def _quarantine(self, step: int, reason: str):
@@ -937,6 +1003,7 @@ class CheckpointManager:
         except OSError as e:
             logger.error("could not quarantine generation %d: %s", step, e)
             return
+        _m_quarantines.inc()
         logger.warning(
             "checkpoint generation %d REJECTED (%s) — quarantined to %s, "
             "cascading to the next-oldest generation", step, reason, dest)
@@ -1028,6 +1095,7 @@ class AsyncCheckpointer:
             replaced = self._pending is not None
             if replaced:
                 self.dropped += 1
+                _m_async_dropped.inc()
                 logger.info(
                     "async checkpoint: generation %s superseded before "
                     "write (newest-wins, depth-1 queue)",
@@ -1099,6 +1167,7 @@ class AsyncCheckpointer:
                 remaining = (None if deadline is None
                              else deadline - time.monotonic())
                 if remaining is not None and remaining <= 0:
+                    _m_async_stalls.inc()
                     return False
                 self._cv.wait(timeout=remaining)
         return True

@@ -29,9 +29,22 @@ from .launch_utils import (
     watch_local_trainers,
 )
 from .resilience import (DURABILITY_EXIT_CODE, PREEMPTED_EXIT_CODE,
-                         backoff_delay)
+                         WATCHDOG_EXIT_CODE, backoff_delay)
+from ..utils.metrics import default_registry
 
 logger = logging.getLogger("paddle_tpu.launch")
+
+# Restart accounting in the shared registry: the launcher's own
+# MonitorServer (--monitor_port) exposes these alongside the federated
+# per-rank /metrics, so "how often does this job die, and why" is a
+# scrape instead of a log grep.
+_REG = default_registry()
+_m_failures = _REG.counter(
+    "paddle_launch_trainer_failures_total",
+    "trainer exits the launcher classified, by reason", label="reason",
+    preset=("preempted", "watchdog", "durability", "crash"))
+_m_restarts = _REG.counter(
+    "paddle_launch_restarts_total", "pod restarts performed")
 
 
 def _parse_args(argv=None):
@@ -67,6 +80,13 @@ def _parse_args(argv=None):
                         help="seconds between SIGTERM and SIGKILL when "
                              "tearing trainers down (lets them write an "
                              "emergency checkpoint)")
+    parser.add_argument("--monitor_port", type=int, default=None,
+                        help="start a pod-level MonitorServer on this "
+                             "port: /metrics federates every local "
+                             "rank's telemetry endpoint (ranks get "
+                             "FLAGS_MONITOR_PORT=port+1+rank) plus the "
+                             "launcher's restart counters; 0 picks a "
+                             "free port, omit to disable")
     parser.add_argument("training_script",
                         help="the training script to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -110,6 +130,29 @@ def launch_collective(args):
     attempt = 0
     procs = []
 
+    # Pod-level observability (--monitor_port): each local rank gets its
+    # own FLAGS_MONITOR_PORT (base+1+rank) and the launcher's endpoint
+    # federates them — one scrape answers "is the fleet healthy" across
+    # every rank plus the launcher's own restart counters.
+    monitor = None
+    per_rank_envs = None
+    if args.monitor_port is not None and args.monitor_port >= 0:
+        from ..monitor import MonitorServer
+
+        monitor = MonitorServer(registry=_REG,
+                                port=args.monitor_port).start()
+
+        def rank_port(rank):
+            return monitor.port + 1 + int(rank)
+
+        def per_rank_envs(rank):
+            return {"FLAGS_MONITOR_PORT": str(rank_port(rank))}
+
+        monitor.federate = [f"http://127.0.0.1:{rank_port(t.rank)}"
+                            for t in pod.trainers]
+        logger.info("pod monitor on %s federating %d rank endpoint(s)",
+                    monitor.url, len(monitor.federate))
+
     # Orphan fix: a SIGTERM to the launcher must tear the trainer
     # subprocesses down (with the grace window) instead of leaving them
     # running; watch_local_trainers only handled KeyboardInterrupt.
@@ -131,7 +174,8 @@ def launch_collective(args):
                 cluster, pod, args.training_script,
                 args.training_script_args, log_dir=args.log_dir,
                 backend=args.backend,
-                envs={"PADDLE_RESTART_COUNT": str(attempt)})
+                envs={"PADDLE_RESTART_COUNT": str(attempt)},
+                per_rank_envs=per_rank_envs)
             try:
                 watch_local_trainers(procs, cluster.trainers_nranks(),
                                      grace=args.grace_period)
@@ -140,6 +184,10 @@ def launch_collective(args):
                 preempted = _is_preemption(e.exit_code)
                 if preempted:
                     reason = "preempted"
+                    _m_failures.inc("preempted")
+                elif e.exit_code == WATCHDOG_EXIT_CODE:
+                    reason = f"hung (watchdog exit {WATCHDOG_EXIT_CODE})"
+                    _m_failures.inc("watchdog")
                 elif e.exit_code == DURABILITY_EXIT_CODE:
                     # NOT a crash: training was healthy but checkpoint
                     # writes kept failing — restarting onto the same
@@ -147,6 +195,7 @@ def launch_collective(args):
                     # 91 NEVER consumes the restart budget: fail fast
                     # and loudly, an operator has to look at the
                     # disk/quota.
+                    _m_failures.inc("durability")
                     logger.error(
                         "trainer rank=%s lost checkpoint durability "
                         "(exit %d: consecutive checkpoint generations "
@@ -156,6 +205,7 @@ def launch_collective(args):
                     raise
                 else:
                     reason = f"crashed (exit {e.exit_code})"
+                    _m_failures.inc("crash")
                 if attempt >= args.max_restarts:
                     logger.error("trainer rank=%s %s — restarts exhausted "
                                  "(%d/%d)", e.rank, reason, attempt,
@@ -166,6 +216,7 @@ def launch_collective(args):
                                  "(--restart_on=preempted)", e.rank, reason)
                     raise
                 attempt += 1
+                _m_restarts.inc()
                 delay = _restart_delay(attempt, base=args.restart_backoff)
                 logger.warning(
                     "trainer rank=%s %s — restart %s/%s in %.2fs "
@@ -173,6 +224,8 @@ def launch_collective(args):
                     e.rank, reason, attempt, args.max_restarts, delay)
                 time.sleep(delay)
     finally:
+        if monitor is not None:
+            monitor.shutdown()
         for s, prev in prev_handlers.items():
             try:
                 signal.signal(s, prev)

@@ -139,8 +139,11 @@ def _trainer_env(cluster: Cluster, trainer: Trainer, backend="auto"):
 
 def start_local_trainers(cluster, pod, training_script,
                          training_script_args, log_dir=None, envs=None,
-                         backend="auto"):
-    """Spawn one subprocess per local trainer (reference :429)."""
+                         backend="auto", per_rank_envs=None):
+    """Spawn one subprocess per local trainer (reference :429).
+    `per_rank_envs(rank) -> dict` adds rank-specific variables on top of
+    the shared `envs` (e.g. each rank's FLAGS_MONITOR_PORT so the
+    launcher can federate their /metrics endpoints)."""
     procs = []
     if log_dir:
         os.makedirs(log_dir, exist_ok=True)
@@ -150,6 +153,8 @@ def start_local_trainers(cluster, pod, training_script,
     for idx, t in enumerate(pod.trainers):
         env = dict(os.environ)
         env.update(envs or {})
+        if per_rank_envs is not None:
+            env.update(per_rank_envs(t.rank) or {})
         env.update(_trainer_env(cluster, t, backend))
         cmd = [sys.executable, "-u", training_script] + \
             list(training_script_args)

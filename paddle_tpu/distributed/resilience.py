@@ -39,8 +39,17 @@ from typing import Any, Callable
 import numpy as np
 
 from ..utils import chaos
+from ..utils.metrics import default_registry as _default_registry
 
 logger = logging.getLogger("paddle_tpu.resilience")
+
+# NaN-policy accounting in the shared runtime registry (scraped via
+# monitor.MonitorServer): the loss-anomaly decisions below used to exist
+# only as log lines
+_m_nan = _default_registry().counter(
+    "paddle_train_nan_steps_total",
+    "non-finite-loss steps by anomaly-policy action", label="action",
+    preset=("detected", "skipped", "halted", "rolled_back"))
 
 __all__ = [
     "PREEMPTED_EXIT_CODE", "WATCHDOG_EXIT_CODE", "DURABILITY_EXIT_CODE",
@@ -416,16 +425,19 @@ class ResilientRunner:
                     if bad:
                         info["bad_steps"] += 1
                         bad_streak += 1
+                        _m_nan.inc("detected")
                         logger.warning(
                             "non-finite loss at step %d (streak %d, "
                             "policy=%s)", step, bad_streak,
                             self.anomaly_policy)
                         if self.anomaly_policy == "halt":
+                            _m_nan.inc("halted")
                             raise FloatingPointError(
                                 f"non-finite loss at step {step} "
                                 f"(anomaly_policy='halt')")
                         if bad_streak >= self.max_bad_steps:
                             if self.anomaly_policy == "skip":
+                                _m_nan.inc("halted")
                                 raise FloatingPointError(
                                     f"{bad_streak} consecutive non-finite "
                                     f"steps (anomaly_policy='skip', "
@@ -438,6 +450,7 @@ class ResilientRunner:
                                     f"rollback requested at step {step} "
                                     f"but no checkpoint exists")
                             info["rollbacks"] += 1
+                            _m_nan.inc("rolled_back")
                             logger.warning("rolling back to checkpoint "
                                            "step %d", step0)
                             state = restored
@@ -452,6 +465,7 @@ class ResilientRunner:
                             continue
                         # tolerated: drop this update, advance
                         info["skipped_steps"] += 1
+                        _m_nan.inc("skipped")
                         step += 1
                     else:
                         bad_streak = 0

@@ -64,6 +64,24 @@ define_flag("FLAGS_serving_buckets", "",
             "serving shape-bucket grid, 'B1,B2,...' or 'B1,B2xS1,S2,...' "
             "(batch x sequence); '' = powers of two up to "
             "FLAGS_serving_max_batch, no sequence bucketing")
+# -- runtime telemetry (paddle_tpu.monitor) --------------------------------
+define_flag("FLAGS_telemetry_dir", "",
+            "directory for the per-step JSONL training event log "
+            "(append-only, rotating, safe to tail) and on-demand "
+            "jax.profiler trace captures; '' disables the event log")
+define_flag("FLAGS_monitor_port", -1,
+            "port for the training MonitorServer (/metrics /healthz "
+            "/debug/trace); 0 picks a free port (logged), -1 disables")
+define_flag("FLAGS_telemetry_rotate_mb", 64.0,
+            "rotate the JSONL event log when it exceeds this many MB "
+            "(old segments keep a bounded .N suffix chain)")
+define_flag("FLAGS_device_peak_flops", 0.0,
+            "per-device peak FLOP/s for the MFU gauge; 0 = look the "
+            "device kind up in monitor.PEAK_FLOPS (TPU generations + a "
+            "nominal CPU entry so smoke runs read a nonzero MFU)")
+define_flag("FLAGS_trace_steps", 3,
+            "how many steps a SIGUSR1-armed jax.profiler capture spans "
+            "(the headless /debug/trace?steps=N equivalent)")
 # -- durable checkpointing (distributed/checkpoint.py) --------------------
 define_flag("FLAGS_ckpt_async", True,
             "fit(resume=/fault_tolerant=) writes interval/epoch "

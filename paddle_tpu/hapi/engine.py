@@ -222,6 +222,8 @@ class TrainEngine:
         self._sharding_rule = None
         self._state_sharding = None
         self._step_key = None  # (mesh, rule) the cached jit was built for
+        self._cost_cache = None  # cost_analysis of the live _step_fn
+        self._cost_cache_fn = None
 
     @property
     def active(self):
@@ -499,6 +501,24 @@ class TrainEngine:
             with mesh_guard(self.mesh):
                 return self._step_fn.lower(self.state, rng, inputs, labels)
         return self._step_fn.lower(self.state, rng, inputs, labels)
+
+    def step_cost_analysis(self, inputs, labels):
+        """XLA cost analysis of the compiled train step ({'flops': ...,
+        per-DEVICE for SPMD modules}) — the number the MFU gauge divides
+        by wall time.  Cached against the live jitted step, so repeated
+        fits of the same model pay the AOT lower+compile once (and even
+        that hits the persistent compilation cache — same HLO the jit
+        path just built).  Returns {} when the backend reports
+        nothing."""
+        if self._cost_cache is not None \
+                and self._cost_cache_fn is self._step_fn:
+            return dict(self._cost_cache)
+        compiled = self.lower_step(inputs, labels).compile()
+        ca = compiled.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+        self._cost_cache = dict(ca) if ca else {}
+        self._cost_cache_fn = self._step_fn
+        return dict(self._cost_cache)
 
     def drain(self):
         """Batched fetch of every pending loss (the sanctioned sync)."""

@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import logging
 import os
+import time
 from typing import Any
 
 import jax
@@ -222,7 +223,22 @@ class Model:
         """One durable checkpoint of the current training state.  With
         an AsyncCheckpointer the host snapshot is taken here (training
         thread — donation makes that mandatory) and the write happens in
-        the background; emergency/final saves pass sync=True."""
+        the background; emergency/final saves pass sync=True.
+
+        The whole call is the checkpoint-induced TRAINING-THREAD stall
+        (host snapshot + submit, or the full write on the sync path) —
+        telemetry records it as `paddle_ckpt_step_stall_ms`, the number
+        the async writer exists to keep small."""
+        t0 = time.perf_counter()
+        try:
+            self._ft_save_inner(mgr, saver, it_count, force=force,
+                                sync=sync)
+        finally:
+            telem = getattr(self, "_telemetry", None)
+            if telem is not None:
+                telem.ckpt_stall((time.perf_counter() - t0) * 1e3)
+
+    def _ft_save_inner(self, mgr, saver, it_count, force=False, sync=False):
         from .engine import mesh_meta
 
         eng = self._engine
@@ -429,6 +445,7 @@ class Model:
         if self._engine is None:
             self._engine = TrainEngine(self)
         engine = self._engine
+        _step_fn_before = engine._step_fn
         engine.begin(mesh=mesh, sharding_rule=sharding_rule)
 
         ft_mgr = None
@@ -470,6 +487,33 @@ class Model:
                 ft_mgr.close()
                 raise
 
+        # Runtime telemetry (paddle_tpu.monitor), flag-gated: with both
+        # FLAGS_telemetry_dir and FLAGS_monitor_port off this is (None,
+        # None) and every telemetry hook below is skipped — the hot loop
+        # is unchanged.  When on: per-step trace polling + step marks,
+        # window emission at log/epoch boundaries (loss, lr, phase times,
+        # samples/s, MFU, device memory → registry gauges + one JSONL
+        # line), SIGUSR1-armed bounded jax.profiler capture, and a
+        # donation-fallback warning counter.  Installed AFTER the
+        # fault-tolerance setup (which can raise before the main
+        # try/finally exists to uninstall the hooks) — like the
+        # placement hook below.
+        from ..monitor import fit_monitor, install_sigusr1
+
+        telem, _mon_srv = fit_monitor()
+        self._telemetry = telem
+        _restore_usr1 = None
+        _unhook_warn = None
+        if telem is not None:
+            from .engine import mesh_meta as _mesh_meta
+
+            telem.on_fit_begin(
+                {"epochs": epochs, "batch_size": batch_size,
+                 "mesh": _mesh_meta(engine.mesh)},
+                compiled=engine._step_fn is not _step_fn_before)
+            _restore_usr1 = install_sigusr1(telem)
+            _unhook_warn = telem.install_warning_hook()
+
         # the placement hook goes on LAST: everything above can still
         # raise (missing ckpt dir, restore errors), and an exception
         # there must not leak a mesh-bound placement onto the user's
@@ -491,6 +535,12 @@ class Model:
 
         history = {"loss": []}
         it_count = 0
+        # telemetry step-window bookkeeping: wall time + StepTimers
+        # snapshots since the last emitted window
+        _win_t0 = time.perf_counter()
+        _win_it0 = 0
+        _win_totals: dict = {}
+        _win_counts: dict = {}
         # local completion sentinel — sys.exc_info() is THREAD-wide, so
         # a caller running fit inside an `except` block would make it
         # non-None for the whole call and silently disable every
@@ -526,7 +576,16 @@ class Model:
                             raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                         _random.split_key()
                         it_count += 1
+                        if telem is not None:
+                            # fast-forwarded batches dispatched nothing:
+                            # they must not count into a step window
+                            _win_t0 = time.perf_counter()
+                            _win_it0 = it_count
                         continue
+                    if telem is not None:
+                        # start/advance/stop an armed jax.profiler capture
+                        # — on the training thread, at a step boundary
+                        telem.poll_trace()
                     cbks.on_train_batch_begin(step_i, {})
                     if ft_mgr is not None:
                         # fault-injection hook (crash/preempt/slow) so the
@@ -541,8 +600,14 @@ class Model:
                         # callbacks) only possible with user callbacks —
                         # identity-scan for them before dispatching
                         engine.refresh_from_layers()
+                    if telem is not None:
+                        # idempotent anchor so the FIRST interval (the
+                        # one containing the compile) is measured too
+                        telem.mark_start()
                     with timers.scope("dispatch"):
                         outs = engine.step(inputs, labels)
+                    if telem is not None:
+                        telem.step_mark()
                     it_count += 1
                     log_step = bool(log_freq) and step_i % log_freq == 0
                     if eager_sync or log_step:
@@ -567,6 +632,14 @@ class Model:
                             logs[m._name] = np.mean(
                                 _to_list(m.accumulate()))
                     cbks.on_train_batch_end(step_i, logs)
+                    if telem is not None and log_step \
+                            and it_count > _win_it0:
+                        _win_t0, _win_it0, _win_totals, _win_counts = \
+                            self._telemetry_window(
+                                telem, engine, timers, epoch, it_count,
+                                batch_size, losses, inputs, labels,
+                                _win_t0, _win_it0, _win_totals,
+                                _win_counts)
                     if ft_mgr is not None:
                         if (checkpoint_interval
                                 and it_count % checkpoint_interval == 0):
@@ -593,6 +666,15 @@ class Model:
                         break
                 with timers.scope("sync"):
                     losses.extend(engine.drain())
+                if telem is not None and it_count > _win_it0:
+                    # close the epoch's partial window (inputs/labels are
+                    # the last dispatched batch — it_count > _win_it0
+                    # guarantees one exists)
+                    _win_t0, _win_it0, _win_totals, _win_counts = \
+                        self._telemetry_window(
+                            telem, engine, timers, epoch, it_count,
+                            batch_size, losses, inputs, labels,
+                            _win_t0, _win_it0, _win_totals, _win_counts)
                 # epoch-boundary write-back: the Layer tree gets device
                 # COPIES so checkpoints/eval/user inspection see current
                 # values while the engine keeps donating its own buffers
@@ -650,6 +732,15 @@ class Model:
                 loader.placement = prev_placement
             # a crash mid-fit must still flush/close callback resources
             cbks.on_train_end({})
+            if telem is not None:
+                # a capture armed for more steps than remained must still
+                # produce a valid trace artifact
+                telem.finish_trace()
+                telem.on_fit_end({"it": it_count, "ok": fit_ok})
+                if _restore_usr1 is not None:
+                    _restore_usr1()
+                if _unhook_warn is not None:
+                    _unhook_warn()
             if guard is not None:
                 guard.__exit__(None, None, None)
             if ft_saver is not None:
@@ -693,6 +784,28 @@ class Model:
                 # whenever ft_mgr is)
                 raise SystemExit(_res.DURABILITY_EXIT_CODE)
         return history
+
+    def _telemetry_window(self, telem, engine, timers, epoch, it_count,
+                          batch_size, losses, inputs, labels,
+                          win_t0, win_it0, win_totals, win_counts):
+        """Close one telemetry step window (monitor.TrainTelemetry):
+        resolve flops-per-step once per fit from the compiled step's XLA
+        cost analysis, hand the per-window StepTimers deltas over, and
+        return the fresh window anchors."""
+        now = time.perf_counter()
+        telem.ensure_flops(
+            lambda: engine.step_cost_analysis(inputs, labels))
+        deltas = {
+            name: (timers.totals.get(name, 0.0)
+                   - win_totals.get(name, 0.0),
+                   timers.counts.get(name, 0) - win_counts.get(name, 0))
+            for name in timers.totals}
+        telem.window(step=it_count, epoch=epoch,
+                     steps=it_count - win_it0, wall_s=now - win_t0,
+                     batch_size=batch_size,
+                     loss=(losses[-1] if losses else None),
+                     lr=self._optimizer.get_lr(), phase_deltas=deltas)
+        return now, it_count, dict(timers.totals), dict(timers.counts)
 
     def _split_batch(self, batch):
         n_label = len(_to_list(self._labels)) or 1

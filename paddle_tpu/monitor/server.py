@@ -1,0 +1,191 @@
+"""HTTP surface for the runtime telemetry: /metrics, /healthz, and
+on-demand trace capture of a RUNNING training job.
+
+Same dependency-free stdlib-HTTP pattern as serving/server.py (one
+`ThreadingHTTPServer`, daemon threads, bounded backlog), but pointed at
+the shared `utils.metrics` registry instead of a serving engine:
+
+  GET /metrics          Prometheus text of the attached registry; in
+                        federation mode (the launcher) the bodies of
+                        every rank's own /metrics are appended, so one
+                        scrape describes the whole pod.
+  GET /healthz          200 {"status": "ok", ...} with the live step.
+  GET /debug/trace?steps=N
+                        arms a bounded jax.profiler capture of the next
+                        N training steps on the attached TrainTelemetry
+                        — the running fit picks it up at its next step
+                        boundary, so a stuck or slow production job can
+                        be profiled WITHOUT restarting it.  SIGUSR1 is
+                        the headless equivalent (telemetry.py).
+
+The server holds no jax state and never blocks training: arming a trace
+is a couple of assignments under a lock; the capture itself runs on the
+training thread.
+"""
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+import urllib.parse
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..utils.metrics import default_registry
+
+logger = logging.getLogger("paddle_tpu.monitor")
+
+__all__ = ["MonitorServer"]
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 64
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code: int, body: bytes, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj):
+        self._send(code, json.dumps(obj).encode())
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        owner = self.server.owner
+        parsed = urllib.parse.urlparse(self.path)
+        if parsed.path == "/metrics":
+            self._send(200, owner.metrics_text().encode(),
+                       ctype="text/plain; version=0.0.4")
+        elif parsed.path == "/healthz":
+            self._send_json(200, owner.health())
+        elif parsed.path == "/debug/trace":
+            q = urllib.parse.parse_qs(parsed.query)
+            try:
+                steps = int(q.get("steps", ["0"])[0] or 0)
+            except ValueError:
+                steps = 0
+            if steps <= 0:
+                self._send_json(400, {"error": "pass ?steps=N (N >= 1)"})
+                return
+            telem = owner.telemetry
+            if telem is None:
+                self._send_json(409, {
+                    "error": "no training telemetry attached (is a fit "
+                             "running with the monitor enabled?)"})
+                return
+            tdir = telem.arm_trace(steps)
+            self._send_json(200, {"armed_steps": steps, "trace_dir": tdir})
+        else:
+            self._send_json(404, {"error": f"no route {parsed.path}"})
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class MonitorServer:
+    """Expose a metrics registry (default: the shared process registry)
+    over HTTP; optionally attach a `TrainTelemetry` for /debug/trace and
+    federate other ranks' /metrics (`federate=[base_url, ...]`)."""
+
+    def __init__(self, registry=None, telemetry=None, host="127.0.0.1",
+                 port=0, federate=(), fetch_timeout_s=2.0):
+        self.registry = registry if registry is not None \
+            else default_registry()
+        self.telemetry = telemetry
+        self._host = host
+        self._requested_port = int(port)
+        self.federate = list(federate)
+        self.fetch_timeout_s = fetch_timeout_s
+        self._httpd = None
+        self._thread = None
+        self._started_at = None
+
+    # -- endpoint bodies ---------------------------------------------------
+    def metrics_text(self) -> str:
+        parts = [self.registry.prometheus_text()]
+        if not self.federate:
+            return parts[0]
+        # fetch every rank CONCURRENTLY: N dead ranks must cost one
+        # fetch timeout total, not N of them — a pod scrape that blows
+        # the scraper's deadline loses the launcher's own healthy
+        # counters too
+        import concurrent.futures as _cf
+
+        def fetch(base):
+            url = base.rstrip("/") + "/metrics"
+            try:
+                with urllib.request.urlopen(
+                        url, timeout=self.fetch_timeout_s) as r:
+                    body = r.read().decode("utf-8", "replace")
+                return f"# federated from {url}\n{body}"
+            except Exception as e:  # noqa: BLE001 - a dead rank must
+                # not take down the pod-level scrape (lazy get-or-create:
+                # `federate` may be assigned after construction)
+                self.registry.counter(
+                    "paddle_monitor_federation_errors_total",
+                    "rank /metrics fetches that failed during "
+                    "federation").inc()
+                return (f"# federated from {url}: FETCH FAILED "
+                        f"({type(e).__name__})\n")
+
+        with _cf.ThreadPoolExecutor(
+                max_workers=min(16, len(self.federate))) as ex:
+            parts.extend(ex.map(fetch, list(self.federate)))
+        return "".join(parts)
+
+    def health(self) -> dict:
+        out = {"status": "ok",
+               "uptime_s": round(time.monotonic() - self._started_at, 1)
+               if self._started_at else 0.0}
+        t = self.telemetry
+        if t is not None:
+            out["step"] = t.g_step.get()
+            out["trace_pending"] = t.trace_pending
+        return out
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd \
+            else self._requested_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "MonitorServer":
+        self._httpd = _HTTPServer((self._host, self._requested_port),
+                                  _Handler)
+        self._httpd.owner = self
+        self._started_at = time.monotonic()
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1}, daemon=True,
+            name="paddle-monitor-http")
+        self._thread.start()
+        logger.info("monitor serving on %s (/metrics /healthz "
+                    "/debug/trace)", self.url)
+        return self
+
+    def shutdown(self):
+        if self._httpd is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._httpd = None
+        if self._thread is not None:
+            self._thread.join(5.0)
+            self._thread = None
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False

@@ -1,10 +1,12 @@
 """Serving metrics: counters, histograms, and a Prometheus text endpoint.
 
-Dependency-free (no prometheus_client): the exposition format is a few
-lines of text (https://prometheus.io/docs/instrumenting/exposition_formats/)
-and the serving engine needs exactly counters, histograms, and gauges.
-Everything is guarded by one lock — the batcher thread, N HTTP handler
-threads, and the /metrics scraper all touch the same state.
+Built on the shared, dependency-free registry in `utils/metrics.py`
+(counters/gauges/histograms/reservoir quantiles, one lock, Prometheus
+text exposition) — `Histogram` is re-exported from there unchanged, and
+`ServingMetrics` is now a declaration of serving's metric catalog over a
+private `MetricsRegistry` instance (private so multiple engines in one
+process don't collide).  The exposition output is BYTE-IDENTICAL to the
+pre-registry module — tests/test_monitor.py pins the golden text.
 
 Quantiles (p50/p99) come from a bounded reservoir of recent request
 latencies rather than histogram interpolation, so a smoke test scraping
@@ -13,44 +15,12 @@ window instead of a bucket-boundary estimate.
 """
 from __future__ import annotations
 
-import bisect
 import collections
-import threading
 import time
 
+from ..utils.metrics import Histogram, MetricsRegistry
+
 __all__ = ["Histogram", "ServingMetrics"]
-
-
-class Histogram:
-    """Cumulative-bucket histogram (Prometheus `histogram` type)."""
-
-    def __init__(self, name: str, help_: str, buckets):
-        self.name = name
-        self.help = help_
-        self.uppers = sorted(float(b) for b in buckets)
-        self.counts = [0] * len(self.uppers)  # per-bucket (non-cumulative)
-        self.total = 0
-        self.sum = 0.0
-
-    def observe(self, value: float):
-        self.total += 1
-        self.sum += value
-        i = bisect.bisect_left(self.uppers, value)
-        if i < len(self.counts):
-            self.counts[i] += 1
-
-    def render(self) -> list[str]:
-        lines = [f"# HELP {self.name} {self.help}",
-                 f"# TYPE {self.name} histogram"]
-        cum = 0
-        for upper, c in zip(self.uppers, self.counts):
-            cum += c
-            le = f"{upper:g}"
-            lines.append(f'{self.name}_bucket{{le="{le}"}} {cum}')
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {self.total}')
-        lines.append(f"{self.name}_sum {self.sum:g}")
-        lines.append(f"{self.name}_count {self.total}")
-        return lines
 
 
 class ServingMetrics:
@@ -70,18 +40,45 @@ class ServingMetrics:
     RESERVOIR = 4096
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self.registry = MetricsRegistry()
+        # the registry's RLock is THE lock (one lock for batcher thread,
+        # N HTTP handler threads, and the /metrics scraper); computed
+        # gauges run under it at scrape time, hence the *_locked helpers
+        self._lock = self.registry._lock
         self.started_at = time.monotonic()
-        self.counters = collections.Counter()
-        self.batch_size_hist = Histogram(
+        reg = self.registry
+        reg.gauge("paddle_serving_qps",
+                  "completed requests per second over the trailing window",
+                  fn=self._qps_locked)
+        reg.gauge("paddle_serving_p50_ms",
+                  "request latency p50 in milliseconds",
+                  fn=lambda: self._quantile_locked(0.50))
+        reg.gauge("paddle_serving_p99_ms",
+                  "request latency p99 in milliseconds",
+                  fn=lambda: self._quantile_locked(0.99))
+        reg.gauge("paddle_serving_padding_waste_ratio",
+                  "padded input elements / dispatched input elements "
+                  "(batch-slot AND sequence padding)",
+                  fn=self._waste_locked)
+        reg.gauge("paddle_serving_compile_count",
+                  "predictor shape-bucket compilations since start",
+                  fn=lambda: self.compile_count)
+        self._requests = reg.counter(
+            "paddle_serving_requests_total",
+            "request outcomes by result", label="result",
+            preset=("accepted", "responses", "rejected_queue_full",
+                    "rejected_draining", "deadline_expired", "cancelled",
+                    "errors"),
+            fixed=True)
+        self.batch_size_hist = reg.histogram(
             "paddle_serving_batch_size",
             "requests coalesced per dispatched batch",
             [1, 2, 4, 8, 16, 32, 64, 128])
-        self.queue_latency_hist = Histogram(
+        self.queue_latency_hist = reg.histogram(
             "paddle_serving_queue_latency_ms",
             "milliseconds a request waited in the batch queue",
             [0.5, 1, 2, 5, 10, 20, 50, 100, 250, 500, 1000, 5000])
-        self.request_latency_hist = Histogram(
+        self.request_latency_hist = reg.histogram(
             "paddle_serving_request_latency_ms",
             "end-to-end request latency in milliseconds",
             [1, 2, 5, 10, 20, 50, 100, 250, 500, 1000, 5000])
@@ -91,10 +88,15 @@ class ServingMetrics:
         self.padded_slots_total = 0
         self.compile_count = 0
 
+    @property
+    def counters(self):
+        """The request-outcome counts, dict-like (tests/engine read
+        `metrics.counters["errors"]` as before the registry migration)."""
+        return self._requests.values
+
     # -- recording hooks (engine/server threads) ---------------------------
     def count(self, name: str, n: int = 1):
-        with self._lock:
-            self.counters[name] += n
+        self._requests.inc(name, n)
 
     def observe_batch(self, n_requests: int, bucket_batch: int,
                       real_elems: int = None, total_elems: int = None):
@@ -104,19 +106,18 @@ class ServingMetrics:
         if total_elems is None:
             real_elems, total_elems = n_requests, bucket_batch
         with self._lock:
-            self.batch_size_hist.observe(n_requests)
+            self.batch_size_hist._observe_locked(n_requests)
             self.batch_slots_total += total_elems
             self.padded_slots_total += total_elems - real_elems
 
     def observe_queue_wait(self, seconds: float):
-        with self._lock:
-            self.queue_latency_hist.observe(seconds * 1e3)
+        self.queue_latency_hist.observe(seconds * 1e3)
 
     def observe_completion(self, latency_s: float):
         now = time.monotonic()
         with self._lock:
-            self.counters["responses"] += 1
-            self.request_latency_hist.observe(latency_s * 1e3)
+            self._requests.inc("responses")
+            self.request_latency_hist._observe_locked(latency_s * 1e3)
             self._latencies.append(latency_s * 1e3)
             self._completions.append(now)
             cutoff = now - self.QPS_WINDOW_S
@@ -146,16 +147,18 @@ class ServingMetrics:
                    if t >= now - self.QPS_WINDOW_S)
         return live / span
 
+    def _waste_locked(self):
+        return (self.padded_slots_total / self.batch_slots_total
+                if self.batch_slots_total else 0.0)
+
     def snapshot(self) -> dict:
         """Programmatic view (bench.py serving fields, tests)."""
         with self._lock:
-            waste = (self.padded_slots_total / self.batch_slots_total
-                     if self.batch_slots_total else 0.0)
             return {
                 "qps": round(self._qps_locked(), 2),
                 "p50_ms": round(self._quantile_locked(0.50), 3),
                 "p99_ms": round(self._quantile_locked(0.99), 3),
-                "padding_waste_ratio": round(waste, 4),
+                "padding_waste_ratio": round(self._waste_locked(), 4),
                 "batches": self.batch_size_hist.total,
                 "mean_batch_size": round(
                     self.batch_size_hist.sum / self.batch_size_hist.total, 2)
@@ -165,38 +168,4 @@ class ServingMetrics:
             }
 
     def prometheus_text(self) -> str:
-        with self._lock:
-            lines = []
-            lines.append("# HELP paddle_serving_qps completed requests per "
-                         "second over the trailing window")
-            lines.append("# TYPE paddle_serving_qps gauge")
-            lines.append(f"paddle_serving_qps {self._qps_locked():g}")
-            for q, name in ((0.50, "p50"), (0.99, "p99")):
-                lines.append(f"# HELP paddle_serving_{name}_ms request "
-                             f"latency {name} in milliseconds")
-                lines.append(f"# TYPE paddle_serving_{name}_ms gauge")
-                lines.append(f"paddle_serving_{name}_ms "
-                             f"{self._quantile_locked(q):g}")
-            waste = (self.padded_slots_total / self.batch_slots_total
-                     if self.batch_slots_total else 0.0)
-            lines.append("# HELP paddle_serving_padding_waste_ratio padded "
-                         "input elements / dispatched input elements "
-                         "(batch-slot AND sequence padding)")
-            lines.append("# TYPE paddle_serving_padding_waste_ratio gauge")
-            lines.append(f"paddle_serving_padding_waste_ratio {waste:g}")
-            lines.append("# HELP paddle_serving_compile_count predictor "
-                         "shape-bucket compilations since start")
-            lines.append("# TYPE paddle_serving_compile_count gauge")
-            lines.append(f"paddle_serving_compile_count {self.compile_count}")
-            lines.append("# HELP paddle_serving_requests_total request "
-                         "outcomes by result")
-            lines.append("# TYPE paddle_serving_requests_total counter")
-            for key in ("accepted", "responses", "rejected_queue_full",
-                        "rejected_draining", "deadline_expired",
-                        "cancelled", "errors"):
-                lines.append(f'paddle_serving_requests_total'
-                             f'{{result="{key}"}} {self.counters[key]}')
-            lines.extend(self.batch_size_hist.render())
-            lines.extend(self.queue_latency_hist.render())
-            lines.extend(self.request_latency_hist.render())
-            return "\n".join(lines) + "\n"
+        return self.registry.prometheus_text()

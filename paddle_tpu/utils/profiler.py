@@ -12,9 +12,12 @@ jax.profiler.TraceAnnotation so named scopes appear inside device traces.
 from __future__ import annotations
 
 import contextlib
+import logging
 import time
 
 import jax
+
+logger = logging.getLogger("paddle_tpu.profiler")
 
 
 class RecordEvent:
@@ -57,6 +60,12 @@ class StepTimers:
     def __init__(self):
         self.totals: dict[str, float] = {}
         self.counts: dict[str, int] = {}
+
+    def reset(self):
+        """Zero the accumulators: per-epoch phase summaries should
+        describe that epoch, not the whole process lifetime."""
+        self.totals.clear()
+        self.counts.clear()
 
     @contextlib.contextmanager
     def scope(self, name: str):
@@ -104,9 +113,9 @@ def stop_profiler(sorted_key=None, profile_path=None):
                                   "host_trace.json")
         n = export_chrome_trace(target)
         if n < 0:
-            print(f"warning: host trace export to {target} failed")
-    print(f"profiler trace written to {_trace_dir} "
-          "(open with TensorBoard or perfetto)")
+            logger.warning("host trace export to %s failed", target)
+    logger.info("profiler trace written to %s (open with TensorBoard or "
+                "perfetto)", _trace_dir)
 
 
 def export_chrome_trace(path: str) -> int:

@@ -34,6 +34,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "dp: SPMD-sharded TrainEngine test (Model.fit on a "
         "dp mesh of the 8 virtual devices) — run via tools/dp_smoke.sh")
+    config.addinivalue_line(
+        "markers", "monitor: runtime telemetry test (paddle_tpu.monitor "
+        "+ utils.metrics) — run via tools/obs_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,139 @@
+"""utils/profiler.py coverage: RecordEvent elapsed/nesting, StepTimers
+accumulation + reset, chrome-trace export, Profiler start/stop
+idempotence, and the logger-not-print satellite contract."""
+import json
+import logging
+import time
+
+import pytest
+
+import paddle_tpu  # noqa: F401 - jax compat shims
+from paddle_tpu.utils import profiler as prof
+
+
+class TestRecordEvent:
+    def test_elapsed_measures_scope(self):
+        with prof.RecordEvent("t.scope") as ev:
+            time.sleep(0.01)
+        assert ev.elapsed >= 0.009
+        assert ev.name == "t.scope"
+
+    def test_nesting(self):
+        with prof.RecordEvent("outer") as outer:
+            with prof.RecordEvent("inner") as inner:
+                time.sleep(0.002)
+        assert inner.elapsed <= outer.elapsed
+        assert inner.elapsed >= 0.001
+
+    def test_exception_propagates_and_still_times(self):
+        ev = prof.RecordEvent("boom")
+        with pytest.raises(ValueError):
+            with ev:
+                raise ValueError("boom")
+        assert ev.elapsed >= 0.0
+
+
+class TestStepTimers:
+    def test_accumulates_totals_and_counts(self):
+        t = prof.StepTimers()
+        for _ in range(3):
+            with t.scope("data"):
+                time.sleep(0.001)
+        with t.scope("dispatch"):
+            pass
+        s = t.summary()
+        assert s["data"]["count"] == 3
+        assert s["data"]["total_s"] >= 0.002
+        assert s["dispatch"]["count"] == 1
+
+    def test_reset_zeroes_accumulators(self):
+        """Per-epoch phase summaries must not accumulate forever."""
+        t = prof.StepTimers()
+        with t.scope("data"):
+            pass
+        assert t.summary()
+        t.reset()
+        assert t.summary() == {}
+        assert t.totals == {} and t.counts == {}
+        # usable after reset
+        with t.scope("sync"):
+            pass
+        assert t.summary()["sync"]["count"] == 1
+
+
+class TestChromeTraceExport:
+    def test_export_path(self, tmp_path):
+        """Host RecordEvent scopes land in chrome://tracing JSON when the
+        native core is available; without it the export reports failure
+        (negative) instead of writing garbage."""
+        from paddle_tpu import core
+
+        path = str(tmp_path / "trace.json")
+        core.trace_clear()
+        core.profiler_enable(True)
+        try:
+            with prof.RecordEvent("outer"):
+                with prof.RecordEvent("inner"):
+                    time.sleep(0.001)
+        finally:
+            core.profiler_enable(False)
+        n = prof.export_chrome_trace(path)
+        if not core.available():
+            assert n < 0
+            return
+        assert n == 2
+        events = json.load(open(path))["traceEvents"]
+        names = {e.get("name") for e in events}
+        assert {"outer", "inner"} <= names
+
+
+class TestProfilerFacade:
+    @pytest.fixture
+    def recorded(self, monkeypatch):
+        calls = []
+        monkeypatch.setattr(prof, "start_profiler",
+                            lambda *a, **k: calls.append("start"))
+        monkeypatch.setattr(prof, "stop_profiler",
+                            lambda *a, **k: calls.append("stop"))
+        return calls
+
+    def test_start_stop_idempotent(self, recorded):
+        p = prof.Profiler()
+        p.start()
+        p.start()  # second start must NOT start a second trace
+        assert recorded == ["start"]
+        p.stop()
+        p.stop()   # second stop is a no-op
+        assert recorded == ["start", "stop"]
+
+    def test_disabled_profiler_never_starts(self, recorded):
+        p = prof.Profiler(enabled=False)
+        p.start()
+        p.stop()
+        assert recorded == []
+
+    def test_context_manager(self, recorded):
+        with prof.Profiler():
+            pass
+        assert recorded == ["start", "stop"]
+
+    def test_options_unknown_key_raises(self):
+        with pytest.raises(ValueError):
+            prof.ProfilerOptions()["no_such_option"]
+
+
+class TestLoggerNotPrint:
+    def test_stop_profiler_routes_through_logger(self, tmp_path, capsys,
+                                                 caplog, monkeypatch):
+        """The user-facing print() calls in stop_profiler were replaced
+        by the module logger (paddle_tpu.hapi logger pattern)."""
+        import jax
+
+        monkeypatch.setattr(jax.profiler, "start_trace", lambda d: None)
+        monkeypatch.setattr(jax.profiler, "stop_trace", lambda: None)
+        with caplog.at_level(logging.INFO, logger="paddle_tpu.profiler"):
+            prof.start_profiler(str(tmp_path))
+            prof.stop_profiler(profile_path=str(tmp_path))
+        assert capsys.readouterr().out == ""
+        assert any("profiler trace written" in r.message
+                   for r in caplog.records)
