@@ -1,0 +1,39 @@
+"""Framework-aware static analysis for paddle_tpu (stdlib-ast, no deps).
+
+The reference Fluid codebase kept 546 operators honest with compile-time
+machinery: op-registry macros, PADDLE_ENFORCE, and sanitizer CI.  This
+package is the jax-native equivalent — a checker suite that mechanizes
+the review passes PRs 4-6 kept re-running by hand, so the recurring
+hazard classes (donated-buffer aliasing, jax on the checkpoint writer
+thread, lock-taking signal handlers, pod-deadlocking divergent
+collectives, hidden host syncs, flag-registry drift) fail CI instead of
+paging someone.
+
+Usage:
+    python -m paddle_tpu.analysis [paths] [--format json] [--baseline F]
+or programmatically:
+    from paddle_tpu.analysis import run_analysis
+    result = run_analysis(["paddle_tpu"], baseline="tools/analysis_baseline.json")
+
+Suppression: `# noqa: PTA001` (line), `# pta: disable-file=PTA001` or
+`# pta: skip-file` (file).  Grandfathered findings live in a committed
+baseline (line-number independent); `--write-baseline` regenerates it.
+"""
+from .core import (  # noqa: F401
+    Checker,
+    Finding,
+    ProjectContext,
+    iter_checkers,
+    register,
+    run_analysis,
+)
+from . import checkers as _checkers  # noqa: F401  (registration side effects)
+
+__all__ = [
+    "Checker",
+    "Finding",
+    "ProjectContext",
+    "iter_checkers",
+    "register",
+    "run_analysis",
+]

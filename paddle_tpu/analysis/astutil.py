@@ -1,0 +1,256 @@
+"""Shared AST helpers: dotted-name resolution, import maps, and a
+name-based (conservative, same-module-biased) function index + call graph
+used by the reachability checkers."""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import posixpath
+from typing import Iterator
+
+from .core import ParsedFile, ProjectContext
+
+
+def dotted_name(node: ast.AST) -> str | None:
+    """'np.asarray' for Attribute chains, 'print' for Names; None for
+    anything dynamic (subscripts, calls, literals)."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(call: ast.Call) -> str | None:
+    return dotted_name(call.func)
+
+
+class ImportMap:
+    """Local alias -> canonical module path, collected from every import
+    statement in the module (module level AND function level — the
+    codebase imports lazily inside functions a lot)."""
+
+    def __init__(self, pf: ParsedFile):
+        self.pf = pf
+        self.alias: dict[str, str] = {}
+        if pf.tree is None:
+            return
+        pkg_dir = posixpath.dirname(pf.relpath)
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.alias[a.asname] = a.name
+                    else:
+                        head = a.name.split(".")[0]
+                        self.alias[head] = head
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                if node.level:  # relative: resolve against the file's dir
+                    base = pkg_dir
+                    for _ in range(node.level - 1):
+                        base = posixpath.dirname(base)
+                    mod = posixpath.join(base, *mod.split(".")) if mod \
+                        else base
+                    mod = mod.replace("/", ".")
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    self.alias[a.asname or a.name] = f"{mod}.{a.name}" \
+                        if mod else a.name
+
+    def canonical(self, dotted: str) -> str:
+        """Expand the first segment through the alias table:
+        'np.asarray' -> 'numpy.asarray', 'jnp.array' -> 'jax.numpy.array'."""
+        head, _, rest = dotted.partition(".")
+        base = self.alias.get(head, head)
+        return f"{base}.{rest}" if rest else base
+
+
+def import_map(ctx: ProjectContext, pf: ParsedFile) -> ImportMap:
+    cache = ctx.cache("import_maps")
+    if pf.relpath not in cache:
+        cache[pf.relpath] = ImportMap(pf)
+    return cache[pf.relpath]
+
+
+def enclosing_function(pf: ParsedFile, node: ast.AST):
+    """Nearest enclosing FunctionDef/AsyncFunctionDef, or None."""
+    parents = pf.parents()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return cur
+        cur = parents.get(cur)
+    return None
+
+
+def in_main_guard(pf: ParsedFile, node: ast.AST) -> bool:
+    """True when node sits under `if __name__ == "__main__":` or inside
+    a function named main/_main (CLI entry points print by contract)."""
+    parents = pf.parents()
+    cur: ast.AST | None = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                and cur.name in ("main", "_main"):
+            return True
+        if isinstance(cur, ast.If):
+            t = cur.test
+            if isinstance(t, ast.Compare) and \
+                    isinstance(t.left, ast.Name) and \
+                    t.left.id == "__name__":
+                return True
+        cur = parents.get(cur)
+    return False
+
+
+# -- function index + call graph -------------------------------------------
+
+@dataclasses.dataclass
+class FuncInfo:
+    module: str          # relpath of the defining file
+    qualname: str        # "f" or "Class.m"
+    node: ast.FunctionDef
+
+
+def _iter_defs(tree: ast.Module) -> Iterator[tuple[str, ast.FunctionDef]]:
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node.name, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield f"{node.name}.{sub.name}", sub
+
+
+class FunctionIndex:
+    """Per-module function/method tables for the whole project."""
+
+    def __init__(self, ctx: ProjectContext):
+        self.ctx = ctx
+        # module -> {qualname: FuncInfo}
+        self.by_module: dict[str, dict[str, FuncInfo]] = {}
+        # module -> {bare method/function name: [FuncInfo, ...]}
+        self.by_name: dict[str, dict[str, list[FuncInfo]]] = {}
+        for pf in ctx.iter_python():
+            if pf.tree is None:
+                continue
+            mod: dict[str, FuncInfo] = {}
+            names: dict[str, list[FuncInfo]] = {}
+            for qual, node in _iter_defs(pf.tree):
+                info = FuncInfo(pf.relpath, qual, node)
+                mod[qual] = info
+                names.setdefault(qual.rsplit(".", 1)[-1], []).append(info)
+            self.by_module[pf.relpath] = mod
+            self.by_name[pf.relpath] = names
+
+    def module_of_canonical(self, canonical: str) -> tuple[str, str] | None:
+        """'pkg.sub.mod.func' -> (relpath, 'func') when pkg/sub/mod.py is
+        one of the scanned files.  Falls back to dropping the leading
+        package segment so absolute imports resolve when the scan root
+        is the package directory itself."""
+        parts = canonical.split(".")
+        for plist in (parts, parts[1:]):
+            if len(plist) < 2:
+                continue
+            mod, fname = "/".join(plist[:-1]), plist[-1]
+            for relpath in (mod + ".py", mod + "/__init__.py"):
+                if relpath in self.by_module:
+                    return relpath, fname
+        return None
+
+
+def function_index(ctx: ProjectContext) -> FunctionIndex:
+    cache = ctx.cache("function_index")
+    if "idx" not in cache:
+        cache["idx"] = FunctionIndex(ctx)
+    return cache["idx"]
+
+
+def body_nodes(func: ast.FunctionDef,
+               include_nested: bool = True) -> Iterator[ast.AST]:
+    """Walk a function body.  With include_nested=False, nested def/class
+    bodies are skipped (their behavior is separate); lambdas are always
+    included (they run inline often enough — Thread targets, retries)."""
+    stack: list[ast.AST] = list(func.body)
+    while stack:
+        node = stack.pop()
+        yield node
+        if not include_nested and isinstance(
+                node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def jax_references(imap: ImportMap,
+                   func: ast.FunctionDef) -> list[ast.AST]:
+    """AST nodes inside `func` that resolve to the jax package (names /
+    attribute chains rooted at a jax import alias)."""
+    out = []
+    parents_seen: set[int] = set()
+    for node in body_nodes(func):
+        if isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if not isinstance(base, ast.Name):
+                continue
+            if id(node) in parents_seen:
+                continue
+            canon = imap.canonical(dotted_name(node) or base.id)
+            if canon == "jax" or canon.startswith("jax."):
+                out.append(node)
+                for sub in ast.walk(node):
+                    parents_seen.add(id(sub))
+        elif isinstance(node, ast.Name) and id(node) not in parents_seen:
+            canon = imap.canonical(node.id)
+            if canon == "jax" or canon.startswith("jax."):
+                out.append(node)
+    return out
+
+
+def call_edges(ctx: ProjectContext, idx: FunctionIndex, module: str,
+               func: ast.FunctionDef) -> list[tuple[FuncInfo, ast.Call]]:
+    """Resolve the calls inside `func` to project functions.
+
+    Conservative name-based resolution:
+      * bare `f()` / imported `mod.f()` -> that function when indexed;
+      * `self.m()` / `obj.m()` -> every same-module function or method
+        named `m` (over-approximates: for invariant checking a false
+        edge beats a missed one).
+    """
+    imap = import_map(ctx, ctx.files[module])
+    edges: list[tuple[FuncInfo, ast.Call]] = []
+    mod_funcs = idx.by_module.get(module, {})
+    mod_names = idx.by_name.get(module, {})
+    for node in body_nodes(func):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = call_name(node)
+        if dotted is None:
+            continue
+        canon = imap.canonical(dotted)
+        hit = idx.module_of_canonical(canon)
+        if hit is not None:
+            relpath, fname = hit
+            target = idx.by_module[relpath].get(fname)
+            if target is not None:
+                edges.append((target, node))
+                continue
+            for info in idx.by_name.get(relpath, {}).get(fname, []):
+                edges.append((info, node))
+            continue
+        if "." not in dotted:
+            target = mod_funcs.get(dotted)
+            if target is not None and target.node is not func:
+                edges.append((target, node))
+            continue
+        # attribute call: match terminal name against same-module defs
+        terminal = dotted.rsplit(".", 1)[-1]
+        for info in mod_names.get(terminal, []):
+            if info.node is not func:
+                edges.append((info, node))
+    return edges

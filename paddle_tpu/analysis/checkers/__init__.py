@@ -1,0 +1,18 @@
+"""Rule modules — importing them registers each checker (see core.register).
+
+Rule catalog (the incident each rule encodes is in its module docstring):
+  PTA001 donation-aliasing        zero-copy host views of donated buffers
+  PTA002 writer-thread-jax-free   jax reachable from jax-free threads
+  PTA003 async-signal-safe        locks/logging inside signal handlers
+  PTA004 divergent-collective     per-process gates before collectives
+  PTA005 host-sync-in-hot-path    implicit device→host syncs in step code
+  PTA006 flags-registry-hygiene   undeclared FLAGS_* reads, print() in libs
+"""
+from . import (  # noqa: F401
+    donation,
+    thread_jax,
+    signal_safe,
+    collective_gate,
+    host_sync,
+    flags_hygiene,
+)

@@ -1,0 +1,133 @@
+"""PTA004: per-process early exits between a multi-process gate and a
+collective deadlock the pod.
+
+Incident (PR 5, third/fourth review passes): `CheckpointManager.save`
+skipped duplicate writes when the generation's COMMIT marker already
+existed on disk, and the emergency-save flush-timeout path returned
+early — both gates read PER-PROCESS state (shared-filesystem visibility,
+a stalled local writer).  On a multi-host pod one process takes the
+early exit while its peers proceed into `_ft_state`'s allgather: the
+collective never completes and the pod hangs inside the SIGTERM grace
+window.  The fix gated both exits on the cached `_single_process` bool
+("a duplicate write is harmless; a divergent collective is not").
+
+Rule: inside the distributed-adjacent packages, a `return`/`continue`
+that (a) precedes a collective call in the same function and (b) is
+conditioned on per-process state (filesystem probes, `process_index`,
+writer-role attributes, timeouts) must be guarded single-process
+(`self._single_process`, `process_count() == 1`).  Uniform conditions
+(pure arithmetic on arguments — e.g. step-interval checks) are exempt:
+they decide identically on every process.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name
+from ..core import Checker, Finding, register
+
+SCOPE_SEGMENTS = {"distributed", "hapi", "serving", "monitor"}
+
+COLLECTIVES = {"process_allgather", "all_gather", "allgather",
+               "broadcast_one_to_all", "sync_global_devices", "_host_view",
+               "materialize", "psum", "all_reduce", "allreduce", "barrier",
+               "_ft_state"}
+
+# condition reads per-process state when it mentions one of these
+DIVERGENT_MARKERS = ("os.path.", "os.stat", "os.listdir", "os.access",
+                     ".exists(", "latest_step", "all_steps", "glob.",
+                     "process_index", "is_writer", "_writer_process",
+                     "getmtime", "environ", "monotonic", "time.time",
+                     "random.", "timed_out", "timeout")
+SAFE_GUARDS = ("_single_process", "single_process", "process_count() == 1",
+               "process_count()==1", "process_count() < 2")
+
+
+def _test_source(pf, test: ast.AST) -> str:
+    try:
+        return ast.unparse(test)
+    except Exception:  # pragma: no cover - unparse is total on 3.10+
+        return pf.line_text(test.lineno)
+
+
+def _first_collective_line(func: ast.FunctionDef):
+    """Line of the first collective call in the function body (nested
+    defs excluded — they execute on their own schedule)."""
+    best = None
+    stack = list(func.body)
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            continue
+        if isinstance(node, ast.Call):
+            d = call_name(node)
+            if d and d.rsplit(".", 1)[-1] in COLLECTIVES:
+                if best is None or node.lineno < best[0]:
+                    best = (node.lineno, d)
+        stack.extend(ast.iter_child_nodes(node))
+    return best
+
+
+@register
+class DivergentCollectiveGate(Checker):
+    rule = "PTA004"
+    name = "divergent-collective-gate"
+    description = ("early return/continue conditioned on per-process "
+                   "state before a collective — one process skips the "
+                   "allgather its peers enter and the pod deadlocks")
+    incident = ("PR 5: save()'s COMMIT-exists dedup and the emergency "
+                "flush-timeout return diverged across hosts ahead of "
+                "_ft_state's allgather — fixed by _single_process gates")
+
+    def check_file(self, ctx, pf):
+        if not SCOPE_SEGMENTS.intersection(pf.relpath.split("/")[:-1]):
+            return
+        parents = pf.parents()
+        for func in ast.walk(pf.tree):
+            if not isinstance(func, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            coll = _first_collective_line(func)
+            if coll is None:
+                continue
+            coll_line, coll_name = coll
+            for node in ast.walk(func):
+                if not isinstance(node, (ast.Return, ast.Continue)):
+                    continue
+                if node.lineno >= coll_line:
+                    continue
+                # collect the If chain between this exit and the function
+                divergent_test = None
+                safe = False
+                cur = parents.get(node)
+                while cur is not None and cur is not func:
+                    if isinstance(cur, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef)):
+                        break  # exit belongs to a nested def
+                    if isinstance(cur, ast.If):
+                        src = _test_source(pf, cur.test)
+                        if any(g in src for g in SAFE_GUARDS):
+                            safe = True
+                            break
+                        if divergent_test is None and \
+                                any(m in src for m in DIVERGENT_MARKERS):
+                            divergent_test = src
+                    cur = parents.get(cur)
+                else:
+                    cur = func
+                if cur is not func and not safe:
+                    continue  # nested def — not this function's flow
+                if safe or divergent_test is None:
+                    continue
+                kind = ("return" if isinstance(node, ast.Return)
+                        else "continue")
+                yield Finding(
+                    self.rule, pf.relpath, node.lineno, node.col_offset,
+                    f"early {kind} gated on per-process state "
+                    f"(`{divergent_test[:80]}`) before the collective "
+                    f"`{coll_name}` at line {coll_line} — a process that "
+                    "exits here skips the collective its peers enter "
+                    "(pod deadlock); gate it on `self._single_process` / "
+                    "`jax.process_count() == 1` or move it after the "
+                    "collective",
+                    pf.line_text(node.lineno))

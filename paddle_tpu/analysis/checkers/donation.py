@@ -1,0 +1,95 @@
+"""PTA001: zero-copy host views of possibly-donated device buffers.
+
+Incident (PR 5, fixed twice): `resilience.materialize` built "materialized"
+checkpoints with `np.asarray`, which on the CPU backend returns a ZERO-COPY
+view of the device buffer.  The engine donates that buffer on the next
+dispatch, so the checkpoint silently tracked post-step values —
+allocation-order-dependent corruption that surfaced as two "order-dependent"
+flaky tests.  The same class recurred in `_legacy_orbax_restore` (orbax hands
+back host numpy that jax ingests zero-copy, then donation invalidates it).
+
+Rule: inside the engine-adjacent packages (hapi/, distributed/, monitor/,
+serving/, inference/, framework/), device→host materialization must copy:
+
+  * `np.asarray(x)`            -> use `np.array(x, copy=True)`
+  * `np.array(x, copy=False)`  -> use `copy=True`
+  * `np.frombuffer(b)`         -> append `.copy()` (read-only view otherwise)
+
+Sanctioned zero-copy faces (`_host_view`-style, where the bytes are consumed
+before the next dispatch) carry `# noqa: PTA001` with a justification.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, import_map
+from ..core import Checker, Finding, register
+
+SCOPE_SEGMENTS = {"hapi", "distributed", "monitor", "serving",
+                  "inference", "framework"}
+
+
+def in_scope(relpath: str) -> bool:
+    return bool(SCOPE_SEGMENTS.intersection(relpath.split("/")[:-1]))
+
+
+def _is_copied_immediately(pf, call: ast.Call) -> bool:
+    """True for np.frombuffer(...).copy() — the view never escapes."""
+    parents = pf.parents()
+    attr = parents.get(call)
+    if isinstance(attr, ast.Attribute) and attr.attr == "copy":
+        outer = parents.get(attr)
+        return isinstance(outer, ast.Call) and outer.func is attr
+    return False
+
+
+@register
+class DonationAliasing(Checker):
+    rule = "PTA001"
+    name = "donation-aliasing"
+    description = ("zero-copy host view (np.asarray/np.frombuffer/"
+                   "copy=False) of a value that may alias a donated "
+                   "device buffer")
+    incident = ("PR 5: materialize() used np.asarray — 'materialized' "
+                "checkpoints aliased donated buffers and tracked "
+                "post-step values")
+
+    def check_file(self, ctx, pf):
+        if not in_scope(pf.relpath):
+            return
+        imap = import_map(ctx, pf)
+        for node in ast.walk(pf.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dotted = call_name(node)
+            if dotted is None:
+                continue
+            canon = imap.canonical(dotted)
+            if canon == "numpy.asarray":
+                yield Finding(
+                    self.rule, pf.relpath, node.lineno, node.col_offset,
+                    "np.asarray is a zero-copy view — a donated device "
+                    "buffer aliased here is rewritten in place by the "
+                    "next dispatched step; materialize with "
+                    "np.array(..., copy=True)",
+                    pf.line_text(node.lineno))
+            elif canon == "numpy.frombuffer" \
+                    and not _is_copied_immediately(pf, node):
+                yield Finding(
+                    self.rule, pf.relpath, node.lineno, node.col_offset,
+                    "np.frombuffer returns a zero-copy (read-only) view "
+                    "of the buffer — jax ingests it zero-copy on CPU and "
+                    "donation then segfaults/corrupts; append .copy()",
+                    pf.line_text(node.lineno))
+            elif canon in ("numpy.array", "jax.numpy.array"):
+                for kw in node.keywords:
+                    if kw.arg == "copy" and \
+                            isinstance(kw.value, ast.Constant) and \
+                            kw.value.value is False:
+                        yield Finding(
+                            self.rule, pf.relpath, node.lineno,
+                            node.col_offset,
+                            "array(..., copy=False) aliases its input — "
+                            "engine state / checkpoint leaves must own "
+                            "their bytes (copy=True)",
+                            pf.line_text(node.lineno))

@@ -1,0 +1,104 @@
+"""PTA006: flags-registry hygiene and library logging discipline.
+
+Two invariants, both registry-shaped:
+
+  * every `FLAGS_*` environment read resolves to a flag declared via
+    `define_flag("FLAGS_...")` in `framework/flags.py`.  The registry is
+    the single source of truth for defaults, types, and the README flag
+    table; an undeclared read (the launcher's `FLAGS_selected_tpus` was
+    one) silently bypasses validation and documentation.
+  * library code talks through module loggers, not `print()`.  Progress
+    bars, `Model.summary()`-style user-facing contracts, and `main()`
+    entrypoints are exempt — the first two via `# noqa: PTA006` with a
+    justification, `main()`/`__main__` automatically.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import call_name, in_main_guard
+from ..core import Checker, Finding, register
+
+FLAG_RE = re.compile(r"^FLAGS_[A-Za-z][A-Za-z0-9_]*$")
+FLAGS_MODULE_SUFFIX = "framework/flags.py"
+
+
+def _declared_flags(ctx):
+    """Normalized flag names declared via define_flag in flags.py."""
+    declared = set()
+    found_registry = False
+    for pf in ctx.iter_python():
+        if not pf.relpath.endswith(FLAGS_MODULE_SUFFIX) or pf.tree is None:
+            continue
+        found_registry = True
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call) and \
+                    (call_name(node) or "").rsplit(".", 1)[-1] == \
+                    "define_flag" and node.args and \
+                    isinstance(node.args[0], ast.Constant) and \
+                    isinstance(node.args[0].value, str):
+                declared.add(node.args[0].value.lower())
+    return declared if found_registry else None
+
+
+def _docstring_nodes(tree):
+    """Constant nodes that are module/class/function docstrings."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)) and node.body:
+            first = node.body[0]
+            if isinstance(first, ast.Expr) and \
+                    isinstance(first.value, ast.Constant) and \
+                    isinstance(first.value.value, str):
+                out.add(id(first.value))
+    return out
+
+
+@register
+class FlagsRegistryHygiene(Checker):
+    rule = "PTA006"
+    name = "flags-registry-hygiene"
+    description = ("FLAGS_* read with no define_flag declaration in "
+                   "framework/flags.py, or print() in library code "
+                   "outside main()")
+    incident = ("FLAGS_selected_tpus was read by the launcher and env "
+                "plumbing but never declared — invisible to validation "
+                "and the README flag table")
+
+    def check_project(self, ctx):
+        declared = _declared_flags(ctx)
+        for pf in ctx.iter_python():
+            if pf.tree is None:
+                continue
+            is_registry = pf.relpath.endswith(FLAGS_MODULE_SUFFIX)
+            docstrings = _docstring_nodes(pf.tree)
+            for node in ast.walk(pf.tree):
+                # -- undeclared FLAGS_* string reads -----------------------
+                if declared is not None and not is_registry and \
+                        isinstance(node, ast.Constant) and \
+                        isinstance(node.value, str) and \
+                        FLAG_RE.match(node.value) and \
+                        id(node) not in docstrings and \
+                        node.value.lower() not in declared:
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        f"`{node.value}` is not declared in "
+                        "framework/flags.py — add a define_flag() entry "
+                        "so the default/type/help live in the registry",
+                        pf.line_text(node.lineno))
+                # -- print() outside main() --------------------------------
+                elif isinstance(node, ast.Call) and \
+                        isinstance(node.func, ast.Name) and \
+                        node.func.id == "print" and \
+                        not in_main_guard(pf, node):
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        "print() in library code — route through the "
+                        "module logger (logging.getLogger(__name__)); "
+                        "user-facing display contracts carry a "
+                        "justified noqa",
+                        pf.line_text(node.lineno))

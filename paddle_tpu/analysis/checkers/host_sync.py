@@ -1,0 +1,111 @@
+"""PTA005: implicit device→host syncs on the training hot path.
+
+Incident (PR 2): the eager fit loop synced on every step (float(loss),
+metric numpy conversions), serializing host and device.  The async
+TrainEngine's contract is sync-free stepping: the ONLY sanctioned
+device→host points run inside `framework/transfer.host_fetch()` scopes
+(loss-ring drains, metric updates, checkpoint materialization) — pinned
+at runtime by the host-conversion tripwire in tests/test_train_engine.py.
+This rule is that tripwire's static twin: it reads the same step/dispatch
+code and flags the sync before it ever runs.
+
+Rule: inside hot-path functions — methods named step/dispatch/_dispatch
+of classes named *Engine, plus any def marked `# pta: hot-path` — flag
+float(x) / x.item() / x.tolist() / x.block_until_ready() /
+np.array|asarray(x) / jax.device_get(x) unless the expression sits under
+`with host_fetch():` (or a `transfer.host_fetch()` attribute spelling)
+or an `if in_host_fetch():` branch.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import call_name, dotted_name, import_map
+from ..core import Checker, Finding, register
+
+HOT_METHOD_NAMES = {"step", "dispatch", "_dispatch"}
+SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+
+def _hot_functions(pf):
+    """(qualname, FunctionDef) for every hot-path function in the file."""
+    for node in ast.walk(pf.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if pf.has_marker(node, "hot-path"):
+                yield node.name, node
+    for cls in pf.tree.body:
+        if not isinstance(cls, ast.ClassDef) or \
+                not cls.name.endswith("Engine"):
+            continue
+        for sub in cls.body:
+            if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)) \
+                    and sub.name in HOT_METHOD_NAMES \
+                    and not pf.has_marker(sub, "hot-path"):
+                yield f"{cls.name}.{sub.name}", sub
+
+
+def _sanctioned(pf, node) -> bool:
+    """True when node is under `with host_fetch()` / `if in_host_fetch()`."""
+    parents = pf.parents()
+    cur = parents.get(node)
+    while cur is not None:
+        if isinstance(cur, ast.With):
+            for item in cur.items:
+                c = item.context_expr
+                if isinstance(c, ast.Call):
+                    d = call_name(c) or ""
+                    if d.rsplit(".", 1)[-1] == "host_fetch":
+                        return True
+        if isinstance(cur, ast.If):
+            for sub in ast.walk(cur.test):
+                if isinstance(sub, ast.Call) and \
+                        (call_name(sub) or "").rsplit(".", 1)[-1] == \
+                        "in_host_fetch":
+                    return True
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+        cur = parents.get(cur)
+    return False
+
+
+@register
+class HostSyncInHotPath(Checker):
+    rule = "PTA005"
+    name = "host-sync-in-hot-path"
+    description = ("implicit device→host sync (float()/.item()/np.array/"
+                   "device_get) in engine step/dispatch code outside a "
+                   "host_fetch() sanctioned scope")
+    incident = ("PR 2: the eager fit loop synced per step; the engine's "
+                "sync-free contract is pinned by the runtime tripwire "
+                "test — this is its static twin")
+
+    def check_file(self, ctx, pf):
+        imap = import_map(ctx, pf)
+        for qual, func in _hot_functions(pf):
+            for node in ast.walk(func):
+                if not isinstance(node, ast.Call):
+                    continue
+                msg = None
+                if isinstance(node.func, ast.Name) and \
+                        node.func.id == "float" and node.args and \
+                        not isinstance(node.args[0], ast.Constant):
+                    msg = "float() forces a device→host sync"
+                elif isinstance(node.func, ast.Attribute) and \
+                        node.func.attr in SYNC_METHODS and not node.args:
+                    msg = f".{node.func.attr}() forces a device→host sync"
+                else:
+                    dotted = call_name(node)
+                    canon = imap.canonical(dotted) if dotted else ""
+                    if canon in ("numpy.array", "numpy.asarray"):
+                        msg = ("numpy conversion of a device array blocks "
+                               "on the device")
+                    elif canon == "jax.device_get":
+                        msg = "jax.device_get blocks on the device"
+                if msg and not _sanctioned(pf, node):
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        f"{msg} inside hot-path `{qual}` — batch it into "
+                        "a host_fetch() scope (framework/transfer.py) or "
+                        "drain it at a log/epoch boundary",
+                        pf.line_text(node.lineno))

@@ -1,0 +1,155 @@
+"""PTA003: signal handlers must be async-signal-safe.
+
+Incident (PR 6): the first obs_smoke run DEADLOCKED — the SIGUSR1 handler
+called `arm_trace`, which takes `_trace_lock`, while the interrupted
+training thread already held that lock inside `poll_trace`.  CPython runs
+handlers between bytecodes on the main thread: any non-reentrant lock the
+interrupted frame holds (including the logging module's internal locks)
+is a self-deadlock waiting for its signal.  The fix was a one-int mailbox
+(`request_trace_signal`) with "no locks, no logging" documented in the
+handler body — this rule mechanizes that comment.
+
+Rule: a function registered via `signal.signal(sig, handler)` — and every
+same-module function it (transitively) calls — must not
+  * acquire locks (`with <...lock/cv/cond...>:`, `.acquire()`,
+    `threading.Lock()` & friends),
+  * log (`logger.*`, `logging.*`, `warnings.warn`) or `print()`.
+Latch an int/flag and act on it from the interrupted thread's next safe
+point instead (see telemetry.request_trace_signal / poll_trace).
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import body_nodes, call_name, dotted_name, import_map
+from ..core import Checker, Finding, register
+
+LOG_METHODS = {"debug", "info", "warning", "warn", "error", "exception",
+               "critical", "log"}
+LOCKISH = ("lock", "mutex", "cond", "_cv")
+LOCK_CTORS = {"threading.Lock", "threading.RLock", "threading.Condition",
+              "threading.Semaphore", "threading.BoundedSemaphore"}
+
+
+def _lockish_name(dotted: str | None) -> bool:
+    if not dotted:
+        return False
+    terminal = dotted.rsplit(".", 1)[-1].lower()
+    return any(t in terminal for t in LOCKISH) or terminal == "cv"
+
+
+def _violations(imap, func):
+    """(node, message) for every unsafe operation inside one function."""
+    for node in body_nodes(func, include_nested=True):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                c = item.context_expr
+                d = dotted_name(c if not isinstance(c, ast.Call)
+                                else c.func)
+                if _lockish_name(d) or (
+                        isinstance(c, ast.Call) and
+                        imap.canonical(call_name(c) or "") in LOCK_CTORS):
+                    yield (node, f"acquires a lock (`with {d}`)")
+        elif isinstance(node, ast.Call):
+            d = call_name(node)
+            if d is None:
+                continue
+            parts = d.split(".")
+            terminal = parts[-1]
+            if terminal == "acquire":
+                yield (node, f"acquires a lock (`{d}()`)")
+            elif len(parts) > 1 and terminal in LOG_METHODS and \
+                    any("log" in p.lower() for p in parts[:-1]):
+                yield (node, f"logs (`{d}`) — the logging module takes "
+                             "handler locks the interrupted frame may "
+                             "hold")
+            elif d == "print":
+                yield (node, "print() takes the stdout lock/buffer")
+            elif imap.canonical(d) == "warnings.warn":
+                yield (node, "warnings.warn allocates and takes "
+                             "registry locks")
+
+
+def _resolve_handler(pf, handler_expr, mod_funcs, mod_names):
+    """handler expression -> list of FunctionDef-like nodes to inspect."""
+    if isinstance(handler_expr, ast.Lambda):
+        return [handler_expr]
+    d = dotted_name(handler_expr)
+    if d is None:
+        return []
+    terminal = d.rsplit(".", 1)[-1]
+    if "." not in d:
+        info = mod_funcs.get(d)
+        if info is not None:
+            return [info.node]
+        # nested def registered from an enclosing function: find any def
+        # with that name anywhere in the module
+        return [n for n in ast.walk(pf.tree)
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and n.name == d]
+    return [i.node for i in mod_names.get(terminal, [])]
+
+
+@register
+class SignalSafeHandlers(Checker):
+    rule = "PTA003"
+    name = "async-signal-safe-handlers"
+    description = ("signal handler (or a same-module function it calls) "
+                   "acquires locks, logs, or prints — self-deadlock when "
+                   "the interrupted frame holds the lock")
+    incident = ("PR 6: SIGUSR1 handler took _trace_lock while the "
+                "interrupted training thread held it in poll_trace — "
+                "obs_smoke deadlocked")
+
+    def check_file(self, ctx, pf):
+        from ..astutil import function_index
+        imap = import_map(ctx, pf)
+        idx = function_index(ctx)
+        mod_funcs = idx.by_module.get(pf.relpath, {})
+        mod_names = idx.by_name.get(pf.relpath, {})
+
+        registered = []  # (register-site call, handler func node)
+        for node in ast.walk(pf.tree):
+            if isinstance(node, ast.Call) and len(node.args) >= 2 and \
+                    imap.canonical(call_name(node) or "") == \
+                    "signal.signal":
+                for fn in _resolve_handler(pf, node.args[1], mod_funcs,
+                                           mod_names):
+                    registered.append((node, fn))
+
+        seen_sites = set()
+        for reg, handler in registered:
+            hname = getattr(handler, "name", "<lambda>")
+            # walk the handler plus same-module transitive callees
+            stack = [(handler, (hname,))]
+            visited = {id(handler)}
+            while stack:
+                func, chain = stack.pop()
+                for node, what in _violations(imap, func):
+                    site = (node.lineno, node.col_offset, self.rule)
+                    if site in seen_sites:
+                        continue
+                    seen_sites.add(site)
+                    via = "" if len(chain) == 1 else \
+                        f" (reached via {' -> '.join(chain)})"
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        f"signal handler `{hname}` {what}{via} — handlers "
+                        "must latch a flag/int and let the interrupted "
+                        "thread act on it (async-signal-safety)",
+                        pf.line_text(node.lineno))
+                for call in body_nodes(func, include_nested=True):
+                    if not isinstance(call, ast.Call):
+                        continue
+                    d = call_name(call)
+                    if d is None:
+                        continue
+                    terminal = d.rsplit(".", 1)[-1]
+                    targets = [mod_funcs[d]] if d in mod_funcs else \
+                        mod_names.get(terminal, []) if "." in d else []
+                    for info in targets:
+                        if id(info.node) not in visited:
+                            visited.add(id(info.node))
+                            stack.append((info.node,
+                                          chain + (info.qualname,)))

@@ -1,0 +1,154 @@
+"""PTA002: jax must be unreachable from jax-free threads.
+
+Incident (PR 2/PR 5): the CPU runtime SEGFAULTS under a third dispatching
+thread.  The async checkpoint writer therefore promises to never touch
+jax — the host snapshot happens on the training thread, the background
+thread only does disk IO (`assume_host=True` all the way down).  PR 5's
+fourth review pass caught `jax.process_count()` sneaking onto the writer
+thread through a dedup gate; PR 6 added the same promise for
+`utils/metrics.py` (the ckpt writer increments counters, so every metrics
+record/render path must stay jax-free too).
+
+Rule, two parts:
+  * jax-free modules (`utils/metrics.py`, or any file carrying a
+    `# pta: disable-file`-style `# pta: jax-free` marker at module level):
+    no jax import or reference anywhere in the module;
+  * jax-free roots (`AsyncCheckpointer._run` — the writer thread's target
+    — plus any def marked `# pta: jax-free`): no call path from the root
+    may reach a function that references jax.  Findings land on the call
+    edge INTO the first jax-touching function, with the full chain in the
+    message; a sanctioned edge (proven unreachable on the thread, e.g.
+    `assume_host=True` pruning) carries `# noqa: PTA002` + justification.
+
+Resolution is name-based and conservative (see astutil.call_edges): a
+false edge beats a silently-missed one for an invariant this sharp.
+"""
+from __future__ import annotations
+
+import ast
+
+from ..astutil import (FuncInfo, body_nodes, call_edges, function_index,
+                       import_map, jax_references)
+from ..core import Checker, Finding, ParsedFile, register
+
+JAX_FREE_MODULE_SUFFIXES = ("utils/metrics.py",)
+DEFAULT_ROOTS = (("distributed/checkpoint.py", "AsyncCheckpointer._run"),)
+
+
+@register
+class WriterThreadJaxFree(Checker):
+    rule = "PTA002"
+    name = "writer-thread-jax-free"
+    description = ("jax reachable from a jax-free thread root (async "
+                   "checkpoint writer) or referenced in a jax-free "
+                   "module (utils/metrics.py)")
+    incident = ("PR 5 fourth pass: jax.process_count() on the writer "
+                "thread — the third-dispatching-thread CPU-runtime "
+                "segfault class")
+
+    # -- part 1: jax-free modules ------------------------------------------
+    def _module_findings(self, ctx, pf: ParsedFile):
+        imap = import_map(ctx, pf)
+        for node in ast.walk(pf.tree):
+            names = []
+            if isinstance(node, ast.Import):
+                names = [a.name for a in node.names]
+            elif isinstance(node, ast.ImportFrom) and not node.level:
+                names = [node.module or ""]
+            if any(n == "jax" or n.startswith("jax.") for n in names):
+                yield Finding(
+                    self.rule, pf.relpath, node.lineno, node.col_offset,
+                    "jax import in a jax-free module — every record/"
+                    "render path here may run on the checkpoint writer "
+                    "thread (third-dispatching-thread segfault)",
+                    pf.line_text(node.lineno))
+
+    # -- part 2: reachability from jax-free roots --------------------------
+    def _roots(self, ctx, idx):
+        for suffix, qual in DEFAULT_ROOTS:
+            for relpath, funcs in idx.by_module.items():
+                if relpath.endswith(suffix) and qual in funcs:
+                    yield funcs[qual]
+        for pf in ctx.iter_python():
+            if pf.tree is None:
+                continue
+            for qual, info in idx.by_module.get(pf.relpath, {}).items():
+                if pf.has_marker(info.node, "jax-free"):
+                    yield info
+
+    def check_project(self, ctx):
+        for pf in ctx.iter_python():
+            if pf.tree is None:
+                continue
+            if any(pf.relpath.endswith(s)
+                   for s in JAX_FREE_MODULE_SUFFIXES) or \
+                    pf.markers.get(1) == "jax-free":
+                yield from self._module_findings(ctx, pf)
+
+        idx = function_index(ctx)
+        # direct-jax table, computed once per function actually visited
+        direct: dict[int, list] = {}
+
+        def jax_in(info: FuncInfo):
+            if id(info.node) not in direct:
+                imap = import_map(ctx, ctx.files[info.module])
+                direct[id(info.node)] = jax_references(imap, info.node)
+            return direct[id(info.node)]
+
+        reported = set()
+        for root in {id(r.node): r for r in self._roots(ctx, idx)}.values():
+            # BFS; stop each branch at the first jax-touching function
+            stack: list[tuple[FuncInfo, tuple[str, ...],
+                              tuple | None]] = [
+                (root, (f"{root.module}:{root.qualname}",), None)]
+            visited = {id(root.node)}
+            while stack:
+                info, chain, entry_edge = stack.pop()
+                refs = jax_in(info)
+                if refs:
+                    ref = min(refs, key=lambda n: n.lineno)
+                    if entry_edge is None:
+                        # the root itself touches jax
+                        site = (info.module, ref.lineno)
+                        if site in reported:
+                            continue
+                        reported.add(site)
+                        pf = ctx.files[info.module]
+                        yield Finding(
+                            self.rule, info.module, ref.lineno,
+                            ref.col_offset,
+                            f"jax-free root `{info.qualname}` references "
+                            "jax directly — this code runs on the "
+                            "checkpoint writer thread (CPU runtime "
+                            "segfaults under a third dispatching thread)",
+                            pf.line_text(ref.lineno))
+                    else:
+                        caller_mod, call_node = entry_edge
+                        site = (caller_mod, call_node.lineno,
+                                info.qualname)
+                        if site in reported:
+                            continue
+                        reported.add(site)
+                        pf = ctx.files[caller_mod]
+                        yield Finding(
+                            self.rule, caller_mod, call_node.lineno,
+                            call_node.col_offset,
+                            f"call chain {' -> '.join(chain)} reaches "
+                            f"jax ({info.module}:{ref.lineno}) from the "
+                            "jax-free writer-thread root — the CPU "
+                            "runtime segfaults under a third "
+                            "dispatching thread; keep this path "
+                            "host-only (assume_host/pre-materialized "
+                            "snapshots) or prove it unreachable and "
+                            "noqa the edge",
+                            pf.line_text(call_node.lineno))
+                    continue  # don't traverse past a tainted function
+                for target, call_node in call_edges(ctx, idx, info.module,
+                                                    info.node):
+                    if id(target.node) in visited:
+                        continue
+                    visited.add(id(target.node))
+                    stack.append(
+                        (target,
+                         chain + (f"{target.module}:{target.qualname}",),
+                         (info.module, call_node)))

@@ -1,0 +1,83 @@
+"""`python -m paddle_tpu.analysis` — run the checker suite.
+
+Exit codes:
+  0  clean (no new findings; baselined/suppressed don't count)
+  1  new findings (or stale baseline entries — the baseline must track
+     the tree it grandfathers)
+  2  usage / configuration error
+
+Typical invocations:
+  python -m paddle_tpu.analysis paddle_tpu \\
+      --baseline tools/analysis_baseline.json
+  python -m paddle_tpu.analysis paddle_tpu --select PTA003 --format json
+  python -m paddle_tpu.analysis paddle_tpu \\
+      --baseline tools/analysis_baseline.json --write-baseline
+"""
+from __future__ import annotations
+
+import argparse
+import sys
+
+from . import checkers as _checkers  # noqa: F401  (registration side effect)
+from .core import run_analysis, write_baseline
+from .reporters import json_report, rules_table, text_report
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="python -m paddle_tpu.analysis",
+        description="paddle_tpu framework-aware static checks (PTA001-006)")
+    p.add_argument("paths", nargs="*", default=["paddle_tpu"],
+                   help="files or directories to analyze "
+                        "(default: paddle_tpu)")
+    p.add_argument("--root", default=None,
+                   help="anchor for relative paths in findings and the "
+                        "baseline (default: the single path's parent, or "
+                        "the common parent)")
+    p.add_argument("--baseline", default=None,
+                   help="committed JSON baseline of grandfathered findings")
+    p.add_argument("--write-baseline", action="store_true",
+                   help="rewrite --baseline from this run's findings "
+                        "(justifications carried over) and exit 0")
+    p.add_argument("--select", default=None,
+                   help="comma-separated rule ids to run (e.g. "
+                        "PTA001,PTA003)")
+    p.add_argument("--format", choices=("text", "json"), default="text")
+    p.add_argument("--verbose", action="store_true",
+                   help="also list baselined findings in text output")
+    p.add_argument("--list-rules", action="store_true",
+                   help="print the rule catalog and exit")
+    return p
+
+
+def main(argv: list[str] | None = None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.list_rules:
+        print(rules_table())
+        return 0
+    select = [s for s in (args.select or "").split(",") if s.strip()] or None
+    if args.write_baseline and not args.baseline:
+        print("--write-baseline requires --baseline", file=sys.stderr)
+        return 2
+    try:
+        result = run_analysis(args.paths, root=args.root,
+                              baseline=args.baseline, select=select)
+    except (ValueError, OSError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(args.baseline, result.all_findings)
+        print(f"baseline written: {args.baseline} "
+              f"({len(result.all_findings)} finding(s))")
+        return 0
+
+    if args.format == "json":
+        print(json_report(result))
+    else:
+        print(text_report(result, verbose=args.verbose))
+    return 0 if result.ok and not result.stale_baseline else 1
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

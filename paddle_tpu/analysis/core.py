@@ -1,0 +1,355 @@
+"""Analysis engine: file model, checker registry, suppression, baseline.
+
+Design constraints:
+  * stdlib only (ast + json + re) — the linter must run before any heavy
+    import and inside the tier-1 budget (<10s over the whole tree);
+  * findings are identified line-number-independently for the baseline
+    (rule + path + hash of the source line text + occurrence index), so
+    unrelated edits above a grandfathered finding don't resurrect it;
+  * checkers never import the code they analyze — pure AST.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import json
+import os
+import re
+import time
+from typing import Iterable, Iterator
+
+# -- findings ---------------------------------------------------------------
+
+_NOQA_RE = re.compile(r"#\s*noqa(?!\w)(?::\s*(?P<rules>[A-Z]+\d+"
+                      r"(?:\s*,\s*[A-Z]+\d+)*))?", re.IGNORECASE)
+_FILE_DIRECTIVE_RE = re.compile(
+    r"#\s*pta:\s*(?P<kind>skip-file|disable-file=(?P<rules>[A-Z0-9,\s]+))",
+    re.IGNORECASE)
+_MARKER_RE = re.compile(r"#\s*pta:\s*(?P<marker>jax-free|hot-path)\b",
+                        re.IGNORECASE)
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str          # "PTA001"
+    path: str          # posix path relative to the analysis root
+    line: int          # 1-based
+    col: int
+    message: str
+    snippet: str = ""  # stripped source line the finding anchors to
+
+    def snippet_hash(self) -> str:
+        return hashlib.sha1(self.snippet.encode()).hexdigest()[:12]
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "col": self.col, "message": self.message,
+                "snippet": self.snippet,
+                "snippet_hash": self.snippet_hash()}
+
+    def text(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"{self.message}")
+
+
+# -- parsed files -----------------------------------------------------------
+
+class ParsedFile:
+    """One source file: AST + suppression/marker maps, parsed once and
+    shared by every checker."""
+
+    def __init__(self, relpath: str, source: str):
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.parse_error: SyntaxError | None = None
+        try:
+            self.tree: ast.Module | None = ast.parse(source)
+        except SyntaxError as e:
+            self.tree = None
+            self.parse_error = e
+        # line (1-based) -> set of suppressed rules, or {"*"} for bare noqa
+        self.noqa: dict[int, set[str]] = {}
+        self.skip_file = False
+        self.disabled_rules: set[str] = set()
+        # line -> marker name ("jax-free" / "hot-path")
+        self.markers: dict[int, str] = {}
+        for i, text in enumerate(self.lines, start=1):
+            if "#" not in text:
+                continue
+            m = _NOQA_RE.search(text)
+            if m:
+                rules = m.group("rules")
+                self.noqa[i] = ({"*"} if not rules else
+                                {r.strip().upper()
+                                 for r in rules.split(",")})
+            d = _FILE_DIRECTIVE_RE.search(text)
+            if d:
+                if d.group("kind").lower() == "skip-file":
+                    self.skip_file = True
+                elif d.group("rules"):
+                    self.disabled_rules |= {
+                        r.strip().upper()
+                        for r in d.group("rules").split(",") if r.strip()}
+            k = _MARKER_RE.search(text)
+            if k:
+                self.markers[i] = k.group("marker").lower()
+        self._parents: dict[ast.AST, ast.AST] | None = None
+
+    def parents(self) -> dict[ast.AST, ast.AST]:
+        """child node -> parent node map (built lazily, cached)."""
+        if self._parents is None:
+            p: dict[ast.AST, ast.AST] = {}
+            for node in ast.walk(self.tree) if self.tree else ():
+                for child in ast.iter_child_nodes(node):
+                    p[child] = node
+            self._parents = p
+        return self._parents
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def suppressed(self, finding: Finding) -> bool:
+        if self.skip_file or finding.rule in self.disabled_rules:
+            return True
+        rules = self.noqa.get(finding.line)
+        return bool(rules) and ("*" in rules or finding.rule in rules)
+
+    def has_marker(self, node: ast.AST, marker: str) -> bool:
+        """True when `node` (a def) carries `# pta: <marker>` on its own
+        line, the line above, or its decorator lines."""
+        lo = getattr(node, "lineno", 0)
+        for line in range(max(1, lo - 1), getattr(node, "body", [node])[0]
+                          .lineno if getattr(node, "body", None) else lo + 1):
+            if self.markers.get(line) == marker:
+                return True
+        return False
+
+
+class ProjectContext:
+    """All parsed files plus lazily-built per-module indexes shared by
+    the project-level checkers."""
+
+    def __init__(self, root: str, files: dict[str, ParsedFile]):
+        self.root = root
+        self.files = files
+        self._caches: dict[str, dict] = {}
+
+    def cache(self, name: str) -> dict:
+        return self._caches.setdefault(name, {})
+
+    def iter_python(self) -> Iterator[ParsedFile]:
+        for rel in sorted(self.files):
+            yield self.files[rel]
+
+
+# -- checker registry -------------------------------------------------------
+
+class Checker:
+    rule = "PTA000"
+    name = "base"
+    description = ""
+    incident = ""  # the real incident this rule encodes (docs/--list-rules)
+
+    def check_file(self, ctx: ProjectContext,
+                   pf: ParsedFile) -> Iterable[Finding]:
+        return ()
+
+    def check_project(self, ctx: ProjectContext) -> Iterable[Finding]:
+        return ()
+
+
+_REGISTRY: dict[str, Checker] = {}
+
+
+def register(cls):
+    """Class decorator: instantiate and register a Checker by rule id."""
+    inst = cls()
+    _REGISTRY[inst.rule] = inst
+    return cls
+
+
+def iter_checkers(select: Iterable[str] | None = None) -> list[Checker]:
+    if select:
+        want = {s.strip().upper() for s in select}
+        unknown = want - set(_REGISTRY)
+        if unknown:
+            raise ValueError(f"unknown rule(s): {sorted(unknown)} "
+                             f"(known: {sorted(_REGISTRY)})")
+        return [_REGISTRY[r] for r in sorted(want)]
+    return [_REGISTRY[r] for r in sorted(_REGISTRY)]
+
+
+# -- baseline ---------------------------------------------------------------
+
+BASELINE_SCHEMA = "paddle_tpu.analysis.baseline/v1"
+
+
+def baseline_key(f: Finding) -> tuple:
+    return (f.rule, f.path, f.snippet_hash())
+
+
+def load_baseline(path: str) -> dict[tuple, list[dict]]:
+    """baseline file -> {(rule, path, snippet_hash): [entry, ...]}.
+    Multiple identical source lines are kept as a list (occurrence
+    count matters, exact line numbers don't)."""
+    with open(path) as fh:
+        data = json.load(fh)
+    if data.get("schema") != BASELINE_SCHEMA:
+        raise ValueError(f"{path}: unknown baseline schema "
+                         f"{data.get('schema')!r}")
+    out: dict[tuple, list[dict]] = {}
+    for e in data.get("findings", []):
+        key = (e["rule"], e["path"], e["snippet_hash"])
+        out.setdefault(key, []).append(e)
+    return out
+
+
+def write_baseline(path: str, findings: list[Finding],
+                   justifications: dict[tuple, str] | None = None):
+    """Write every finding (post-suppression) as the new baseline.
+    Existing per-entry justifications are carried over by key."""
+    prev: dict[tuple, str] = dict(justifications or {})
+    if os.path.exists(path):
+        try:
+            for key, entries in load_baseline(path).items():
+                for e in entries:
+                    if e.get("justification"):
+                        prev.setdefault(key, e["justification"])
+        except (ValueError, OSError, KeyError, json.JSONDecodeError):
+            pass
+    entries = []
+    for f in sorted(findings, key=lambda f: (f.path, f.line, f.rule)):
+        e = {"rule": f.rule, "path": f.path,
+             "snippet_hash": f.snippet_hash(), "snippet": f.snippet,
+             "justification": prev.get(baseline_key(f), "")}
+        entries.append(e)
+    payload = {"schema": BASELINE_SCHEMA, "findings": entries}
+    tmp = path + ".tmp"
+    with open(tmp, "w") as fh:
+        json.dump(payload, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+
+
+# -- run --------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AnalysisResult:
+    root: str
+    new: list[Finding]
+    baselined: list[Finding]
+    suppressed: int
+    stale_baseline: list[dict]   # baseline entries no longer found
+    parse_errors: list[Finding]
+    files_scanned: int
+    elapsed_s: float
+
+    @property
+    def all_findings(self) -> list[Finding]:
+        return sorted(self.new + self.baselined,
+                      key=lambda f: (f.path, f.line, f.rule))
+
+    @property
+    def ok(self) -> bool:
+        return not self.new and not self.parse_errors
+
+
+_SKIP_DIRS = {"__pycache__", ".git", ".hg", "node_modules", "build",
+              "dist", ".eggs"}
+
+
+def _collect_files(paths: list[str], root: str) -> dict[str, ParsedFile]:
+    files: dict[str, ParsedFile] = {}
+    seen = set()
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            cands = [p]
+        else:
+            cands = []
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames if d not in _SKIP_DIRS
+                               and not d.startswith(".")]
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        cands.append(os.path.join(dirpath, fn))
+        for f in cands:
+            if f in seen:
+                continue
+            seen.add(f)
+            rel = os.path.relpath(f, root).replace(os.sep, "/")
+            try:
+                with open(f, encoding="utf-8", errors="replace") as fh:
+                    src = fh.read()
+            except OSError:
+                continue
+            files[rel] = ParsedFile(rel, src)
+    return files
+
+
+def run_analysis(paths: list[str], root: str | None = None,
+                 baseline: str | None = None,
+                 select: Iterable[str] | None = None) -> AnalysisResult:
+    """Analyze `paths` (files or directories).  `root` anchors the
+    relative paths used in findings and the baseline (default: common
+    parent of `paths`).  `baseline` is a committed JSON file of
+    grandfathered findings; matches are reported separately and do not
+    fail the run."""
+    t0 = time.monotonic()
+    if root is None:
+        abspaths = [os.path.abspath(p) for p in paths]
+        root = (os.path.dirname(abspaths[0]) if os.path.isfile(abspaths[0])
+                else abspaths[0]) if len(abspaths) == 1 \
+            else os.path.commonpath(abspaths)
+    root = os.path.abspath(root)
+    files = _collect_files(paths, root)
+    ctx = ProjectContext(root, files)
+
+    parse_errors: list[Finding] = []
+    for pf in ctx.iter_python():
+        if pf.parse_error is not None:
+            e = pf.parse_error
+            parse_errors.append(Finding(
+                "PTA000", pf.relpath, e.lineno or 1, (e.offset or 1) - 1,
+                f"syntax error: {e.msg} (file is unanalyzable)",
+                pf.line_text(e.lineno or 1)))
+
+    collected: list[Finding] = []
+    suppressed = 0
+    for checker in iter_checkers(select):
+        produced: list[Finding] = []
+        for pf in ctx.iter_python():
+            if pf.tree is None:
+                continue
+            produced.extend(checker.check_file(ctx, pf))
+        produced.extend(checker.check_project(ctx))
+        for f in produced:
+            pf = ctx.files.get(f.path)
+            if pf is not None and pf.suppressed(f):
+                suppressed += 1
+            else:
+                collected.append(f)
+
+    base = {}
+    if baseline and os.path.exists(baseline):
+        base = load_baseline(baseline)
+    remaining = {k: list(v) for k, v in base.items()}
+    new: list[Finding] = []
+    baselined: list[Finding] = []
+    for f in sorted(collected, key=lambda f: (f.path, f.line, f.rule)):
+        entries = remaining.get(baseline_key(f))
+        if entries:
+            entries.pop()
+            baselined.append(f)
+        else:
+            new.append(f)
+    stale = [e for entries in remaining.values() for e in entries]
+
+    return AnalysisResult(
+        root=root, new=new, baselined=baselined, suppressed=suppressed,
+        stale_baseline=stale, parse_errors=parse_errors,
+        files_scanned=len(files), elapsed_s=time.monotonic() - t0)

@@ -1,0 +1,81 @@
+"""Render an AnalysisResult as text (human/CI log) or JSON (tooling).
+
+The JSON document is a stable contract (schema key below) — tools/lint.sh
+and tests/test_analysis.py consume it; bump the version when a field
+changes shape.
+"""
+from __future__ import annotations
+
+import json
+
+from .core import AnalysisResult, iter_checkers
+
+REPORT_SCHEMA = "paddle_tpu.analysis.report/v1"
+
+
+def text_report(result: AnalysisResult, verbose: bool = False) -> str:
+    lines = []
+    for f in sorted(result.parse_errors + result.new,
+                    key=lambda f: (f.path, f.line, f.rule)):
+        lines.append(f.text())
+    if result.stale_baseline:
+        lines.append("")
+        lines.append(f"stale baseline entries ({len(result.stale_baseline)}) "
+                     "— the code they pointed at is gone; refresh with "
+                     "--write-baseline:")
+        for e in sorted(result.stale_baseline,
+                        key=lambda e: (e["path"], e["rule"],
+                                       e["snippet_hash"])):
+            lines.append(f"  {e['rule']} {e['path']} "
+                         f"[{e['snippet_hash']}] {e.get('snippet', '')}")
+    if verbose and result.baselined:
+        lines.append("")
+        lines.append(f"baselined (grandfathered) findings "
+                     f"({len(result.baselined)}):")
+        for f in sorted(result.baselined,
+                        key=lambda f: (f.path, f.line, f.rule)):
+            lines.append(f"  {f.text()}")
+    lines.append("")
+    lines.append(
+        f"{len(result.new) + len(result.parse_errors)} finding(s) "
+        f"({len(result.baselined)} baselined, "
+        f"{result.suppressed} suppressed, "
+        f"{len(result.stale_baseline)} stale baseline) "
+        f"in {result.files_scanned} files [{result.elapsed_s:.2f}s]")
+    return "\n".join(lines)
+
+
+def json_report(result: AnalysisResult) -> str:
+    doc = {
+        "schema": REPORT_SCHEMA,
+        "ok": result.ok,
+        "counts": {
+            "new": len(result.new),
+            "parse_errors": len(result.parse_errors),
+            "baselined": len(result.baselined),
+            "suppressed": result.suppressed,
+            "stale_baseline": len(result.stale_baseline),
+            "files_scanned": result.files_scanned,
+        },
+        "elapsed_s": round(result.elapsed_s, 3),
+        "findings": [f.as_dict() for f in
+                     sorted(result.parse_errors + result.new,
+                            key=lambda f: (f.path, f.line, f.rule))],
+        "baselined": [f.as_dict() for f in
+                      sorted(result.baselined,
+                             key=lambda f: (f.path, f.line, f.rule))],
+        "stale_baseline": sorted(
+            result.stale_baseline,
+            key=lambda e: (e["path"], e["rule"], e["snippet_hash"])),
+    }
+    return json.dumps(doc, indent=2, sort_keys=False)
+
+
+def rules_table() -> str:
+    lines = []
+    for checker in sorted(iter_checkers(), key=lambda c: c.rule):
+        lines.append(f"{checker.rule}  {checker.name}")
+        lines.append(f"       {checker.description}")
+        if checker.incident:
+            lines.append(f"       incident: {checker.incident}")
+    return "\n".join(lines)

@@ -173,7 +173,9 @@ def _coerce_leaf(v) -> np.ndarray:
     """Host-numpy view of one state leaf (Tensor / jax array / scalar)."""
     val = getattr(v, "value", v) if not isinstance(v, (np.ndarray,
                                                        np.generic)) else v
-    return np.asarray(val)
+    # save paths hand this already-host leaves (assume_host contract /
+    # materialize-d snapshots); the view never outlives the write
+    return np.asarray(val)  # noqa: PTA001
 
 
 # -- tree <-> (structure json, flat leaves) ---------------------------------
@@ -466,7 +468,8 @@ def _read_leaf(gen_dir: str, entry) -> np.ndarray:
     # whose jnp.array(copy=True) makes the jax-owned copy the training
     # engine can legally donate.  Copying here too would double restore
     # peak host memory on multi-GB states.
-    return np.frombuffer(raw, dtype=dt).reshape(entry["shape"])
+    return np.frombuffer(raw, dtype=dt).reshape(  # noqa: PTA001
+        entry["shape"])
 
 
 def _load_generation(gen_dir: str, manifest, template=None, shardings=None):
@@ -571,7 +574,10 @@ def _host_view(tree):
     the two paths."""
     from .resilience import materialize
 
-    return materialize(tree, copy=False)
+    # the writer thread never gets here: AsyncCheckpointer._run calls
+    # save(assume_host=True), which skips _host_view entirely — the
+    # jaxful materialize below runs on the training thread only
+    return materialize(tree, copy=False)  # noqa: PTA002
 
 
 # -- single-checkpoint functional API ---------------------------------------

@@ -319,4 +319,5 @@ class UtilBase:
         from ...env import ParallelEnv
 
         if ParallelEnv().rank == rank_id:
-            print(message)
+            # print_on_rank IS a stdout API (fleet.util parity)
+            print(message)  # noqa: PTA006

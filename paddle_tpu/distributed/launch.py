@@ -34,6 +34,15 @@ from ..utils.metrics import default_registry
 
 logger = logging.getLogger("paddle_tpu.launch")
 
+
+class _LauncherSignaled(Exception):
+    """Latched by the async-signal-safe SIGTERM/SIGINT handler; the
+    launcher's main flow catches it to log and tear trainers down."""
+
+    def __init__(self, signum: int):
+        super().__init__(signum)
+        self.signum = signum
+
 # Restart accounting in the shared registry: the launcher's own
 # MonitorServer (--monitor_port) exposes these alongside the federated
 # per-rank /metrics, so "how often does this job die, and why" is a
@@ -156,11 +165,12 @@ def launch_collective(args):
     # Orphan fix: a SIGTERM to the launcher must tear the trainer
     # subprocesses down (with the grace window) instead of leaving them
     # running; watch_local_trainers only handled KeyboardInterrupt.
+    # The handler only raises: logging or terminating inside the handler
+    # runs between bytecodes of the interrupted frame, which may hold the
+    # very locks those calls take (PTA003).  Raising unwinds the frame —
+    # its `with` locks release — before the except block below acts.
     def _on_signal(signum, frame):
-        logger.warning("launcher got signal %s — terminating trainers "
-                       "(grace %.1fs)", signum, args.grace_period)
-        terminate_local_procs(procs, grace=args.grace_period)
-        sys.exit(128 + signum)
+        raise _LauncherSignaled(signum)
 
     prev_handlers = {}
     for s in (signal.SIGTERM, signal.SIGINT):
@@ -223,6 +233,11 @@ def launch_collective(args):
                     "(trainers auto-resume from their latest checkpoint)",
                     e.rank, reason, attempt, args.max_restarts, delay)
                 time.sleep(delay)
+    except _LauncherSignaled as sig:
+        logger.warning("launcher got signal %s — terminating trainers "
+                       "(grace %.1fs)", sig.signum, args.grace_period)
+        terminate_local_procs(procs, grace=args.grace_period)
+        sys.exit(128 + sig.signum)
     finally:
         if monitor is not None:
             monitor.shutdown()

@@ -61,7 +61,8 @@ def build_mesh(mesh_shape: dict[str, int] | None = None,
             raise ValueError(
                 f"mesh shape {mesh_shape} needs {n_needed} devices, "
                 f"have {len(devices)}")
-    arr = np.asarray(devices).reshape(dims)
+    # a list of Device OBJECTS, not a tensor buffer — nothing to donate
+    arr = np.asarray(devices).reshape(dims)  # noqa: PTA001
     return Mesh(arr, axis_names=tuple(names))
 
 

@@ -99,9 +99,14 @@ def materialize(tree, copy: bool = True):
                 and not getattr(a, "is_fully_addressable", True)):
             from jax.experimental import multihost_utils
 
-            return np.asarray(
+            # allgather already materialized fresh host values — this
+            # view owns the only reference to them
+            return np.asarray(  # noqa: PTA001
                 multihost_utils.process_allgather(a, tiled=True))
-        return np.array(a, copy=True) if copy else np.asarray(a)
+        # the copy=False branch IS the documented zero-copy _host_view
+        # face: callers consume the bytes before the next dispatch
+        return np.array(a, copy=True) if copy \
+            else np.asarray(a)  # noqa: PTA001
 
     with host_fetch():
         return jax.tree_util.tree_map(to_host, tree)
@@ -199,22 +204,34 @@ class PreemptionGuard:
 
     def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
         self.signals = tuple(signals)
-        self.preempted = False
+        self._preempted = False
+        self._announced = False
         self.signum = None
         self._prev = {}
 
+    @property
+    def preempted(self) -> bool:
+        # Deferred announcement: the handler only latches — logging from
+        # a signal handler can self-deadlock on the logging module's
+        # locks (PTA003) — so the first poll from regular code reports
+        # the signal instead.
+        if self._preempted and not self._announced:
+            self._announced = True
+            logger.warning("preemption signal %s latched — will "
+                           "checkpoint after the in-flight step",
+                           self.signum)
+        return self._preempted
+
     def _handler(self, signum, frame):
-        if self.preempted:  # second signal: escalate to the old handler
+        if self._preempted:  # second signal: escalate to the old handler
             prev = self._prev.get(signum)
             if callable(prev):
                 prev(signum, frame)
             else:
                 raise KeyboardInterrupt
             return
-        self.preempted = True
         self.signum = signum
-        logger.warning("preemption signal %s latched — will checkpoint "
-                       "after the in-flight step", signum)
+        self._preempted = True
 
     def __enter__(self):
         for s in self.signals:
@@ -421,7 +438,7 @@ class ResilientRunner:
                         loss = float("nan")
                     bad = (loss is not None
                            and not np.all(np.isfinite(
-                               np.asarray(loss, dtype=np.float64))))
+                               np.array(loss, dtype=np.float64))))
                     if bad:
                         info["bad_steps"] += 1
                         bad_streak += 1

@@ -91,8 +91,10 @@ def memory_usage(program=None, batch_size=1):
             break
     else:
         size, unit = float(total), "B"
-    print(f"Your program requires about {size:.2f} {unit} memory at "
-          f"batch size {batch_size} (captured-DAG estimate).")
+    # memory_usage() prints its estimate by contract (fluid parity)
+    print(f"Your program requires about {size:.2f} "  # noqa: PTA006
+          f"{unit} memory at batch size {batch_size} "
+          f"(captured-DAG estimate).")
     return size, unit
 
 

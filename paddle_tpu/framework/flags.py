@@ -46,6 +46,10 @@ define_flag("FLAGS_cudnn_deterministic", False, "inert; XLA is deterministic")
 define_flag("FLAGS_sort_sum_gradient", False, "grad accumulation order")
 define_flag("FLAGS_max_inplace_grad_add", 0, "inert")
 define_flag("FLAGS_selected_gpus", "", "inert; device selection via set_device")
+define_flag("FLAGS_selected_tpus", "",
+            "comma-separated local accelerator ids for this trainer; set "
+            "per rank by the distributed launcher, read by Env to pick "
+            "the default device id")
 define_flag("FLAGS_mesh_shape", "",
             "default SPMD mesh for Model.fit when no mesh= argument or "
             "ambient mesh_guard is active: 'dp=8', 'dp=2,mp=4', or a bare "

@@ -17,7 +17,10 @@ def _to_storable(obj):
     from ..tensor import Tensor
 
     if isinstance(obj, Tensor):
-        return np.asarray(obj.numpy())
+        # Tensor.numpy() is a zero-copy view of the device buffer; a
+        # saved state dict must own its bytes — the engine may donate
+        # the buffer on the next dispatched step (PTA001).
+        return np.array(obj.numpy(), copy=True)
     if isinstance(obj, dict):
         return {k: _to_storable(v) for k, v in obj.items()}
     if isinstance(obj, (list, tuple)):

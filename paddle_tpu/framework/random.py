@@ -102,5 +102,6 @@ def np_random_state():
     import numpy as np
 
     key = split_key(1)
-    data = np.asarray(jax.random.key_data(key)).ravel()
+    # fresh key_data, consumed immediately by the astype copy below
+    data = np.asarray(jax.random.key_data(key)).ravel()  # noqa: PTA001
     return np.random.RandomState(data.astype(np.uint32)[-1])

@@ -131,18 +131,20 @@ class ProgBarLogger(Callback):
         self._seen += 1
         if self.verbose and self.log_freq and step % self.log_freq == 0:
             total = f"/{self.steps}" if self.steps else ""
-            print(f"Epoch {self._epoch + 1}/{self.epochs} "
+            # ProgBarLogger's stdout progress display is the verbose=1
+            # API contract (keras/paddle parity), not library logging
+            print(f"Epoch {self._epoch + 1}/{self.epochs} "  # noqa: PTA006
                   f"step {step}{total} - {self._fmt(logs)}", flush=True)
 
     def on_epoch_end(self, epoch, logs=None):
         if self.verbose:
-            print(f"Epoch {epoch + 1}/{self.epochs} done in "
+            print(f"Epoch {epoch + 1}/{self.epochs} done in "  # noqa: PTA006
                   f"{time.time() - self._t0:.1f}s - {self._fmt(logs)}",
                   flush=True)
 
     def on_eval_end(self, logs=None):
         if self.verbose:
-            print(f"Eval - {self._fmt(logs)}", flush=True)
+            print(f"Eval - {self._fmt(logs)}", flush=True)  # noqa: PTA006
 
 
 class ModelCheckpoint(Callback):
@@ -230,8 +232,10 @@ class EarlyStopping(Callback):
                 if self.model is not None:
                     self.model.stop_training = True
                 if self.verbose:
-                    print(f"Epoch {epoch + 1}: early stopping "
-                          f"(best {self.monitor}={self.best:.4f})",
+                    # same stdout display contract as ProgBarLogger
+                    print(f"Epoch {epoch + 1}: early "  # noqa: PTA006
+                          f"stopping (best "
+                          f"{self.monitor}={self.best:.4f})",
                           flush=True)
 
 

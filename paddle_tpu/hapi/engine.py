@@ -595,9 +595,9 @@ class TrainEngine:
         return {"params": materialize(st["trainable"]),
                 "buffers": materialize(st["buffers"]),
                 "opt": materialize(st["opt"]),
-                "meta": {"it": np.asarray(it_count, np.int32),
-                         "opt_steps": np.asarray(self._host_step,
-                                                 np.int32)}}
+                "meta": {"it": np.array(it_count, np.int32),
+                         "opt_steps": np.array(self._host_step,
+                                               np.int32)}}
 
     def ft_restore_shardings(self, template):
         """NamedSharding pytree mirroring an `ft_state`-shaped template,

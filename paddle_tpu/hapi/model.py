@@ -188,7 +188,7 @@ class Model:
         if sched is not None:
             # lr-schedule reconciliation on (elastic) resume: the
             # scheduler's epoch counter travels with the checkpoint
-            snap["meta"]["lr_last_epoch"] = np.asarray(
+            snap["meta"]["lr_last_epoch"] = np.array(
                 int(sched.last_epoch), np.int32)
         return snap
 
@@ -369,12 +369,12 @@ class Model:
 
             cur_dp = mesh_meta(eng.mesh)["dp"]
         if saved_dp is not None and int(saved_dp) != cur_dp:
-            print(f"fit: ELASTIC resume — checkpoint saved at "
-                  f"dp={saved_dp}, restoring onto dp={cur_dp} "
-                  f"(reconciled step={int(back['meta']['opt_steps'])})",
-                  flush=True)
-        print(f"fit: resumed from checkpoint at iteration {step0} "
-              f"(restart #{restart})", flush=True)
+            logger.info("fit: ELASTIC resume — checkpoint saved at "
+                        "dp=%s, restoring onto dp=%s (reconciled "
+                        "step=%d)", saved_dp, cur_dp,
+                        int(back["meta"]["opt_steps"]))
+        logger.info("fit: resumed from checkpoint at iteration %d "
+                    "(restart #%s)", step0, restart)
         return int(back["meta"]["it"])
 
     # -- loop-level API ----------------------------------------------------
@@ -846,7 +846,8 @@ class Model:
         for m in self._metrics:
             res[m._name] = m.accumulate()
         if verbose:
-            print("Eval:", res, flush=True)
+            # verbose=1 stdout contract, like ProgBarLogger
+            print("Eval:", res, flush=True)  # noqa: PTA006
         return res
 
     def predict(self, test_data, batch_size=1, num_workers=0, stack_outputs=False,
@@ -923,8 +924,9 @@ class Model:
             engine, host=host, port=port,
             install_signal_handlers=install_signal_handlers).start()
         if blocking:
-            print(f"serving on {server.url} (SIGTERM drains gracefully)",
-                  flush=True)
+            # operator-facing notice on the blocking serve() path
+            print(f"serving on {server.url} "  # noqa: PTA006
+                  f"(SIGTERM drains gracefully)", flush=True)
             return server.wait()
         return server
 
@@ -944,7 +946,8 @@ def summary(net, input_size=None, dtypes=None):
         total += n
         lines.append(f"{name:60s} {str(p.shape):20s} {n}")
     out = "\n".join(lines) + f"\nTotal params: {total}"
-    print(out)
+    # Model.summary() prints the table by API contract (hapi parity)
+    print(out)  # noqa: PTA006
     return {"total_params": total}
 
 
@@ -989,8 +992,9 @@ def flops(net, input_size, custom_ops=None, print_detail=False):
                 "not report a 'flops' key; returning 0", stacklevel=2)
         total = int(ca.get("flops", 0.0))
         if print_detail:
-            print(f"XLA-analyzed forward FLOPs for input {input_size}: "
-                  f"{total:,}")
+            # print_detail=True is the flops() API contract
+            print(f"XLA-analyzed forward FLOPs for "  # noqa: PTA006
+                  f"input {input_size}: {total:,}")
         return total
     finally:
         for layer, mode in modes:

@@ -14,6 +14,7 @@ InputSpec, program_translator.py:719).
 """
 from __future__ import annotations
 
+import logging
 import os
 import pickle
 
@@ -24,6 +25,8 @@ from ..autograd import suspend_tape
 from ..framework import random as _random
 from ..nn.layer_base import Layer, _swapped_state, state_pytrees
 from ..tensor import Tensor
+
+logger = logging.getLogger("paddle_tpu.jit")
 
 
 class InputSpec:
@@ -137,9 +140,9 @@ def _maybe_convert(method):
         converted = dy2static.convert_function(method)
         if _LOG_LEVELS["code_level"] > 0 and \
                 getattr(converted, "__converted_source__", None):
-            print(f"[dy2static] transformed code of "
-                  f"{getattr(method, '__qualname__', method)}:\n"
-                  f"{converted.__converted_source__}")
+            logger.info("[dy2static] transformed code of %s:\n%s",
+                        getattr(method, "__qualname__", method),
+                        converted.__converted_source__)
         return converted
     except dy2static.BenignNoConversion:
         return method  # nothing to convert: plain tracing is not a hazard

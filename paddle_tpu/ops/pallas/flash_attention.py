@@ -35,7 +35,8 @@ DEFAULT_BLOCK_K = 128
 _NEG_INF = -1e30
 
 
-from . import im as _im, interpret_default as _interpret_default
+from . import (CompilerParams as _CompilerParams, im as _im,
+               interpret_default as _interpret_default)
 
 
 def _dot(a, b, contract):
@@ -129,7 +130,7 @@ def _fwd_call(q, k, v, causal, sm_scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v)
@@ -236,7 +237,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
         out_specs=pl.BlockSpec((1, block_q, d), _im(lambda b, i, j: (b, i, 0))),
         out_shape=jax.ShapeDtypeStruct(q.shape, q.dtype),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_r, delta_r)
@@ -259,7 +260,7 @@ def _bwd_call(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
                    jax.ShapeDtypeStruct(v.shape, v.dtype)],
         scratch_shapes=[pltpu.VMEM((block_k, d), jnp.float32),
                         pltpu.VMEM((block_k, d), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(q, k, v, do, lse_r, delta_r)

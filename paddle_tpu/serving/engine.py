@@ -37,6 +37,7 @@ import time
 import numpy as np
 
 from ..framework import flags as _flags
+from ..framework.transfer import host_fetch
 from ..utils import chaos
 from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
@@ -309,7 +310,9 @@ class ServingEngine:
         """Single-sample arrays → (padded arrays, orig seq lens, group
         key).  The group key is the padded per-sample signature — one key
         == one XLA bucket."""
-        arrays = [np.asarray(x) for x in inputs]
+        # intake converts host payloads (lists / client numpy), never
+        # device buffers; the device round-trip copies on distribution
+        arrays = [np.asarray(x) for x in inputs]  # noqa: PTA001
         if self._input_specs:
             if len(arrays) != len(self._input_specs):
                 raise ValueError(
@@ -511,8 +514,16 @@ class ServingEngine:
                                        total_elems)
             self._sync_compile_count()
             done_t = time.monotonic()
+            # Result distribution is the batcher's one sanctioned
+            # device→host point (PTA005), and the rows handed to client
+            # futures must OWN their bytes (PTA001): a zero-copy view of
+            # the batch output would pin the whole [bucket_b, ...] buffer
+            # per request and alias storage the runtime may reuse for the
+            # next dispatched batch.
+            with host_fetch():
+                host_outs = [np.array(o, copy=True) for o in outs]
             for i, r in enumerate(live):
-                row = [self._unpad(np.asarray(o)[i], r) for o in outs]
+                row = [self._unpad(o[i], r) for o in host_outs]
                 # stop() may have failed this future while the batch was
                 # on the accelerator — a done future is not re-resolved
                 if not r.future.done():

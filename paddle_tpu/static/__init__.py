@@ -240,7 +240,9 @@ def Print(input, first_n=-1, message=None, summarize=20,
                 parts.append(f"dtype={v.dtype}")
             flat = jnp.ravel(v)[:summarize]
             parts.append(f"data={flat}")
-            print("  ".join(parts))
+            # static.Print emulates the reference Print OP: stdout
+            # side effect is the operator's documented behavior
+            print("  ".join(parts))  # noqa: PTA006
         return v
 
     return apply(f, input)

@@ -73,13 +73,15 @@ def run_check():
     import jax
 
     devs = jax.devices()
-    print(f"Running verify PaddlePaddle(paddle_tpu) program ... "
-          f"device: {devs[0].platform} x{len(devs)}")
+    # run_check() mirrors the reference's stdout success messages
+    print(f"Running verify PaddlePaddle(paddle_tpu) "  # noqa: PTA006
+          f"program ... device: {devs[0].platform} x{len(devs)}")
     loss, net = _simple_step()
     ploss = _parallel_step(net)
     if ploss is not None:
-        print(f"PaddlePaddle(paddle_tpu) works well on {len(devs)} "
-              f"devices (dp loss {ploss:.4f}).")
-    print("PaddlePaddle(paddle_tpu) is installed successfully! "
-          "Let's start deep learning with paddle_tpu now.")
+        print(f"PaddlePaddle(paddle_tpu) works well "  # noqa: PTA006
+              f"on {len(devs)} devices (dp loss {ploss:.4f}).")
+    print("PaddlePaddle(paddle_tpu) is installed "  # noqa: PTA006
+          "successfully! Let's start deep learning with "
+          "paddle_tpu now.")
     return True

@@ -24,9 +24,10 @@ def mkl():
 
 
 def show():
-    print("full_version:", full_version)
-    print("major:", major)
-    print("minor:", minor)
-    print("patch:", patch)
-    print("rc:", rc)
-    print("commit:", commit)
+    # paddle.version.show() prints by API contract (reference parity)
+    print("full_version:", full_version)  # noqa: PTA006
+    print("major:", major)  # noqa: PTA006
+    print("minor:", minor)  # noqa: PTA006
+    print("patch:", patch)  # noqa: PTA006
+    print("rc:", rc)  # noqa: PTA006
+    print("commit:", commit)  # noqa: PTA006

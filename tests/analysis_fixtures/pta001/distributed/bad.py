@@ -1,0 +1,14 @@
+"""PTA001 fixture: every zero-copy materialization face, flagged."""
+import numpy as np
+
+
+def materialize_leaf(x):
+    return np.asarray(x)  # FINDING: zero-copy view
+
+
+def read_bytes(raw, dt):
+    return np.frombuffer(raw, dtype=dt)  # FINDING: view escapes
+
+
+def alias_explicitly(x):
+    return np.array(x, copy=False)  # FINDING: explicit alias

@@ -1,0 +1,14 @@
+"""PTA001 near-misses: owning copies and immediately-copied views."""
+import numpy as np
+
+
+def materialize_leaf(x):
+    return np.array(x, copy=True)
+
+
+def read_bytes(raw, dt):
+    return np.frombuffer(raw, dtype=dt).copy()
+
+
+def plain_array(x):
+    return np.array(x)

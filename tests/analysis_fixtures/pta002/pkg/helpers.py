@@ -1,0 +1,10 @@
+import jax
+
+
+def snapshot(state):
+    return jax.device_get(state)
+
+
+def write_disk(payload):
+    with open("/dev/null", "wb") as fh:
+        fh.write(repr(payload).encode())

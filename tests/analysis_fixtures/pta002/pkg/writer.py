@@ -1,0 +1,8 @@
+"""PTA002 fixture: a jax-free writer root whose call chain reaches jax."""
+from . import helpers
+
+
+# pta: jax-free
+def writer_loop(state):
+    payload = helpers.snapshot(state)  # FINDING: chain reaches jax
+    helpers.write_disk(payload)

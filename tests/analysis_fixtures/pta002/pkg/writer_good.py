@@ -1,0 +1,7 @@
+"""PTA002 near-miss: a jax-free root that only touches host helpers."""
+from . import helpers
+
+
+# pta: jax-free
+def writer_loop(payload):
+    helpers.write_disk(payload)

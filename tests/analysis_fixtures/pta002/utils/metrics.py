@@ -1,0 +1,6 @@
+"""PTA002 module fixture: utils/metrics.py must stay jax-free."""
+import jax  # FINDING: jax import in a jax-free module
+
+
+def record(value):
+    return jax.numpy.asarray(value)

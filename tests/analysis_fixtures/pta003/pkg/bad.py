@@ -1,0 +1,21 @@
+"""PTA003 fixture: a registered handler that logs, locks, and calls a
+same-module helper that prints."""
+import logging
+import signal
+import threading
+
+logger = logging.getLogger(__name__)
+_lock = threading.Lock()
+
+
+def _flush():
+    print("flushing")  # FINDING (reached via handler -> _flush)
+
+
+def handler(signum, frame):
+    logger.warning("got signal %s", signum)  # FINDING: logs
+    with _lock:  # FINDING: acquires a lock
+        _flush()
+
+
+signal.signal(signal.SIGTERM, handler)

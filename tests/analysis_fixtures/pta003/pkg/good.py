@@ -1,0 +1,22 @@
+"""PTA003 near-miss: the one-int-mailbox pattern, plus an unregistered
+function that logs (logging is fine OUTSIDE handler reachability)."""
+import logging
+import signal
+
+logger = logging.getLogger(__name__)
+_pending = 0
+
+
+def handler(signum, frame):
+    global _pending
+    _pending = signum  # latch only — no locks, no logging
+
+
+def poll():
+    global _pending
+    if _pending:
+        logger.warning("acting on deferred signal %s", _pending)
+        _pending = 0
+
+
+signal.signal(signal.SIGTERM, handler)

@@ -1,0 +1,9 @@
+"""PTA004 fixture: per-process early exits ahead of a collective."""
+import os
+
+
+def save(path, state, allgather):
+    if os.path.exists(os.path.join(path, "COMMIT")):
+        return None  # FINDING: fs probe diverges across hosts
+    merged = allgather(state)
+    return merged

@@ -1,0 +1,16 @@
+"""PTA004 near-misses: single-process-gated exit and a uniform gate."""
+import os
+
+
+def save(self, path, state, allgather):
+    if self._single_process and os.path.exists(
+            os.path.join(path, "COMMIT")):
+        return None  # gated: only ever taken when there are no peers
+    merged = allgather(state)
+    return merged
+
+
+def save_every(step, interval, state, allgather):
+    if step % interval:
+        return None  # uniform arithmetic on arguments — same on all hosts
+    return allgather(state)

@@ -1,0 +1,14 @@
+"""PTA005 fixture: implicit device→host syncs inside engine hot paths."""
+import numpy as np
+
+
+class TrainEngine:
+    def step(self, state, loss):
+        lossf = float(loss)  # FINDING: per-step sync
+        arr = np.asarray(state)  # FINDING: blocking conversion
+        return lossf, arr
+
+
+# pta: hot-path
+def dispatch_batch(out):
+    return out.item()  # FINDING: sync in a marked hot path

@@ -1,0 +1,18 @@
+"""PTA005 near-misses: sanctioned host_fetch scopes, cold-path floats."""
+import numpy as np
+
+from paddle_tpu.framework.transfer import host_fetch, in_host_fetch
+
+
+class TrainEngine:
+    def step(self, state, loss):
+        with host_fetch():
+            lossf = float(loss)  # sanctioned scope
+        if in_host_fetch():
+            arr = np.asarray(state)  # sanctioned branch
+        return lossf, float(3.5)  # constant: no device sync
+
+
+class Reporter:
+    def render(self, loss):
+        return float(loss)  # not a hot path — no finding

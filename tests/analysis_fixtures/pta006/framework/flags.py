@@ -1,0 +1,8 @@
+"""PTA006 fixture registry."""
+
+
+def define_flag(name, default, help_=""):
+    return name
+
+
+define_flag("FLAGS_known_flag", "", "declared flag")

@@ -1,0 +1,10 @@
+"""PTA006 fixture: undeclared flag read + library print."""
+import os
+
+
+def configure(env=os.environ):
+    return env.get("FLAGS_mystery_flag", "")  # FINDING: undeclared
+
+
+def report(msg):
+    print(msg)  # FINDING: library print

@@ -1,0 +1,15 @@
+"""PTA006 near-misses: declared flag read, main()-guard prints."""
+import os
+
+
+def configure(env=os.environ):
+    return env.get("FLAGS_known_flag", "")
+
+
+def main():
+    print("CLI entry points print by contract")
+
+
+if __name__ == "__main__":
+    print("module entry")
+    main()

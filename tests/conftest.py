@@ -37,6 +37,9 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "monitor: runtime telemetry test (paddle_tpu.monitor "
         "+ utils.metrics) — run via tools/obs_smoke.sh")
+    config.addinivalue_line(
+        "markers", "lint: static-analysis suite test (paddle_tpu.analysis "
+        "rules PTA001-006) — run via tools/lint.sh")
 
 
 @pytest.fixture(autouse=True)

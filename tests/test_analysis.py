@@ -1,0 +1,294 @@
+"""paddle_tpu.analysis: the framework-aware static checker suite.
+
+Every rule is pinned twice — a seeded fixture it MUST flag (true
+positive) and a near-miss it MUST NOT (the compliant twin of the same
+code shape) — plus the machinery: suppression comments, the committed
+baseline round-trip, the JSON report contract, and the live-tree gate
+(zero unbaselined findings, inside the tier-1 time budget).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+import paddle_tpu.analysis  # noqa: F401  (registers the checkers)
+from paddle_tpu.analysis.core import (baseline_key, load_baseline,
+                                      run_analysis, write_baseline)
+from paddle_tpu.analysis.reporters import (REPORT_SCHEMA, json_report,
+                                           text_report)
+
+pytestmark = pytest.mark.lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "analysis_fixtures")
+BASELINE = os.path.join(REPO, "tools", "analysis_baseline.json")
+
+
+def fixture_run(rule, select=None):
+    root = os.path.join(FIXTURES, rule.lower())
+    return run_analysis([root], root=root, select=select or [rule])
+
+
+def findings_in(result, path_part):
+    return [f for f in result.new if path_part in f.path]
+
+
+# -- one true positive + one near-miss per rule -----------------------------
+
+class TestRuleFixtures:
+    def test_pta001_flags_every_zero_copy_face(self):
+        res = fixture_run("PTA001")
+        bad = findings_in(res, "bad.py")
+        assert {f.line for f in bad} == {6, 10, 14}, [f.text() for f in
+                                                      res.new]
+        assert not findings_in(res, "good.py")
+
+    def test_pta002_reports_the_edge_into_jax(self):
+        res = fixture_run("PTA002")
+        chain = findings_in(res, "writer.py")
+        assert len(chain) == 1
+        assert "helpers.py" in chain[0].message  # names the jax module
+        assert "writer_loop" in chain[0].message
+        assert not findings_in(res, "writer_good.py")
+
+    def test_pta002_jax_free_module(self):
+        res = fixture_run("PTA002")
+        mod = findings_in(res, "utils/metrics.py")
+        assert len(mod) == 1 and mod[0].line == 2
+
+    def test_pta003_handler_and_transitive_callees(self):
+        res = fixture_run("PTA003")
+        bad = findings_in(res, "bad.py")
+        kinds = {f.line for f in bad}
+        assert kinds == {12, 16, 17}, [f.text() for f in res.new]
+        # the print is attributed through the call chain
+        via = [f for f in bad if f.line == 12]
+        assert "_flush" in via[0].message
+        assert not findings_in(res, "good.py")
+
+    def test_pta004_divergent_gate_before_collective(self):
+        res = fixture_run("PTA004")
+        bad = findings_in(res, "bad.py")
+        assert len(bad) == 1 and bad[0].line == 7
+        assert "allgather" in bad[0].message
+        assert not findings_in(res, "good.py")
+
+    def test_pta005_hot_path_syncs(self):
+        res = fixture_run("PTA005")
+        bad = findings_in(res, "bad.py")
+        assert {f.line for f in bad} == {7, 8, 14}, [f.text() for f in
+                                                     res.new]
+        assert not findings_in(res, "good.py")
+
+    def test_pta006_undeclared_flag_and_print(self):
+        res = fixture_run("PTA006")
+        bad = findings_in(res, "bad.py")
+        assert {f.line for f in bad} == {6, 10}, [f.text() for f in res.new]
+        assert not findings_in(res, "good.py")
+
+
+# -- suppression + baseline machinery ---------------------------------------
+
+class TestSuppression:
+    def _run_src(self, tmp_path, source, select):
+        d = tmp_path / "distributed"
+        d.mkdir()
+        (d / "mod.py").write_text(source)
+        return run_analysis([str(tmp_path)], root=str(tmp_path),
+                            select=select)
+
+    def test_line_noqa(self, tmp_path):
+        res = self._run_src(
+            tmp_path,
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.asarray(x)  # noqa: PTA001\n",
+            ["PTA001"])
+        assert not res.new and res.suppressed == 1
+
+    def test_noqa_for_other_rule_does_not_suppress(self, tmp_path):
+        res = self._run_src(
+            tmp_path,
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.asarray(x)  # noqa: PTA006\n",
+            ["PTA001"])
+        assert len(res.new) == 1
+
+    def test_file_directives(self, tmp_path):
+        res = self._run_src(
+            tmp_path,
+            "# pta: skip-file\n"
+            "import numpy as np\n"
+            "def f(x):\n"
+            "    return np.asarray(x)\n",
+            ["PTA001"])
+        assert not res.new and res.suppressed == 1
+
+    def test_parse_error_is_a_finding(self, tmp_path):
+        res = self._run_src(tmp_path, "def broken(:\n", ["PTA001"])
+        assert res.parse_errors and res.parse_errors[0].rule == "PTA000"
+        assert not res.ok
+
+
+class TestBaseline:
+    def test_round_trip_and_staleness(self, tmp_path):
+        d = tmp_path / "distributed"
+        d.mkdir()
+        src = d / "mod.py"
+        src.write_text("import numpy as np\n"
+                       "def f(x):\n"
+                       "    return np.asarray(x)\n")
+        bl = tmp_path / "baseline.json"
+
+        res = run_analysis([str(tmp_path)], root=str(tmp_path),
+                           select=["PTA001"])
+        assert len(res.new) == 1
+        write_baseline(str(bl), res.all_findings,
+                       justifications={baseline_key(res.new[0]):
+                                       "grandfathered for the test"})
+
+        # same tree + baseline -> clean
+        res2 = run_analysis([str(tmp_path)], root=str(tmp_path),
+                            baseline=str(bl), select=["PTA001"])
+        assert not res2.new and len(res2.baselined) == 1
+        assert res2.ok and not res2.stale_baseline
+
+        # baseline identity survives edits ABOVE the finding
+        src.write_text("import numpy as np\n\n\n"
+                       "def f(x):\n"
+                       "    return np.asarray(x)\n")
+        res3 = run_analysis([str(tmp_path)], root=str(tmp_path),
+                            baseline=str(bl), select=["PTA001"])
+        assert not res3.new and len(res3.baselined) == 1
+
+        # fixing the code makes the entry stale (baseline must shrink)
+        src.write_text("import numpy as np\n"
+                       "def f(x):\n"
+                       "    return np.array(x, copy=True)\n")
+        res4 = run_analysis([str(tmp_path)], root=str(tmp_path),
+                            baseline=str(bl), select=["PTA001"])
+        assert not res4.new
+        assert len(res4.stale_baseline) == 1
+        assert res4.stale_baseline[0]["justification"] == \
+            "grandfathered for the test"
+
+        # --write-baseline carries justifications over by key
+        src.write_text("import numpy as np\n"
+                       "def g(y):\n"
+                       "    return np.asarray(y)\n")
+        res5 = run_analysis([str(tmp_path)], root=str(tmp_path),
+                            select=["PTA001"])
+        write_baseline(str(bl), res5.all_findings)
+        data = load_baseline(str(bl))
+        assert len(data) == 1  # old entry dropped, new one present
+
+    def test_duplicate_lines_counted_by_occurrence(self, tmp_path):
+        d = tmp_path / "distributed"
+        d.mkdir()
+        src = d / "mod.py"
+        src.write_text("import numpy as np\n"
+                       "def f(x, y):\n"
+                       "    a = np.asarray(x)\n"
+                       "    b = np.asarray(x)\n"
+                       "    return a, b\n")
+        bl = tmp_path / "baseline.json"
+        res = run_analysis([str(tmp_path)], root=str(tmp_path),
+                           select=["PTA001"])
+        assert len(res.new) == 2  # identical lines, two occurrences
+        write_baseline(str(bl), res.all_findings)
+        res2 = run_analysis([str(tmp_path)], root=str(tmp_path),
+                            baseline=str(bl), select=["PTA001"])
+        assert not res2.new and len(res2.baselined) == 2
+
+
+# -- reporters --------------------------------------------------------------
+
+class TestReporters:
+    def test_json_schema(self):
+        res = fixture_run("PTA001")
+        doc = json.loads(json_report(res))
+        assert doc["schema"] == REPORT_SCHEMA
+        assert doc["ok"] is False
+        assert doc["counts"]["new"] == len(res.new) == len(doc["findings"])
+        for f in doc["findings"]:
+            assert set(f) == {"rule", "path", "line", "col", "message",
+                              "snippet", "snippet_hash"}
+            assert f["rule"] == "PTA001"
+            assert len(f["snippet_hash"]) == 12
+
+    def test_text_summary_line(self):
+        res = fixture_run("PTA001")
+        out = text_report(res)
+        assert "finding(s)" in out.splitlines()[-1]
+        assert any(line.startswith("distributed/bad.py:")
+                   for line in out.splitlines())
+
+
+# -- the live-tree gate -----------------------------------------------------
+
+class TestLiveTree:
+    def test_live_tree_clean_within_budget(self):
+        """The committed baseline covers the tree exactly: no new
+        findings, no stale entries, under the tier-1 time budget."""
+        res = run_analysis([os.path.join(REPO, "paddle_tpu")],
+                           root=REPO, baseline=BASELINE)
+        assert not res.new, "\n".join(f.text() for f in res.new)
+        assert not res.parse_errors
+        assert not res.stale_baseline, (
+            "baseline entries with no matching code — refresh with "
+            "--write-baseline: %r" % res.stale_baseline)
+        assert res.elapsed_s < 10.0
+        # every grandfathered finding carries a written justification
+        for entries in load_baseline(BASELINE).values():
+            for e in entries:
+                assert e["justification"].strip(), e
+
+    def test_no_print_regression_in_library_code(self):
+        """The print() sweep stays swept: any NEW print in library code
+        (outside main() guards) must be a logger call or carry a
+        justified noqa."""
+        res = run_analysis([os.path.join(REPO, "paddle_tpu")],
+                           root=REPO, baseline=BASELINE, select=["PTA006"])
+        assert not res.new, "\n".join(f.text() for f in res.new)
+        # and the baseline grandfathers no PTA006 at all — prints were
+        # fixed or individually justified, never waved through wholesale
+        assert not any(k[0] == "PTA006" for k in load_baseline(BASELINE))
+
+    def test_cli_exit_codes(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        clean = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu",
+             "--root", ".", "--baseline", "tools/analysis_baseline.json",
+             "--format", "json"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert clean.returncode == 0, clean.stdout + clean.stderr
+        doc = json.loads(clean.stdout)
+        assert doc["ok"] is True and doc["counts"]["new"] == 0
+
+        dirty = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis",
+             os.path.join("tests", "analysis_fixtures", "pta001"),
+             "--root", os.path.join("tests", "analysis_fixtures",
+                                    "pta001"),
+             "--select", "PTA001"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert dirty.returncode == 1
+
+        usage = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "paddle_tpu",
+             "--select", "PTA999"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert usage.returncode == 2
+
+    def test_list_rules_catalog(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-m", "paddle_tpu.analysis", "--list-rules"],
+            cwd=REPO, env=env, capture_output=True, text=True)
+        assert out.returncode == 0
+        for rule in ("PTA001", "PTA002", "PTA003", "PTA004", "PTA005",
+                     "PTA006"):
+            assert rule in out.stdout

@@ -548,7 +548,7 @@ class TestElasticResume:
         assert mgr.last_restore_manifest["meta"]["mesh"]["dp"] == 8
 
     def test_dp8_save_dp1_restore_bitwise_at_restore_point(self, tmp_path,
-                                                           capsys):
+                                                           caplog):
         """The restore itself is lossless across meshes: weights right
         after a dp8→dp1 elastic resume equal the dp8-saved weights
         bit for bit."""
@@ -558,9 +558,10 @@ class TestElasticResume:
         w8 = _weights(ma)
 
         mb, ds = _model_and_data()
-        mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
-               mesh={"dp": 1}, resume=str(tmp_path))
-        out = capsys.readouterr().out
+        with caplog.at_level("INFO", logger="paddle_tpu.hapi"):
+            mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+                   mesh={"dp": 1}, resume=str(tmp_path))
+        out = caplog.text
         assert "ELASTIC resume" in out and "dp=8" in out
         got = _weights(mb)
         for k in w8:
@@ -587,7 +588,7 @@ class TestElasticResume:
             np.testing.assert_allclose(got[k], ref[k], rtol=1e-4,
                                        atol=1e-6, err_msg=k)
 
-    def test_dp1_save_dp8_restore(self, tmp_path, capsys):
+    def test_dp1_save_dp8_restore(self, tmp_path, caplog):
         """Elasticity is symmetric: scale UP from dp1 to dp8 too."""
         ma, ds = _model_and_data()
         ma.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
@@ -595,9 +596,10 @@ class TestElasticResume:
         w1 = _weights(ma)
 
         mb, ds = _model_and_data()
-        mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
-               mesh={"dp": 8}, resume=str(tmp_path))
-        out = capsys.readouterr().out
+        with caplog.at_level("INFO", logger="paddle_tpu.hapi"):
+            mb.fit(ds, batch_size=8, epochs=1, shuffle=False, verbose=0,
+                   mesh={"dp": 8}, resume=str(tmp_path))
+        out = caplog.text
         assert "ELASTIC resume" in out
         got = _weights(mb)
         for k in w1:

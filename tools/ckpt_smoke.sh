@@ -12,6 +12,11 @@
 #   5. the full durability pytest matrix
 set -euo pipefail
 cd "$(dirname "$0")/.."
+
+# static-analysis preflight (tools/lint.sh): fail fast on PTA violations
+if [ "${PADDLE_SKIP_LINT:-0}" != "1" ]; then
+    tools/lint.sh || { echo "$(basename "$0"): lint preflight failed"; exit 1; }
+fi
 export JAX_PLATFORMS=cpu
 export PYTHONPATH="$PWD${PYTHONPATH:+:$PYTHONPATH}"
 if [[ "${XLA_FLAGS:-}" != *xla_force_host_platform_device_count* ]]; then

@@ -11,6 +11,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# static-analysis preflight (tools/lint.sh): fail fast on PTA violations
+if [ "${PADDLE_SKIP_LINT:-0}" != "1" ]; then
+    tools/lint.sh || { echo "$(basename "$0"): lint preflight failed"; exit 1; }
+fi
+
 export JAX_PLATFORMS=cpu
 CACHE_DIR="$(mktemp -d /tmp/paddle_perf_cache.XXXXXX)"
 OUT_DIR="$(mktemp -d /tmp/paddle_perf_out.XXXXXX)"

@@ -8,6 +8,11 @@
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
+# static-analysis preflight (tools/lint.sh): fail fast on PTA violations
+if [ "${PADDLE_SKIP_LINT:-0}" != "1" ]; then
+    tools/lint.sh || { echo "$(basename "$0"): lint preflight failed"; exit 1; }
+fi
+
 export JAX_PLATFORMS=cpu
 WORK="$(mktemp -d /tmp/paddle_serve_smoke.XXXXXX)"
 SERVER_PID=""

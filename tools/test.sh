@@ -5,5 +5,14 @@
 # is held or wedged — see bench.py _tunnel_lock). Always run the test suite
 # through this wrapper while any TPU bench is running.
 cd /root/repo || exit 1
+# static-analysis preflight: a PTA violation fails the run before pytest
+# starts (skip with PADDLE_SKIP_LINT=1 when iterating on a known-dirty tree)
+if [ "${PADDLE_SKIP_LINT:-0}" != "1" ]; then
+    tools/lint.sh > /tmp/paddle_lint.$$ 2>&1 || {
+        cat /tmp/paddle_lint.$$; rm -f /tmp/paddle_lint.$$
+        echo "tools/test.sh: static analysis failed (tools/lint.sh)"; exit 1
+    }
+    rm -f /tmp/paddle_lint.$$
+fi
 if [ $# -eq 0 ]; then set -- tests/ -q; fi
 exec env -u PALLAS_AXON_POOL_IPS JAX_PLATFORMS=cpu python -m pytest "$@"
