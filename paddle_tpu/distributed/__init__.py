@@ -55,6 +55,8 @@ from .pipeline import (  # noqa: F401
     unstack_stage_params,
 )
 from .sharding import zero_shardings, shard_spec  # noqa: F401
+from . import layout  # noqa: F401
+from .layout import SpecLayout  # noqa: F401
 # NOTE: the recompute FUNCTION lives at distributed.recompute.recompute
 # (and fleet.utils re-exports it for paddle parity); re-exporting it here
 # would shadow the .recompute submodule.

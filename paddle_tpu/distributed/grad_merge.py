@@ -8,24 +8,24 @@ grad_merge_all_reduce_op_handle for the multi-device path).
 TPU-native: `lax.scan` over the microbatch axis inside ONE jitted step — the
 accumulator is a scan carry, the allreduce (if data-parallel sharded) happens
 once on the merged gradient because XLA sees a single psum of the sum.
+
+The in-step implementation lives in `distributed.layout`
+(`microbatch_scan` / `microbatch_split`, re-exported here) and is what
+`Model.fit(accum_steps=k)` runs; `gradient_merge` keeps the standalone
+fleet-shaped wrapper for eager value_and_grad fns.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
 
-__all__ = ["gradient_merge", "split_microbatches"]
+from .layout import microbatch_scan, microbatch_split
 
+__all__ = ["gradient_merge", "split_microbatches", "microbatch_scan",
+           "microbatch_split"]
 
-def split_microbatches(batch, k_steps):
-    """Reshape each leaf [k*mb, ...] -> [k, mb, ...]."""
-    def leaf(x):
-        if x.shape[0] % k_steps:
-            raise ValueError(
-                f"batch dim {x.shape[0]} not divisible by k_steps={k_steps}")
-        return x.reshape((k_steps, x.shape[0] // k_steps) + x.shape[1:])
-
-    return jax.tree.map(leaf, batch)
+# the historical name for the same reshape
+split_microbatches = microbatch_split
 
 
 def gradient_merge(value_and_grad_fn, k_steps, avg=True):

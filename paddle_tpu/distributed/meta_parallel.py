@@ -21,6 +21,8 @@ program-inspection tests, SURVEY.md §4).
 """
 from __future__ import annotations
 
+import warnings
+
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -33,6 +35,27 @@ from ..tensor import Tensor, apply
 from .mesh import get_mesh
 
 MP_AXIS = "mp"  # model-parallel mesh axis name (≙ ring_id of the mp group)
+
+_deprecation_warned = False
+
+
+def _warn_layout_subsumes_once():
+    # once-per-process, like parallel._warn_mesh_subsumes_dp_once.  Only
+    # the fleet-shaped ENTRYPOINTS (split, param_sharding) warn — the
+    # parallel layer classes and shard_constraint/annotate/dist_specs
+    # stay sanctioned: they are the in-model dist_spec annotation
+    # mechanism the layout system composes with (models/gpt.py uses
+    # them under tensor_parallel=True).
+    global _deprecation_warned
+    if _deprecation_warned:
+        return
+    _deprecation_warned = True
+    warnings.warn(
+        "distributed.split / meta_parallel.param_sharding are "
+        "deprecated: Model.fit(mesh=..., layout=SpecLayout()) places "
+        "qkv/attn-out/ffn/embedding weights over the 'tp' axis from one "
+        "PartitionSpec table — migrate to the layout system (README "
+        "'Scaling', MIGRATION §5a-ii).", DeprecationWarning, stacklevel=3)
 
 
 def _mesh_has(axis) -> bool:
@@ -75,7 +98,9 @@ def param_sharding(layer_or_params, mesh=None) -> dict:
     Accepts a Layer (reads named_parameters, keys match state_pytrees) or a
     {name: Parameter} dict; unannotated params replicate.  Without a mesh
     (single chip) every entry is None — jax.device_put(x, None) is a no-op
-    placement, so call sites work unchanged."""
+    placement, so call sites work unchanged.  DEPRECATED: fit(layout=)
+    resolves per-param placements (dist_spec annotations still win)."""
+    _warn_layout_subsumes_once()
     mesh = mesh or get_mesh()
     if isinstance(layer_or_params, Layer):
         items = list(layer_or_params.named_parameters())
@@ -190,7 +215,9 @@ def split(x, size, operation="linear", axis=0, num_partitions=None,
     operation='linear': size=(in, out); axis=0 → row-parallel, axis=1 →
     column-parallel.  operation='embedding': size=(vocab, hidden), vocab
     sharded.  Builds the parallel layer and applies it (graph-builder UX of
-    the reference; for reusable modules use the *Parallel* classes)."""
+    the reference; for reusable modules use the *Parallel* classes).
+    DEPRECATED: fit(layout=) shards these weights from the spec table."""
+    _warn_layout_subsumes_once()
     if weight_attr is False:
         raise ValueError("split() requires a weight (weight_attr=False)")
     if operation == "linear":

@@ -7,36 +7,26 @@ program (backward.py ProgramStats:38 finds the segments).
 TPU-native: `jax.checkpoint` (remat) — XLA re-runs the forward of the wrapped
 region during the backward pass; policies choose what to keep (the reference
 always keeps only segment boundaries, ≙ policy None).
+
+The in-step implementation lives in `distributed.layout` (the engine wraps
+its per-microbatch loss in `layout.remat` when `Model.fit(recompute=)` is
+set); this module re-exports it so the fleet-shaped entrypoints keep
+working.  Prefer `fit(recompute=...)` — it composes with accumulation and
+the 3D layout inside the ONE donated jitted step.
 """
 from __future__ import annotations
 
-import jax
+from .layout import POLICIES, remat, resolve_policy
 
-__all__ = ["recompute", "checkpoint", "recompute_sequential", "POLICIES"]
-
-POLICIES = {
-    None: None,
-    "full": None,                                  # save nothing, recompute all
-    "dots": jax.checkpoint_policies.checkpoint_dots,
-    "dots_saveable": jax.checkpoint_policies.dots_saveable,
-    "dots_with_no_batch_dims":
-        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-    "nothing_saveable": jax.checkpoint_policies.nothing_saveable,
-    "everything_saveable": jax.checkpoint_policies.everything_saveable,
-}
+__all__ = ["recompute", "checkpoint", "recompute_sequential", "POLICIES",
+           "remat", "resolve_policy"]
 
 
 def checkpoint(function, policy=None, prevent_cse=True, static_argnums=()):
-    """Wrap `function` so its activations are rematerialized in backward."""
-    if isinstance(policy, str):
-        if policy not in POLICIES:
-            raise ValueError(f"unknown recompute policy {policy!r}; one of "
-                             f"{sorted(k for k in POLICIES if k)}")
-        pol = POLICIES[policy]
-    else:
-        pol = policy
-    return jax.checkpoint(function, policy=pol, prevent_cse=prevent_cse,
-                          static_argnums=static_argnums)
+    """Wrap `function` so its activations are rematerialized in backward
+    (forwards to `distributed.layout.remat` — THE implementation)."""
+    return remat(function, policy=policy, prevent_cse=prevent_cse,
+                 static_argnums=static_argnums)
 
 
 def recompute(function, *args, policy=None, **kwargs):

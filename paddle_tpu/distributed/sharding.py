@@ -11,16 +11,43 @@ axis and GSPMD inserts the all-gathers / reduce-scatters:
            (≈ free with pjit — the reference's sharding_optimizer default)
   stage 2  + gradients reduce-scattered (pass grad specs as out_shardings)
   stage 3  + parameters sharded (all-gather at use: fully-sharded DP / FSDP)
+
+DEPRECATED: the layout system (`distributed.layout.SpecLayout` via
+`Model.fit(mesh=, layout=)`) subsumes every builder here — the engine
+places params, grads, AND opt slots from one PartitionSpec table and
+pins the jitted step's in/out shardings itself.  These entrypoints warn
+once per process and forward their spec selection onto
+`layout.zero_spec`.
 """
 from __future__ import annotations
+
+import warnings
 
 import numpy as np
 import jax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .layout import zero_spec
+
 __all__ = ["shard_spec", "merge_zero_spec", "zero_shardings",
            "param_shardings", "grad_shardings", "opt_state_shardings",
            "merged_zero_shardings"]
+
+_deprecation_warned = False
+
+
+def _warn_layout_subsumes_once():
+    global _deprecation_warned
+    if _deprecation_warned:
+        return
+    _deprecation_warned = True
+    warnings.warn(
+        "distributed.sharding spec builders are deprecated: "
+        "Model.fit(mesh=..., layout=SpecLayout()) shards params, grads "
+        "and optimizer slots from one PartitionSpec table (ZeRO-1/2/3 "
+        "semantics over the 'fsdp' axis) inside the engine's donated "
+        "step — migrate to the layout system (README 'Scaling', "
+        "MIGRATION §5a-ii).", DeprecationWarning, stacklevel=3)
 
 
 def shard_spec(shape, axis_name, axis_size):
@@ -28,17 +55,10 @@ def shard_spec(shape, axis_name, axis_size):
 
     Largest-first (not first-divisible) so a [vocab, hidden] embedding
     shards its big vocab dim — and, more importantly, `merge_zero_spec`
-    below composes with tensor-parallel dist_specs without collisions."""
-    best = None
-    for d, n in enumerate(shape):
-        if n % axis_size == 0 and n >= axis_size:
-            if best is None or n > shape[best]:
-                best = d
-    if best is None:
-        return P()
-    spec = [None] * len(shape)
-    spec[best] = axis_name
-    return P(*spec)
+    below composes with tensor-parallel dist_specs without collisions.
+    DEPRECATED — forwards onto `distributed.layout.zero_spec`."""
+    _warn_layout_subsumes_once()
+    return zero_spec(shape, axis_name, axis_size)
 
 
 def merge_zero_spec(dist_spec, shape, axis_name, axis_size):
@@ -48,6 +68,7 @@ def merge_zero_spec(dist_spec, shape, axis_name, axis_size):
     dist_spec previously had no merge logic and could collide on one dim).
 
     dist_spec may be None / P(); returns a PartitionSpec."""
+    _warn_layout_subsumes_once()
     base = list(dist_spec) if dist_spec is not None else []
     base += [None] * (len(shape) - len(base))
     used = {a for entry in base if entry is not None
@@ -73,25 +94,29 @@ def _tree_shardings(tree, mesh, axis_name, sharded: bool):
     def leaf(v):
         if not sharded:
             return NamedSharding(mesh, P())
-        return NamedSharding(mesh, shard_spec(np.shape(v), axis_name, size))
+        return NamedSharding(mesh, zero_spec(np.shape(v), axis_name, size))
 
     return jax.tree.map(leaf, tree)
 
 
 def param_shardings(params, mesh, axis_name="dp", stage=1):
+    _warn_layout_subsumes_once()
     return _tree_shardings(params, mesh, axis_name, sharded=stage >= 3)
 
 
 def grad_shardings(params, mesh, axis_name="dp", stage=1):
+    _warn_layout_subsumes_once()
     return _tree_shardings(params, mesh, axis_name, sharded=stage >= 2)
 
 
 def opt_state_shardings(opt_state, mesh, axis_name="dp", stage=1):
+    _warn_layout_subsumes_once()
     return _tree_shardings(opt_state, mesh, axis_name, sharded=stage >= 1)
 
 
 def zero_shardings(params, opt_state, mesh, axis_name="dp", stage=1):
     """(param, opt_state, grad) NamedSharding pytrees for a ZeRO stage."""
+    _warn_layout_subsumes_once()
     return (param_shardings(params, mesh, axis_name, stage),
             opt_state_shardings(opt_state, mesh, axis_name, stage),
             grad_shardings(params, mesh, axis_name, stage))
@@ -109,6 +134,7 @@ def merged_zero_shardings(params, dist_specs, opt_state, mesh,
       grads      dp-sharded when stage >= 2 (reduce-scatter point)
       opt slots  dp-sharded when stage >= 1 (always inherit TP placement)
     """
+    _warn_layout_subsumes_once()
     size = int(np.prod([mesh.shape[a] for a in
                         (axis_name if isinstance(axis_name, tuple)
                          else (axis_name,))]))

@@ -51,13 +51,18 @@ def fetch_floats(device_scalars):
         return [float(v) for v in jax.device_get(list(device_scalars))]
 
 
-def shard_batch(tree, mesh, axis: str = "dp"):
+def shard_batch(tree, mesh, axis="dp"):
     """Place a batch pytree onto `mesh`: every array leaf is device_put
     with its leading dim split over the named mesh `axis`
     (`NamedSharding(mesh, P(axis))`); leaves whose leading dim doesn't
     divide by the axis size — and scalars — replicate instead.  Tensor
     leaves are rebuilt around the sharded array (Tensor is a registered
     pytree node).
+
+    `axis` may also be a TUPLE of axis names (the 3D-parallel engine
+    splits the batch over `('dp', 'fsdp')` — fsdp is a data axis with
+    sharded state): the leading dim is split over the axes jointly
+    (`P(('dp', 'fsdp'))`), sized by their product.
 
     This is the sharded analog of the buffered_reader device prefetch:
     `device_put` is ASYNC (a non-blocking host→device enqueue), so when
@@ -67,7 +72,16 @@ def shard_batch(tree, mesh, axis: str = "dp"):
     (device_put short-circuits), which also makes this idempotent."""
     from jax.sharding import NamedSharding, PartitionSpec
 
-    size = int(mesh.shape[axis]) if axis in mesh.axis_names else 1
+    if isinstance(axis, (tuple, list)):
+        names = [a for a in axis if a in mesh.axis_names]
+        entry = tuple(names) if len(names) > 1 else \
+            (names[0] if names else "dp")
+    else:
+        names = [axis] if axis in mesh.axis_names else []
+        entry = axis
+    size = 1
+    for a in names:
+        size *= int(mesh.shape[a])
 
     def place(v):
         shape = getattr(v, "shape", None)
@@ -75,7 +89,7 @@ def shard_batch(tree, mesh, axis: str = "dp"):
             return v
         divisible = (len(shape) >= 1 and shape[0] > 0
                      and shape[0] % size == 0)
-        spec = (PartitionSpec(axis) if size > 1 and divisible
+        spec = (PartitionSpec(entry) if size > 1 and divisible
                 else PartitionSpec())
         return jax.device_put(v, NamedSharding(mesh, spec))
 

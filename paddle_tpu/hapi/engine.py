@@ -224,13 +224,18 @@ class TrainEngine:
         self._step_key = None  # (mesh, rule) the cached jit was built for
         self._cost_cache = None  # cost_analysis of the live _step_fn
         self._cost_cache_fn = None
+        self._layout = None
+        self._recompute = None
+        self._accum = 1
+        self.batch_axes = "dp"  # str or tuple — shard_batch's split axes
 
     @property
     def active(self):
         return self.state is not None
 
     # -- lifecycle ---------------------------------------------------------
-    def begin(self, mesh=None, sharding_rule=None):
+    def begin(self, mesh=None, sharding_rule=None, layout=None,
+              recompute=None, accum_steps=1):
         m = self.model
         if m._optimizer is None or m._loss is None:
             raise RuntimeError("prepare() an optimizer and a loss before "
@@ -245,6 +250,25 @@ class TrainEngine:
         self._host_step = int(m._optimizer._step_count)
         self.mesh = resolve_mesh(mesh)
         self._sharding_rule = sharding_rule
+        from ..distributed import layout as _layout_mod
+
+        if layout is True:
+            layout = _layout_mod.SpecLayout()
+        self._layout = layout
+        self._layout_unmatched = set()
+        # validate the policy NAME eagerly (a typo'd fit(recompute=) must
+        # fail here, not after a 6-minute trace)
+        _layout_mod.resolve_policy(recompute)
+        self._recompute = recompute
+        self._accum = int(accum_steps)
+        if self._accum < 1:
+            raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        if self.mesh is not None and layout is not None:
+            self.batch_axes = layout.batch_axes(self.mesh)
+        else:
+            # the PR-4 call shape, bit for bit: dp-only meshes must keep
+            # the exact shard_batch spec (and jit cache key) they had
+            self.batch_axes = "dp"
         raw = {
             "trainable": trainable,
             "frozen": frozen,
@@ -264,6 +288,8 @@ class TrainEngine:
             step_key = None
         else:
             self._state_sharding = self._build_state_sharding(raw)
+            if self._layout_unmatched:
+                _layout_mod.warn_unmatched(self._layout_unmatched)
             self.state = jax.device_put(_copy_tree(raw),
                                         self._state_sharding)
             self._warn_if_mesh_unused()
@@ -276,6 +302,14 @@ class TrainEngine:
             leaves, treedef = jax.tree_util.tree_flatten(
                 self._state_sharding)
             step_key = (self.mesh, treedef, tuple(leaves))
+        # the step BODY now also depends on accum/remat/batch axes; a
+        # policy callable keys by identity (a fresh-but-equal lambda
+        # retraces — the safe direction)
+        rec = self._recompute
+        rec_key = rec if (rec is None or isinstance(rec, (str, bool))) \
+            else id(rec)
+        step_key = (step_key, self._accum, rec_key, self.batch_axes,
+                    self._layout is not None)
         self._record_synced_ids()
         self.ring = _LossRing()
         if self._step_fn is None or step_key != self._step_key:
@@ -309,7 +343,9 @@ class TrainEngine:
     def _param_spec(self, name) -> PartitionSpec:
         """PartitionSpec for one named param: the fit(sharding_rule=)
         hook wins, then a `distributed.annotate` dist_spec on the
-        Parameter, else replicated.  Axis names outside the mesh are
+        Parameter, then the fit(layout=) SpecLayout table (pattern-
+        matched by name/shape, replicated fallback with an aggregated
+        warning), else replicated.  Axis names outside the mesh are
         dropped (same leniency as meta_parallel.shard_constraint), so an
         mp-annotated model still fits on a pure-dp mesh."""
         p = self._param_refs.get(name)
@@ -318,6 +354,16 @@ class TrainEngine:
             spec = self._sharding_rule(name, p)
         if spec is None and p is not None:
             spec = getattr(p, "dist_spec", None)
+        if spec is None and self._layout is not None and p is not None:
+            shape = tuple(p.shape)
+            spec = self._layout.spec_for(name, shape)
+            if spec is None:
+                self._layout_unmatched.add(name)
+                return PartitionSpec()
+            # layout pruning is per-dim divisibility-aware (a tuple
+            # entry degrades axis by axis), stronger than the bare
+            # axis-presence filter below
+            return self._layout.prune(spec, shape, self.mesh)
         if spec is None:
             return PartitionSpec()
         axes = self.mesh.axis_names
@@ -335,13 +381,23 @@ class TrainEngine:
     def _build_state_sharding(self, raw):
         """NamedSharding pytree mirroring the state: params follow
         `_param_spec`, each opt slot inherits its param's spec when the
-        shapes match (Adam-family moments) and replicates otherwise
-        (scalar slots), everything else replicates."""
+        shapes match (Adam-family moments — ZeRO semantics: slots live
+        on their param's fsdp shards) and replicates otherwise.
+        Scalar/0-d/1-element slots ALWAYS replicate: on a 1-element
+        param the shapes-match heuristic would otherwise pin a step
+        counter or beta-power slot to the param's spec
+        (tests/test_layout3d.py regression-pins this)."""
         mesh = self.mesh
         rep = NamedSharding(mesh, PartitionSpec())
 
         def psh(name):
             return NamedSharding(mesh, self._param_spec(name))
+
+        def inherits(v, ref):
+            shp = getattr(v, "shape", None)
+            return (ref is not None and shp == ref.shape
+                    and shp is not None
+                    and int(np.prod(shp, dtype=np.int64)) > 1)
 
         opt_sh = {}
         for name, slots in raw["opt"].items():
@@ -355,8 +411,7 @@ class TrainEngine:
             ref = raw["trainable"].get(name)
             ps = psh(name)
             opt_sh[name] = {
-                slot: (ps if ref is not None
-                       and getattr(v, "shape", None) == ref.shape else rep)
+                slot: (ps if inherits(v, ref) else rep)
                 for slot, v in slots.items()}
         return {
             "trainable": {k: psh(k) for k in raw["trainable"]},
@@ -415,6 +470,9 @@ class TrainEngine:
         return dirty
 
     def _build_step(self):
+        if (self._accum > 1 or self._recompute is not None
+                or (self._layout is not None and self.mesh is not None)):
+            return self._build_featured_step()
         m = self.model
         pure = build_pure_train_step(m.network, m._loss, m._optimizer)
 
@@ -445,6 +503,96 @@ class TrainEngine:
         return jax.jit(step, donate_argnums=(0,),
                        out_shardings=(self._state_sharding, rep, None))
 
+    def _build_featured_step(self):
+        """The 3D-parallel step: same donated `(state, rng, inputs,
+        labels)` contract as `_build_step`, plus (any combination of)
+
+          * rematerialization — the per-microbatch loss is wrapped in
+            `jax.checkpoint` with the fit(recompute=) policy
+            (distributed.layout.remat; subsumes the RecomputeOptimizer
+            port).  Inside the accumulation scan prevent_cse is off —
+            the scan barrier already blocks XLA from CSE-ing the
+            recompute away;
+          * microbatch gradient accumulation — fit(accum_steps=k) runs
+            a `lax.scan` over k equal microbatches INSIDE this one
+            donated jitted step (distributed.layout.microbatch_scan;
+            subsumes GradientMergeOptimizer): grads/loss accumulate in
+            the carry, buffers thread sequentially, rng splits per
+            microbatch, and XLA sees one psum of the merged grad — the
+            collective fires once per step, not once per microbatch;
+          * activation constraints — with a layout on a mesh, batch
+            leaves (and each scan slice of them) are re-pinned to the
+            data axes with `with_sharding_constraint` so GSPMD keeps
+            intermediates on the layout instead of gathering them.
+
+        This builder is only reached when a feature is ON: the default
+        path compiles the exact PR-4 step, byte for byte (dp-only jit
+        cache keys are unchanged)."""
+        from ..distributed import layout as _layout_mod
+
+        m = self.model
+        network, loss_layer, opt = m.network, m._loss, m._optimizer
+        k = self._accum
+        use_remat = self._recompute is not None \
+            and self._recompute is not False
+        policy = _layout_mod.resolve_policy(
+            None if self._recompute is True else self._recompute)
+        constrain = None
+        if self._layout is not None and self.mesh is not None:
+            constrain = _layout_mod.batch_constrainer(self.mesh,
+                                                      self.batch_axes)
+
+        def forward(trainable, frozen, buffers, rng, inputs, labels):
+            if constrain is not None:
+                inputs = constrain(inputs)
+            all_params = {**trainable, **frozen}
+            outs, new_buffers = functional_call(
+                network, all_params, tuple(inputs), {}, buffers=buffers,
+                rng=rng)
+            outs_l = _to_list(outs)
+            if callable(loss_layer):
+                lv = loss_layer(*(outs_l + list(labels)))
+            else:
+                raise RuntimeError("prepare() a loss before fit()")
+            lv = lv.value if isinstance(lv, Tensor) else jnp.asarray(lv)
+            return jnp.mean(lv), (outs, new_buffers)
+
+        def step(state, rng, inputs, labels):
+            t = state["step"] + 1
+            frozen = state["frozen"]
+
+            def loss_fn(trainable, buffers, mb_rng, mb_in, mb_lab):
+                return forward(trainable, frozen, buffers, mb_rng,
+                               mb_in, mb_lab)
+
+            body = loss_fn
+            if use_remat:
+                body = jax.checkpoint(loss_fn, policy=policy,
+                                      prevent_cse=(k == 1))
+            grad_fn = jax.value_and_grad(body, has_aux=True)
+            if k == 1:
+                (loss_val, (outs, new_buffers)), grads = grad_fn(
+                    state["trainable"], state["buffers"], rng, inputs,
+                    labels)
+            else:
+                loss_val, grads, outs, new_buffers = \
+                    _layout_mod.microbatch_scan(
+                        grad_fn, state["trainable"], state["buffers"],
+                        rng, inputs, labels, k, constrain=constrain)
+            new_params, new_opt = opt.apply_pytree(
+                state["trainable"], grads, state["opt"], lr=state["lr"],
+                step=t)
+            new_state = {"trainable": new_params, "frozen": frozen,
+                         "buffers": new_buffers, "opt": new_opt,
+                         "lr": state["lr"], "step": t}
+            return new_state, loss_val, outs
+
+        if self.mesh is None:
+            return jax.jit(step, donate_argnums=(0,))
+        rep = NamedSharding(self.mesh, PartitionSpec())
+        return jax.jit(step, donate_argnums=(0,),
+                       out_shardings=(self._state_sharding, rep, None))
+
     def step(self, inputs, labels):
         """Dispatch one donated train step WITHOUT syncing.  The loss
         lands in the ring; returns the (device-resident) model outputs
@@ -465,8 +613,8 @@ class TrainEngine:
             # (io.DataLoader.placement); this is the idempotent fallback
             # for direct engine callers and odd-sized tail batches
             # (device_put onto the sharding an array already has is free)
-            inputs = shard_batch(inputs, self.mesh)
-            labels = shard_batch(labels, self.mesh)
+            inputs = shard_batch(inputs, self.mesh, axis=self.batch_axes)
+            labels = shard_batch(labels, self.mesh, axis=self.batch_axes)
             from ..distributed.mesh import mesh_guard
 
             # ambient mesh during trace/dispatch so in-model
@@ -490,8 +638,8 @@ class TrainEngine:
         scaling tests and bench assert on."""
         rng = jax.random.PRNGKey(0)
         if self.mesh is not None:
-            inputs = shard_batch(inputs, self.mesh)
-            labels = shard_batch(labels, self.mesh)
+            inputs = shard_batch(inputs, self.mesh, axis=self.batch_axes)
+            labels = shard_batch(labels, self.mesh, axis=self.batch_axes)
             from ..distributed.mesh import mesh_guard
 
             # same ambient scope as step(): in-model shard_constraint /

@@ -363,15 +363,30 @@ class Model:
         restart = os.environ.get("PADDLE_RESTART_COUNT", "0")
         saved_mesh = (man.get("meta") or {}).get("mesh") or {}
         saved_dp = saved_mesh.get("dp")
-        cur_dp = 1
+        cur_meta = {"dp": 1, "devices": 1, "axes": {}}
         if eng is not None and eng.mesh is not None:
             from .engine import mesh_meta
 
-            cur_dp = mesh_meta(eng.mesh)["dp"]
-        if saved_dp is not None and int(saved_dp) != cur_dp:
+            cur_meta = mesh_meta(eng.mesh)
+        cur_dp = cur_meta["dp"]
+        # ANY axis-geometry change is an elastic reshard — dp2×fsdp4 →
+        # dp2×fsdp2×tp2 keeps dp=2 but still re-lands every shard — so
+        # compare the full axes dict when the manifest carries one
+        # (older manifests only recorded dp)
+        saved_axes = saved_mesh.get("axes")
+        changed = (saved_dp is not None and int(saved_dp) != cur_dp)
+        if saved_axes is not None:
+            changed = ({str(k): int(v) for k, v in saved_axes.items()}
+                       != cur_meta["axes"])
+        if changed:
+            def _fmt(axes, dp):
+                return "×".join(f"{a}{n}" for a, n in axes.items()) \
+                    or f"dp{dp}"
             logger.info("fit: ELASTIC resume — checkpoint saved at "
-                        "dp=%s, restoring onto dp=%s (reconciled "
-                        "step=%d)", saved_dp, cur_dp,
+                        "dp=%s (%s), restoring onto dp=%s (%s) "
+                        "(reconciled step=%d)", saved_dp,
+                        _fmt(saved_axes or {}, saved_dp), cur_dp,
+                        _fmt(cur_meta["axes"], cur_dp),
                         int(back["meta"]["opt_steps"]))
         logger.info("fit: resumed from checkpoint at iteration %d "
                     "(restart #%s)", step0, restart)
@@ -383,7 +398,7 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, fault_tolerant=False,
             resume=None, checkpoint_interval=None, mesh=None,
-            sharding_rule=None):
+            sharding_rule=None, layout=None, recompute=None, accum_steps=1):
         """[fault tolerance — opt-in] `resume=<dir>` (or `resume=True`
         with `save_dir`) auto-resumes from the newest checkpoint in that
         directory and checkpoints every `checkpoint_interval` iterations
@@ -407,8 +422,24 @@ class Model:
         (GSPMD) — so `batch_size` is the GLOBAL batch and throughput
         scales with the dp degree.  All single-chip fit contracts
         (donation, sync-free stepping, compile cache, checkpoints,
-        callbacks) are preserved; see README "Scaling"."""
+        callbacks) are preserved; see README "Scaling".
+
+        [3D parallelism — opt-in] `layout=` a `distributed.SpecLayout`
+        (or `True` for the canonical transformer table) shards params
+        AND optimizer slots over the mesh's `fsdp`/`tp` axes (ZeRO
+        semantics; the batch additionally splits over fsdp), with
+        unmatched params replicated + warned.  `recompute=` (True, a
+        policy name like "dots", or a jax.checkpoint_policies callable)
+        rematerializes activations in the backward pass; `accum_steps=k`
+        (alias: the Paddle-named `accumulate_grad_batches`) accumulates
+        gradients over k microbatches via a lax.scan INSIDE the one
+        donated step, so `batch_size` stays the GLOBAL batch.  See
+        MIGRATION §5a-ii for the fleet-strategy mapping."""
         from .callbacks import config_callbacks
+
+        if accumulate_grad_batches != 1 and accum_steps == 1:
+            # Paddle's fleet name for the same knob — one implementation
+            accum_steps = accumulate_grad_batches
 
         loader = train_data if isinstance(train_data, DataLoader) else \
             DataLoader(train_data, batch_size=batch_size, shuffle=shuffle,
@@ -446,7 +477,8 @@ class Model:
             self._engine = TrainEngine(self)
         engine = self._engine
         _step_fn_before = engine._step_fn
-        engine.begin(mesh=mesh, sharding_rule=sharding_rule)
+        engine.begin(mesh=mesh, sharding_rule=sharding_rule, layout=layout,
+                     recompute=recompute, accum_steps=accum_steps)
 
         ft_mgr = None
         ft_saver = None
@@ -527,7 +559,8 @@ class Model:
 
             from ..framework.transfer import shard_batch
             prev_placement = loader.placement
-            loader.placement = _partial(shard_batch, mesh=engine.mesh)
+            loader.placement = _partial(shard_batch, mesh=engine.mesh,
+                                        axis=engine.batch_axes)
         eager_sync = user_cbs or bool(self._metrics)
         timers = StepTimers()
         self._last_fit_timers = timers

@@ -40,6 +40,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "lint: static-analysis suite test (paddle_tpu.analysis "
         "rules PTA001-006) — run via tools/lint.sh")
+    config.addinivalue_line(
+        "markers", "mesh3d: 3D-parallel layout/remat/accumulation test "
+        "(SpecLayout over dp×fsdp×tp on the 8 virtual devices) — run via "
+        "tools/mesh3d_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

@@ -1,0 +1,504 @@
+"""3D-parallel layout system (distributed/layout.py + engine wiring).
+
+Pins the tentpole contracts on the 8 virtual CPU devices:
+
+  * the SpecLayout table — every gpt/bert/ernie param matches a
+    NON-replicated spec (silent full replication of a transformer weight
+    is the failure mode the table exists to prevent); unmatched names
+    warn and replicate; prune() fits table specs onto any mesh;
+  * opt-state ZeRO semantics — slots inherit their param's spec, while
+    scalar/0-d/1-element slots ALWAYS replicate (regression pin: the
+    shapes-match heuristic must not pin a beta-power slot to a 1-elem
+    param's spec);
+  * parity — dp8, dp2×fsdp2×tp2 and dp2×fsdp4 agree at fixed global
+    batch to f32 ULP-scale tolerances; accum_steps=4 ≡ accum_steps=1;
+    recompute="dots" is numerically invisible;
+  * donation — zero silent-fallback under 3D + remat + accumulation;
+  * HLO — the 3D step carries all-gather (fsdp param gather) alongside
+    the dp grad all-reduce;
+  * elasticity — a dp8-saved checkpoint restores onto dp2×fsdp2×tp2,
+    then back onto dp8, agreeing with dp8-throughout to f32 ULP;
+  * deprecation routing — distributed.sharding / meta_parallel
+    entrypoints warn once per process and forward onto the layout
+    implementations; recompute/grad_merge re-export them.
+
+Run standalone via tools/mesh3d_smoke.sh.
+"""
+import warnings
+
+import jax
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import paddle_tpu as paddle
+from paddle_tpu.distributed import layout as layout_mod
+from paddle_tpu.distributed.layout import SpecLayout
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.framework.transfer import shard_batch
+from paddle_tpu.hapi import Model
+from paddle_tpu.hapi.engine import TrainEngine
+
+pytestmark = pytest.mark.mesh3d
+
+needs8 = pytest.mark.skipif(jax.device_count() < 8,
+                            reason="needs the 8-virtual-device conftest mesh")
+
+MESH3D = {"dp": 2, "fsdp": 2, "tp": 2}
+MESH_F4 = {"dp": 2, "fsdp": 4}
+
+
+class _MLP(paddle.nn.Layer):
+    """Layout-matchable names: fc1 (up), fc2 (down)."""
+
+    def __init__(self):
+        super().__init__()
+        self.fc1 = paddle.nn.Linear(4, 8)
+        self.act = paddle.nn.ReLU()
+        self.fc2 = paddle.nn.Linear(8, 2)
+
+    def forward(self, x):
+        return self.fc2(self.act(self.fc1(x)))
+
+
+def _model(lr=0.01):
+    paddle.seed(0)
+    net = _MLP()
+    model = Model(net)
+    model.prepare(
+        paddle.optimizer.Adam(learning_rate=lr,
+                              parameters=net.parameters()),
+        paddle.nn.CrossEntropyLoss())
+    return model
+
+
+def _dataset(n=24):
+    from paddle_tpu.io import TensorDataset
+
+    rs = np.random.RandomState(0)
+    x = rs.randn(n, 4).astype("float32")
+    y = (x.sum(1) > 0).astype("int64")
+    return TensorDataset([x, y])
+
+
+def _weights(model):
+    return {k: np.asarray(p._value)
+            for k, p in model.network.named_parameters()}
+
+
+# -- the PartitionSpec table -------------------------------------------------
+class TestLayoutTable:
+    @staticmethod
+    def _assert_all_matched(named_params):
+        lay = SpecLayout()
+        unmatched, replicated = [], []
+        for name, p in named_params:
+            shape = tuple(p.shape)
+            spec = lay.spec_for(name, shape)
+            if spec is None:
+                unmatched.append(name)
+            elif int(np.prod(shape)) > 1 and spec == P():
+                replicated.append(name)
+        assert not unmatched, f"no table match: {unmatched}"
+        assert not replicated, f"silently replicated: {replicated}"
+
+    def test_every_gpt_param_matches_non_replicated(self):
+        from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+
+        cfg = GPTConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                        num_heads=2, max_position_embeddings=16)
+        self._assert_all_matched(GPTForCausalLM(cfg).named_parameters())
+
+    def test_every_bert_param_matches_non_replicated(self):
+        from paddle_tpu.models.bert import BertConfig, BertForPretraining
+
+        cfg = BertConfig(vocab_size=64, hidden_size=16, num_layers=2,
+                         num_heads=2, intermediate_size=32,
+                         max_position_embeddings=16)
+        self._assert_all_matched(BertForPretraining(cfg).named_parameters())
+
+    def test_every_ernie_param_matches_non_replicated(self):
+        from paddle_tpu.models import ErnieModel
+
+        m = ErnieModel(vocab_size=64, hidden_size=16, num_layers=1,
+                       num_heads=2, intermediate_size=32,
+                       max_position_embeddings=16)
+        self._assert_all_matched(m.named_parameters())
+
+    def test_canonical_table_entries(self):
+        lay = SpecLayout()
+        assert lay.spec_for("gpt.wte.weight", (64, 16)) == \
+            P(("fsdp", "tp"), None)
+        assert lay.spec_for("gpt.h_0.attn.qkv.weight", (16, 48)) == \
+            P("fsdp", "tp")
+        assert lay.spec_for("gpt.h_0.attn.out.weight", (16, 16)) == \
+            P("tp", "fsdp")
+        assert lay.spec_for("gpt.h_0.mlp.fc1.weight", (16, 64)) == \
+            P("fsdp", "tp")
+        assert lay.spec_for("gpt.h_0.mlp.fc2.weight", (64, 16)) == \
+            P("tp", "fsdp")
+        assert lay.spec_for("gpt.h_0.ln_1.weight", (16,)) == P("fsdp")
+        assert lay.spec_for("gpt.h_0.attn.qkv.bias", (48,)) == P("tp")
+        assert lay.spec_for("scale", ()) == P()
+        assert lay.spec_for("conv.kernel", (3, 3, 8, 8)) is None
+
+    @needs8
+    def test_prune_fits_spec_to_mesh(self):
+        lay = SpecLayout()
+        mesh3d = build_mesh(MESH3D)
+        # [2, 16] token-type embedding: fsdp×tp=4 does not divide 2 →
+        # trailing tuple axes drop until fsdp alone fits
+        spec = lay.spec_for("embeddings.token_type.weight", (2, 16))
+        assert spec == P(("fsdp", "tp"), None)
+        assert lay.prune(spec, (2, 16), mesh3d) == P(("fsdp",), None)
+        # axes the mesh lacks drop per-dim
+        mesh_dp = build_mesh({"dp": 8})
+        assert lay.prune(P("fsdp", "tp"), (16, 16), mesh_dp) == P()
+        # non-dividing single axis drops to None
+        assert lay.prune(P("fsdp"), (3,), mesh3d) == P()
+
+    def test_resolve_warns_unmatched_and_replicates(self):
+        lay = SpecLayout()
+        with pytest.warns(UserWarning, match="REPLICATED"):
+            out = lay.resolve({"conv.kernel": (3, 3, 8, 8),
+                               "fc1.weight": (4, 8)})
+        assert out["conv.kernel"] == P()
+        assert out["fc1.weight"] == P("fsdp", "tp")
+
+    @needs8
+    def test_batch_axes(self):
+        lay = SpecLayout()
+        dp = lay.batch_axes(build_mesh({"dp": 8}))
+        assert dp == "dp" and isinstance(dp, str)  # PR-4 call shape
+        assert lay.batch_axes(build_mesh(MESH3D)) == ("dp", "fsdp")
+        assert lay.batch_axes(build_mesh({"fsdp": 4, "tp": 2})) == ("fsdp",)
+
+
+# -- engine resolution + opt slots -------------------------------------------
+@needs8
+class TestEngineLayoutResolution:
+    def test_unmatched_param_warns_and_replicates(self):
+        paddle.seed(0)
+        net = paddle.nn.Sequential(paddle.nn.Linear(4, 8),
+                                   paddle.nn.ReLU(),
+                                   paddle.nn.Linear(8, 2))
+        model = Model(net)
+        model.prepare(
+            paddle.optimizer.Adam(learning_rate=0.01,
+                                  parameters=net.parameters()),
+            paddle.nn.CrossEntropyLoss())
+        eng = TrainEngine(model)
+        with pytest.warns(UserWarning, match="REPLICATED"):
+            eng.begin(mesh=MESH3D, layout=SpecLayout())
+        # "0.weight" matches no table pattern → replicated
+        assert eng._state_sharding["trainable"]["0.weight"].spec == P()
+        eng.finish()
+
+    def test_matched_params_and_slots_shard(self):
+        eng = TrainEngine(_model()).begin(mesh=MESH3D, layout=SpecLayout())
+        sh = eng._state_sharding
+        assert sh["trainable"]["fc1.weight"].spec == P("fsdp", "tp")
+        assert sh["trainable"]["fc2.weight"].spec == P("tp", "fsdp")
+        # ZeRO: Adam moments live on their param's shards
+        for slot in ("moment1", "moment2"):
+            assert sh["opt"]["fc1.weight"][slot].spec == P("fsdp", "tp")
+        eng.finish()
+
+    def test_scalar_and_one_elem_slots_replicate(self):
+        """Regression pin (PR-4 satellite): the shapes-match slot
+        heuristic must never pin a scalar/1-element slot — even when
+        shapes coincide with a 1-element param's."""
+        eng = TrainEngine(_model()).begin(mesh=MESH3D, layout=SpecLayout())
+        raw = {
+            "trainable": {"fc1.weight": np.zeros((4, 8), np.float32),
+                          "gain": np.zeros((1,), np.float32)},
+            "frozen": {}, "buffers": {},
+            "opt": {"fc1.weight": {"moment1": np.zeros((4, 8), np.float32),
+                                   "beta1_pow": np.zeros((), np.float32)},
+                    "gain": {"moment1": np.zeros((1,), np.float32)}},
+            "lr": np.float32(0.0), "step": np.int32(0),
+        }
+        eng._sharding_rule = \
+            lambda name, p: P("fsdp") if name == "gain" else None
+        sh = eng._build_state_sharding(raw)
+        assert sh["trainable"]["fc1.weight"].spec != P()
+        assert sh["opt"]["fc1.weight"]["moment1"].spec == \
+            sh["trainable"]["fc1.weight"].spec
+        assert sh["opt"]["fc1.weight"]["beta1_pow"].spec == P()
+        # shapes match ((1,) == (1,)) but 1-element slots still replicate
+        assert sh["trainable"]["gain"].spec == P("fsdp")
+        assert sh["opt"]["gain"]["moment1"].spec == P()
+        eng.finish()
+
+    def test_dp_only_keeps_pr4_step_path(self, monkeypatch):
+        """Bitwise-compat guard: without layout/remat/accum the engine
+        must compile the UNCHANGED PR-4 step (same builder, bare-string
+        'dp' batch axis → identical shard_batch spec and jit keys)."""
+        def boom(self):
+            raise AssertionError("featured step built on the default path")
+
+        monkeypatch.setattr(TrainEngine, "_build_featured_step", boom)
+        eng = TrainEngine(_model()).begin(mesh={"dp": 8})
+        assert eng.batch_axes == "dp" and isinstance(eng.batch_axes, str)
+        eng.finish()
+        with pytest.raises(AssertionError, match="featured step"):
+            TrainEngine(_model()).begin(mesh=MESH3D, layout=SpecLayout())
+
+
+# -- parity ------------------------------------------------------------------
+@needs8
+class TestParity3D:
+    @staticmethod
+    def _per_step(mesh=None, steps=4, B=16, **begin_kw):
+        paddle.seed(0)
+        model = _model()
+        rs = np.random.RandomState(7)
+        x = rs.randn(steps * B, 4).astype("float32")
+        y = (x.sum(1) > 0).astype("int64")
+        eng = TrainEngine(model).begin(mesh=mesh, **begin_kw)
+        model.network.train()
+        for i in range(steps):
+            lo, hi = i * B, (i + 1) * B
+            eng.step([paddle.to_tensor(x[lo:hi])],
+                     [paddle.to_tensor(y[lo:hi])])
+        losses = eng.drain()
+        eng.finish()
+        return losses, _weights(model)
+
+    def test_3d_meshes_match_dp8_to_ulp(self):
+        """SAME global batch on dp8 (replicated params), dp2×fsdp2×tp2
+        and dp2×fsdp4 (layout-sharded params + opt): per-step losses and
+        final weights agree to f32 ULP-scale tolerances — sharding
+        relocates the math, it must not change it."""
+        l_dp, w_dp = self._per_step(mesh={"dp": 8})
+        l_3d, w_3d = self._per_step(mesh=MESH3D, layout=SpecLayout())
+        l_f4, w_f4 = self._per_step(mesh=MESH_F4, layout=SpecLayout())
+        assert len(l_dp) == len(l_3d) == len(l_f4) == 4
+        np.testing.assert_allclose(l_dp, l_3d, rtol=2e-5, atol=1e-6)
+        np.testing.assert_allclose(l_dp, l_f4, rtol=2e-5, atol=1e-6)
+        for k in w_dp:
+            np.testing.assert_allclose(w_dp[k], w_3d[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+            np.testing.assert_allclose(w_dp[k], w_f4[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+    def test_accum4_matches_accum1(self):
+        """fit(accum_steps=4): mean-of-means over 4 equal microbatches
+        inside the scan ≡ the one full-batch step (up to float
+        reassociation) — losses AND updated weights."""
+        l1, w1 = self._per_step()                      # PR-4 path
+        l4, w4 = self._per_step(accum_steps=4)         # featured path
+        np.testing.assert_allclose(l1, l4, rtol=1e-5, atol=1e-6)
+        for k in w1:
+            np.testing.assert_allclose(w1[k], w4[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+    def test_accum4_on_3d_mesh_matches_dp8(self):
+        l_dp, w_dp = self._per_step(mesh={"dp": 8})
+        l_a, w_a = self._per_step(mesh=MESH3D, layout=SpecLayout(),
+                                  accum_steps=4, recompute="dots")
+        np.testing.assert_allclose(l_dp, l_a, rtol=2e-5, atol=1e-6)
+        for k in w_dp:
+            np.testing.assert_allclose(w_dp[k], w_a[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+    def test_recompute_is_numerically_invisible(self):
+        """Remat re-runs the identical forward ops in backward — the
+        losses must match the no-remat run exactly-ish (same reduction
+        shapes, no reassociation introduced)."""
+        l0, w0 = self._per_step()
+        lr_, wr = self._per_step(recompute="dots")
+        np.testing.assert_allclose(l0, lr_, rtol=2e-6, atol=1e-7)
+        for k in w0:
+            np.testing.assert_allclose(w0[k], wr[k], rtol=1e-5,
+                                       atol=1e-7, err_msg=k)
+
+    def test_fit_loop_3d(self):
+        """The whole fit() wiring: layout/recompute/accum kwargs reach
+        the engine, the loader placement splits over ('dp','fsdp'),
+        history matches a dp8 fit."""
+        ma = _model()
+        ha = ma.fit(_dataset(), batch_size=8, epochs=2, shuffle=False,
+                    verbose=0, mesh={"dp": 8})
+        mb = _model()
+        hb = mb.fit(_dataset(), batch_size=8, epochs=2, shuffle=False,
+                    verbose=0, mesh=MESH3D, layout=True,
+                    recompute="dots", accum_steps=2)
+        np.testing.assert_allclose(ha["loss"], hb["loss"], rtol=2e-5,
+                                   atol=1e-6)
+        wa, wb = _weights(ma), _weights(mb)
+        for k in wa:
+            np.testing.assert_allclose(wa[k], wb[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+
+# -- donation + HLO ----------------------------------------------------------
+@needs8
+class TestFeaturedStepMechanics:
+    def test_no_silent_donation_fallback_3d_remat_accum(self):
+        """The featured step (layout + remat + scan accumulation) must
+        keep the donation contract: every pre-step state leaf consumed,
+        zero fallback warnings."""
+        eng = TrainEngine(_model()).begin(
+            mesh=MESH3D, layout=SpecLayout(), recompute="dots",
+            accum_steps=2)
+        refs = [v for tree in (eng.state["trainable"], eng.state["opt"],
+                               eng.state["buffers"])
+                for v in jax.tree_util.tree_leaves(tree)]
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(16, 4).astype("float32"))
+        y = paddle.to_tensor(rs.randint(0, 2, (16,)).astype("int64"))
+        with warnings.catch_warnings():
+            warnings.filterwarnings("error", message=".*donated buffers.*")
+            eng.step([x], [y])
+        undonated = [v for v in refs if not v.is_deleted()]
+        assert not undonated, f"{len(undonated)} state buffers survived " \
+                              "the donated dispatch (silent fallback)"
+        assert all(np.isfinite(v) for v in eng.drain())
+        eng.finish()
+
+    def test_hlo_has_fsdp_gather_alongside_dp_all_reduce(self):
+        """The acceptance HLO shape: param all-gather (fsdp resharding)
+        AND the data-parallel grad all-reduce in ONE partitioned step."""
+        eng = TrainEngine(_model()).begin(mesh=MESH3D, layout=SpecLayout())
+        rs = np.random.RandomState(0)
+        x = paddle.to_tensor(rs.randn(16, 4).astype("float32"))
+        y = paddle.to_tensor(rs.randint(0, 2, (16,)).astype("int64"))
+        text = eng.lower_step([x], [y]).compile().as_text()
+        eng.finish()
+        assert "all-gather" in text or "reduce-scatter" in text, \
+            "no fsdp collective in the 3D step HLO"
+        assert "all-reduce" in text, "no grad all-reduce in the 3D step HLO"
+
+    def test_microbatch_split(self):
+        tree = {"x": np.arange(24).reshape(12, 2)}
+        out = layout_mod.microbatch_split(tree, 4)
+        assert out["x"].shape == (4, 3, 2)
+        np.testing.assert_array_equal(np.asarray(out["x"]).reshape(12, 2),
+                                      np.arange(24).reshape(12, 2))
+        with pytest.raises(ValueError, match="not divisible"):
+            layout_mod.microbatch_split({"x": np.zeros((10, 2))}, 4)
+
+    def test_bad_recompute_policy_fails_eagerly(self):
+        with pytest.raises(ValueError, match="unknown recompute policy"):
+            TrainEngine(_model()).begin(recompute="dotz")
+
+    def test_bad_accum_steps_rejected(self):
+        with pytest.raises(ValueError, match="accum_steps"):
+            TrainEngine(_model()).begin(accum_steps=0)
+
+
+# -- the sharded data path ---------------------------------------------------
+@needs8
+class TestShardBatchTupleAxis:
+    def test_tuple_axis_splits_over_product(self):
+        mesh = build_mesh(MESH3D)
+        x = np.arange(64, dtype=np.float32).reshape(16, 4)
+        out = shard_batch([paddle.to_tensor(x)], mesh, axis=("dp", "fsdp"))
+        arr = out[0]._value
+        assert arr.sharding.spec == P(("dp", "fsdp"))
+        assert {s.data.shape for s in arr.addressable_shards} == {(4, 4)}
+
+    def test_indivisible_replicates(self):
+        mesh = build_mesh(MESH3D)
+        x = np.zeros((6, 4), np.float32)  # 6 % (dp2*fsdp2) != 0
+        out = shard_batch([paddle.to_tensor(x)], mesh, axis=("dp", "fsdp"))
+        assert out[0]._value.sharding.spec == P()
+
+    def test_string_axis_unchanged(self):
+        mesh = build_mesh({"dp": 8})
+        x = np.zeros((16, 4), np.float32)
+        out = shard_batch([paddle.to_tensor(x)], mesh)
+        assert out[0]._value.sharding.spec == P("dp")
+
+
+# -- elastic any-mesh reshard ------------------------------------------------
+@needs8
+class TestElasticAnyMesh:
+    def test_dp8_to_3d_and_back_ulp(self, tmp_path, caplog):
+        """The acceptance round trip: dp8-saved checkpoint restores onto
+        dp2×fsdp2×tp2 (layout shardings), trains an epoch, restores back
+        onto dp8, and the final weights agree with dp8-throughout to f32
+        ULP tolerances."""
+        ma = _model()
+        ma.fit(_dataset(), batch_size=8, epochs=3, shuffle=False,
+               verbose=0, mesh={"dp": 8})
+        ref = _weights(ma)
+
+        mb = _model()
+        mb.fit(_dataset(), batch_size=8, epochs=1, shuffle=False,
+               verbose=0, mesh={"dp": 8}, resume=str(tmp_path))
+        mc = _model()
+        with caplog.at_level("INFO", logger="paddle_tpu.hapi"):
+            mc.fit(_dataset(), batch_size=8, epochs=2, shuffle=False,
+                   verbose=0, mesh=MESH3D, layout=True,
+                   resume=str(tmp_path))
+        out = caplog.text
+        assert "ELASTIC resume" in out and "dp=8" in out
+        assert "dp2×fsdp2×tp2" in out
+        md = _model()
+        md.fit(_dataset(), batch_size=8, epochs=3, shuffle=False,
+               verbose=0, mesh={"dp": 8}, resume=str(tmp_path))
+        got = _weights(md)
+        for k in ref:
+            np.testing.assert_allclose(got[k], ref[k], rtol=1e-4,
+                                       atol=1e-6, err_msg=k)
+
+    def test_dp8_to_3d_restore_is_bitwise(self, tmp_path):
+        """The restore itself (before any training) is lossless across
+        the mesh change: weights right after the 3D elastic resume equal
+        the dp8-saved weights bit for bit."""
+        ma = _model()
+        ma.fit(_dataset(), batch_size=8, epochs=1, shuffle=False,
+               verbose=0, mesh={"dp": 8}, resume=str(tmp_path))
+        w8 = _weights(ma)
+        mb = _model()
+        mb.fit(_dataset(), batch_size=8, epochs=1, shuffle=False,
+               verbose=0, mesh=MESH3D, layout=True, resume=str(tmp_path))
+        got = _weights(mb)
+        for k in w8:
+            np.testing.assert_array_equal(got[k], w8[k], err_msg=k)
+
+
+# -- deprecation routing -----------------------------------------------------
+class TestDeprecationRouting:
+    def test_sharding_warns_once_and_forwards(self, monkeypatch):
+        from paddle_tpu.distributed import sharding as sh
+
+        monkeypatch.setattr(sh, "_deprecation_warned", False)
+        with pytest.warns(DeprecationWarning, match="layout"):
+            spec = sh.shard_spec((64, 16), "fsdp", 2)
+        assert spec == layout_mod.zero_spec((64, 16), "fsdp", 2)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")  # second call: silent
+            sh.shard_spec((64, 16), "fsdp", 2)
+
+    def test_meta_parallel_warns_once(self, monkeypatch):
+        from paddle_tpu.distributed import meta_parallel as mp
+
+        monkeypatch.setattr(mp, "_deprecation_warned", False)
+        with pytest.warns(DeprecationWarning, match="layout"):
+            mp.param_sharding({})
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            mp.param_sharding({})
+
+    def test_recompute_reexports_layout_impl(self):
+        from paddle_tpu.distributed import recompute as rc
+
+        assert rc.POLICIES is layout_mod.POLICIES
+        assert rc.remat is layout_mod.remat
+        g = jax.grad(rc.checkpoint(lambda x: (x * x).sum(),
+                                   policy="dots"))(np.float32(3.0))
+        assert float(g) == pytest.approx(6.0)
+
+    def test_grad_merge_reexports_layout_impl(self):
+        from paddle_tpu.distributed import grad_merge as gm
+
+        assert gm.split_microbatches is layout_mod.microbatch_split
+        assert gm.microbatch_scan is layout_mod.microbatch_scan
+
+    def test_spec_layout_public_export(self):
+        import paddle_tpu.distributed as dist
+
+        assert dist.SpecLayout is SpecLayout
