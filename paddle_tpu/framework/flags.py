@@ -68,6 +68,19 @@ define_flag("FLAGS_serving_buckets", "",
             "serving shape-bucket grid, 'B1,B2,...' or 'B1,B2xS1,S2,...' "
             "(batch x sequence); '' = powers of two up to "
             "FLAGS_serving_max_batch, no sequence bucketing")
+# -- generation serving (paddle_tpu.serving.generation) --------------------
+define_flag("FLAGS_genserve_max_slots", 4,
+            "in-flight sequences per decode iteration (the continuous-"
+            "batching lane count; one decode executable spans all slots)")
+define_flag("FLAGS_genserve_max_seq_len", 256,
+            "per-slot KV-cache length S_max; prompt + max_new_tokens of "
+            "every request must fit inside it")
+define_flag("FLAGS_genserve_prompt_buckets", "16,32,64",
+            "admitted prompt-length grid 'S1,S2,...'; one prefill+insert "
+            "executable pair is AOT-compiled per bucket at start()")
+define_flag("FLAGS_genserve_queue_depth", 128,
+            "bounded generation admission queue; submit() raises "
+            "QueueFullError beyond this")
 # -- runtime telemetry (paddle_tpu.monitor) --------------------------------
 define_flag("FLAGS_telemetry_dir", "",
             "directory for the per-step JSONL training event log "

@@ -963,6 +963,40 @@ class Model:
             return server.wait()
         return server
 
+    def serve_generate(self, host="127.0.0.1", port=8866, *,
+                       max_slots=None, max_seq_len=None,
+                       prompt_buckets=None, queue_depth=None,
+                       blocking=True, install_signal_handlers=True):
+        """Serve autoregressive generation over HTTP with continuous
+        batching (paddle_tpu.serving.generation): prefill seeds a
+        device-resident KV cache, one donated decode executable advances
+        every in-flight request a token per iteration, and POST
+        /generate streams tokens as they decode (SSE).  The network must
+        expose the slot-batched decode path (``slot_prefill`` /
+        ``slot_decode``, e.g. models.GPTForCausalLM).
+
+        With `blocking=False` returns the started `ServingServer` (use
+        `.url`, `.shutdown()`); otherwise blocks until SIGTERM and
+        returns the drain exit code (0 = clean).
+        """
+        from ..serving import ServingServer
+        from ..serving.generation import GenerationEngine
+
+        self.network.eval()
+        engine = GenerationEngine(
+            self.network, max_slots=max_slots, max_seq_len=max_seq_len,
+            prompt_buckets=prompt_buckets, queue_depth=queue_depth)
+        server = ServingServer(
+            None, host=host, port=port,
+            install_signal_handlers=install_signal_handlers,
+            gen_engine=engine).start()
+        if blocking:
+            # operator-facing notice on the blocking serve path
+            print(f"serving generation on {server.url} "  # noqa: PTA006
+                  f"(SIGTERM drains gracefully)", flush=True)
+            return server.wait()
+        return server
+
     def parameters(self, *args, **kwargs):
         return self.network.parameters()
 

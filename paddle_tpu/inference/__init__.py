@@ -37,7 +37,7 @@ logger = logging.getLogger("paddle_tpu.inference")
 
 __all__ = ["Config", "Predictor", "create_predictor",
            "save_inference_model", "load_inference_model", "PrecisionType",
-           "DataType", "PlaceType"]
+           "DataType", "PlaceType", "aot_compile", "spec_tree"]
 
 
 class PrecisionType:
@@ -373,6 +373,33 @@ class Predictor:
 
 def create_predictor(config):
     return Predictor(config)
+
+
+def spec_tree(tree):
+    """ShapeDtypeStructs mirroring an argument pytree — the AOT lowering
+    input for ``aot_compile``.  Scalars should already be committed
+    numpy scalars (np.int32/np.float32): a weak-typed python int would
+    lower a different program than the one traffic calls."""
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(np.shape(a), np.result_type(a)),
+        tree)
+
+
+def aot_compile(fn, arg_specs, *, donate_argnums=()):
+    """Lower + compile ``fn`` for one EXACT argument signature, ahead of
+    traffic (the Predictor bucket-cache discipline, factored out for
+    engines that manage their own executables — the generation engine's
+    donated decode step).  ``arg_specs`` are ShapeDtypeStructs (or
+    pytrees of them, e.g. from ``spec_tree``); ``donate_argnums`` is
+    forwarded to jax.jit, so a donated state argument keeps its
+    buffer-reuse contract in the compiled executable.
+
+    Calling the result with a mismatched shape/dtype raises instead of
+    recompiling — steady-state serving performs zero XLA compiles, and a
+    signature drift is a loud error rather than a silent compile storm.
+    """
+    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    return jitted.lower(*arg_specs).compile()
 
 
 

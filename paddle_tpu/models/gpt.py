@@ -94,6 +94,62 @@ class GPTAttention(Layer):
             return out, k, v  # [B, S, nh, hd] — prefill seeds the KV cache
         return out
 
+    def decode_slots(self, x, k_cache, v_cache, pos, active):
+        """Continuous-batching decode: one token per cache SLOT, each at
+        its OWN position (the batched generalization of decode_step for
+        paddle_tpu.serving.generation — lanes belong to different
+        requests admitted at different times, so there is no shared
+        scalar position).
+
+        x: [slots, 1, H] hidden; caches: [slots, S_max, nh, hd];
+        pos: [slots] int32 per-lane write index; active: [slots] bool —
+        inactive lanes leave their cache rows untouched.  Returns
+        (out, k', v').  Per-lane math is identical to decode_step at the
+        same position, which is what makes an engine lane bitwise-equal
+        to a solo ``generate`` run.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..tensor import unwrap
+
+        cfg = self.cfg
+        B = x.shape[0]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = T.reshape(self.qkv(x), [B, 1, 3, nh, hd])
+        q = unwrap(qkv[:, :, 0])                     # [slots, 1, nh, hd]
+        k = unwrap(qkv[:, :, 1])
+        v = unwrap(qkv[:, :, 2])
+        pos = jnp.asarray(unwrap(pos), jnp.int32)
+        active = jnp.asarray(unwrap(active), bool)
+        k_cache, v_cache = unwrap(k_cache), unwrap(v_cache)
+        # per-lane scatter: lane b writes column pos[b] (dynamic_update
+        # _slice cannot express per-row offsets; the one-hot where is the
+        # jit-safe equivalent and XLA fuses it into the cache update)
+        write = (jnp.arange(k_cache.shape[1])[None, :] == pos[:, None]) \
+            & active[:, None]                         # [slots, S_max]
+        k_cache = jnp.where(write[:, :, None, None], k, k_cache)
+        v_cache = jnp.where(write[:, :, None, None], v, v_cache)
+        if cfg.tensor_parallel:
+            # head-axis pinning, as in forward()/decode_step: without it
+            # GSPMD may gather the cache every decode iteration
+            q = unwrap(shard_constraint(Tensor(q), None, None, "mp", None))
+            k_cache = unwrap(shard_constraint(
+                Tensor(k_cache), None, None, "mp", None))
+            v_cache = unwrap(shard_constraint(
+                Tensor(v_cache), None, None, "mp", None))
+        scores = jnp.einsum("bqnd,bsnd->bnqs", q, k_cache) \
+            * (1.0 / float(hd) ** 0.5)
+        valid = jnp.arange(k_cache.shape[1])[None, :] <= pos[:, None]
+        scores = jnp.where(valid[:, None, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jnp.exp(scores - lax.stop_gradient(
+            scores.max(axis=-1, keepdims=True)))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, v_cache)
+        out = self.out(Tensor(ctx.reshape(B, 1, cfg.hidden_size)))
+        return out, Tensor(k_cache), Tensor(v_cache)
+
     def decode_step(self, x, k_cache, v_cache, pos):
         """One-token cached attention (the KV-cache serving path; the
         reference's analog is fused_multi_transformer's CacheKV decode,
@@ -188,6 +244,13 @@ class GPTBlock(Layer):
         x = x + self.mlp(self.ln_2(x))
         return x, k_cache, v_cache
 
+    def decode_slots(self, x, k_cache, v_cache, pos, active):
+        a, k_cache, v_cache = self.attn.decode_slots(
+            self.ln_1(x), k_cache, v_cache, pos, active)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_cache, v_cache
+
 
 class GPTModel(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -266,6 +329,21 @@ class GPTModel(Layer):
             new_caches.append((unwrap(kc), unwrap(vc)))
         return self.ln_f(x), tuple(new_caches)
 
+    def decode_slots(self, token_ids, pos, caches, active):
+        """Continuous-batching decode step: token_ids [slots,1], each
+        lane at its own absolute position ``pos[slot]``; ``active``
+        masks lanes whose slot currently holds no request.  Returns
+        (hidden [slots,1,H], new caches)."""
+        from ..tensor import unwrap
+
+        x = self.wte(token_ids) \
+            + self.wpe(T.reshape(Tensor(unwrap(pos)), [-1, 1]))
+        new_caches = []
+        for blk, (kc, vc) in zip(self.h, caches):
+            x, kc, vc = blk.decode_slots(x, kc, vc, pos, active)
+            new_caches.append((unwrap(kc), unwrap(vc)))
+        return self.ln_f(x), tuple(new_caches)
+
 
 class GPTForCausalLM(Layer):
     def __init__(self, cfg: GPTConfig):
@@ -304,6 +382,73 @@ class GPTForCausalLM(Layer):
             return T.matmul(hidden,
                             T.transpose(self.gpt.wte.weight, [1, 0]))
         return self.lm_head(hidden)
+
+    def slot_prefill(self, input_ids, length):
+        """Serving prefill for ONE request (paddle_tpu.serving.generation):
+        input_ids [1, Sp] right-padded to the prompt bucket ``Sp``,
+        ``length`` the real prompt length L (traced int32).  Causal
+        attention makes the padded tail invisible to positions < L, so
+        the returned last-real-token logits are exact; the padded tail's
+        K/V entries are garbage the engine's per-slot position mask never
+        exposes (and overwrites as decoding advances).
+
+        Returns (k [layers, Sp, nh, hd], v [layers, Sp, nh, hd],
+        logits [V] at position L-1) as raw jax arrays — the engine
+        scatters them into its device-resident slot cache.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        import paddle_tpu as paddle
+
+        from ..tensor import unwrap
+
+        if self.training:
+            raise RuntimeError(
+                "slot_prefill/slot_decode are eval-only serving paths; "
+                "call model.eval() first")
+        gpt = self.gpt
+        S = input_ids.shape[1]
+        pos = paddle.arange(S)
+        x = gpt.drop(gpt.wte(input_ids) + gpt.wpe(pos))
+        ks, vs = [], []
+        for blk in gpt.h:
+            x, k, v = blk(x, return_kv=True)
+            ks.append(unwrap(k)[0])
+            vs.append(unwrap(v)[0])
+        hidden = gpt.ln_f(x)                         # [1, Sp, H]
+        length = jnp.asarray(unwrap(length), jnp.int32)
+        last = lax.dynamic_slice_in_dim(unwrap(hidden), length - 1, 1,
+                                        axis=1)      # [1, 1, H]
+        logits = self._head(Tensor(last))
+        return jnp.stack(ks), jnp.stack(vs), unwrap(logits)[0, 0]
+
+    def slot_decode(self, tokens, pos, active, k_cache, v_cache):
+        """Serving decode iteration over the slot-batched KV cache:
+        tokens [slots] int32 (each lane's pending token), pos [slots]
+        int32 write positions, active [slots] bool, caches
+        [layers, slots, S_max, nh, hd].  Returns (logits [slots, V],
+        k_cache', v_cache') — ONE fixed-shape program regardless of
+        which lanes are live (continuous batching's iteration step).
+        """
+        import jax.numpy as jnp
+
+        from ..tensor import unwrap
+
+        if self.training:
+            raise RuntimeError(
+                "slot_prefill/slot_decode are eval-only serving paths; "
+                "call model.eval() first")
+        tokens = jnp.asarray(unwrap(tokens), jnp.int32)
+        k_cache, v_cache = unwrap(k_cache), unwrap(v_cache)
+        caches = tuple((k_cache[i], v_cache[i])
+                       for i in range(self.cfg.num_layers))
+        hidden, new_caches = self.gpt.decode_slots(
+            Tensor(tokens[:, None]), pos, caches, active)
+        logits = self._head(hidden)                  # [slots, 1, V]
+        k2 = jnp.stack([k for k, _ in new_caches])
+        v2 = jnp.stack([v for _, v in new_caches])
+        return unwrap(logits)[:, 0], k2, v2
 
     def _beam_traced(self, input_ids, max_new_tokens, num_beams,
                      eos_token_id):

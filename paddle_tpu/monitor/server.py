@@ -92,16 +92,23 @@ class _Handler(BaseHTTPRequestHandler):
 class MonitorServer:
     """Expose a metrics registry (default: the shared process registry)
     over HTTP; optionally attach a `TrainTelemetry` for /debug/trace and
-    federate other ranks' /metrics (`federate=[base_url, ...]`)."""
+    federate other ranks' /metrics (`federate=[base_url, ...]`).
+
+    `extra_registries` co-exposes additional in-process registries (or
+    anything with a ``prometheus_text()``, e.g. a serving engine's
+    ServingMetrics / GenerationMetrics) on the same /metrics scrape —
+    one monitor port covers training AND serving observability."""
 
     def __init__(self, registry=None, telemetry=None, host="127.0.0.1",
-                 port=0, federate=(), fetch_timeout_s=2.0):
+                 port=0, federate=(), fetch_timeout_s=2.0,
+                 extra_registries=()):
         self.registry = registry if registry is not None \
             else default_registry()
         self.telemetry = telemetry
         self._host = host
         self._requested_port = int(port)
         self.federate = list(federate)
+        self.extra_registries = list(extra_registries)
         self.fetch_timeout_s = fetch_timeout_s
         self._httpd = None
         self._thread = None
@@ -110,8 +117,9 @@ class MonitorServer:
     # -- endpoint bodies ---------------------------------------------------
     def metrics_text(self) -> str:
         parts = [self.registry.prometheus_text()]
+        parts.extend(r.prometheus_text() for r in self.extra_registries)
         if not self.federate:
-            return parts[0]
+            return "".join(parts)
         # fetch every rank CONCURRENTLY: N dead ranks must cost one
         # fetch timeout total, not N of them — a pod scrape that blows
         # the scraper's deadline loses the launcher's own healthy

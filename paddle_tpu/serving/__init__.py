@@ -14,15 +14,28 @@ SIGTERM drain.
     with serving.ServingServer(engine, port=8866) as srv:
         srv.wait()          # until SIGTERM → drain → clean exit
 
-or one-shot from the high-level API: ``paddle.Model(net).serve(...)``.
+Autoregressive traffic runs through the continuous-batching
+GenerationEngine instead (serving/generation.py): prefill seeds a
+device-resident KV cache, ONE donated decode executable advances every
+in-flight sequence a token per iteration, and the scheduler
+admits/retires requests at iteration boundaries.  Mounted on the same
+HTTP server as streaming POST /generate:
+
+    gen = serving.GenerationEngine(model, max_slots=8)
+    with serving.ServingServer(None, gen_engine=gen, port=8866) as srv:
+        srv.wait()
+
+or one-shot from the high-level API: ``paddle.Model(net).serve(...)`` /
+``.serve_generate(...)``.
 """
 from .engine import (BucketSpec, DeadlineExceededError, EngineStoppedError,
                      QueueFullError, ServingEngine)
-from .metrics import ServingMetrics
+from .metrics import GenerationMetrics, ServingMetrics
 
 __all__ = ["ServingEngine", "ServingServer", "ServingClient", "BucketSpec",
-           "ServingMetrics", "QueueFullError", "DeadlineExceededError",
-           "EngineStoppedError"]
+           "ServingMetrics", "GenerationMetrics", "GenerationEngine",
+           "GenerationHandle", "CacheGeometry", "SlotScheduler",
+           "QueueFullError", "DeadlineExceededError", "EngineStoppedError"]
 
 
 def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
@@ -32,4 +45,13 @@ def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
     if name == "ServingClient":
         from .client import ServingClient
         return ServingClient
+    if name in ("GenerationEngine", "GenerationHandle"):
+        from . import generation
+        return getattr(generation, name)
+    if name == "CacheGeometry":
+        from .kv_cache import CacheGeometry
+        return CacheGeometry
+    if name == "SlotScheduler":
+        from .scheduler import SlotScheduler
+        return SlotScheduler
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,10 +1,12 @@
 """Minimal stdlib client for the serving HTTP API.
 
-`ServingClient` wraps /predict, /healthz, and /metrics with
-urllib.request (no dependencies — usable from any host that can reach
-the server).  The __main__ entry is the load generator
-tools/serve_smoke.sh drives: N requests from K threads, then a one-line
-JSON summary on stdout.
+`ServingClient` wraps /predict, /generate (blocking and token-streaming
+SSE), /healthz, and /metrics with urllib.request (no dependencies —
+usable from any host that can reach the server).  The __main__ entry is
+the load generator tools/serve_smoke.sh drives: N requests from K
+threads — pure /predict, pure streaming /generate, or a mixed blend —
+then a one-line JSON summary on stdout (with client-side TTFT and
+inter-token quantiles for generation traffic).
 """
 from __future__ import annotations
 
@@ -63,6 +65,68 @@ class ServingClient:
         return [np.asarray(o, dtype=np.dtype(dt)) for o, dt in
                 zip(payload["outputs"], payload["dtypes"])]
 
+    def _gen_body(self, prompt, max_new_tokens, do_sample, temperature,
+                  top_k, seed, eos_token_id, deadline_ms, stream):
+        body = {"prompt": [int(t) for t in prompt],
+                "max_new_tokens": int(max_new_tokens),
+                "do_sample": bool(do_sample),
+                "temperature": float(temperature), "top_k": int(top_k),
+                "seed": int(seed), "stream": stream}
+        if eos_token_id is not None:
+            body["eos_token_id"] = int(eos_token_id)
+        if deadline_ms is not None:
+            body["deadline_ms"] = deadline_ms
+        return body
+
+    def generate(self, prompt, max_new_tokens=32, *, do_sample=False,
+                 temperature=1.0, top_k=0, seed=0, eos_token_id=None,
+                 deadline_ms=None) -> dict:
+        """Blocking generation: {"tokens": [...], "ttft_ms",
+        "latency_ms"}.  Raises ServingHTTPError on 429/503/504."""
+        status, raw = self._request("/generate", self._gen_body(
+            prompt, max_new_tokens, do_sample, temperature, top_k, seed,
+            eos_token_id, deadline_ms, stream=False))
+        if status != 200:
+            try:
+                detail = json.loads(raw or b"{}").get("error", "?")
+            except ValueError:
+                detail = (raw or b"").decode(errors="replace")[:200]
+            raise ServingHTTPError(status, detail)
+        return json.loads(raw or b"{}")
+
+    def generate_stream(self, prompt, max_new_tokens=32, *,
+                        do_sample=False, temperature=1.0, top_k=0, seed=0,
+                        eos_token_id=None, deadline_ms=None):
+        """Streaming generation: yields one event dict per SSE frame as
+        the server's decode loop produces it — {"token": t} per decoded
+        token, then a final {"done": true, "tokens": n, ...} (which
+        carries "error" when the request failed mid-decode).  Admission
+        failures (429/503) raise ServingHTTPError before the first
+        yield."""
+        req = urllib.request.Request(
+            self.base + "/generate",
+            data=json.dumps(self._gen_body(
+                prompt, max_new_tokens, do_sample, temperature, top_k,
+                seed, eos_token_id, deadline_ms, stream=True)).encode(),
+            headers={"Content-Type": "application/json"}, method="POST")
+        try:
+            resp = urllib.request.urlopen(req, timeout=self.timeout)
+        except urllib.error.HTTPError as e:
+            try:
+                detail = json.loads(e.read() or b"{}").get("error", "?")
+            except ValueError:
+                detail = "?"
+            raise ServingHTTPError(e.code, detail) from None
+        with resp:
+            for line in resp:  # urllib undoes the chunked framing
+                line = line.strip()
+                if not line.startswith(b"data: "):
+                    continue
+                evt = json.loads(line[len(b"data: "):])
+                yield evt
+                if evt.get("done"):
+                    return
+
     def healthz(self) -> dict:
         status, raw = self._request("/healthz")
         return {"status_code": status, **json.loads(raw or b"{}")}
@@ -82,25 +146,73 @@ def main(argv=None):
     parser.add_argument("--url", required=True)
     parser.add_argument("--requests", type=int, default=20)
     parser.add_argument("--concurrency", type=int, default=4)
+    parser.add_argument("--mode", default="predict",
+                        choices=("predict", "generate", "mixed"),
+                        help="traffic blend: /predict, streaming "
+                             "/generate, or alternating both")
     parser.add_argument("--shape", default="8",
                         help="comma-separated SAMPLE shape, e.g. '16' or "
-                             "'16,8' (no batch dim)")
+                             "'16,8' (no batch dim) — predict traffic")
     parser.add_argument("--dtype", default="float32")
+    parser.add_argument("--prompt-len", type=int, default=8,
+                        help="generate traffic: prompt token count")
+    parser.add_argument("--max-new", type=int, default=16,
+                        help="generate traffic: max_new_tokens")
+    parser.add_argument("--vocab", type=int, default=200,
+                        help="generate traffic: prompt id upper bound")
+    parser.add_argument("--sample", action="store_true",
+                        help="generate traffic: temperature/top-k "
+                             "sampling instead of greedy")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
 
     shape = tuple(int(d) for d in args.shape.split(",") if d.strip())
     client = ServingClient(args.url)
     results = {"ok": 0, "backpressure": 0, "errors": 0}
+    ttfts, gaps = [], []
+    gen_tokens = [0]
     lock = threading.Lock()
+
+    def predict_once(rs):
+        x = (rs.randint(0, 100, shape) if "int" in args.dtype
+             else rs.randn(*shape)).astype(args.dtype)
+        client.predict([x])
+
+    def generate_once(rs):
+        prompt = [int(t) for t in rs.randint(1, args.vocab,
+                                             args.prompt_len)]
+        t0 = last = time.perf_counter()
+        ntok = 0
+        my_ttft, my_gaps, err = None, [], None
+        for evt in client.generate_stream(
+                prompt, args.max_new, do_sample=args.sample,
+                temperature=0.8, top_k=5,
+                seed=int(rs.randint(1 << 30))):
+            now = time.perf_counter()
+            if "token" in evt:
+                ntok += 1
+                if my_ttft is None:
+                    my_ttft = now - t0
+                else:
+                    my_gaps.append(now - last)
+                last = now
+            if evt.get("done"):
+                err = evt.get("error")
+        with lock:
+            gen_tokens[0] += ntok
+            if my_ttft is not None:
+                ttfts.append(my_ttft * 1e3)
+            gaps.extend(g * 1e3 for g in my_gaps)
+        if err:
+            raise ServingHTTPError(200, err)
 
     def worker(wid: int, n: int):
         rs = np.random.RandomState(args.seed + wid)
-        for _ in range(n):
-            x = (rs.randint(0, 100, shape) if "int" in args.dtype
-                 else rs.randn(*shape)).astype(args.dtype)
+        for i in range(n):
+            gen = (args.mode == "generate"
+                   or (args.mode == "mixed" and (wid + i) % 2 == 0))
             try:
-                client.predict([x])
+                (generate_once if gen else predict_once)(rs)
                 key = "ok"
             except ServingHTTPError as e:
                 key = "backpressure" if e.status == 429 else "errors"
@@ -122,6 +234,16 @@ def main(argv=None):
     results["elapsed_s"] = round(time.perf_counter() - t0, 3)
     results["client_qps"] = round(results["ok"] /
                                   max(results["elapsed_s"], 1e-9), 1)
+    if args.mode in ("generate", "mixed"):
+        results["gen_tokens"] = gen_tokens[0]
+        results["client_tokens_per_sec"] = round(
+            gen_tokens[0] / max(results["elapsed_s"], 1e-9), 1)
+        results["ttft_p50_ms"] = round(
+            float(np.percentile(ttfts, 50)), 3) if ttfts else None
+        results["inter_token_p50_ms"] = round(
+            float(np.percentile(gaps, 50)), 3) if gaps else None
+        results["inter_token_p99_ms"] = round(
+            float(np.percentile(gaps, 99)), 3) if gaps else None
     print(json.dumps(results), flush=True)
     return 0 if results["errors"] == 0 else 1
 

@@ -1,0 +1,145 @@
+"""Device-resident slot-batched KV cache for continuous-batching decode.
+
+The generation engine's whole mutable decode state is ONE pytree of
+fixed-shape jax arrays — the stacked per-layer KV cache
+(``[layers, slots, S_max, nh, hd]``, the fused_multi_transformer CacheKV
+layout turned TPU-native) plus the per-slot lane registers (pending
+token, write position, active mask, sampling params, per-slot PRNG
+keys).  Every jitted transition (insert / decode / release) takes the
+state as its first argument with ``donate_argnums=(0,)`` — the
+TrainEngine donation contract from hapi/engine.py — so XLA rewrites the
+cache in place and the KV bytes NEVER round-trip to host between
+iterations.  The engine thread owns the single live reference; a
+consumed (donated) state is immediately replaced by the transition's
+output.
+
+This module is layout + traced transitions only; scheduling policy lives
+in serving/scheduler.py and the compiled-executable lifecycle in
+serving/generation.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+__all__ = ["CacheGeometry", "make_state", "state_specs", "write_prompt",
+           "admit_slot", "release_slots"]
+
+
+@dataclass(frozen=True)
+class CacheGeometry:
+    """Static shape of the decode state — one geometry == one decode
+    executable (the zero-steady-state-compile invariant)."""
+    num_layers: int
+    max_slots: int
+    max_seq_len: int       # S_max: prompt + generated tokens per slot
+    num_heads: int
+    head_dim: int
+    vocab_size: int
+    dtype: str = "float32"
+
+    @property
+    def kv_shape(self):
+        return (self.num_layers, self.max_slots, self.max_seq_len,
+                self.num_heads, self.head_dim)
+
+    def kv_bytes(self) -> int:
+        import numpy as np
+
+        n = 2  # k and v
+        for d in self.kv_shape:
+            n *= d
+        return n * np.dtype(self.dtype).itemsize
+
+
+def make_state(geom: CacheGeometry):
+    """Fresh all-lanes-free decode state (device arrays).
+
+    Keys: ``k``/``v`` the stacked cache; per-slot lanes ``tok`` (pending
+    token, written at ``pos`` next iteration), ``pos`` (absolute write
+    index), ``active``, ``rng`` (per-slot PRNG key), and the per-slot
+    sampling registers ``do_sample``/``temp``/``top_k``/``eos``/
+    ``stop_pos`` (stop_pos = prompt_len + max_new_tokens; a lane retires
+    when its next write position would reach it, or on eos).
+    """
+    import jax
+    import jax.numpy as jnp
+
+    S = geom.max_slots
+    key_shape = jax.random.PRNGKey(0).shape  # (2,) for threefry
+    return {
+        "k": jnp.zeros(geom.kv_shape, jnp.dtype(geom.dtype)),
+        "v": jnp.zeros(geom.kv_shape, jnp.dtype(geom.dtype)),
+        "tok": jnp.zeros((S,), jnp.int32),
+        "pos": jnp.zeros((S,), jnp.int32),
+        "active": jnp.zeros((S,), bool),
+        "rng": jnp.zeros((S,) + tuple(key_shape), jnp.uint32),
+        "do_sample": jnp.zeros((S,), bool),
+        "temp": jnp.ones((S,), jnp.float32),
+        "top_k": jnp.zeros((S,), jnp.int32),
+        "eos": jnp.full((S,), geom.vocab_size, jnp.int32),  # V = never
+        "stop_pos": jnp.zeros((S,), jnp.int32),
+    }
+
+
+def state_specs(state):
+    """ShapeDtypeStructs mirroring a state pytree (AOT lowering input)."""
+    import jax
+
+    return jax.tree_util.tree_map(
+        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+
+
+def write_prompt(state, slot, k_new, v_new):
+    """Scatter one request's prefill K/V (``[layers, Sp, nh, hd]``) into
+    cache row ``slot``, zero-filling positions Sp..S_max-1 (clears the
+    previous occupant's tail — slot-reuse isolation by construction, not
+    just by masking).  Traced; ``slot`` is a traced scalar so ONE
+    executable per prompt bucket serves every slot index."""
+    import jax.numpy as jnp
+    from jax import lax
+
+    k_cache = state["k"]
+    L, _, S_max = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
+    slot = jnp.asarray(slot, jnp.int32)
+    zero = jnp.int32(0)
+
+    def pad(x):
+        full = jnp.zeros((L, S_max) + x.shape[2:], k_cache.dtype)
+        return full.at[:, :x.shape[1]].set(x.astype(k_cache.dtype))
+
+    k_cache = lax.dynamic_update_slice(
+        k_cache, pad(k_new)[:, None], (zero, slot, zero, zero, zero))
+    v_cache = lax.dynamic_update_slice(
+        state["v"], pad(v_new)[:, None], (zero, slot, zero, zero, zero))
+    return dict(state, k=k_cache, v=v_cache)
+
+
+def admit_slot(state, slot, tok, length, rng_key, do_sample, temp, top_k,
+               stop_pos, eos):
+    """Arm lane ``slot``: pending token ``tok`` (the first generated
+    token, sampled from the prefill logits) will be written at position
+    ``length`` on the next decode iteration.  Traced scalar args."""
+    import jax.numpy as jnp
+
+    slot = jnp.asarray(slot, jnp.int32)
+    return dict(
+        state,
+        tok=state["tok"].at[slot].set(jnp.asarray(tok, jnp.int32)),
+        pos=state["pos"].at[slot].set(jnp.asarray(length, jnp.int32)),
+        active=state["active"].at[slot].set(True),
+        rng=state["rng"].at[slot].set(rng_key),
+        do_sample=state["do_sample"].at[slot].set(
+            jnp.asarray(do_sample, bool)),
+        temp=state["temp"].at[slot].set(jnp.asarray(temp, jnp.float32)),
+        top_k=state["top_k"].at[slot].set(jnp.asarray(top_k, jnp.int32)),
+        stop_pos=state["stop_pos"].at[slot].set(
+            jnp.asarray(stop_pos, jnp.int32)),
+        eos=state["eos"].at[slot].set(jnp.asarray(eos, jnp.int32)),
+    )
+
+
+def release_slots(state, mask):
+    """Deactivate the masked lanes (retire / cancel / deadline-preempt).
+    The cache rows keep their bytes — the next occupant's write_prompt
+    overwrites them and the position mask hides them meanwhile."""
+    return dict(state, active=state["active"] & ~mask)

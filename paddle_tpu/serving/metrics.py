@@ -20,7 +20,7 @@ import time
 
 from ..utils.metrics import Histogram, MetricsRegistry
 
-__all__ = ["Histogram", "ServingMetrics"]
+__all__ = ["Histogram", "ServingMetrics", "GenerationMetrics"]
 
 
 class ServingMetrics:
@@ -163,6 +163,140 @@ class ServingMetrics:
                 "mean_batch_size": round(
                     self.batch_size_hist.sum / self.batch_size_hist.total, 2)
                     if self.batch_size_hist.total else 0.0,
+                "compile_count": self.compile_count,
+                **{k: v for k, v in sorted(self.counters.items())},
+            }
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
+
+
+class GenerationMetrics:
+    """Decode-path observability for the continuous-batching generation
+    engine (same private-registry pattern as ServingMetrics, so several
+    engines coexist in one process).
+
+    Exposes (scraped by tools/serve_smoke.sh, read by bench.py genserve):
+      paddle_genserve_decode_tokens_per_sec  tokens streamed / s (window)
+      paddle_genserve_ttft_p50_ms / _p99_ms  time-to-first-token
+      paddle_genserve_inter_token_p50_ms / _p99_ms
+                                             gap between a slot's tokens
+      paddle_genserve_slot_occupancy         occupied / max_slots
+      paddle_genserve_tokens_total           generated tokens
+      paddle_genserve_requests_total{result} admitted/retired/preempted/…
+      paddle_genserve_compile_count          executables built at warmup
+    """
+
+    WINDOW_S = 60.0
+    RESERVOIR = 4096
+
+    def __init__(self, max_slots: int = 1):
+        self.registry = MetricsRegistry()
+        self._lock = self.registry._lock
+        self.started_at = time.monotonic()
+        self.max_slots = max(1, int(max_slots))
+        reg = self.registry
+        reg.gauge("paddle_genserve_decode_tokens_per_sec",
+                  "generated tokens per second over the trailing window",
+                  fn=self._tps_locked)
+        reg.gauge("paddle_genserve_ttft_p50_ms",
+                  "time-to-first-token p50 in milliseconds",
+                  fn=lambda: self._quantile_locked(self._ttft, 0.50))
+        reg.gauge("paddle_genserve_ttft_p99_ms",
+                  "time-to-first-token p99 in milliseconds",
+                  fn=lambda: self._quantile_locked(self._ttft, 0.99))
+        reg.gauge("paddle_genserve_inter_token_p50_ms",
+                  "inter-token latency p50 in milliseconds",
+                  fn=lambda: self._quantile_locked(self._gaps, 0.50))
+        reg.gauge("paddle_genserve_inter_token_p99_ms",
+                  "inter-token latency p99 in milliseconds",
+                  fn=lambda: self._quantile_locked(self._gaps, 0.99))
+        reg.gauge("paddle_genserve_slot_occupancy",
+                  "occupied decode slots / max_slots",
+                  fn=lambda: self._occupied / self.max_slots)
+        reg.gauge("paddle_genserve_compile_count",
+                  "decode/prefill/insert executables compiled at warmup "
+                  "(must not grow under traffic)",
+                  fn=lambda: self.compile_count)
+        self._requests = reg.counter(
+            "paddle_genserve_requests_total",
+            "generation request outcomes by result", label="result",
+            preset=("admitted", "retired", "preempted",
+                    "rejected_queue_full", "rejected_draining",
+                    "deadline_expired", "cancelled", "errors"),
+            fixed=True)
+        self._tokens = reg.counter(
+            "paddle_genserve_tokens_total", "generated tokens streamed")
+        self._ttft = collections.deque(maxlen=self.RESERVOIR)
+        self._gaps = collections.deque(maxlen=self.RESERVOIR)
+        self._token_stamps = collections.deque()   # (monotonic, count)
+        self._occupied = 0
+        self.compile_count = 0
+
+    @property
+    def counters(self):
+        return self._requests.values
+
+    # -- recording hooks (decode thread + HTTP threads) --------------------
+    def count(self, name: str, n: int = 1):
+        self._requests.inc(name, n)
+
+    def observe_tokens(self, n: int):
+        now = time.monotonic()
+        self._tokens.inc(n)
+        with self._lock:
+            self._token_stamps.append((now, n))
+            cutoff = now - self.WINDOW_S
+            while self._token_stamps and self._token_stamps[0][0] < cutoff:
+                self._token_stamps.popleft()
+
+    def observe_ttft(self, seconds: float):
+        with self._lock:
+            self._ttft.append(seconds * 1e3)
+
+    def observe_inter_token(self, seconds: float):
+        with self._lock:
+            self._gaps.append(seconds * 1e3)
+
+    def set_occupancy(self, occupied: int):
+        with self._lock:
+            self._occupied = int(occupied)
+
+    def set_compile_count(self, n: int):
+        with self._lock:
+            self.compile_count = int(n)
+
+    # -- derived values ----------------------------------------------------
+    def _quantile_locked(self, deque_, q: float):
+        if not deque_:
+            return 0.0
+        xs = sorted(deque_)
+        idx = min(len(xs) - 1, max(0, int(round(q * (len(xs) - 1)))))
+        return xs[idx]
+
+    def _tps_locked(self, now=None):
+        now = time.monotonic() if now is None else now
+        if not self._token_stamps:
+            return 0.0
+        span = max(1e-9, min(now - self.started_at, self.WINDOW_S))
+        live = sum(n for t, n in self._token_stamps
+                   if t >= now - self.WINDOW_S)
+        return live / span
+
+    def snapshot(self) -> dict:
+        """Programmatic view (bench.py genserve fields, tests)."""
+        with self._lock:
+            return {
+                "decode_tokens_per_sec": round(self._tps_locked(), 2),
+                "ttft_p50_ms": round(
+                    self._quantile_locked(self._ttft, 0.50), 3),
+                "ttft_p99_ms": round(
+                    self._quantile_locked(self._ttft, 0.99), 3),
+                "inter_token_p50_ms": round(
+                    self._quantile_locked(self._gaps, 0.50), 3),
+                "inter_token_p99_ms": round(
+                    self._quantile_locked(self._gaps, 0.99), 3),
+                "slot_occupancy": round(self._occupied / self.max_slots, 3),
                 "compile_count": self.compile_count,
                 **{k: v for k, v in sorted(self.counters.items())},
             }

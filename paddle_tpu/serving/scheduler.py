@@ -1,0 +1,66 @@
+"""Iteration-level slot scheduler for continuous-batching generation.
+
+Host-side bookkeeping only (the Orca-style scheduling half of the
+generation engine): which decode lane holds which request, which lanes
+are free, and which occupied lanes must be swept (client cancellation,
+deadline expiry).  All device state lives in serving/kv_cache.py; the
+scheduler never touches a jax array, so it needs no lock beyond the
+engine's single decode thread owning it.
+"""
+from __future__ import annotations
+
+import time
+
+__all__ = ["SlotScheduler"]
+
+
+class SlotScheduler:
+    """Fixed-capacity slot table: ``admit`` at iteration boundaries,
+    ``retire`` on EOS/length, ``sweep`` for mid-decode preemption."""
+
+    def __init__(self, max_slots: int):
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.max_slots = int(max_slots)
+        # LIFO free list: hot slots are reused first, which keeps the
+        # occupied lanes dense at low load (cache locality on TPU)
+        self._free = list(range(self.max_slots - 1, -1, -1))
+        self._occupants: dict[int, object] = {}   # slot -> request
+
+    @property
+    def free_slots(self) -> int:
+        return len(self._free)
+
+    @property
+    def occupied(self) -> dict:
+        return self._occupants
+
+    def has_free(self) -> bool:
+        return bool(self._free)
+
+    def admit(self, request) -> int:
+        """Claim a free slot for ``request``; raises when full (the
+        engine checks ``has_free()`` first — a raise is a logic bug)."""
+        slot = self._free.pop()
+        self._occupants[slot] = request
+        return slot
+
+    def retire(self, slot: int):
+        """Release ``slot`` back to the free list; returns its request."""
+        req = self._occupants.pop(slot)
+        self._free.append(slot)
+        return req
+
+    def sweep(self, now=None):
+        """Occupied lanes whose request is cancelled or past deadline:
+        [(slot, request, reason)].  The engine releases them on-device
+        and retires them here."""
+        now = time.monotonic() if now is None else now
+        out = []
+        for slot, req in self._occupants.items():
+            if getattr(req, "cancelled", False):
+                out.append((slot, req, "cancelled"))
+            elif getattr(req, "deadline", None) is not None \
+                    and now > req.deadline:
+                out.append((slot, req, "deadline_expired"))
+        return out

@@ -9,9 +9,20 @@ Endpoints:
                   → {"outputs": [...], "dtypes": [...], "latency_ms": t}
                   429 on queue-full backpressure, 503 while draining,
                   504 on deadline expiry
+  POST /generate  {"prompt": [ids], "max_new_tokens"?, "do_sample"?,
+                  "temperature"?, "top_k"?, "seed"?, "eos_token_id"?,
+                  "deadline_ms"?, "stream"?} — continuous-batching
+                  generation (requires a mounted GenerationEngine).
+                  stream=false → one JSON body {"tokens": [...]};
+                  stream=true  → Server-Sent Events over chunked
+                  transfer, one `data: {"token": t}` event per decoded
+                  token as the decode loop produces it, then a final
+                  `data: {"done": true, ...}` event.  Same 400/429/503/
+                  504 admission split as /predict.
   GET  /healthz   200 {"status": "ok"} | 503 {"status": "draining"}
-  GET  /metrics   Prometheus text (qps, p50/p99, batch-size and
-                  queue-latency histograms, padding-waste ratio)
+  GET  /metrics   Prometheus text from every mounted engine (batching
+                  qps/p50/p99 + genserve decode tokens/s, TTFT,
+                  inter-token quantiles, slot occupancy)
 
 Graceful shutdown reuses the resilience latch pattern
 (distributed/resilience.py PreemptionGuard): SIGTERM/SIGINT is LATCHED,
@@ -74,7 +85,9 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(200, {"status": "ok"})
         elif self.path == "/metrics":
-            self._send(200, owner.engine.metrics.prometheus_text().encode(),
+            parts = [e.metrics.prometheus_text() for e in
+                     (owner.engine, owner.gen_engine) if e is not None]
+            self._send(200, "".join(parts).encode(),
                        ctype="text/plain; version=0.0.4")
         else:
             self._send_json(404, {"error": f"no route {self.path}"})
@@ -86,8 +99,14 @@ class _Handler(BaseHTTPRequestHandler):
         # bytes to be misparsed as the next request line
         n = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(n)
+        if self.path == "/generate":
+            self._do_generate(owner, raw)
+            return
         if self.path != "/predict":
             self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        if owner.engine is None:
+            self._send_json(404, {"error": "no predict engine mounted"})
             return
         t0 = time.monotonic()
         try:
@@ -136,6 +155,110 @@ class _Handler(BaseHTTPRequestHandler):
             "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
         })
 
+    def _do_generate(self, owner, raw):
+        gen = owner.gen_engine
+        if gen is None:
+            self._send_json(404, {"error": "no generation engine mounted"})
+            return
+        t0 = time.monotonic()
+        try:
+            payload = json.loads(raw or b"{}")
+            prompt = payload["prompt"]
+            if not isinstance(prompt, list) or not prompt:
+                raise ValueError(
+                    "'prompt' must be a non-empty list of token ids")
+            stream = bool(payload.get("stream", False))
+            kw = dict(
+                max_new_tokens=int(payload.get("max_new_tokens", 32)),
+                do_sample=bool(payload.get("do_sample", False)),
+                temperature=float(payload.get("temperature", 1.0)),
+                top_k=int(payload.get("top_k", 0)),
+                seed=int(payload.get("seed", 0)),
+                eos_token_id=payload.get("eos_token_id"),
+                deadline_ms=payload.get("deadline_ms"),
+            )
+        except (KeyError, ValueError, TypeError, json.JSONDecodeError) as e:
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            handle = gen.submit(prompt, **kw)
+        except ValueError as e:  # geometry/sampling bounds, at submit
+            self._send_json(400, {"error": f"bad request: {e}"})
+            return
+        except QueueFullError as e:
+            self._send_json(429, {"error": str(e)})
+            return
+        except EngineStoppedError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        if stream:
+            self._stream_tokens(owner, handle, t0)
+            return
+        try:
+            toks = handle.result(timeout=owner.request_timeout_s)
+        except DeadlineExceededError as e:
+            self._send_json(504, {"error": str(e)})
+            return
+        except TimeoutError:
+            handle.cancel()
+            self._send_json(504, {"error": "generation timed out in "
+                                  f"{owner.request_timeout_s:g}s"})
+            return
+        except EngineStoppedError as e:
+            self._send_json(503, {"error": str(e)})
+            return
+        except Exception as e:  # noqa: BLE001 - engine failure → 500
+            self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
+            return
+        self._send_json(200, {
+            "tokens": toks,
+            "ttft_ms": round(handle.ttft_ms, 3)
+            if handle.ttft_ms is not None else None,
+            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+        })
+
+    def _stream_tokens(self, owner, handle, t0):
+        """Server-Sent Events over explicit chunked framing.  The
+        response is open-ended, so the connection is marked close — a
+        keep-alive client would otherwise wait on a Content-Length that
+        can never be known up front."""
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Transfer-Encoding", "chunked")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self.close_connection = True
+
+        def event(obj):
+            data = b"data: " + json.dumps(obj).encode() + b"\n\n"
+            self.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+            self.wfile.flush()
+
+        n = 0
+        try:
+            try:
+                while True:
+                    tok = handle.next_token(timeout=owner.request_timeout_s)
+                    if tok is None:
+                        break
+                    n += 1
+                    event({"token": tok})
+                event({"done": True, "tokens": n,
+                       "ttft_ms": round(handle.ttft_ms, 3)
+                       if handle.ttft_ms is not None else None,
+                       "latency_ms": round((time.monotonic() - t0) * 1e3,
+                                           3)})
+            except TimeoutError as e:  # covers DeadlineExceededError
+                handle.cancel()
+                event({"done": True, "tokens": n, "error": str(e)})
+            except Exception as e:  # noqa: BLE001 - surface in-band
+                event({"done": True, "tokens": n,
+                       "error": f"{type(e).__name__}: {e}"})
+            self.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            handle.cancel()  # client went away mid-stream: free the slot
+
     def log_message(self, fmt, *args):  # route through logging, not stderr
         logger.debug("%s - %s", self.address_string(), fmt % args)
 
@@ -152,8 +275,12 @@ class ServingServer:
 
     def __init__(self, engine: ServingEngine, host="127.0.0.1", port=8866,
                  install_signal_handlers=True, drain_timeout_s=60.0,
-                 request_timeout_s=120.0):
+                 request_timeout_s=120.0, *, gen_engine=None):
+        if engine is None and gen_engine is None:
+            raise ValueError("ServingServer needs at least one engine "
+                             "(predict and/or generation)")
         self.engine = engine
+        self.gen_engine = gen_engine
         self._host = host
         self._requested_port = int(port)
         self._install_signals = install_signal_handlers
@@ -185,8 +312,12 @@ class ServingServer:
 
     # -- lifecycle ---------------------------------------------------------
     @property
+    def _engines(self):
+        return [e for e in (self.engine, self.gen_engine) if e is not None]
+
+    @property
     def draining(self) -> bool:
-        return self.engine.draining or self._done.is_set()
+        return any(e.draining for e in self._engines) or self._done.is_set()
 
     @property
     def port(self) -> int:
@@ -198,7 +329,8 @@ class ServingServer:
         return f"http://{self._host}:{self.port}"
 
     def start(self) -> "ServingServer":
-        self.engine.start()
+        for e in self._engines:
+            e.start()
         self._httpd = _HTTPServer((self._host, self._requested_port),
                                   _Handler)
         self._httpd.owner = self
@@ -216,7 +348,12 @@ class ServingServer:
         self._threads = [t_serve, t_watch]
         t_serve.start()
         t_watch.start()
-        logger.info("serving on %s (%s)", self.url, self.engine.buckets)
+        logger.info(
+            "serving on %s (%s)", self.url,
+            ", ".join(f"{b}" for b in [
+                self.engine.buckets if self.engine is not None else None,
+                f"genserve slots={self.gen_engine.max_slots}"
+                if self.gen_engine is not None else None] if b))
         return self
 
     def _watch(self):
@@ -234,8 +371,10 @@ class ServingServer:
         with self._shutdown_once:
             if self._drain_clean is not None:
                 return self._drain_clean
-            clean = self.engine.drain(timeout=self.drain_timeout_s)
-            self.engine.stop()
+            clean = True
+            for e in self._engines:
+                clean = e.drain(timeout=self.drain_timeout_s) and clean
+                e.stop()
             if self._httpd is not None:
                 self._httpd.shutdown()
                 self._httpd.server_close()

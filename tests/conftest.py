@@ -32,6 +32,9 @@ def pytest_configure(config):
         "markers", "serving: adaptive-batching serving engine test "
         "(paddle_tpu.serving) — run via tools/serve_smoke.sh")
     config.addinivalue_line(
+        "markers", "genserve: continuous-batching generation serving test "
+        "(paddle_tpu.serving.generation) — run via tools/serve_smoke.sh")
+    config.addinivalue_line(
         "markers", "dp: SPMD-sharded TrainEngine test (Model.fit on a "
         "dp mesh of the 8 virtual devices) — run via tools/dp_smoke.sh")
     config.addinivalue_line(

@@ -1,0 +1,358 @@
+"""Continuous-batching generation serving (paddle_tpu.serving.generation).
+
+The contract under test: continuous batching must be INVISIBLE to each
+request — a prompt admitted into a busy decode batch produces tokens
+bitwise-identical to running `model.generate` alone (greedy AND
+temperature/top-k sampling, per-request seed); slots are reused without
+leaking a prior occupant's KV; preemption (cancel / deadline) frees the
+slot mid-decode; drain finishes every in-flight decode; and after
+start()'s AOT warmup the steady state NEVER compiles.
+
+Run via tools/serve_smoke.sh (`pytest -m genserve`); also in tier-1.
+"""
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import paddle_tpu as paddle
+from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+from paddle_tpu.serving import (DeadlineExceededError, EngineStoppedError,
+                                GenerationEngine)
+from paddle_tpu.serving.kv_cache import CacheGeometry
+from paddle_tpu.serving.scheduler import SlotScheduler
+
+pytestmark = pytest.mark.genserve
+
+PROMPT_A = list(range(3, 10))          # L=7  -> bucket 8
+PROMPT_B = [5, 9, 2]                   # L=3  -> bucket 8
+PROMPT_C = list(range(50, 62))         # L=12 -> bucket 16
+SAMPLE_KW = dict(do_sample=True, temperature=0.8, top_k=5)
+
+
+@pytest.fixture(scope="module")
+def model():
+    paddle.seed(0)
+    m = GPTForCausalLM(GPTConfig(
+        vocab_size=211, hidden_size=48, num_layers=2, num_heads=4,
+        max_position_embeddings=64, dropout=0.0, attn_dropout=0.0))
+    m.eval()
+    return m
+
+
+@pytest.fixture(scope="module")
+def engine(model):
+    eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                           prompt_buckets="8,16").start()
+    yield eng
+    eng.stop()
+
+
+def solo(model, prompt, max_new, **kw):
+    """The reference: the model's own single-sequence generate loop."""
+    ids = paddle.to_tensor(np.array([prompt], np.int32))
+    out = model.generate(ids, max_new_tokens=max_new, **kw)
+    return np.array(out.numpy())[0, len(prompt):].tolist()
+
+
+class TestBitwiseParity:
+    def test_greedy_matches_solo(self, model, engine):
+        got = engine.generate(PROMPT_A, 12, timeout=60)
+        assert got == solo(model, PROMPT_A, 12)
+
+    def test_sampled_matches_solo(self, model, engine):
+        got = engine.generate(PROMPT_B, 12, timeout=60, seed=7,
+                              **SAMPLE_KW)
+        assert got == solo(model, PROMPT_B, 12, seed=7, **SAMPLE_KW)
+
+    def test_seed_determinism_across_slots(self, model, engine):
+        """Same prompt+seed in different slots of the same batch → the
+        same tokens; the per-slot PRNG chain is the request's alone."""
+        hs = [engine.submit(PROMPT_B, 12, seed=7, **SAMPLE_KW)
+              for _ in range(3)]
+        outs = [h.result(60) for h in hs]
+        ref = solo(model, PROMPT_B, 12, seed=7, **SAMPLE_KW)
+        assert all(o == ref for o in outs)
+        # and a different seed decodes a different (still solo-exact)
+        # stream from a neighboring slot
+        other = engine.generate(PROMPT_B, 12, timeout=60, seed=8,
+                                **SAMPLE_KW)
+        assert other == solo(model, PROMPT_B, 12, seed=8, **SAMPLE_KW)
+
+    def test_mid_decode_admission_bitwise(self, model, engine):
+        """A request submitted while another is mid-decode is admitted
+        at an iteration boundary and decodes the SAME tokens it would
+        alone — the acceptance criterion of the subsystem."""
+        long_h = engine.submit(PROMPT_C, 25)
+        first = long_h.next_token(timeout=60)   # decode provably underway
+        mid = engine.submit(PROMPT_B, 12, seed=7, **SAMPLE_KW)
+        assert mid.result(60) == solo(model, PROMPT_B, 12, seed=7,
+                                      **SAMPLE_KW)
+        rest = [first] + list(long_h)
+        assert rest == solo(model, PROMPT_C, 25)
+
+    def test_slot_reuse_isolation(self, model, engine):
+        """More requests than slots: every retirement hands its slot to
+        a new occupant; stale KV from the previous occupant must never
+        leak into the next (write_prompt zero-fills the tail)."""
+        refs = {
+            "a": solo(model, PROMPT_A, 12),
+            "b": solo(model, PROMPT_B, 12, seed=7, **SAMPLE_KW),
+            "c": solo(model, PROMPT_C, 9),
+        }
+        jobs = [("a", engine.submit(PROMPT_A, 12)),
+                ("b", engine.submit(PROMPT_B, 12, seed=7, **SAMPLE_KW)),
+                ("c", engine.submit(PROMPT_C, 9))] * 3
+        for name, h in jobs:
+            assert h.result(60) == refs[name]
+
+    def test_eos_and_single_token(self, model, engine):
+        ref = solo(model, PROMPT_A, 12)
+        eos = ref[4]
+        got = engine.generate(PROMPT_A, 12, timeout=60, eos_token_id=eos)
+        assert got == ref[:ref.index(eos) + 1]
+        assert engine.generate(PROMPT_A, 1, timeout=60) == ref[:1]
+
+
+class TestPreemption:
+    def test_cancel_mid_decode_frees_slot(self, model, engine):
+        h = engine.submit(PROMPT_C, 25)
+        assert h.next_token(timeout=60) is not None
+        h.cancel()
+        t0 = time.monotonic()
+        while not h.done and time.monotonic() - t0 < 30:
+            time.sleep(0.01)
+        assert h.done and h.error is None
+        assert 0 < len(h.tokens) < 25
+        # the slot is genuinely free: a full batch still fits
+        hs = [engine.submit(PROMPT_A, 8) for _ in range(3)]
+        ref = solo(model, PROMPT_A, 8)
+        assert all(h2.result(60) == ref for h2 in hs)
+
+    def test_deadline_mid_decode_frees_slot(self, model):
+        """Deterministic mid-decode expiry: slow each decode iteration
+        so the deadline provably lands while the lane is in flight."""
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=2, max_seq_len=40,
+                               prompt_buckets="8").start()
+        try:
+            fast = eng._decode_exec
+
+            def slow(params, state):
+                time.sleep(0.02)
+                return fast(params, state)
+
+            eng._decode_exec = slow
+            h = eng.submit(PROMPT_A, 30, deadline_ms=120)
+            with pytest.raises(DeadlineExceededError):
+                h.result(60)
+            assert 0 < len(h.tokens) < 30      # it WAS decoding
+            eng._decode_exec = fast
+            # the preempted lane is free again: full batch still fits
+            hs = [eng.submit(PROMPT_A, 6) for _ in range(2)]
+            ref = solo(model, PROMPT_A, 6)
+            assert all(h2.result(60) == ref for h2 in hs)
+        finally:
+            eng.stop()
+
+    def test_validation_rejected_at_submit(self, engine):
+        with pytest.raises(ValueError):
+            engine.submit([], 4)
+        with pytest.raises(ValueError):
+            engine.submit(list(range(20)), 4)     # > largest bucket
+        with pytest.raises(ValueError):
+            engine.submit(PROMPT_A, 40)           # L+new > max_seq_len
+        with pytest.raises(ValueError):
+            engine.submit(PROMPT_A, 0)
+        with pytest.raises(ValueError):
+            engine.submit(PROMPT_A, 4, do_sample=True, top_k=10_000)
+
+
+class TestLifecycle:
+    def test_drain_finishes_inflight(self, model):
+        """The SIGTERM-drain contract (ServingServer.shutdown calls
+        exactly this): no new work, every queued + in-flight decode
+        completes in full, loop exits."""
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=2, max_seq_len=40,
+                               prompt_buckets="8").start()
+        hs = [eng.submit(PROMPT_A, 10) for _ in range(4)]  # 2 queued
+        assert eng.drain(timeout=120)
+        ref = solo(model, PROMPT_A, 10)
+        for h in hs:
+            assert h.result(1) == ref        # finished BEFORE drain ret
+        with pytest.raises(EngineStoppedError):
+            eng.submit(PROMPT_A, 2)
+        eng.stop()
+
+    def test_stop_fails_inflight(self, model):
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=2, max_seq_len=40,
+                               prompt_buckets="8").start()
+        eng.submit(PROMPT_A, 30)
+        eng.stop()
+        # every handle resolves (no stranded client threads)
+
+
+class _CompileTripwire:
+    def __enter__(self):
+        import jax._src.compiler as C
+
+        self._mod = C
+        self._orig = C.compile_or_get_cached
+
+        def hook(*a, **k):
+            raise AssertionError("XLA compilation after generation warmup "
+                                 "— steady state must never compile")
+
+        C.compile_or_get_cached = hook
+        return self
+
+    def __exit__(self, *exc):
+        self._mod.compile_or_get_cached = self._orig
+        return False
+
+
+class TestZeroRecompile:
+    def test_steady_state_never_compiles(self, model, engine):
+        """With jax's compile entry point booby-trapped, admission +
+        decode + retirement across both prompt buckets and both sampling
+        modes must run purely from the warmed executables."""
+        before = engine.compile_count
+        with _CompileTripwire():
+            hs = [engine.submit(PROMPT_A, 10),
+                  engine.submit(PROMPT_B, 10, seed=3, **SAMPLE_KW),
+                  engine.submit(PROMPT_C, 10)]
+            for h in hs:
+                assert len(h.result(120)) == 10
+        assert engine.compile_count == before
+        assert engine.metrics.snapshot()["compile_count"] == before
+
+
+class TestMetrics:
+    def test_snapshot_and_prometheus(self, engine, model):
+        engine.generate(PROMPT_A, 8, timeout=60)
+        snap = engine.metrics.snapshot()
+        assert snap["decode_tokens_per_sec"] > 0
+        assert snap["ttft_p50_ms"] > 0
+        assert snap["inter_token_p99_ms"] >= snap["inter_token_p50_ms"] > 0
+        assert snap["retired"] >= 1
+        text = engine.metrics.prometheus_text()
+        for name in ("paddle_genserve_decode_tokens_per_sec",
+                     "paddle_genserve_inter_token_p99_ms",
+                     "paddle_genserve_slot_occupancy",
+                     "paddle_genserve_requests_total",
+                     "paddle_genserve_compile_count"):
+            assert name in text
+
+    def test_monitor_co_exposure(self, engine):
+        """One MonitorServer port serves training AND genserve metrics
+        via extra_registries."""
+        from paddle_tpu.monitor.server import MonitorServer
+
+        mon = MonitorServer(port=0, extra_registries=(engine.metrics,))
+        text = mon.metrics_text()
+        assert "paddle_genserve_decode_tokens_per_sec" in text
+
+
+class TestUnits:
+    def test_scheduler(self):
+        s = SlotScheduler(2)
+        assert s.has_free() and s.free_slots == 2
+
+        class R:
+            cancelled = False
+            deadline = None
+
+        r1, r2 = R(), R()
+        a, b = s.admit(r1), s.admit(r2)
+        assert {a, b} == {0, 1} and not s.has_free()
+        r2.cancelled = True
+        swept = s.sweep()
+        assert swept == [(b, r2, "cancelled")]
+        assert s.retire(b) is r2
+        r3 = R()
+        r3.deadline = time.monotonic() - 1
+        c = s.admit(r3)
+        assert s.sweep() == [(c, r3, "deadline_expired")]
+
+    def test_geometry(self):
+        g = CacheGeometry(num_layers=2, max_slots=4, max_seq_len=8,
+                          num_heads=2, head_dim=4, vocab_size=100)
+        assert g.kv_shape == (2, 4, 8, 2, 4)
+        assert g.kv_bytes() == 2 * 2 * 4 * 8 * 2 * 4 * 4
+
+
+@pytest.fixture(scope="module")
+def server(model):
+    from paddle_tpu.serving.server import ServingServer
+
+    eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                           prompt_buckets="8,16")
+    srv = ServingServer(None, gen_engine=eng, port=0,
+                        install_signal_handlers=False).start()
+    yield srv
+    srv.shutdown()
+
+
+class TestHTTP:
+    def test_blocking_generate(self, model, server):
+        from paddle_tpu.serving.client import ServingClient
+
+        cli = ServingClient(server.url)
+        out = cli.generate(PROMPT_A, 10)
+        assert out["tokens"] == solo(model, PROMPT_A, 10)
+        assert out["ttft_ms"] > 0 and out["latency_ms"] > 0
+
+    def test_streaming_sse(self, model, server):
+        from paddle_tpu.serving.client import ServingClient
+
+        cli = ServingClient(server.url)
+        toks, done = [], None
+        for evt in cli.generate_stream(PROMPT_B, 10, seed=7, **SAMPLE_KW):
+            if "token" in evt:
+                toks.append(evt["token"])
+            if evt.get("done"):
+                done = evt
+        assert toks == solo(model, PROMPT_B, 10, seed=7, **SAMPLE_KW)
+        assert done["tokens"] == 10 and "error" not in done
+
+    def test_concurrent_streams(self, model, server):
+        from paddle_tpu.serving.client import ServingClient
+
+        cli = ServingClient(server.url)
+        ref, outs = solo(model, PROMPT_A, 10), {}
+
+        def go(i):
+            outs[i] = [e["token"] for e in cli.generate_stream(PROMPT_A, 10)
+                       if "token" in e]
+
+        ts = [threading.Thread(target=go, args=(i,)) for i in range(5)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert all(outs[i] == ref for i in range(5))
+
+    def test_admission_errors(self, server):
+        from paddle_tpu.serving.client import (ServingClient,
+                                               ServingHTTPError)
+
+        cli = ServingClient(server.url)
+        with pytest.raises(ServingHTTPError) as e:
+            cli.generate([], 4)
+        assert e.value.status == 400
+        with pytest.raises(ServingHTTPError) as e:
+            cli.generate(PROMPT_A, 500)
+        assert e.value.status == 400
+        with pytest.raises(ServingHTTPError) as e:
+            cli.predict([[1.0, 2.0]])       # no predict engine mounted
+        assert e.value.status == 404
+
+    def test_metrics_endpoint(self, server):
+        from paddle_tpu.serving.client import ServingClient
+
+        text = ServingClient(server.url).metrics()
+        assert "paddle_genserve_decode_tokens_per_sec" in text
+        assert "paddle_genserve_compile_count" in text

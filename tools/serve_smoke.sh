@@ -3,8 +3,12 @@
 # export a model, start the HTTP server, fire concurrent requests via
 # serving/client.py, scrape /metrics and assert the qps and p99 fields
 # are present and sane, then SIGTERM the server and require a clean
-# graceful drain (exit 0).  Finishes by running the serving-marked
-# pytest suite.  Extra args are passed through to pytest.
+# graceful drain (exit 0).  Then the same contract for the continuous-
+# batching generation server: N parallel streaming /generate clients,
+# inter-token p99 asserted from /metrics, compile count proven FLAT
+# across a second load burst (zero recompiles after warmup), SIGTERM
+# drain.  Finishes by running the serving- and genserve-marked pytest
+# suites.  Extra args are passed through to pytest.
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -105,5 +109,103 @@ grep -q "serving drain clean" "$WORK/server.log" \
     || { echo "no clean-drain marker in server log"; cat "$WORK/server.log"; exit 1; }
 echo "[serve_smoke] clean drain OK"
 
-exec python -m pytest tests/ -q -m serving \
+# ---- concurrent-decode section: continuous-batching generation --------
+echo "[serve_smoke] starting generation server..."
+python -m paddle_tpu.serving.generation --port 0 --slots 4 \
+    --prompt-buckets 8,16 --max-seq-len 48 > "$WORK/genserver.log" 2>&1 &
+SERVER_PID=$!
+
+GURL=""
+for _ in $(seq 1 600); do
+    GURL=$(sed -n 's/.*listening on \(http[^ ]*\).*/\1/p' \
+           "$WORK/genserver.log" | head -1)
+    [ -n "$GURL" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null \
+        || { echo "generation server died:"; cat "$WORK/genserver.log"; exit 1; }
+    sleep 0.1
+done
+[ -n "$GURL" ] || { echo "generation server never came up"; \
+    cat "$WORK/genserver.log"; exit 1; }
+echo "[serve_smoke] generation server up at $GURL"
+
+echo "[serve_smoke] firing concurrent streaming decode load..."
+python -m paddle_tpu.serving.client --url "$GURL" --mode generate \
+    --requests 12 --concurrency 6 --prompt-len 8 --max-new 16 \
+    --vocab 200 --sample
+
+echo "[serve_smoke] scraping genserve /metrics..."
+COMPILES_1=$(python - "$GURL" <<'EOF'
+import sys
+import urllib.request
+
+text = urllib.request.urlopen(sys.argv[1] + "/metrics",
+                              timeout=10).read().decode()
+needed = ["paddle_genserve_decode_tokens_per_sec",
+          "paddle_genserve_ttft_p50_ms", "paddle_genserve_ttft_p99_ms",
+          "paddle_genserve_inter_token_p50_ms",
+          "paddle_genserve_inter_token_p99_ms",
+          "paddle_genserve_slot_occupancy",
+          "paddle_genserve_tokens_total",
+          "paddle_genserve_compile_count"]
+missing = [n for n in needed if n not in text]
+assert not missing, f"missing metrics: {missing}"
+
+
+def value(name):
+    line = [l for l in text.splitlines() if l.startswith(name + " ")][0]
+    return float(line.split()[1])
+
+
+tps = value("paddle_genserve_decode_tokens_per_sec")
+it_p99 = value("paddle_genserve_inter_token_p99_ms")
+ttft = value("paddle_genserve_ttft_p99_ms")
+compiles = value("paddle_genserve_compile_count")
+assert tps > 0, f"decode tokens/s not positive: {tps}"
+assert 0 < it_p99 < 60_000, f"inter-token p99 insane: {it_p99}"
+assert ttft > 0, f"ttft p99 not positive: {ttft}"
+print(f"genserve metrics OK: tokens/s={tps:g} inter_token_p99_ms="
+      f"{it_p99:g} ttft_p99_ms={ttft:g} compiles={compiles:g}",
+      file=sys.stderr)
+print(int(compiles))
+EOF
+)
+
+echo "[serve_smoke] second burst (recompile check)..."
+python -m paddle_tpu.serving.client --url "$GURL" --mode generate \
+    --requests 8 --concurrency 4 --prompt-len 12 --max-new 10 \
+    --vocab 200
+
+COMPILES_2=$(python - "$GURL" <<'EOF'
+import sys
+import urllib.request
+
+text = urllib.request.urlopen(sys.argv[1] + "/metrics",
+                              timeout=10).read().decode()
+line = [l for l in text.splitlines()
+        if l.startswith("paddle_genserve_compile_count ")][0]
+print(int(float(line.split()[1])))
+EOF
+)
+if [ "$COMPILES_1" != "$COMPILES_2" ]; then
+    echo "[serve_smoke] RECOMPILE after warmup: $COMPILES_1 -> $COMPILES_2"
+    exit 1
+fi
+echo "[serve_smoke] zero recompiles after warmup OK ($COMPILES_2 total)"
+
+echo "[serve_smoke] SIGTERM -> generation graceful drain..."
+kill -TERM "$SERVER_PID"
+rc=0
+wait "$SERVER_PID" || rc=$?
+SERVER_PID=""
+if [ "$rc" -ne 0 ]; then
+    echo "[serve_smoke] generation server exit code $rc (want 0)"
+    cat "$WORK/genserver.log"
+    exit 1
+fi
+grep -q "serving drain clean" "$WORK/genserver.log" \
+    || { echo "no clean-drain marker in generation server log"; \
+         cat "$WORK/genserver.log"; exit 1; }
+echo "[serve_smoke] generation clean drain OK"
+
+exec python -m pytest tests/ -q -m "serving or genserve" \
     -p no:cacheprovider -p no:randomly "$@"
