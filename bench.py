@@ -497,12 +497,23 @@ def _obs_fields(step_times_s=None, dt=None, mfu=None, flops_per_step=None):
             mem_mb = round(mem["peak_bytes_in_use"] / 1048576, 1)
     except Exception:  # noqa: BLE001 - a meter, never a bench failure
         pass
-    return {
+    out = {
         "mfu": round(float(mfu), 4),
         "step_time_p50_ms": round(q(0.50), 3),
         "step_time_p99_ms": round(q(0.99), 3),
         "device_mem_peak_mb": mem_mb,
     }
+    try:
+        # rides along only when a goodput ledger registered its gauge in
+        # this process (distributed/goodput.py) — absent otherwise
+        from paddle_tpu.utils.metrics import default_registry
+
+        g = default_registry().get("paddle_goodput_ratio")
+        if g is not None:
+            out["goodput_ratio"] = round(float(g.get()), 4)
+    except Exception:  # noqa: BLE001 - a meter, never a bench failure
+        pass
+    return out
 
 
 def _roundtrip():

@@ -1,0 +1,216 @@
+# pta: jax-free
+"""Fleet goodput accounting: classify every rank's wall-clock.
+
+The reference stack's fleet monitoring (brpc profiler endpoints + the
+parameter-server's barrier/downpour counters) answered "is the job
+making progress"; on preemptible TPU pods the sharper question is *what
+fraction of the paid wall-clock turned into training* — and where the
+rest went.  `GoodputLedger` folds the evidence the runtime already
+leaves behind into five buckets:
+
+  productive_train   sum of post-warmup step time across restarts
+                     (`paddle_train_step_ms` histogram sums, carried by
+                     each rank's flight-recorder dump)
+  compile            first-step compile+warmup time
+                     (`paddle_train_first_step_ms`)
+  ckpt_stall         training-thread checkpoint stalls
+                     (`paddle_ckpt_step_stall_ms`)
+  restart_backoff    the launcher's deliberate backoff sleeps between
+                     pod restarts (reported by the launcher itself)
+  down               failure-detection → next-start gaps beyond the
+                     backoff sleep (teardown, process spawn)
+
+Sources: `flightrec-<pid>.json` dumps (monitor/flightrec.py — every
+rank leaves one on watchdog/durability/preemption/crash AND on clean
+exit, so healthy runs are accounted too) plus the telemetry
+`events.jsonl` window records as a lossy fallback for ranks killed too
+hard to dump (SIGKILL).  Per-file contributions REPLACE on re-ingest
+(keyed by path+mtime), so repeated scans never double-count.
+
+Exposition: `paddle_goodput_ratio` (gauge, computed at scrape) and
+`paddle_badput_seconds_total{reason=...}` (counter — `publish()` adds
+only positive deltas, keeping it monotonic) on the launcher's registry,
+plus `report()` for the launcher's final human-readable summary.
+"""
+from __future__ import annotations
+
+import glob
+import json
+import logging
+import os
+import threading
+
+from ..utils.metrics import default_registry
+
+logger = logging.getLogger("paddle_tpu.launch")
+
+__all__ = ["GoodputLedger", "BADPUT_REASONS", "CATEGORIES"]
+
+GOOD = "productive_train"
+BADPUT_REASONS = ("compile", "ckpt_stall", "restart_backoff", "down")
+CATEGORIES = (GOOD,) + BADPUT_REASONS
+
+
+class GoodputLedger:
+    """Aggregate per-rank time accounting across restarts.
+
+    `telemetry_dir` is scanned recursively (the launcher gives each
+    rank its own `rank<N>/` subdir so JSONL streams don't interleave);
+    `None` disables file ingestion — the launcher-side backoff/down
+    buckets still work.
+    """
+
+    def __init__(self, telemetry_dir=None, registry=None):
+        self.telemetry_dir = str(telemetry_dir or "") or None
+        self._lock = threading.Lock()
+        self._files: dict = {}    # path -> (mtime, {category: seconds})
+        self._local = {"restart_backoff": 0.0, "down": 0.0}
+        self._published = {r: 0.0 for r in BADPUT_REASONS}
+        reg = registry if registry is not None else default_registry()
+        self._m_badput = reg.counter(
+            "paddle_badput_seconds_total",
+            "non-productive wall-clock seconds, by reason",
+            label="reason", preset=BADPUT_REASONS)
+        reg.gauge("paddle_goodput_ratio",
+                  "productive-training share of accounted wall-clock "
+                  "across restarts", fn=self.ratio)
+
+    # -- launcher-side buckets ---------------------------------------------
+    def add_backoff(self, seconds: float):
+        """One deliberate restart-backoff sleep."""
+        with self._lock:
+            self._local["restart_backoff"] += max(0.0, float(seconds))
+
+    def add_down(self, seconds: float):
+        """Failure-to-restart gap beyond the backoff sleep."""
+        with self._lock:
+            self._local["down"] += max(0.0, float(seconds))
+
+    # -- file ingestion -----------------------------------------------------
+    @staticmethod
+    def _dump_contribution(doc: dict) -> dict:
+        acc = doc.get("accounting") or {}
+
+        def sec(key):
+            try:
+                return max(0.0, float(acc.get(key) or 0.0))
+            except (TypeError, ValueError):
+                return 0.0
+        return {GOOD: sec("train_s"), "compile": sec("compile_s"),
+                "ckpt_stall": sec("ckpt_stall_s")}
+
+    @staticmethod
+    def _jsonl_contribution(path: str) -> dict:
+        """Lossy fallback: sum window wall-time from the telemetry event
+        log — covers ranks killed too hard (SIGKILL) to leave a dump."""
+        train = 0.0
+        try:
+            with open(path, encoding="utf-8") as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if rec.get("event") == "window":
+                        try:
+                            train += max(0.0, float(rec.get("wall_s")
+                                                    or 0.0))
+                        except (TypeError, ValueError):
+                            pass
+        except OSError:
+            return {}
+        return {GOOD: train} if train > 0 else {}
+
+    def ingest(self) -> int:
+        """Scan `telemetry_dir` for flight-recorder dumps (and JSONL
+        event logs in directories with no dump), folding new/updated
+        files into the ledger.  Returns how many files were (re)read."""
+        if not self.telemetry_dir:
+            return 0
+        root = self.telemetry_dir
+        dumps = glob.glob(os.path.join(root, "flightrec-*.json")) + \
+            glob.glob(os.path.join(root, "**", "flightrec-*.json"),
+                      recursive=True)
+        dump_dirs = {os.path.dirname(p) for p in dumps}
+        jsonls = [p for p in
+                  glob.glob(os.path.join(root, "events.jsonl*")) +
+                  glob.glob(os.path.join(root, "**", "events.jsonl*"),
+                            recursive=True)
+                  if os.path.dirname(p) not in dump_dirs]
+        n = 0
+        for path in sorted(set(dumps) | set(jsonls)):
+            try:
+                mtime = os.path.getmtime(path)
+            except OSError:
+                continue
+            with self._lock:
+                prev = self._files.get(path)
+                if prev is not None and prev[0] >= mtime:
+                    continue
+            if os.path.basename(path).startswith("flightrec-"):
+                try:
+                    with open(path, encoding="utf-8") as f:
+                        doc = json.load(f)
+                except (OSError, ValueError) as e:
+                    logger.warning("goodput: unreadable dump %s (%s)",
+                                   path, e)
+                    continue
+                contrib = self._dump_contribution(doc)
+            else:
+                contrib = self._jsonl_contribution(path)
+            with self._lock:
+                self._files[path] = (mtime, contrib)
+            n += 1
+        return n
+
+    # -- accounting ---------------------------------------------------------
+    def totals(self) -> dict:
+        """{category: seconds} over everything ingested so far (call
+        `ingest()`/`publish()` first to refresh from disk)."""
+        with self._lock:
+            out = {c: 0.0 for c in CATEGORIES}
+            for _mtime, contrib in self._files.values():
+                for k, v in contrib.items():
+                    out[k] = out.get(k, 0.0) + v
+            out["restart_backoff"] += self._local["restart_backoff"]
+            out["down"] += self._local["down"]
+            return out
+
+    def ratio(self) -> float:
+        """productive_train / (all accounted categories); 0 when nothing
+        is accounted yet.  Pure in-memory math — safe as a scrape-time
+        gauge fn (never takes the registry lock, never touches disk)."""
+        t = self.totals()
+        denom = sum(t.values())
+        return round(t[GOOD] / denom, 6) if denom > 0 else 0.0
+
+    def publish(self) -> dict:
+        """Refresh from disk and push badput deltas into the counter
+        (monotonic: only positive movement is added).  Returns totals."""
+        self.ingest()
+        t = self.totals()
+        incs = []
+        with self._lock:
+            for r in BADPUT_REASONS:
+                delta = t[r] - self._published[r]
+                if delta > 0:
+                    incs.append((r, delta))
+                    self._published[r] = t[r]
+        # counter incs OUTSIDE self._lock: the scrape path holds the
+        # registry lock and calls ratio() -> self._lock, so taking them
+        # in the opposite order here would be an ABBA deadlock
+        for r, delta in incs:
+            self._m_badput.inc(r, float(delta))
+        return t
+
+    def report(self) -> dict:
+        """The launcher's final-report payload."""
+        t = self.publish()
+        with self._lock:
+            n_files = len(self._files)
+        return {"goodput_ratio": self.ratio(),
+                "seconds": {k: round(v, 3) for k, v in t.items()},
+                "sources": n_files}

@@ -96,6 +96,14 @@ def _parse_args(argv=None):
                              "FLAGS_MONITOR_PORT=port+1+rank) plus the "
                              "launcher's restart counters; 0 picks a "
                              "free port, omit to disable")
+    parser.add_argument("--telemetry_dir", default=None,
+                        help="give each rank FLAGS_TELEMETRY_DIR="
+                             "<dir>/rank<N> (own subdir: JSONL streams "
+                             "and flight-recorder dumps never "
+                             "interleave) and run a goodput ledger over "
+                             "the dumps: paddle_goodput_ratio / "
+                             "paddle_badput_seconds_total on the pod "
+                             "monitor, final report at teardown")
     parser.add_argument("training_script",
                         help="the training script to launch")
     parser.add_argument("training_script_args", nargs=argparse.REMAINDER)
@@ -144,7 +152,24 @@ def launch_collective(args):
     # federates them — one scrape answers "is the fleet healthy" across
     # every rank plus the launcher's own restart counters.
     monitor = None
-    per_rank_envs = None
+    rank_env_fns = []
+
+    # Goodput ledger (--telemetry_dir): each rank telemeters into its
+    # own subdir; the ledger folds their flight-recorder dumps (plus the
+    # launcher's own backoff/down buckets) into paddle_goodput_ratio /
+    # paddle_badput_seconds_total and a final teardown report.
+    ledger = None
+    if args.telemetry_dir:
+        from .goodput import GoodputLedger
+
+        tdir = os.path.abspath(args.telemetry_dir)
+        ledger = GoodputLedger(tdir, registry=_REG)
+
+        def _telemetry_env(rank):
+            return {"FLAGS_TELEMETRY_DIR":
+                    os.path.join(tdir, f"rank{int(rank)}")}
+        rank_env_fns.append(_telemetry_env)
+
     if args.monitor_port is not None and args.monitor_port >= 0:
         from ..monitor import MonitorServer
 
@@ -154,13 +179,22 @@ def launch_collective(args):
         def rank_port(rank):
             return monitor.port + 1 + int(rank)
 
-        def per_rank_envs(rank):
+        def _monitor_env(rank):
             return {"FLAGS_MONITOR_PORT": str(rank_port(rank))}
+        rank_env_fns.append(_monitor_env)
 
         monitor.federate = [f"http://127.0.0.1:{rank_port(t.rank)}"
                             for t in pod.trainers]
         logger.info("pod monitor on %s federating %d rank endpoint(s)",
                     monitor.url, len(monitor.federate))
+
+    per_rank_envs = None
+    if rank_env_fns:
+        def per_rank_envs(rank):
+            env = {}
+            for fn in rank_env_fns:
+                env.update(fn(rank))
+            return env
 
     # Orphan fix: a SIGTERM to the launcher must tear the trainer
     # subprocesses down (with the grace window) instead of leaving them
@@ -178,6 +212,8 @@ def launch_collective(args):
             prev_handlers[s] = signal.signal(s, _on_signal)
         except ValueError:
             pass  # not the main thread (embedded use) — skip
+    t_fail = None
+    last_delay = 0.0
     try:
         while True:
             procs[:] = start_local_trainers(
@@ -186,11 +222,21 @@ def launch_collective(args):
                 backend=args.backend,
                 envs={"PADDLE_RESTART_COUNT": str(attempt)},
                 per_rank_envs=per_rank_envs)
+            if ledger is not None and t_fail is not None:
+                # failure-detection → running-again gap, minus the
+                # deliberate backoff sleep (accounted separately)
+                ledger.add_down(
+                    time.monotonic() - t_fail - last_delay)
+                t_fail = None
             try:
                 watch_local_trainers(procs, cluster.trainers_nranks(),
                                      grace=args.grace_period)
                 return 0
             except TrainerFailure as e:
+                t_fail = time.monotonic()
+                if ledger is not None:
+                    # fold in whatever dumps the dying rank just wrote
+                    ledger.publish()
                 preempted = _is_preemption(e.exit_code)
                 if preempted:
                     reason = "preempted"
@@ -228,6 +274,9 @@ def launch_collective(args):
                 attempt += 1
                 _m_restarts.inc()
                 delay = _restart_delay(attempt, base=args.restart_backoff)
+                last_delay = delay
+                if ledger is not None:
+                    ledger.add_backoff(delay)
                 logger.warning(
                     "trainer rank=%s %s — restart %s/%s in %.2fs "
                     "(trainers auto-resume from their latest checkpoint)",
@@ -239,6 +288,15 @@ def launch_collective(args):
         terminate_local_procs(procs, grace=args.grace_period)
         sys.exit(128 + sig.signum)
     finally:
+        if ledger is not None:
+            try:
+                rep = ledger.report()
+                logger.info(
+                    "goodput report: ratio=%.4f seconds=%s "
+                    "(%d source file(s))", rep["goodput_ratio"],
+                    rep["seconds"], rep["sources"])
+            except Exception:  # noqa: BLE001 - teardown must not mask
+                logger.exception("goodput report failed")
         if monitor is not None:
             monitor.shutdown()
         for s, prev in prev_handlers.items():

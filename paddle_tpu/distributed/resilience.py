@@ -312,6 +312,17 @@ class Watchdog:
                                                 all_threads=True)
                 except Exception:
                     pass
+                # postmortem BEFORE os._exit (which skips atexit): this
+                # is a regular monitor thread, so IO/locks are fine here
+                try:
+                    from ..monitor import flightrec as _flightrec
+
+                    _flightrec.record("watchdog",
+                                      elapsed_s=round(elapsed, 3),
+                                      timeout_s=self.timeout)
+                    _flightrec.dump("watchdog")
+                except Exception:
+                    pass
                 if self.on_timeout is not None:
                     self.on_timeout(elapsed)
                     return
@@ -443,6 +454,14 @@ class ResilientRunner:
                         info["bad_steps"] += 1
                         bad_streak += 1
                         _m_nan.inc("detected")
+                        try:
+                            from ..monitor import flightrec as _flightrec
+
+                            _flightrec.record(
+                                "nan", step=step, streak=bad_streak,
+                                policy=self.anomaly_policy)
+                        except Exception:
+                            pass
                         logger.warning(
                             "non-finite loss at step %d (streak %d, "
                             "policy=%s)", step, bad_streak,
@@ -513,6 +532,13 @@ class ResilientRunner:
             logger.warning("exiting with PREEMPTED_EXIT_CODE=%d (launcher "
                            "will restart and auto-resume)",
                            PREEMPTED_EXIT_CODE)
+            try:
+                from ..monitor import flightrec as _flightrec
+
+                _flightrec.record("preempt", step=last)
+                _flightrec.dump("preempt")
+            except Exception:
+                pass
             raise SystemExit(PREEMPTED_EXIT_CODE)
 
 

@@ -99,6 +99,25 @@ define_flag("FLAGS_device_peak_flops", 0.0,
 define_flag("FLAGS_trace_steps", 3,
             "how many steps a SIGUSR1-armed jax.profiler capture spans "
             "(the headless /debug/trace?steps=N equivalent)")
+define_flag("FLAGS_trace_sample_rate", 0.01,
+            "head-sampling probability for request-scoped spans "
+            "(monitor/tracing.py): the decision is derived from the "
+            "trace_id itself, so client and server independently agree; "
+            "0 disables the tracer, 1 traces every request.  Training "
+            "fits are few, so any nonzero rate records their spans")
+define_flag("FLAGS_trace_buffer_spans", 2048,
+            "bounded ring of finished spans the tracer retains for "
+            "/debug/spans and chrome-trace export (oldest evicted first)")
+define_flag("FLAGS_metrics_window_s", 0.0,
+            "when > 0, utils.metrics Reservoir quantiles (e.g. the "
+            "paddle_train_step_ms p50/p99 gauges) cover only the last "
+            "this-many seconds instead of the whole run; 0 keeps the "
+            "lifetime-cumulative default")
+define_flag("FLAGS_flightrec_records", 512,
+            "bounded ring of recent spans/windows/ckpt/NaN events the "
+            "crash flight recorder (monitor/flightrec.py) dumps to "
+            "FLAGS_telemetry_dir/flightrec-<pid>.json on watchdog exit "
+            "86, durability exit 91, SIGTERM, or uncaught crash")
 # -- durable checkpointing (distributed/checkpoint.py) --------------------
 define_flag("FLAGS_ckpt_async", True,
             "fit(resume=/fault_tolerant=) writes interval/epoch "

@@ -229,14 +229,26 @@ class Model:
         (host snapshot + submit, or the full write on the sync path) —
         telemetry records it as `paddle_ckpt_step_stall_ms`, the number
         the async writer exists to keep small."""
+        from ..monitor import flightrec as _flightrec
+
         t0 = time.perf_counter()
+        fit_span = getattr(self, "_fit_span", None)
+        sp_ckpt = (fit_span.child("train.ckpt_stall", step=it_count,
+                                  sync=bool(sync))
+                   if fit_span is not None else None)
         try:
             self._ft_save_inner(mgr, saver, it_count, force=force,
                                 sync=sync)
         finally:
+            stall_ms = (time.perf_counter() - t0) * 1e3
+            if sp_ckpt is not None:
+                sp_ckpt.end()
             telem = getattr(self, "_telemetry", None)
             if telem is not None:
-                telem.ckpt_stall((time.perf_counter() - t0) * 1e3)
+                telem.ckpt_stall(stall_ms)
+            _flightrec.record("ckpt", step=it_count,
+                              stall_ms=round(stall_ms, 3),
+                              sync=bool(sync))
 
     def _ft_save_inner(self, mgr, saver, it_count, force=False, sync=False):
         from .engine import mesh_meta
@@ -531,6 +543,8 @@ class Model:
         # try/finally exists to uninstall the hooks) — like the
         # placement hook below.
         from ..monitor import fit_monitor, install_sigusr1
+        from ..monitor import flightrec as _flightrec
+        from ..monitor import tracing as _tracing
 
         telem, _mon_srv = fit_monitor()
         self._telemetry = telem
@@ -545,6 +559,19 @@ class Model:
                 compiled=engine._step_fn is not _step_fn_before)
             _restore_usr1 = install_sigusr1(telem)
             _unhook_warn = telem.install_warning_hook()
+
+        # request-scoped tracing: the fit gets a FORCE-sampled span (fits
+        # are few — head sampling is for serving traffic) with epoch /
+        # step / ckpt-stall children, so a training stall is attributable
+        # from /debug/spans the same way a slow request is
+        _tracer = _tracing.default_tracer()
+        _fit_span = None
+        if _tracer.enabled:
+            _fit_span = _tracer.start_span(
+                "train.fit", sampled=True,
+                attrs={"epochs": epochs, "batch_size": batch_size})
+        self._fit_span = _fit_span
+        _epoch_span = None
 
         # the placement hook goes on LAST: everything above can still
         # raise (missing ckpt dir, restore errors), and an exception
@@ -586,6 +613,9 @@ class Model:
                 for m in self._metrics:
                     m.reset()
                 cbks.on_epoch_begin(epoch, {})
+                if _fit_span is not None:
+                    _epoch_span = _fit_span.child("train.epoch",
+                                                  epoch=epoch)
                 # fold user writes to Layer params/buffers (epoch-end
                 # callbacks: SWA/EMA write-back, re-init, pruning) back
                 # into the device-resident state
@@ -606,6 +636,7 @@ class Model:
                         # here exits immediately — nothing new to save,
                         # the restored checkpoint is still the newest
                         if guard is not None and guard.preempted:
+                            _flightrec.dump("preempt")
                             raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                         _random.split_key()
                         it_count += 1
@@ -637,10 +668,17 @@ class Model:
                         # idempotent anchor so the FIRST interval (the
                         # one containing the compile) is measured too
                         telem.mark_start()
+                    _sp_step = (_epoch_span.child("train.step",
+                                                  step=it_count + 1)
+                                if _epoch_span is not None else None)
                     with timers.scope("dispatch"):
                         outs = engine.step(inputs, labels)
                     if telem is not None:
                         telem.step_mark()
+                    if _sp_step is not None:
+                        # covers dispatch only: the async engine returns
+                        # futures, so device time lands in the sync scope
+                        _sp_step.end()
                     it_count += 1
                     log_step = bool(log_freq) and step_i % log_freq == 0
                     if eager_sync or log_step:
@@ -685,6 +723,7 @@ class Model:
                             # WITHOUT durability — abort with the
                             # distinct code so the launcher alerts
                             # instead of restarting blindly
+                            _flightrec.dump("durability")
                             raise SystemExit(_res.DURABILITY_EXIT_CODE)
                         if guard is not None and guard.preempted:
                             # in-flight batch done: emergency checkpoint
@@ -694,6 +733,7 @@ class Model:
                             self._ft_save(ft_mgr, ft_saver, it_count,
                                           force=True, sync=True)
                             ft_mgr.wait()
+                            _flightrec.dump("preempt")
                             raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                     if num_iters is not None and it_count >= num_iters:
                         break
@@ -732,6 +772,9 @@ class Model:
                                        for k, v in eval_res.items()})
                     cbks.on_eval_end(eval_res)
                 cbks.on_epoch_end(epoch, epoch_logs)
+                if _epoch_span is not None:
+                    _epoch_span.end(status="ok")
+                    _epoch_span = None
                 # SIGTERM during epoch-end eval/callbacks must still turn
                 # into a clean preempted exit (not a SIGKILL after the
                 # grace window); a final-epoch latch just finishes the run
@@ -741,6 +784,7 @@ class Model:
                         self._ft_save(ft_mgr, ft_saver, it_count,
                                       force=True, sync=True)
                         ft_mgr.wait()
+                    _flightrec.dump("preempt")
                     raise SystemExit(_res.PREEMPTED_EXIT_CODE)
                 if self.stop_training:
                     break
@@ -765,6 +809,16 @@ class Model:
                 loader.placement = prev_placement
             # a crash mid-fit must still flush/close callback resources
             cbks.on_train_end({})
+            if _fit_span is not None:
+                _status = "ok" if fit_ok else (
+                    "preempted" if guard is not None and guard.preempted
+                    else "error")
+                if _epoch_span is not None:
+                    _epoch_span.end(status=_status)
+                    _epoch_span = None
+                _fit_span.set_attr("it", it_count)
+                _fit_span.end(status=_status)
+                self._fit_span = None
             if telem is not None:
                 # a capture armed for more steps than remained must still
                 # produce a valid trace artifact
@@ -815,6 +869,7 @@ class Model:
                 # distinct durability code, not a clean 0 — but never
                 # mask an exception already unwinding (_res is bound
                 # whenever ft_mgr is)
+                _flightrec.dump("durability")
                 raise SystemExit(_res.DURABILITY_EXIT_CODE)
         return history
 
@@ -838,6 +893,12 @@ class Model:
                      batch_size=batch_size,
                      loss=(losses[-1] if losses else None),
                      lr=self._optimizer.get_lr(), phase_deltas=deltas)
+        from ..monitor import flightrec as _flightrec
+
+        _flightrec.record(
+            "window", step=it_count, epoch=epoch,
+            steps=it_count - win_it0, wall_s=round(now - win_t0, 3),
+            loss=(float(losses[-1]) if losses else None))
         return now, it_count, dict(timers.totals), dict(timers.counts)
 
     def _split_batch(self, batch):

@@ -23,17 +23,22 @@ import threading
 
 from ..framework import flags as _flags
 from ..utils.metrics import default_registry
-from .server import MonitorServer
+from . import flightrec, tracing
+from .flightrec import FlightRecorder
+from .server import MonitorServer, runtime_health
 from .telemetry import (PEAK_FLOPS, JsonlWriter, TrainTelemetry,
                         device_memory_stats, install_sigusr1,
                         peak_flops_per_device)
+from .tracing import NullSpan, Span, Tracer, default_tracer
 
 logger = logging.getLogger("paddle_tpu.monitor")
 
 __all__ = ["TrainTelemetry", "MonitorServer", "JsonlWriter", "PEAK_FLOPS",
            "peak_flops_per_device", "device_memory_stats",
            "install_sigusr1", "default_registry", "fit_monitor",
-           "get_monitor_server", "reset"]
+           "get_monitor_server", "reset", "runtime_health",
+           "Tracer", "Span", "NullSpan", "default_tracer",
+           "FlightRecorder", "tracing", "flightrec"]
 
 _lock = threading.Lock()
 _telemetry: TrainTelemetry | None = None
@@ -57,6 +62,13 @@ def fit_monitor():
     with _lock:
         if _telemetry is None:
             _telemetry = TrainTelemetry(telemetry_dir=tdir or None)
+            if tdir:
+                # crash flight recorder rides along whenever the event
+                # log is on: spans mirror into its ring, and the
+                # excepthook/atexit hooks leave a postmortem dump
+                rec = flightrec.configure(tdir)
+                flightrec.install_hooks()
+                default_tracer().add_listener(rec.on_span)
         if _server is None and port >= 0:
             try:
                 _server = MonitorServer(telemetry=_telemetry,
@@ -85,3 +97,5 @@ def reset():
         if _telemetry is not None:
             _telemetry.close()
             _telemetry = None
+    tracing.reset()
+    flightrec.reset()

@@ -9,7 +9,10 @@ the shared `utils.metrics` registry instead of a serving engine:
                         federation mode (the launcher) the bodies of
                         every rank's own /metrics are appended, so one
                         scrape describes the whole pod.
-  GET /healthz          200 {"status": "ok", ...} with the live step.
+  GET /healthz          200 {"status": "ok", ...} with the live step
+                        plus version/device identity (framework + jax
+                        versions, device kind/count, uptime_s, pid) so a
+                        fleet health sweep detects version skew.
   GET /debug/trace?steps=N
                         arms a bounded jax.profiler capture of the next
                         N training steps on the attached TrainTelemetry
@@ -17,6 +20,10 @@ the shared `utils.metrics` registry instead of a serving engine:
                         boundary, so a stuck or slow production job can
                         be profiled WITHOUT restarting it.  SIGUSR1 is
                         the headless equivalent (telemetry.py).
+  GET /debug/spans      finished request/train spans from the process
+                        tracer (monitor/tracing.py); `?trace_id=` for
+                        one trace, `?limit=N`, `?format=chrome` for a
+                        perfetto-loadable chrome-trace document.
 
 The server holds no jax state and never blocks training: arming a trace
 is a couple of assignments under a lock; the capture itself runs on the
@@ -26,6 +33,7 @@ from __future__ import annotations
 
 import json
 import logging
+import os
 import threading
 import time
 import urllib.parse
@@ -33,10 +41,47 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from ..utils.metrics import default_registry
+from . import tracing as _tracing
 
 logger = logging.getLogger("paddle_tpu.monitor")
 
-__all__ = ["MonitorServer"]
+__all__ = ["MonitorServer", "runtime_health"]
+
+_runtime_identity = None
+_identity_lock = threading.Lock()
+
+
+def runtime_health() -> dict:
+    """Version/device identity for /healthz (here AND serving/server.py)
+    — the fields a fleet sweep compares to detect version skew.  Device
+    enumeration is cached after the first call so scrapes stay cheap,
+    and every field degrades to a placeholder rather than failing the
+    health check."""
+    global _runtime_identity
+    if _runtime_identity is None:
+        with _identity_lock:
+            if _runtime_identity is None:
+                ident = {}
+                try:
+                    from .. import __version__ as _ver
+                    ident["version"] = _ver
+                except Exception:  # noqa: BLE001
+                    ident["version"] = "unknown"
+                try:
+                    import jax
+                    ident["jax_version"] = jax.__version__
+                    devs = jax.devices()
+                    ident["device_kind"] = devs[0].device_kind \
+                        if devs else "none"
+                    ident["device_count"] = len(devs)
+                except Exception:  # noqa: BLE001 - health must answer
+                    ident["jax_version"] = "unavailable"
+                    ident["device_kind"] = "unavailable"
+                    ident["device_count"] = 0
+                _runtime_identity = ident
+    out = dict(_runtime_identity)
+    out["pid"] = os.getpid()
+    return out
 
 
 class _HTTPServer(ThreadingHTTPServer):
@@ -82,6 +127,24 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             tdir = telem.arm_trace(steps)
             self._send_json(200, {"armed_steps": steps, "trace_dir": tdir})
+        elif parsed.path == "/debug/spans":
+            q = urllib.parse.parse_qs(parsed.query)
+            trace_id = (q.get("trace_id", [None])[0] or None)
+            try:
+                limit = int(q.get("limit", ["-1"])[0])
+            except ValueError:
+                limit = -1
+            tracer = owner.tracer
+            if (q.get("format", [""])[0] or "").lower() == "chrome":
+                self._send_json(200, tracer.chrome_trace(trace_id=trace_id))
+                return
+            spans = tracer.spans(trace_id=trace_id,
+                                 limit=limit if limit >= 0 else None)
+            self._send_json(200, {
+                "sample_rate": tracer.sample_rate,
+                "spans_finished": tracer.spans_finished,
+                "count": len(spans),
+                "spans": spans})
         else:
             self._send_json(404, {"error": f"no route {parsed.path}"})
 
@@ -101,10 +164,11 @@ class MonitorServer:
 
     def __init__(self, registry=None, telemetry=None, host="127.0.0.1",
                  port=0, federate=(), fetch_timeout_s=2.0,
-                 extra_registries=()):
+                 extra_registries=(), tracer=None):
         self.registry = registry if registry is not None \
             else default_registry()
         self.telemetry = telemetry
+        self._tracer = tracer
         self._host = host
         self._requested_port = int(port)
         self.federate = list(federate)
@@ -152,11 +216,19 @@ class MonitorServer:
         out = {"status": "ok",
                "uptime_s": round(time.monotonic() - self._started_at, 1)
                if self._started_at else 0.0}
+        out.update(runtime_health())
         t = self.telemetry
         if t is not None:
             out["step"] = t.g_step.get()
             out["trace_pending"] = t.trace_pending
         return out
+
+    @property
+    def tracer(self):
+        """The span tracer /debug/spans queries (default: the process
+        tracer, resolved lazily so flag changes before first use win)."""
+        return self._tracer if self._tracer is not None \
+            else _tracing.default_tracer()
 
     # -- lifecycle ---------------------------------------------------------
     @property
@@ -179,7 +251,7 @@ class MonitorServer:
             name="paddle-monitor-http")
         self._thread.start()
         logger.info("monitor serving on %s (/metrics /healthz "
-                    "/debug/trace)", self.url)
+                    "/debug/trace /debug/spans)", self.url)
         return self
 
     def shutdown(self):

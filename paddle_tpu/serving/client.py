@@ -7,6 +7,14 @@ the load generator tools/serve_smoke.sh drives: N requests from K
 threads — pure /predict, pure streaming /generate, or a mixed blend —
 then a one-line JSON summary on stdout (with client-side TTFT and
 inter-token quantiles for generation traffic).
+
+Tracing: when the process tracer is enabled (FLAGS_trace_sample_rate >
+0) every predict/generate starts a client-side root span and sends its
+W3C `traceparent` header, so the server's queue/prefill/decode spans
+join the caller's trace; the head-sampling decision is derived from the
+trace_id, so client and server agree without coordination.  Pass
+`traceparent=` explicitly to join an existing trace instead; the header
+actually sent is kept on `client.last_traceparent`.
 """
 from __future__ import annotations
 
@@ -16,6 +24,8 @@ import urllib.error
 import urllib.request
 
 import numpy as np
+
+from ..monitor import tracing as _tracing
 
 __all__ = ["ServingClient", "ServingHTTPError"]
 
@@ -27,15 +37,40 @@ class ServingHTTPError(RuntimeError):
 
 
 class ServingClient:
-    def __init__(self, url: str, timeout: float = 30.0):
+    def __init__(self, url: str, timeout: float = 30.0, tracer=None):
         self.base = url.rstrip("/")
         self.timeout = timeout
+        self._tracer = tracer
+        self.last_traceparent = None  # header sent on the last request
 
-    def _request(self, path: str, body=None):
+    @property
+    def tracer(self):
+        return self._tracer if self._tracer is not None \
+            else _tracing.default_tracer()
+
+    def _start_span(self, name: str, traceparent, attrs=None):
+        """(span, header) for one outgoing request: an explicit
+        `traceparent=` is forwarded as-is (the caller owns that span);
+        otherwise a client root span supplies the header."""
+        if traceparent is not None:
+            self.last_traceparent = traceparent
+            return None, traceparent
+        tracer = self.tracer
+        if not tracer.enabled:
+            self.last_traceparent = None
+            return None, None
+        span = tracer.start_span(name, attrs=attrs)
+        self.last_traceparent = span.traceparent
+        return span, span.traceparent
+
+    def _request(self, path: str, body=None, traceparent=None):
+        headers = {"Content-Type": "application/json"}
+        if traceparent:
+            headers["traceparent"] = traceparent
         req = urllib.request.Request(
             self.base + path,
             data=(json.dumps(body).encode() if body is not None else None),
-            headers={"Content-Type": "application/json"},
+            headers=headers,
             method="POST" if body is not None else "GET")
         try:
             with urllib.request.urlopen(req, timeout=self.timeout) as r:
@@ -43,7 +78,8 @@ class ServingClient:
         except urllib.error.HTTPError as e:  # non-2xx still carries a body
             return e.code, e.read()
 
-    def predict(self, inputs, dtypes=None, deadline_ms=None):
+    def predict(self, inputs, dtypes=None, deadline_ms=None,
+                traceparent=None):
         """inputs: list of single-sample arrays/nested lists (no batch
         dim).  Returns list of numpy outputs; raises ServingHTTPError on
         backpressure (429), draining (503), deadline (504)."""
@@ -52,7 +88,12 @@ class ServingClient:
             body["dtypes"] = [str(d) for d in dtypes]
         if deadline_ms is not None:
             body["deadline_ms"] = deadline_ms
-        status, raw = self._request("/predict", body)
+        span, header = self._start_span(
+            "client.predict", traceparent, attrs={"n_inputs": len(inputs)})
+        status, raw = self._request("/predict", body, traceparent=header)
+        if span is not None:
+            span.set_attr("http_status", status)
+            span.end(status="ok" if status == 200 else "error")
         if status != 200:
             # status decides FIRST: a proxy's non-JSON 502/504 body must
             # surface as ServingHTTPError, not a JSONDecodeError
@@ -80,12 +121,19 @@ class ServingClient:
 
     def generate(self, prompt, max_new_tokens=32, *, do_sample=False,
                  temperature=1.0, top_k=0, seed=0, eos_token_id=None,
-                 deadline_ms=None) -> dict:
+                 deadline_ms=None, traceparent=None) -> dict:
         """Blocking generation: {"tokens": [...], "ttft_ms",
         "latency_ms"}.  Raises ServingHTTPError on 429/503/504."""
+        span, header = self._start_span(
+            "client.generate", traceparent,
+            attrs={"prompt_len": len(prompt),
+                   "max_new_tokens": int(max_new_tokens)})
         status, raw = self._request("/generate", self._gen_body(
             prompt, max_new_tokens, do_sample, temperature, top_k, seed,
-            eos_token_id, deadline_ms, stream=False))
+            eos_token_id, deadline_ms, stream=False), traceparent=header)
+        if span is not None:
+            span.set_attr("http_status", status)
+            span.end(status="ok" if status == 200 else "error")
         if status != 200:
             try:
                 detail = json.loads(raw or b"{}").get("error", "?")
@@ -96,19 +144,27 @@ class ServingClient:
 
     def generate_stream(self, prompt, max_new_tokens=32, *,
                         do_sample=False, temperature=1.0, top_k=0, seed=0,
-                        eos_token_id=None, deadline_ms=None):
+                        eos_token_id=None, deadline_ms=None,
+                        traceparent=None):
         """Streaming generation: yields one event dict per SSE frame as
         the server's decode loop produces it — {"token": t} per decoded
         token, then a final {"done": true, "tokens": n, ...} (which
         carries "error" when the request failed mid-decode).  Admission
         failures (429/503) raise ServingHTTPError before the first
         yield."""
+        span, header = self._start_span(
+            "client.generate_stream", traceparent,
+            attrs={"prompt_len": len(prompt),
+                   "max_new_tokens": int(max_new_tokens)})
+        headers = {"Content-Type": "application/json"}
+        if header:
+            headers["traceparent"] = header
         req = urllib.request.Request(
             self.base + "/generate",
             data=json.dumps(self._gen_body(
                 prompt, max_new_tokens, do_sample, temperature, top_k,
                 seed, eos_token_id, deadline_ms, stream=True)).encode(),
-            headers={"Content-Type": "application/json"}, method="POST")
+            headers=headers, method="POST")
         try:
             resp = urllib.request.urlopen(req, timeout=self.timeout)
         except urllib.error.HTTPError as e:
@@ -116,16 +172,29 @@ class ServingClient:
                 detail = json.loads(e.read() or b"{}").get("error", "?")
             except ValueError:
                 detail = "?"
+            if span is not None:
+                span.set_attr("http_status", e.code)
+                span.end(status="error")
             raise ServingHTTPError(e.code, detail) from None
-        with resp:
-            for line in resp:  # urllib undoes the chunked framing
-                line = line.strip()
-                if not line.startswith(b"data: "):
-                    continue
-                evt = json.loads(line[len(b"data: "):])
-                yield evt
-                if evt.get("done"):
-                    return
+        ntok = 0
+        try:
+            with resp:
+                for line in resp:  # urllib undoes the chunked framing
+                    line = line.strip()
+                    if not line.startswith(b"data: "):
+                        continue
+                    evt = json.loads(line[len(b"data: "):])
+                    if "token" in evt:
+                        ntok += 1
+                        if span is not None and ntok == 1:
+                            span.event("first_token")
+                    yield evt
+                    if evt.get("done"):
+                        return
+        finally:
+            if span is not None:
+                span.set_attr("tokens", ntok)
+                span.end()
 
     def healthz(self) -> dict:
         status, raw = self._request("/healthz")

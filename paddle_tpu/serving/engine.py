@@ -38,6 +38,7 @@ import numpy as np
 
 from ..framework import flags as _flags
 from ..framework.transfer import host_fetch
+from ..monitor import tracing as _tracing
 from ..utils import chaos
 from ..utils.profiler import RecordEvent
 from .metrics import ServingMetrics
@@ -126,15 +127,34 @@ class BucketSpec:
 
 class _Request:
     __slots__ = ("inputs", "orig_lens", "key", "future", "t_enqueue",
-                 "deadline")
+                 "deadline", "span", "own_span", "span_queue", "span_exec")
 
-    def __init__(self, inputs, orig_lens, key, deadline):
+    def __init__(self, inputs, orig_lens, key, deadline, span=None,
+                 own_span=False):
         self.inputs = inputs
         self.orig_lens = orig_lens     # per-input pre-pad seq length
         self.key = key                 # padded shape signature = bucket
         self.future = concurrent.futures.Future()
         self.t_enqueue = time.monotonic()
         self.deadline = deadline       # absolute monotonic time or None
+        self.span = span               # request span (tracing), or None
+        self.own_span = own_span       # engine-rooted: engine ends it
+        self.span_queue = None         # live "serve.queued" child
+        self.span_exec = None          # live "serve.execute" child
+
+    def end_spans(self, status: str):
+        """Terminal span cleanup for early exits (deadline, cancel,
+        drain): close any live child, and the root if the engine owns
+        it (server-owned roots are ended by the HTTP handler)."""
+        for s in (self.span_queue, self.span_exec):
+            if s is not None:
+                s.end(status=status)
+        self.span_queue = self.span_exec = None
+        if self.span is not None:
+            self.span.set_attr("status", status)
+            if self.own_span:
+                self.span.end()
+            self.span = None
 
 
 _WAKE = object()   # queue sentinel: wakes an idle-blocked batcher
@@ -362,11 +382,16 @@ class ServingEngine:
             self._seen_keys.add(key)
         return padded, orig, key
 
-    def submit(self, inputs, deadline_ms=None) -> concurrent.futures.Future:
+    def submit(self, inputs, deadline_ms=None, span=None) \
+            -> concurrent.futures.Future:
         """Enqueue one request (a list of single-sample arrays, NO batch
         dim).  Returns a Future resolving to the per-request output list.
         Raises QueueFullError under backpressure and EngineStoppedError
-        once draining/stopped."""
+        once draining/stopped.
+
+        `span=` joins the request to a caller-owned trace span (the HTTP
+        layer passes its server span); without one, a direct API caller
+        gets a head-sampled engine root span."""
         if self._draining or self._stopped:
             self.metrics.count("rejected_draining")
             raise EngineStoppedError("serving engine is draining — no new "
@@ -378,10 +403,24 @@ class ServingEngine:
             inputs if isinstance(inputs, (list, tuple)) else [inputs])
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
-        req = _Request(padded, orig, key, deadline)
+        own_span = False
+        if span is None:
+            tracer = _tracing.default_tracer()
+            if tracer.enabled:
+                span = tracer.start_span("serve.request")
+                own_span = True
+        if span is not None and not span.sampled:
+            span, own_span = None, False
+        req = _Request(padded, orig, key, deadline, span=span,
+                       own_span=own_span)
+        if span is not None:
+            # child spans MUST attach before enqueue: the batcher may
+            # claim the request the instant it lands on the queue
+            req.span_queue = span.child("serve.queued")
         try:
             self._queue.put_nowait(req)
         except queue.Full:
+            req.end_spans("rejected_queue_full")
             self.metrics.count("rejected_queue_full")
             raise QueueFullError(
                 f"serving queue at capacity ({self.queue_depth}); retry "
@@ -455,8 +494,10 @@ class ServingEngine:
             for r in lst:
                 if r.future.done():   # client-side cancel: just drop it
                     self.metrics.count("cancelled")
+                    r.end_spans("cancelled")
                 elif r.deadline is not None and now > r.deadline:
                     self.metrics.count("deadline_expired")
+                    r.end_spans("deadline_expired")
                     r.future.set_exception(DeadlineExceededError(
                         "request deadline passed while queued"))
                 else:
@@ -471,12 +512,22 @@ class ServingEngine:
                 live.append(r)
             else:
                 self.metrics.count("cancelled")
+                r.end_spans("cancelled")
         if not live:
             return
         self._batch_seq += 1
         now = time.monotonic()
         for r in live:
             self.metrics.observe_queue_wait(now - r.t_enqueue)
+            # queued → dispatched transition (host timestamps only —
+            # this is the engine's hot path)
+            if r.span_queue is not None:
+                r.span_queue.end()
+                r.span_queue = None
+            if r.span is not None:
+                r.span_exec = r.span.child("serve.execute",
+                                           batch=len(live),
+                                           batch_seq=self._batch_seq)
         try:
             chaos.on_step(self._batch_seq)  # fault injection seam
             bucket_b = self.buckets.batch_for(len(live))
@@ -494,6 +545,7 @@ class ServingEngine:
             self.metrics.count("errors", len(live))
             logger.exception("serving batch %d failed", self._batch_seq)
             for r in live:
+                r.end_spans("error")
                 if not r.future.done():
                     r.future.set_exception(e)
             return
@@ -529,12 +581,14 @@ class ServingEngine:
                 if not r.future.done():
                     r.future.set_result(row)
                     self.metrics.observe_completion(done_t - r.t_enqueue)
+                r.end_spans("ok")
         except Exception as e:  # noqa: BLE001 - e.g. an output without the
             # batch dim: fail this batch's unresolved futures, never the
             # batcher thread (the engine's single point of failure)
             logger.exception("serving batch %d result distribution failed",
                              self._batch_seq)
             for r in live:
+                r.end_spans("error")
                 if not r.future.done():
                     self.metrics.count("errors")
                     r.future.set_exception(e)

@@ -40,6 +40,7 @@ import numpy as np
 
 from ..framework import flags as _flags
 from ..framework.transfer import host_fetch
+from ..monitor import tracing as _tracing
 from ..utils import chaos
 from ..utils.profiler import RecordEvent
 from .engine import (DeadlineExceededError, EngineStoppedError,
@@ -149,10 +150,12 @@ class GenerationHandle:
 class _GenRequest:
     __slots__ = ("prompt", "bucket", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "seed", "eos", "deadline",
-                 "handle", "engine", "cancelled", "t_last_token")
+                 "handle", "engine", "cancelled", "t_last_token",
+                 "span", "own_span", "span_queue", "span_decode")
 
     def __init__(self, engine, prompt, bucket, max_new_tokens, do_sample,
-                 temperature, top_k, seed, eos, deadline):
+                 temperature, top_k, seed, eos, deadline, span=None,
+                 own_span=False):
         self.engine = engine
         self.prompt = prompt               # np.int32 [L]
         self.bucket = bucket               # padded prompt length Sp
@@ -165,8 +168,26 @@ class _GenRequest:
         self.deadline = deadline           # absolute monotonic or None
         self.cancelled = False
         self.t_last_token = None
+        self.span = span                   # request span (sampled or None)
+        self.own_span = own_span           # engine owns span's end()
+        self.span_queue = None             # "gen.queued" child
+        self.span_decode = None            # "gen.decode" child
         self.handle = GenerationHandle(len(prompt), max_new_tokens)
         self.handle._req = self
+
+    def end_spans(self, status: str):
+        """Close any open child spans and settle the request span with a
+        terminal status; the parent is ended here only when the engine
+        owns it (direct submit — HTTP requests end theirs upstream)."""
+        for s in (self.span_queue, self.span_decode):
+            if s is not None:
+                s.end(status=status)
+        self.span_queue = self.span_decode = None
+        if self.span is not None:
+            self.span.set_attr("status", status)
+            if self.own_span:
+                self.span.end()
+            self.span = None
 
 
 class GenerationEngine:
@@ -389,11 +410,16 @@ class GenerationEngine:
 
     def submit(self, prompt, max_new_tokens=32, *, do_sample=False,
                temperature=1.0, top_k=0, seed=0, eos_token_id=None,
-               deadline_ms=None) -> GenerationHandle:
+               deadline_ms=None, span=None) -> GenerationHandle:
         """Enqueue one prompt (1-D int token ids).  Returns a streaming
         :class:`GenerationHandle`.  Raises QueueFullError under
         backpressure, EngineStoppedError once draining/stopped, and
-        ValueError for requests the cache geometry cannot hold."""
+        ValueError for requests the cache geometry cannot hold.
+
+        `span`: an open request span to hang the engine's gen.queued /
+        gen.prefill / gen.decode children from (the HTTP server passes
+        its adopted server.generate span); without one, a sampled root
+        span is started when the process tracer is enabled."""
         if self._draining or self._stopped:
             self.metrics.count("rejected_draining")
             raise EngineStoppedError("generation engine is draining — no "
@@ -421,13 +447,31 @@ class GenerationEngine:
             else int(eos_token_id)
         deadline = (time.monotonic() + deadline_ms / 1e3
                     if deadline_ms is not None else None)
+        own_span = False
+        if span is not None and not span.sampled:
+            span = None
+        elif span is None:
+            tracer = _tracing.default_tracer()
+            if tracer.enabled:
+                root = tracer.start_span(
+                    "genserve.request",
+                    attrs={"prompt_len": L,
+                           "max_new_tokens": max_new_tokens})
+                if root.sampled:
+                    span, own_span = root, True
         req = _GenRequest(self, prompt, bucket, max_new_tokens,
                           bool(do_sample), float(temperature), top_k,
-                          int(seed), eos, deadline)
+                          int(seed), eos, deadline, span=span,
+                          own_span=own_span)
+        if span is not None:
+            # attached BEFORE enqueue: the decode thread may admit the
+            # request (and close this child) before put_nowait returns
+            req.span_queue = span.child("gen.queued", bucket=bucket)
         try:
             self._queue.put_nowait(req)
         except queue.Full:
             self.metrics.count("rejected_queue_full")
+            req.end_spans("rejected_queue_full")
             raise QueueFullError(
                 f"generation queue at capacity ({self.queue_depth}); "
                 "retry with backoff") from None
@@ -495,9 +539,11 @@ class GenerationEngine:
         for req in self._backlog:
             if req.cancelled:
                 self.metrics.count("cancelled")
+                req.end_spans("cancelled")
                 req.handle._finish()
             elif req.deadline is not None and now > req.deadline:
                 self.metrics.count("deadline_expired")
+                req.end_spans("deadline_expired")
                 req.handle._finish(DeadlineExceededError(
                     "request deadline passed while queued"))
             else:
@@ -516,6 +562,7 @@ class GenerationEngine:
                 logger.exception("generation admission failed")
                 self.metrics.count("errors")
                 self._sched.retire(slot)
+                req.end_spans("error")
                 req.handle._finish(e)
 
     def _admit(self, req: _GenRequest, slot: int):
@@ -523,6 +570,12 @@ class GenerationEngine:
         with its first sampled token — the request joins the in-flight
         batch at this iteration boundary."""
         L = len(req.prompt)
+        if req.span_queue is not None:
+            req.span_queue.end(status="ok")
+            req.span_queue = None
+        sp_prefill = (req.span.child("gen.prefill", bucket=req.bucket,
+                                     prompt_len=L, slot=slot)
+                      if req.span is not None else None)
         ids = np.zeros((1, req.bucket), np.int32)
         ids[0, :L] = req.prompt
         with RecordEvent("paddle.genserve/prefill"):
@@ -536,16 +589,23 @@ class GenerationEngine:
         self._state = state
         with host_fetch():
             t1 = int(np.array(tok1, copy=True))
+        if sp_prefill is not None:
+            sp_prefill.end(status="ok")
         now = time.monotonic()
         req.t_last_token = now
         req.handle._push(t1)
+        if req.span is not None:
+            req.span.event("first_token", slot=slot)
         self.metrics.observe_ttft(now - req.handle.t_submit)
         self.metrics.observe_tokens(1)
         if req.max_new_tokens == 1 or t1 == req.eos:
             self._release([slot])
             self._sched.retire(slot)
             self.metrics.count("retired")
+            req.end_spans("ok")
             req.handle._finish()
+        elif req.span is not None:
+            req.span_decode = req.span.child("gen.decode", slot=slot)
 
     def _release(self, slots):
         mask = np.zeros((self.max_slots,), np.bool_)
@@ -562,6 +622,7 @@ class GenerationEngine:
             self._sched.retire(slot)
             self.metrics.count(reason)
             self.metrics.count("preempted")
+            req.end_spans(reason)
             req.handle._finish(
                 None if reason == "cancelled" else DeadlineExceededError(
                     "request deadline passed mid-decode"))
@@ -591,24 +652,33 @@ class GenerationEngine:
                 self.metrics.observe_inter_token(now - req.t_last_token)
             req.t_last_token = now
             req.handle._push(tok)
+            if req.span_decode is not None:
+                # host ints only — toks/fin were fetched in step()
+                req.span_decode.event("token", i=len(req.handle.tokens))
             if bool(fin_np[slot]):
                 self._sched.retire(slot)
                 self.metrics.count("retired")
+                req.end_spans("ok")
                 req.handle._finish()
 
     def _fail_everything(self, exc):
         for dq in (self._backlog,):
             while dq:
-                dq.popleft().handle._finish(exc)
+                req = dq.popleft()
+                req.end_spans("error")
+                req.handle._finish(exc)
         while True:
             try:
                 req = self._queue.get_nowait()
             except queue.Empty:
                 break
             if req is not _WAKE:
+                req.end_spans("error")
                 req.handle._finish(exc)
         for slot in list(self._sched.occupied):
-            self._sched.retire(slot).handle._finish(exc)
+            req = self._sched.retire(slot)
+            req.end_spans("error")
+            req.handle._finish(exc)
 
     # -- shutdown ----------------------------------------------------------
     def drain(self, timeout=None) -> bool:
@@ -637,6 +707,7 @@ class GenerationEngine:
                 continue
             drained = False
             if not req.handle.done:
+                req.end_spans("rejected_draining")
                 req.handle._finish(EngineStoppedError(
                     "request arrived during drain"))
         return drained and not alive

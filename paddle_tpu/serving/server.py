@@ -19,7 +19,9 @@ Endpoints:
                   token as the decode loop produces it, then a final
                   `data: {"done": true, ...}` event.  Same 400/429/503/
                   504 admission split as /predict.
-  GET  /healthz   200 {"status": "ok"} | 503 {"status": "draining"}
+  GET  /healthz   200 {"status": "ok", ...} | 503 {"status": "draining",
+                  ...} — plus framework/jax versions, device kind/count,
+                  uptime_s and pid (fleet version-skew detection)
   GET  /metrics   Prometheus text from every mounted engine (batching
                   qps/p50/p99 + genserve decode tokens/s, TTFT,
                   inter-token quantiles, slot occupancy)
@@ -43,6 +45,9 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 import numpy as np
 
 from ..distributed.resilience import PreemptionGuard
+from ..monitor import flightrec as _flightrec
+from ..monitor import tracing as _tracing
+from ..monitor.server import runtime_health
 from .engine import (DeadlineExceededError, EngineStoppedError,
                      QueueFullError, ServingEngine)
 
@@ -80,10 +85,11 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 - http.server API
         owner = self.server.owner
         if self.path == "/healthz":
+            info = {"uptime_s": owner.uptime_s, **runtime_health()}
             if owner.draining:
-                self._send_json(503, {"status": "draining"})
+                self._send_json(503, {"status": "draining", **info})
             else:
-                self._send_json(200, {"status": "ok"})
+                self._send_json(200, {"status": "ok", **info})
         elif self.path == "/metrics":
             parts = [e.metrics.prometheus_text() for e in
                      (owner.engine, owner.gen_engine) if e is not None]
@@ -99,12 +105,28 @@ class _Handler(BaseHTTPRequestHandler):
         # bytes to be misparsed as the next request line
         n = int(self.headers.get("Content-Length", 0))
         raw = self.rfile.read(n)
+        # adopt the caller's W3C trace context (or head-sample a fresh
+        # trace); a NullSpan when unsampled/disabled, so every handler
+        # below threads it through unconditionally
+        tracer = _tracing.default_tracer()
+        tp = self.headers.get("traceparent")
         if self.path == "/generate":
-            self._do_generate(owner, raw)
+            span = tracer.start_span("server.generate", traceparent=tp)
+            try:
+                self._do_generate(owner, raw, span)
+            finally:
+                span.end()
             return
         if self.path != "/predict":
             self._send_json(404, {"error": f"no route {self.path}"})
             return
+        span = tracer.start_span("server.predict", traceparent=tp)
+        try:
+            self._do_predict(owner, raw, span)
+        finally:
+            span.end()
+
+    def _do_predict(self, owner, raw, span):
         if owner.engine is None:
             self._send_json(404, {"error": "no predict engine mounted"})
             return
@@ -123,14 +145,17 @@ class _Handler(BaseHTTPRequestHandler):
         # out of the model can never masquerade as "bad request"
         try:
             fut = owner.engine.submit(
-                arrays, deadline_ms=payload.get("deadline_ms"))
+                arrays, deadline_ms=payload.get("deadline_ms"), span=span)
         except ValueError as e:  # shape/spec mismatch caught at submit
+            span.set_attr("status", "bad_request")
             self._send_json(400, {"error": f"bad request: {e}"})
             return
         except QueueFullError as e:
+            span.set_attr("status", "rejected_queue_full")
             self._send_json(429, {"error": str(e)})
             return
         except EngineStoppedError as e:
+            span.set_attr("status", "rejected_draining")
             self._send_json(503, {"error": str(e)})
             return
         try:
@@ -149,13 +174,15 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - model failure → 500
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
+        latency_ms = round((time.monotonic() - t0) * 1e3, 3)
+        span.set_attr("latency_ms", latency_ms)
         self._send_json(200, {
             "outputs": [np.asarray(o).tolist() for o in outs],
             "dtypes": [str(np.asarray(o).dtype) for o in outs],
-            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            "latency_ms": latency_ms,
         })
 
-    def _do_generate(self, owner, raw):
+    def _do_generate(self, owner, raw, span):
         gen = owner.gen_engine
         if gen is None:
             self._send_json(404, {"error": "no generation engine mounted"})
@@ -181,18 +208,21 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request: {e}"})
             return
         try:
-            handle = gen.submit(prompt, **kw)
+            handle = gen.submit(prompt, span=span, **kw)
         except ValueError as e:  # geometry/sampling bounds, at submit
+            span.set_attr("status", "bad_request")
             self._send_json(400, {"error": f"bad request: {e}"})
             return
         except QueueFullError as e:
+            span.set_attr("status", "rejected_queue_full")
             self._send_json(429, {"error": str(e)})
             return
         except EngineStoppedError as e:
+            span.set_attr("status", "rejected_draining")
             self._send_json(503, {"error": str(e)})
             return
         if stream:
-            self._stream_tokens(owner, handle, t0)
+            self._stream_tokens(owner, handle, t0, span)
             return
         try:
             toks = handle.result(timeout=owner.request_timeout_s)
@@ -210,6 +240,9 @@ class _Handler(BaseHTTPRequestHandler):
         except Exception as e:  # noqa: BLE001 - engine failure → 500
             self._send_json(500, {"error": f"{type(e).__name__}: {e}"})
             return
+        if handle.ttft_ms is not None:
+            span.set_attr("ttft_ms", round(handle.ttft_ms, 3))
+        span.set_attr("tokens", len(toks))
         self._send_json(200, {
             "tokens": toks,
             "ttft_ms": round(handle.ttft_ms, 3)
@@ -217,7 +250,7 @@ class _Handler(BaseHTTPRequestHandler):
             "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
         })
 
-    def _stream_tokens(self, owner, handle, t0):
+    def _stream_tokens(self, owner, handle, t0, span):
         """Server-Sent Events over explicit chunked framing.  The
         response is open-ended, so the connection is marked close — a
         keep-alive client would otherwise wait on a Content-Length that
@@ -244,6 +277,9 @@ class _Handler(BaseHTTPRequestHandler):
                         break
                     n += 1
                     event({"token": tok})
+                span.set_attr("tokens", n)
+                if handle.ttft_ms is not None:
+                    span.set_attr("ttft_ms", round(handle.ttft_ms, 3))
                 event({"done": True, "tokens": n,
                        "ttft_ms": round(handle.ttft_ms, 3)
                        if handle.ttft_ms is not None else None,
@@ -292,6 +328,12 @@ class ServingServer:
         self._done = threading.Event()
         self._drain_clean = None
         self._shutdown_once = threading.Lock()
+        self._started_at = None
+
+    @property
+    def uptime_s(self) -> float:
+        return round(time.monotonic() - self._started_at, 1) \
+            if self._started_at is not None else 0.0
 
     # -- input decode ------------------------------------------------------
     def _decode(self, inputs, dtypes=None):
@@ -334,6 +376,7 @@ class ServingServer:
         self._httpd = _HTTPServer((self._host, self._requested_port),
                                   _Handler)
         self._httpd.owner = self
+        self._started_at = time.monotonic()
         if self._install_signals:
             # latch, don't die: the handler only sets .preempted — the
             # watcher thread performs the drain (same latch→finish→exit
@@ -384,6 +427,11 @@ class ServingServer:
             self._drain_clean = clean
             self._done.set()
             logger.info("serving drain %s", "clean" if clean else "TIMED OUT")
+            # serving postmortem: when a flight recorder is configured
+            # (FLAGS_telemetry_dir), leave the last spans + engine state
+            # for the goodput ledger / on-call (no-op otherwise)
+            _flightrec.record("drain", clean=clean)
+            _flightrec.dump("drain")
             return clean
 
     def wait(self, timeout=None) -> int:

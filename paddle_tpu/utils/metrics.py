@@ -31,6 +31,7 @@ from __future__ import annotations
 import bisect
 import collections
 import threading
+import time
 
 __all__ = ["Counter", "Gauge", "Histogram", "Reservoir", "MetricsRegistry",
            "default_registry"]
@@ -77,7 +78,9 @@ class Counter:
 
     def inc(self, arg=1, n: int = None):
         """Unlabeled: `inc()` / `inc(3)`.  Labeled: `inc("reason")` /
-        `inc("reason", 3)`."""
+        `inc("reason", 3)`.  A float labeled increment stays a float
+        (seconds-style counters, e.g. the goodput ledger's badput
+        accounting); integral increments keep rendering as ints."""
         with self._lock:
             if self.label is None:
                 self.value += int(arg)
@@ -85,7 +88,8 @@ class Counter:
             key = str(arg)
             if key not in self.values:
                 self._order.append(key)
-            self.values[key] += 1 if n is None else int(n)
+            self.values[key] += 1 if n is None else \
+                (float(n) if isinstance(n, float) else int(n))
 
     def get(self, key=None) -> int:
         with self._lock:
@@ -179,15 +183,35 @@ class Histogram:
 class Reservoir:
     """Bounded window of recent observations for exact order-statistic
     quantiles.  Not itself rendered — pair it with computed `Gauge`s
-    (`fn=lambda: res.quantile(0.99)`)."""
+    (`fn=lambda: res.quantile(0.99)`).
 
-    def __init__(self, size: int = 4096, lock=None):
+    Bounded by COUNT (the last `size` observations, the default) and
+    optionally by TIME: with `window_s` set, observations older than the
+    window are evicted before every quantile, so a scraped p99 after a
+    traffic lull describes recent behavior instead of stale history.
+    `window_s=None` keeps the lifetime-cumulative default."""
+
+    def __init__(self, size: int = 4096, lock=None,
+                 window_s: float = None):
         self._lock = lock or threading.RLock()
         self.values = collections.deque(maxlen=size)
+        self.window_s = float(window_s) if window_s else None
+        self._stamps = collections.deque(maxlen=size) \
+            if self.window_s else None
 
     def observe(self, v: float):
         with self._lock:
             self.values.append(float(v))
+            if self._stamps is not None:
+                self._stamps.append(time.monotonic())
+
+    def _evict_locked(self):
+        # values/_stamps share maxlen and are appended in lockstep, so
+        # ring overflow drops the same (oldest) entries from both
+        cutoff = time.monotonic() - self.window_s
+        while self._stamps and self._stamps[0] < cutoff:
+            self._stamps.popleft()
+            self.values.popleft()
 
     def __len__(self):
         return len(self.values)
@@ -197,6 +221,8 @@ class Reservoir:
             return self.quantile_locked(q)
 
     def quantile_locked(self, q: float) -> float:
+        if self._stamps is not None:
+            self._evict_locked()
         if not self.values:
             return 0.0
         xs = sorted(self.values)
@@ -248,13 +274,25 @@ class MetricsRegistry:
                 self._metrics[name] = m
             return m
 
-    def reservoir(self, name: str, size: int = 4096) -> Reservoir:
+    def reservoir(self, name: str, size: int = 4096,
+                  window_s: float = None) -> Reservoir:
         """Unrendered observation window (see Reservoir); keyed separately
-        from rendered metrics."""
+        from rendered metrics.  `window_s=None` defers to
+        `FLAGS_metrics_window_s` (0 = lifetime-cumulative, the
+        default)."""
         with self._lock:
             r = self._reservoirs.get(name)
             if r is None:
-                r = Reservoir(size, lock=self._lock)
+                if window_s is None:
+                    try:  # lazy: utils.metrics stays importable standalone
+                        from ..framework import flags as _flags
+                        window_s = float(
+                            _flags.flag("FLAGS_metrics_window_s", 0.0)
+                            or 0.0)
+                    except Exception:  # noqa: BLE001
+                        window_s = 0.0
+                r = Reservoir(size, lock=self._lock,
+                              window_s=window_s or None)
                 self._reservoirs[name] = r
             return r
 

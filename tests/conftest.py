@@ -47,6 +47,10 @@ def pytest_configure(config):
         "markers", "mesh3d: 3D-parallel layout/remat/accumulation test "
         "(SpecLayout over dp×fsdp×tp on the 8 virtual devices) — run via "
         "tools/mesh3d_smoke.sh")
+    config.addinivalue_line(
+        "markers", "trace: request-scoped tracing / flight recorder / "
+        "goodput ledger test (monitor.tracing, monitor.flightrec, "
+        "distributed.goodput) — run via tools/obs_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

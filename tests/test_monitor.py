@@ -494,6 +494,69 @@ class TestMonitorServer:
         assert own.counter(
             "paddle_monitor_federation_errors_total").get() == 1
 
+    def test_concurrent_scrapes_with_slow_rank_no_convoy(self):
+        """One SLOW federated rank must not convoy the monitor: while
+        N scrapes sit in its fetch, /healthz on the same server answers
+        immediately (the rank fetch happens OUTSIDE the registry lock,
+        and the HTTP server threads per request), and the N scrapes
+        overlap on the slow rank instead of serializing behind it."""
+        import threading
+        import time as _time
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from paddle_tpu.monitor import MonitorServer
+
+        class _SlowRank(BaseHTTPRequestHandler):
+            def do_GET(self):
+                _time.sleep(1.2)
+                body = b"slow_rank_gauge 7\n"
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):
+                pass
+
+        rank_httpd = ThreadingHTTPServer(("127.0.0.1", 0), _SlowRank)
+        rank_httpd.daemon_threads = True
+        threading.Thread(target=rank_httpd.serve_forever,
+                         daemon=True).start()
+        rank_url = "http://127.0.0.1:%d" % rank_httpd.server_address[1]
+        own = MetricsRegistry()
+        own.counter("launcher_counter").inc()
+        try:
+            with MonitorServer(registry=own, port=0, federate=[rank_url],
+                               fetch_timeout_s=5.0) as fed:
+                bodies = {}
+
+                def scrape(i):
+                    bodies[i] = _scrape(fed.url + "/metrics")
+
+                t0 = _time.monotonic()
+                threads = [threading.Thread(target=scrape, args=(i,))
+                           for i in range(4)]
+                for t in threads:
+                    t.start()
+                _time.sleep(0.2)   # scrapes are now parked in the fetch
+                t1 = _time.monotonic()
+                h = json.loads(_scrape(fed.url + "/healthz"))
+                healthz_s = _time.monotonic() - t1
+                for t in threads:
+                    t.join()
+                total = _time.monotonic() - t0
+        finally:
+            rank_httpd.shutdown()
+            rank_httpd.server_close()
+        assert h["status"] == "ok"
+        assert healthz_s < 1.0, \
+            f"/healthz took {healthz_s:.2f}s behind a slow rank scrape"
+        assert len(bodies) == 4
+        for b in bodies.values():
+            assert "launcher_counter 1" in b and "slow_rank_gauge 7" in b
+        assert total < 3.5, \
+            f"4 scrapes of a 1.2s rank took {total:.1f}s — serialized"
+
 
 # -- on-demand trace capture on a RUNNING fit -------------------------------
 def _trace_files(root):

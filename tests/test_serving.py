@@ -404,7 +404,13 @@ class TestHTTPServer:
 
     def test_predict_healthz_metrics(self, server, exported_mlp):
         client = ServingClient(server.url)
-        assert client.healthz() == {"status_code": 200, "status": "ok"}
+        h = client.healthz()
+        assert h["status_code"] == 200 and h["status"] == "ok"
+        # enriched identity fields (PR 12): fleet sweeps compare these
+        # to detect version skew
+        assert h["pid"] > 0 and h["device_count"] >= 1
+        assert "version" in h and "jax_version" in h
+        assert h["uptime_s"] >= 0.0
         s = _sample(3)
         out, = client.predict([s])
         pred = inference.create_predictor(inference.Config(exported_mlp))

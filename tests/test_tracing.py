@@ -1,0 +1,462 @@
+"""Request-scoped tracing, crash flight recorder, goodput ledger
+(PR 12): span-tree shape through the serving stack, W3C traceparent
+propagation, deterministic head sampling, flight-recorder dumps on
+chaos-injected watchdog/SIGTERM exits, and the launcher-side goodput
+accounting."""
+import json
+import os
+import subprocess
+import sys
+import urllib.request
+
+import numpy as np
+import pytest
+
+from conftest import cpu_subprocess_env
+from paddle_tpu.framework import flags as _flags
+from paddle_tpu.monitor import tracing
+from paddle_tpu.monitor.tracing import (NullSpan, Span, Tracer,
+                                        format_traceparent,
+                                        parse_traceparent, sample_decision)
+
+pytestmark = pytest.mark.trace
+
+
+@pytest.fixture()
+def tracer_on():
+    """Force-sample everything for the duration of one test, resetting
+    the process tracer/recorder singletons on both sides."""
+    import paddle_tpu.monitor as monitor
+
+    old = _flags.flag("FLAGS_trace_sample_rate")
+    _flags.set_flags({"FLAGS_trace_sample_rate": 1.0})
+    monitor.reset()
+    yield tracing.default_tracer()
+    _flags.set_flags({"FLAGS_trace_sample_rate": old})
+    monitor.reset()
+
+
+# ---------------------------------------------------------------------------
+# tracer core
+# ---------------------------------------------------------------------------
+class TestTracerCore:
+    def test_traceparent_roundtrip(self):
+        tid, sid = "ab" * 16, "cd" * 8
+        hdr = format_traceparent(tid, sid, True)
+        assert hdr == f"00-{tid}-{sid}-01"
+        assert parse_traceparent(hdr) == (tid, sid, True)
+        assert parse_traceparent(format_traceparent(tid, sid, False)) \
+            == (tid, sid, False)
+        # malformed headers are rejected, not half-parsed
+        for bad in ("", "00-xyz", f"00-{tid}-{sid}", f"00-{'0'*32}-{sid}-01",
+                    f"00-{tid}-{'0'*16}-01", "zz-" + hdr[3:]):
+            assert parse_traceparent(bad) is None, bad
+
+    def test_sampling_determinism(self):
+        lo = "00000000" + "a" * 24   # prefix 0 -> always sampled
+        hi = "ffffffff" + "a" * 24   # prefix max -> sampled only at 1.0
+        assert sample_decision(lo, 0.01) is True
+        assert sample_decision(hi, 0.99) is False
+        assert sample_decision(hi, 1.0) is True
+        mid = "80000000" + "a" * 24  # exactly 0.5 of the id space
+        assert sample_decision(mid, 0.5) is False
+        assert sample_decision(mid, 0.51) is True
+        # the decision is a pure function of (trace_id, rate): client and
+        # server reach the same verdict with no coordination
+        for rate in (0.0, 0.25, 0.5, 1.0):
+            for tid in (lo, hi, mid):
+                assert sample_decision(tid, rate) \
+                    == sample_decision(tid, rate)
+
+    def test_span_tree_and_ring_bound(self):
+        tr = Tracer(sample_rate=1.0, max_spans=5)
+        with tr.start_span("root", attrs={"k": 1}) as root:
+            child = root.child("child", x=2)
+            child.event("tick", i=0)
+            child.end(status="ok")
+        spans = tr.spans()
+        assert [s["name"] for s in spans] == ["child", "root"]
+        c, r = spans
+        assert c["trace_id"] == r["trace_id"]
+        assert c["parent_id"] == r["span_id"]
+        assert c["attrs"]["status"] == "ok" and c["attrs"]["x"] == 2
+        assert c["events"][0]["name"] == "tick"
+        # bounded ring: only the newest max_spans survive
+        for i in range(12):
+            tr.start_span(f"s{i}").end()
+        assert len(tr.spans()) == 5
+        assert tr.spans()[-1]["name"] == "s11"
+
+    def test_unsampled_paths(self):
+        assert not Tracer(sample_rate=0.0).enabled
+        assert isinstance(Tracer(sample_rate=0.0).start_span("x"), NullSpan)
+        tr = Tracer(sample_rate=1.0, max_spans=16)
+        # an incoming UNsampled traceparent wins over the local rate
+        hdr = format_traceparent("ab" * 16, "cd" * 8, False)
+        sp = tr.start_span("x", traceparent=hdr)
+        assert isinstance(sp, NullSpan) and not sp.sampled
+        # ...and still propagates trace identity downstream (flag 00)
+        assert sp.traceparent is not None
+        assert sp.traceparent.startswith("00-" + "ab" * 16)
+        assert sp.traceparent.endswith("-00")
+        sp.event("ignored")
+        assert sp.child("y") is sp
+        sp.end()
+        assert tr.spans() == []
+        # an incoming SAMPLED traceparent is adopted
+        sp2 = tr.start_span("x", traceparent=format_traceparent(
+            "ef" * 16, "12" * 8, True))
+        assert isinstance(sp2, Span)
+        assert sp2.trace_id == "ef" * 16 and sp2.parent_id == "12" * 8
+        sp2.end()
+
+    def test_chrome_trace_export(self):
+        tr = Tracer(sample_rate=1.0, max_spans=16)
+        with tr.start_span("req") as root:
+            ch = root.child("phase")
+            ch.event("tok")
+            ch.end()
+        doc = tr.chrome_trace()
+        evts = doc["traceEvents"]
+        kinds = {e["ph"] for e in evts}
+        assert kinds == {"X", "i"}
+        xs = [e for e in evts if e["ph"] == "X"]
+        assert {e["name"] for e in xs} == {"req", "phase"}
+        for e in xs:
+            assert e["dur"] >= 0 and "ts" in e and "pid" in e
+        # perfetto-loadable == valid JSON document
+        json.loads(json.dumps(doc))
+
+
+# ---------------------------------------------------------------------------
+# serving span trees (client -> server -> generation engine)
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def gen_server():
+    import paddle_tpu as paddle
+    from paddle_tpu.models.gpt import GPTConfig, GPTForCausalLM
+    from paddle_tpu.serving.generation import GenerationEngine
+    from paddle_tpu.serving.server import ServingServer
+
+    paddle.seed(0)
+    cfg = GPTConfig(vocab_size=97, hidden_size=32, num_layers=2,
+                    num_heads=2, max_position_embeddings=64, dropout=0.0,
+                    attn_dropout=0.0)
+    model = GPTForCausalLM(cfg)
+    model.eval()
+    eng = GenerationEngine(model, max_slots=2, max_seq_len=32,
+                           prompt_buckets="8")
+    srv = ServingServer(None, gen_engine=eng,
+                        install_signal_handlers=False).start()
+    yield srv
+    srv.shutdown()
+
+
+class TestServingTrace:
+    def _tree(self, tracer, trace_id):
+        return {s["name"]: s for s in tracer.spans(trace_id=trace_id)}
+
+    def test_blocking_generate_tree(self, tracer_on, gen_server):
+        from paddle_tpu.serving.client import ServingClient
+
+        client = ServingClient(gen_server.url)
+        out = client.generate([1, 2, 3, 4], max_new_tokens=5)
+        assert len(out["tokens"]) >= 1
+        trace_id = client.last_traceparent.split("-")[1]
+        by = self._tree(tracer_on, trace_id)
+        assert {"client.generate", "server.generate", "gen.queued",
+                "gen.prefill", "gen.decode"} <= set(by)
+        # parentage: engine children hang off the server span, which
+        # hangs off the client root
+        assert by["server.generate"]["parent_id"] \
+            == by["client.generate"]["span_id"]
+        for child in ("gen.queued", "gen.prefill", "gen.decode"):
+            assert by[child]["parent_id"] == by["server.generate"]["span_id"]
+        # ttft decomposition: the queue/prefill/decode children are all
+        # inside (and together bounded by) the request wall time
+        total = sum(by[c]["dur_ms"] for c in
+                    ("gen.queued", "gen.prefill", "gen.decode"))
+        assert 0 < total <= by["client.generate"]["dur_ms"] * 1.05
+        assert by["gen.decode"]["events"], "per-token events missing"
+        assert by["server.generate"]["attrs"]["tokens"] == 5
+
+    def test_streaming_generate_tree(self, tracer_on, gen_server):
+        from paddle_tpu.serving.client import ServingClient
+
+        client = ServingClient(gen_server.url)
+        events = list(client.generate_stream([5, 6, 7], max_new_tokens=4))
+        assert events[-1].get("done")
+        trace_id = client.last_traceparent.split("-")[1]
+        by = self._tree(tracer_on, trace_id)
+        assert {"client.generate_stream", "server.generate", "gen.queued",
+                "gen.prefill", "gen.decode"} <= set(by)
+        ntok = sum(1 for e in events if "token" in e)
+        assert by["client.generate_stream"]["attrs"]["tokens"] == ntok
+        assert [e["name"] for e in
+                by["client.generate_stream"]["events"]] == ["first_token"]
+
+    def test_explicit_traceparent_joins_trace(self, tracer_on, gen_server):
+        from paddle_tpu.serving.client import ServingClient
+
+        tid = "ab" * 16
+        hdr = format_traceparent(tid, "cd" * 8, True)
+        client = ServingClient(gen_server.url)
+        client.generate([9, 8, 7], max_new_tokens=2, traceparent=hdr)
+        assert client.last_traceparent == hdr  # forwarded as-is
+        by = self._tree(tracer_on, tid)
+        # no client-side root: the caller owns that span; the server
+        # adopted the incoming identity for its whole subtree
+        assert "client.generate" not in by
+        assert by["server.generate"]["parent_id"] == "cd" * 8
+        assert {"gen.queued", "gen.prefill", "gen.decode"} <= set(by)
+
+    def test_unsampled_rate_produces_no_spans(self, gen_server):
+        import paddle_tpu.monitor as monitor
+        from paddle_tpu.serving.client import ServingClient
+
+        old = _flags.flag("FLAGS_trace_sample_rate")
+        _flags.set_flags({"FLAGS_trace_sample_rate": 0.0})
+        monitor.reset()
+        try:
+            client = ServingClient(gen_server.url)
+            out = client.generate([1, 2, 3], max_new_tokens=2)
+            assert len(out["tokens"]) >= 1
+            assert client.last_traceparent is None
+            assert tracing.default_tracer().spans() == []
+        finally:
+            _flags.set_flags({"FLAGS_trace_sample_rate": old})
+            monitor.reset()
+
+    def test_healthz_enriched(self, gen_server):
+        from paddle_tpu.serving.client import ServingClient
+
+        h = ServingClient(gen_server.url).healthz()
+        assert h["status"] == "ok" and h["pid"] == os.getpid()
+        assert h["device_count"] >= 1 and "jax_version" in h
+        assert "version" in h and h["uptime_s"] >= 0.0
+
+
+# ---------------------------------------------------------------------------
+# /debug/spans endpoint
+# ---------------------------------------------------------------------------
+class TestDebugSpans:
+    def _get(self, url):
+        with urllib.request.urlopen(url, timeout=5) as r:
+            return json.loads(r.read())
+
+    def test_endpoint_json_and_chrome(self, tracer_on):
+        from paddle_tpu.monitor import MonitorServer
+
+        with tracer_on.start_span("req") as root:
+            root.child("phase").end()
+        with MonitorServer(port=0) as srv:
+            doc = self._get(srv.url + "/debug/spans")
+            assert doc["sample_rate"] == 1.0
+            assert doc["count"] == len(doc["spans"]) == 2
+            tid = doc["spans"][0]["trace_id"]
+            one = self._get(f"{srv.url}/debug/spans?trace_id={tid}&limit=1")
+            assert one["count"] == 1
+            chrome = self._get(srv.url + "/debug/spans?format=chrome")
+            assert {e["ph"] for e in chrome["traceEvents"]} == {"X"}
+            h = self._get(srv.url + "/healthz")
+            assert h["pid"] == os.getpid() and "version" in h
+
+
+# ---------------------------------------------------------------------------
+# flight recorder
+# ---------------------------------------------------------------------------
+class TestFlightRecorder:
+    def test_ring_bound_and_dump(self, tmp_path):
+        from paddle_tpu.monitor.flightrec import FlightRecorder
+
+        rec = FlightRecorder(directory=str(tmp_path), max_records=5)
+        for i in range(12):
+            rec.record("tick", i=i)
+        assert len(rec) == 5
+        assert [r["i"] for r in rec.records("tick")] == list(range(7, 12))
+        path = rec.dump("test", extra={"note": "x"})
+        doc = json.loads(open(path).read())
+        assert doc["version"] == 1 and doc["reason"] == "test"
+        assert doc["pid"] == os.getpid() and doc["note"] == "x"
+        assert len(doc["records"]) == 5
+        assert set(doc["accounting"]) == {"wall_s", "train_s", "compile_s",
+                                          "ckpt_stall_s"}
+        assert rec.dumped_reason == "test"
+
+    def test_span_listener_mirrors_into_ring(self, tmp_path):
+        from paddle_tpu.monitor.flightrec import FlightRecorder
+
+        rec = FlightRecorder(directory=str(tmp_path), max_records=8)
+        tr = Tracer(sample_rate=1.0, max_spans=8)
+        tr.add_listener(rec.on_span)
+        tr.start_span("serve.request", attrs={"a": 1}).end(status="ok")
+        spans = rec.records("span")
+        assert len(spans) == 1
+        assert spans[0]["name"] == "serve.request"
+        assert spans[0]["attrs"]["status"] == "ok"
+
+    def _run_trainer(self, tmp_path, chaos_env, watchdog=None,
+                     timeout=120):
+        script = f"""
+import sys
+from paddle_tpu.monitor import flightrec
+from paddle_tpu.distributed.resilience import ResilientRunner
+
+flightrec.configure({str(tmp_path)!r})
+flightrec.install_hooks()
+
+def step(i, s):
+    flightrec.record("step", step=i)
+    return s, 0.1
+
+runner = ResilientRunner(watchdog_timeout={watchdog!r})
+runner.run(step, {{}}, num_steps=10)
+"""
+        env = cpu_subprocess_env()
+        env.update(chaos_env)
+        return subprocess.run([sys.executable, "-c", script], env=env,
+                              capture_output=True, text=True,
+                              timeout=timeout)
+
+    def _the_dump(self, tmp_path):
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("flightrec-") and p.endswith(".json")]
+        assert len(dumps) == 1, dumps
+        return json.loads(open(os.path.join(tmp_path, dumps[0])).read())
+
+    @pytest.mark.chaos
+    def test_watchdog_exit_86_leaves_dump(self, tmp_path):
+        r = self._run_trainer(
+            tmp_path, {"PADDLE_CHAOS_SLOW_STEP": "3",
+                       "PADDLE_CHAOS_SLOW_SECONDS": "30"}, watchdog=0.5)
+        assert r.returncode == 86, r.stderr[-2000:]
+        doc = self._the_dump(tmp_path)
+        assert doc["reason"] == "watchdog"
+        # the ring shows training progressed up to the stalled step
+        # (chaos stalls at the step-3 boundary, before step_fn runs)
+        assert [x["step"] for x in doc["records"]
+                if x["kind"] == "step"] == [1, 2]
+        assert any(x["kind"] == "watchdog" for x in doc["records"])
+
+    @pytest.mark.chaos
+    def test_sigterm_preemption_leaves_dump(self, tmp_path):
+        r = self._run_trainer(
+            tmp_path, {"PADDLE_CHAOS_PREEMPT_STEP": "2"}, watchdog=None)
+        assert r.returncode == 75, r.stderr[-2000:]
+        doc = self._the_dump(tmp_path)
+        assert doc["reason"] == "preempt"
+        assert any(x["kind"] == "preempt" for x in doc["records"])
+
+
+# ---------------------------------------------------------------------------
+# goodput ledger
+# ---------------------------------------------------------------------------
+class TestGoodputLedger:
+    def _dump(self, d, name, train, compile_s=0.0, stall=0.0):
+        os.makedirs(d, exist_ok=True)
+        with open(os.path.join(d, name), "w") as f:
+            json.dump({"accounting": {
+                "wall_s": train + compile_s + stall, "train_s": train,
+                "compile_s": compile_s, "ckpt_stall_s": stall}}, f)
+
+    def test_aggregation_and_ratio(self, tmp_path):
+        from paddle_tpu.distributed.goodput import GoodputLedger
+        from paddle_tpu.utils.metrics import MetricsRegistry
+
+        self._dump(str(tmp_path / "rank0"), "flightrec-11.json",
+                   train=6.0, compile_s=2.0, stall=1.0)
+        self._dump(str(tmp_path / "rank1"), "flightrec-22.json", train=3.0)
+        reg = MetricsRegistry()
+        led = GoodputLedger(str(tmp_path), registry=reg)
+        led.add_backoff(2.0)
+        led.add_down(1.0)
+        t = led.publish()
+        assert t == {"productive_train": 9.0, "compile": 2.0,
+                     "ckpt_stall": 1.0, "restart_backoff": 2.0,
+                     "down": 1.0}
+        assert abs(led.ratio() - 9.0 / 15.0) < 1e-9
+        # re-publish must not double-count (path+mtime keyed)
+        led.publish()
+        assert led.totals()["productive_train"] == 9.0
+        text = reg.prometheus_text()
+        assert 'paddle_badput_seconds_total{reason="compile"} 2' in text
+        assert "paddle_goodput_ratio" in text
+
+    def test_jsonl_fallback_for_sigkilled_rank(self, tmp_path):
+        from paddle_tpu.distributed.goodput import GoodputLedger
+
+        # rank0 dumped; rank1 was SIGKILLed — only its event log remains
+        self._dump(str(tmp_path / "rank0"), "flightrec-11.json", train=4.0)
+        os.makedirs(tmp_path / "rank1")
+        with open(tmp_path / "rank1" / "events.jsonl", "w") as f:
+            f.write(json.dumps({"event": "fit_begin"}) + "\n")
+            f.write(json.dumps({"event": "window", "wall_s": 2.5}) + "\n")
+            f.write(json.dumps({"event": "window", "wall_s": 1.5}) + "\n")
+        led = GoodputLedger(str(tmp_path))
+        led.ingest()
+        assert led.totals()["productive_train"] == 8.0
+        # a dump appearing later SUPERSEDES nothing (separate files), but
+        # a rank dir WITH a dump never double-reads its JSONL
+        self._dump(str(tmp_path / "rank1"), "flightrec-22.json", train=5.0)
+        led2 = GoodputLedger(str(tmp_path))
+        led2.ingest()
+        assert led2.totals()["productive_train"] == 9.0
+
+    def test_counter_stays_monotonic(self, tmp_path):
+        from paddle_tpu.distributed.goodput import GoodputLedger
+        from paddle_tpu.utils.metrics import MetricsRegistry
+
+        reg = MetricsRegistry()
+        led = GoodputLedger(str(tmp_path), registry=reg)
+        led.add_backoff(1.5)
+        led.publish()
+        c = reg.get("paddle_badput_seconds_total")
+        assert c.get("restart_backoff") == 1.5
+        led.publish()   # no growth -> no increment
+        assert c.get("restart_backoff") == 1.5
+        led.add_backoff(0.5)
+        led.publish()
+        assert c.get("restart_backoff") == 2.0
+
+
+# ---------------------------------------------------------------------------
+# training spans (fit bridged through the tracer)
+# ---------------------------------------------------------------------------
+class TestTrainingSpans:
+    def test_fit_emits_span_tree(self, tracer_on, tmp_path):
+        import paddle_tpu as paddle
+
+        _flags.set_flags({"FLAGS_telemetry_dir": str(tmp_path)})
+        import paddle_tpu.monitor as monitor
+        monitor.reset()
+        try:
+            net = paddle.nn.Linear(4, 2)
+            model = paddle.Model(net)
+            model.prepare(
+                paddle.optimizer.SGD(learning_rate=0.1,
+                                     parameters=net.parameters()),
+                paddle.nn.CrossEntropyLoss())
+            x = np.random.randn(16, 4).astype("float32")
+            y = np.random.randint(0, 2, (16, 1))
+            ds = paddle.io.TensorDataset([x, y])
+            model.fit(ds, batch_size=8, epochs=2, verbose=0)
+            spans = tracing.default_tracer().spans()
+            names = [s["name"] for s in spans]
+            assert names.count("train.fit") == 1
+            assert names.count("train.epoch") == 2
+            assert names.count("train.step") == 4
+            fit = next(s for s in spans if s["name"] == "train.fit")
+            assert fit["attrs"]["status"] == "ok"
+            assert fit["attrs"]["it"] == 4
+            for s in spans:
+                if s["name"] != "train.fit":
+                    assert s["trace_id"] == fit["trace_id"]
+            # spans mirrored into the flight-recorder ring
+            from paddle_tpu.monitor import flightrec
+            rec = flightrec.get_recorder()
+            assert rec is not None
+            assert any(r["name"] == "train.fit"
+                       for r in rec.records("span"))
+        finally:
+            _flags.set_flags({"FLAGS_telemetry_dir": ""})
+            monitor.reset()
