@@ -7,6 +7,8 @@ Rule catalog (the incident each rule encodes is in its module docstring):
   PTA004 divergent-collective     per-process gates before collectives
   PTA005 host-sync-in-hot-path    implicit device→host syncs in step code
   PTA006 flags-registry-hygiene   undeclared FLAGS_* reads, print() in libs
+  PTA007 metric-name-hygiene      paddle_ namespace, unit suffixes, one
+                                  name = one kind across registries
 """
 from . import (  # noqa: F401
     donation,
@@ -15,4 +17,5 @@ from . import (  # noqa: F401
     collective_gate,
     host_sync,
     flags_hygiene,
+    metric_names,
 )

@@ -1,0 +1,122 @@
+"""PTA007: metric-name hygiene across the shared registries.
+
+The Prometheus exposition and the bench/gate pipeline key on metric
+NAMES: a name outside the `paddle_` namespace never shows up in the
+federated scrape config, a histogram without a unit suffix is ambiguous
+at the dashboard (`paddle_serving_batch_size` — requests? sequences?
+bytes?), and two call sites registering one name as different kinds get
+whichever object registered first (get-or-create) and crash far from
+the typo.  Three invariants over every `counter/gauge/histogram/
+reservoir(name, ...)` registration whose name argument is a string
+literal or f-string:
+
+  * names match ``^paddle_[a-z0-9_]+$`` (f-string placeholders are
+    substituted with a well-formed dummy, so only the LITERAL parts are
+    judged);
+  * histogram/reservoir names carry a unit suffix
+    (``_ms|_s|_bytes|_ratio|_total``) — these render/aggregate as
+    distributions, where the unit is the difference between a latency
+    and a count;
+  * one name, one kind: conflicting kinds for the same rendered name
+    anywhere in the tree is a finding on the later site.  Reservoirs
+    are keyed separately from rendered metrics (``_reservoirs`` dict in
+    utils/metrics.py), so `histogram("x_ms")` + `reservoir("x_ms")` is
+    legal and common.
+"""
+from __future__ import annotations
+
+import ast
+import re
+
+from ..astutil import call_name
+from ..core import Checker, Finding, register
+
+NAME_RE = re.compile(r"^paddle_[a-z0-9_]+$")
+UNIT_SUFFIXES = ("_ms", "_s", "_bytes", "_ratio", "_total")
+_METHODS = {"counter", "gauge", "histogram", "reservoir"}
+
+
+def _literal_name(node):
+    """The metric-name string for a Constant or f-string first argument,
+    with each formatted placeholder replaced by the dummy segment ``x``
+    (well-formed, so only literal text can fail the regex).  None for
+    anything dynamic (a variable name is out of static reach — the
+    runtime kind check in utils/metrics.py covers those)."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    if isinstance(node, ast.JoinedStr):
+        parts = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                parts.append(v.value)
+            else:
+                parts.append("x")
+        return "".join(parts)
+    return None
+
+
+@register
+class MetricNameHygiene(Checker):
+    rule = "PTA007"
+    name = "metric-name-hygiene"
+    description = ("metric registered outside the paddle_ namespace, "
+                   "distribution metric without a unit suffix, or one "
+                   "name registered as two kinds")
+    incident = ("paddle_serving_batch_size renders bucket bounds with "
+                "no unit — a dashboard can't tell sequences from "
+                "tokens; grandfathered rather than renamed because "
+                "scrape configs already key on it")
+
+    def check_project(self, ctx):
+        # rendered-metric namespace only — reservoirs live in their own
+        # dict and may share a name with a histogram
+        first_kind: dict[str, tuple[str, str, int]] = {}
+        for pf in ctx.iter_python():
+            if pf.tree is None:
+                continue
+            for node in ast.walk(pf.tree):
+                if not isinstance(node, ast.Call) or not node.args:
+                    continue
+                kind = (call_name(node) or "").rsplit(".", 1)[-1]
+                if kind not in _METHODS:
+                    continue
+                name = _literal_name(node.args[0])
+                if name is None or not name.startswith("paddle"):
+                    # non-string first args are numpy/jnp histogram()
+                    # etc.; non-paddle string args are other APIs —
+                    # the namespace rule below only fires on names that
+                    # were trying to be metrics
+                    continue
+                if not NAME_RE.match(name):
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        f"metric name `{name}` does not match "
+                        "^paddle_[a-z0-9_]+$ — lowercase, underscores, "
+                        "paddle_ namespace",
+                        pf.line_text(node.lineno))
+                    continue
+                if kind in ("histogram", "reservoir") and \
+                        not name.endswith(UNIT_SUFFIXES):
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        f"{kind} `{name}` has no unit suffix — "
+                        "distribution metrics must end in one of "
+                        f"{'/'.join(UNIT_SUFFIXES)} so dashboards "
+                        "know what they aggregate",
+                        pf.line_text(node.lineno))
+                if kind == "reservoir":
+                    continue
+                prev = first_kind.get(name)
+                if prev is None:
+                    first_kind[name] = (kind, pf.relpath, node.lineno)
+                elif prev[0] != kind:
+                    yield Finding(
+                        self.rule, pf.relpath, node.lineno,
+                        node.col_offset,
+                        f"metric `{name}` registered as {kind} here "
+                        f"but as {prev[0]} at {prev[1]}:{prev[2]} — "
+                        "get-or-create returns the first kind and the "
+                        "second site breaks at record time",
+                        pf.line_text(node.lineno))

@@ -96,6 +96,14 @@ define_flag("FLAGS_device_peak_flops", 0.0,
             "per-device peak FLOP/s for the MFU gauge; 0 = look the "
             "device kind up in monitor.PEAK_FLOPS (TPU generations + a "
             "nominal CPU entry so smoke runs read a nonzero MFU)")
+define_flag("FLAGS_device_peak_bw", 0.0,
+            "per-device HBM bytes/s for the op-table roofline "
+            "(monitor/perf.py); 0 = look the device kind up in "
+            "perf.PEAK_BW (TPU generations + a nominal CPU entry)")
+define_flag("FLAGS_perf_ops_top", 48,
+            "op-table rows kept before rolling the tail into one "
+            "'(other)' row (sums stay exact); /debug/perf and "
+            "engine.op_report() share this bound")
 define_flag("FLAGS_trace_steps", 3,
             "how many steps a SIGUSR1-armed jax.profiler capture spans "
             "(the headless /debug/trace?steps=N equivalent)")

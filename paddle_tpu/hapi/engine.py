@@ -224,6 +224,9 @@ class TrainEngine:
         self._step_key = None  # (mesh, rule) the cached jit was built for
         self._cost_cache = None  # cost_analysis of the live _step_fn
         self._cost_cache_fn = None
+        self._compiled_cache = None  # AOT-compiled step (op_report)
+        self._example_batch = None   # last (inputs, labels) seen by
+        # step_cost_analysis — lets op_report() run without a batch
         self._layout = None
         self._recompute = None
         self._accum = 1
@@ -658,6 +661,7 @@ class TrainEngine:
         that hits the persistent compilation cache — same HLO the jit
         path just built).  Returns {} when the backend reports
         nothing."""
+        self._example_batch = (inputs, labels)
         if self._cost_cache is not None \
                 and self._cost_cache_fn is self._step_fn:
             return dict(self._cost_cache)
@@ -666,7 +670,43 @@ class TrainEngine:
         ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
         self._cost_cache = dict(ca) if ca else {}
         self._cost_cache_fn = self._step_fn
+        self._compiled_cache = compiled
         return dict(self._cost_cache)
+
+    def op_report(self, inputs=None, labels=None, *,
+                  measured_step_ms=None, trace_dir=None):
+        """Per-op attribution of the compiled train step
+        (monitor/perf.py): analytic flops/bytes per entry HLO
+        instruction joined with measured times from a bounded profiler
+        capture (``trace_dir``), or — absent a capture — the measured
+        step wall (``measured_step_ms``, defaulting to the telemetry
+        reservoir's p50) attributed by roofline share.  Reuses the
+        AOT-compiled executable step_cost_analysis() built; never
+        consumes a donation.  With no arguments, lowers against the
+        last batch step_cost_analysis() saw."""
+        if inputs is None:
+            if self._example_batch is None:
+                raise ValueError(
+                    "op_report() without a batch needs a prior "
+                    "step_cost_analysis()/op_report(inputs, labels)")
+            inputs, labels = self._example_batch
+        ca = self.step_cost_analysis(inputs, labels)
+        compiled = self._compiled_cache
+        if compiled is None or self._cost_cache_fn is not self._step_fn:
+            compiled = self.lower_step(inputs, labels).compile()
+            self._compiled_cache = compiled
+        if measured_step_ms is None:
+            from ..utils.metrics import default_registry
+
+            q = default_registry().reservoir(
+                "paddle_train_step_ms").quantile(0.5)
+            measured_step_ms = q if q > 0 else None
+        from ..monitor import perf as _perf
+
+        return _perf.build_report(compiled, name="train",
+                                  cost_analysis=ca,
+                                  measured_step_ms=measured_step_ms,
+                                  trace_dir=trace_dir)
 
     def drain(self):
         """Batched fetch of every pending loss (the sanctioned sync)."""

@@ -792,6 +792,21 @@ class Model:
                     break
             fit_ok = True
         finally:
+            if not fit_ok:
+                # OOM postmortem BEFORE the engine unwinds: the census
+                # must see the allocations that were resident when the
+                # step failed.  Covers callers that catch the exception
+                # themselves (the crash excepthook never fires then)
+                try:
+                    import sys as _sys
+
+                    from ..monitor import perf as _perf
+
+                    _exc = _sys.exc_info()[1]
+                    if _perf.is_oom(_exc):
+                        _perf.oom_postmortem(_exc)
+                except Exception:  # noqa: BLE001 - never mask the error
+                    pass
             # final write-back: the engine's device-resident state becomes
             # the Layer tree's state again (single source of truth for
             # train_batch/save/parameters after fit returns) — even when
@@ -883,6 +898,38 @@ class Model:
         now = time.perf_counter()
         telem.ensure_flops(
             lambda: engine.step_cost_analysis(inputs, labels))
+        from ..monitor import perf as _perf
+
+        # publish introspection surfaces against the live engine: the
+        # op table over /debug/perf (re-registered each window so the
+        # provider always lowers against a current batch) and owner
+        # tags so the buffer census can split params/opt state/buffers
+        # from activations.  engine.finish() drops the device state at
+        # fit exit (write-back rebinds the buffers into the Layer tree
+        # and model._opt_state), so each supplier falls back there —
+        # a census scraped between fits still claims the weights.
+        network, model_obj = self.network, self
+        _perf.register_provider(
+            "train", lambda: engine.op_report(inputs, labels))
+
+        def _own_params():
+            if engine.state is not None:
+                return (engine.state["trainable"], engine.state["frozen"])
+            return [p.value for p in network.parameters()]
+
+        def _own_opt():
+            if engine.state is not None:
+                return engine.state["opt"]
+            return model_obj._opt_state
+
+        def _own_buffers():
+            if engine.state is not None:
+                return engine.state["buffers"]
+            return [getattr(b, "value", None) for b in network.buffers()]
+
+        _perf.register_owner("params", _own_params)
+        _perf.register_owner("opt_state", _own_opt)
+        _perf.register_owner("buffers", _own_buffers)
         deltas = {
             name: (timers.totals.get(name, 0.0)
                    - win_totals.get(name, 0.0),

@@ -23,7 +23,7 @@ import threading
 
 from ..framework import flags as _flags
 from ..utils.metrics import default_registry
-from . import flightrec, tracing
+from . import flightrec, perf, tracing
 from .flightrec import FlightRecorder
 from .server import MonitorServer, runtime_health
 from .telemetry import (PEAK_FLOPS, JsonlWriter, TrainTelemetry,
@@ -36,9 +36,10 @@ logger = logging.getLogger("paddle_tpu.monitor")
 __all__ = ["TrainTelemetry", "MonitorServer", "JsonlWriter", "PEAK_FLOPS",
            "peak_flops_per_device", "device_memory_stats",
            "install_sigusr1", "default_registry", "fit_monitor",
-           "get_monitor_server", "reset", "runtime_health",
+           "get_monitor_server", "get_telemetry", "reset",
+           "runtime_health",
            "Tracer", "Span", "NullSpan", "default_tracer",
-           "FlightRecorder", "tracing", "flightrec"]
+           "FlightRecorder", "tracing", "flightrec", "perf"]
 
 _lock = threading.Lock()
 _telemetry: TrainTelemetry | None = None
@@ -68,6 +69,7 @@ def fit_monitor():
                 # excepthook/atexit hooks leave a postmortem dump
                 rec = flightrec.configure(tdir)
                 flightrec.install_hooks()
+                perf.install_oom_hook()
                 default_tracer().add_listener(rec.on_span)
         if _server is None and port >= 0:
             try:
@@ -87,6 +89,13 @@ def get_monitor_server():
     return _server
 
 
+def get_telemetry():
+    """The live TrainTelemetry, or None when no monitored fit has
+    started — existence check only, never creates (fit_monitor
+    does)."""
+    return _telemetry
+
+
 def reset():
     """Tear down the process singletons (tests)."""
     global _telemetry, _server
@@ -99,3 +108,4 @@ def reset():
             _telemetry = None
     tracing.reset()
     flightrec.reset()
+    perf.reset()

@@ -163,6 +163,19 @@ _recorder: FlightRecorder | None = None
 _lock = threading.Lock()
 _hooks_installed = False
 _prev_excepthook = None
+_enrichers: list = []
+
+
+def add_enricher(fn):
+    """Register a crash-dump enricher: ``fn(exc_type, exc)`` returning
+    None (not interested) or ``{"reason": str, "extra": dict}`` merged
+    into the crash dump — how monitor.perf turns a RESOURCE_EXHAUSTED
+    crash into an "oom" dump carrying the buffer census.  Enrichers run
+    inside the excepthook's try, on the crashing thread; this module
+    stays jax-free, the callable may not be.  Idempotent per
+    function."""
+    if fn not in _enrichers:
+        _enrichers.append(fn)
 
 
 def configure(directory: str = None, max_records: int = None) \
@@ -221,10 +234,19 @@ def _excepthook(exc_type, exc, tb):
             frames = traceback.format_exception(exc_type, exc, tb)
             r.record("exception", type=exc_type.__name__,
                      msg=str(exc)[:500])
-            r.dump("crash", extra={"exception": {
+            reason, extra = "crash", {"exception": {
                 "type": exc_type.__name__,
                 "msg": str(exc)[:500],
-                "traceback": frames[-30:]}})
+                "traceback": frames[-30:]}}
+            for fn in list(_enrichers):
+                try:
+                    out = fn(exc_type, exc)
+                except Exception:  # noqa: BLE001 - enrichment is optional
+                    continue
+                if out:
+                    reason = out.get("reason", reason)
+                    extra.update(out.get("extra", {}))
+            r.dump(reason, extra=extra)
         except Exception:  # noqa: BLE001 - never mask the real crash
             pass
     if _prev_excepthook is not None:
@@ -259,8 +281,9 @@ def install_hooks():
 
 
 def reset():
-    """Drop the process recorder (tests).  Installed hooks stay but
-    no-op while the recorder is None."""
+    """Drop the process recorder and enrichers (tests).  Installed
+    hooks stay but no-op while the recorder is None."""
     global _recorder
     with _lock:
         _recorder = None
+        del _enrichers[:]

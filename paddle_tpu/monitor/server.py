@@ -127,6 +127,28 @@ class _Handler(BaseHTTPRequestHandler):
                 return
             tdir = telem.arm_trace(steps)
             self._send_json(200, {"armed_steps": steps, "trace_dir": tdir})
+        elif parsed.path == "/debug/perf":
+            from . import perf as _perf
+
+            q = urllib.parse.parse_qs(parsed.query)
+            names = q.get("name") or None
+            reports = _perf.collect_reports(names=names)
+            if (q.get("format", [""])[0] or "").lower() == "chrome":
+                # one perfetto document: request/fit spans (the
+                # tracer's export) + a synthetic "device ops" process
+                # carrying each report's op timeline
+                self._send_json(200, _perf.chrome_document(
+                    reports, base=owner.tracer.chrome_trace()))
+                return
+            try:
+                census = _perf.buffer_census()
+            except Exception as e:  # noqa: BLE001 - census is best-effort
+                census = {"error": f"{type(e).__name__}: {e}"}
+            self._send_json(200, {
+                "providers": sorted(reports),
+                "reports": reports,
+                "census": census,
+                "hbm": _perf.hbm_stats()})
         elif parsed.path == "/debug/spans":
             q = urllib.parse.parse_qs(parsed.query)
             trace_id = (q.get("trace_id", [None])[0] or None)

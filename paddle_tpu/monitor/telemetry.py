@@ -231,6 +231,16 @@ class TrainTelemetry:
             "paddle_train_device_mem_in_use_mb",
             "device bytes in use (MB); 0 when the backend has no "
             "memory stats")
+        self.g_hbm_in_use = reg.gauge(
+            "paddle_hbm_in_use_bytes",
+            "device bytes in use at the last per-step sample (PJRT "
+            "memory_stats); 0 when the backend has no memory stats")
+        self.g_hbm_watermark = reg.gauge(
+            "paddle_hbm_watermark_bytes",
+            "high-watermark of device peak bytes in use across the "
+            "whole run (sampled every step on the training thread)")
+        self._hbm_watermark = 0
+        self._hbm_unavailable = False
         self.h_step = reg.histogram(
             "paddle_train_step_ms", "per-step wall time (training-thread "
             "enqueue-to-enqueue; device execution overlaps under the "
@@ -356,8 +366,26 @@ class TrainTelemetry:
         if self._last_mark is None:
             self._last_mark = time.perf_counter()
 
+    def sample_hbm(self):
+        """Per-step HBM watermark sample (training thread): one local
+        PJRT memory_stats read — no device sync.  Backends without
+        stats (CPU) disable the sampler after the first None so the hot
+        loop doesn't pay the probe every step."""
+        if self._hbm_unavailable:
+            return
+        mem = device_memory_stats()
+        if mem is None:
+            self._hbm_unavailable = True
+            return
+        self.g_hbm_in_use.set(int(mem.get("bytes_in_use", 0)))
+        peak = int(mem.get("peak_bytes_in_use", 0))
+        if peak > self._hbm_watermark:
+            self._hbm_watermark = peak
+            self.g_hbm_watermark.set(peak)
+
     def step_mark(self):
         now = time.perf_counter()
+        self.sample_hbm()
         if self._last_mark is not None:
             dt_ms = (now - self._last_mark) * 1e3
             self._steps_marked += 1

@@ -393,11 +393,42 @@ class GenerationEngine:
             self.max_slots, self.max_seq_len, self.prompt_buckets,
             self.geometry.kv_bytes() / 1048576)
 
+        # publish introspection surfaces (monitor/perf.py): the decode
+        # op table over /debug/perf, and owner tags so the buffer
+        # census attributes the KV cache and weights ("latest engine
+        # wins" — one process, one serving engine in practice)
+        from ..monitor import perf as _perf
+
+        _perf.register_provider("decode", self.op_report)
+        _perf.register_owner("params", lambda: self._params)
+        _perf.register_owner("kv_pages", lambda: self._state)
+
         self._started = True
         self._thread = threading.Thread(target=self._run, daemon=True,
                                         name="paddle-genserve-decode")
         self._thread.start()
         return self
+
+    def op_report(self, *, measured_step_ms=None, trace_dir=None):
+        """Per-op attribution of the AOT-compiled decode step
+        (monitor/perf.py).  Measured time defaults to the inter-token
+        p50 — in steady state one decode iteration IS the inter-token
+        gap.  Reads only the compiled executable's HLO; never touches
+        the live (donated) decode state."""
+        if self._decode_exec is None:
+            raise RuntimeError("op_report() before start()")
+        ca = self._decode_exec.cost_analysis()
+        ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
+        if measured_step_ms is None:
+            gaps = sorted(self.metrics._gaps)
+            if gaps:
+                measured_step_ms = gaps[len(gaps) // 2] * 1e3
+        from ..monitor import perf as _perf
+
+        return _perf.build_report(self._decode_exec, name="decode",
+                                  cost_analysis=dict(ca),
+                                  measured_step_ms=measured_step_ms,
+                                  trace_dir=trace_dir)
 
     # -- request intake ----------------------------------------------------
     def _bucket_for(self, n: int) -> int:
@@ -507,8 +538,19 @@ class GenerationEngine:
                     self._idle.set()
                     if self._draining or self._stopped:
                         return
-        except BaseException:  # pragma: no cover - last-resort: never die
+        except BaseException as e:  # pragma: no cover - last-resort:
+            # never die silently
             logger.exception("generation decode loop crashed")
+            try:
+                from ..monitor import perf as _perf
+
+                if _perf.is_oom(e):
+                    # the decode thread CAUGHT the failure, so the
+                    # crash excepthook will never see it — dump the
+                    # census + op table postmortem here
+                    _perf.oom_postmortem(e)
+            except Exception:  # noqa: BLE001 - never mask the crash
+                pass
             self._stopped = True
             self._fail_everything(EngineStoppedError(
                 "generation decode loop crashed"))

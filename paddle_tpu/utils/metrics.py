@@ -245,10 +245,23 @@ class MetricsRegistry:
         self._reservoirs: dict[str, Reservoir] = {}
 
     # -- registration (get-or-create) --------------------------------------
+    def _existing(self, name: str, kind: str):
+        """Get-or-create guard: a second registration of `name` must ask
+        for the SAME kind — `counter("x")` after `gauge("x")` would hand
+        back a Gauge and fail later at `.inc()`, far from the typo.
+        (PTA007 catches the static cases; this is the runtime
+        complement for dynamically-built names.)"""
+        m = self._metrics.get(name)
+        if m is not None and m.kind != kind:
+            raise ValueError(
+                f"metric {name!r} already registered as {m.kind}, "
+                f"re-requested as {kind}")
+        return m
+
     def counter(self, name: str, help_: str = "", label: str = None,
                 preset=(), fixed: bool = False) -> Counter:
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._existing(name, "counter")
             if m is None:
                 m = Counter(name, help_, self._lock, label=label,
                             preset=preset, fixed=fixed)
@@ -257,7 +270,7 @@ class MetricsRegistry:
 
     def gauge(self, name: str, help_: str = "", fn=None) -> Gauge:
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._existing(name, "gauge")
             if m is None:
                 m = Gauge(name, help_, self._lock, fn=fn)
                 self._metrics[name] = m
@@ -268,7 +281,7 @@ class MetricsRegistry:
     def histogram(self, name: str, help_: str = "", buckets=(1, 10, 100)) \
             -> Histogram:
         with self._lock:
-            m = self._metrics.get(name)
+            m = self._existing(name, "histogram")
             if m is None:
                 m = Histogram(name, help_, buckets, lock=self._lock)
                 self._metrics[name] = m

@@ -85,6 +85,63 @@ class StepTimers:
         }
 
 
+class _BoundedCapture:
+    """Self-driven bounded capture for loops without a TrainTelemetry:
+    the caller IS the dispatching thread, so it brackets its own step
+    loop — ``with`` starts the trace, ``step()`` after each dispatched
+    step counts it down, and the trace stops at zero (or scope exit,
+    whichever first)."""
+
+    def __init__(self, steps: int, out_dir: str):
+        self.steps_left = max(1, int(steps))
+        self.trace_dir = out_dir
+        self._active = False
+
+    def __enter__(self):
+        import os
+
+        os.makedirs(self.trace_dir, exist_ok=True)
+        jax.profiler.start_trace(self.trace_dir)
+        self._active = True
+        return self
+
+    def step(self):
+        if self._active:
+            self.steps_left -= 1
+            if self.steps_left <= 0:
+                self._stop()
+
+    def _stop(self):
+        if self._active:
+            self._active = False
+            jax.profiler.stop_trace()
+
+    def __exit__(self, *exc):
+        self._stop()
+        return False
+
+
+def capture_device_trace(steps: int, out_dir: str, telemetry=None):
+    """Bounded ``jax.profiler`` capture of the next ``steps`` steps.
+
+    With a live monitored fit (a TrainTelemetry — passed explicitly or
+    the process one), the capture is ARMED on it and returns the trace
+    dir: start/stop happen at step boundaries ON the training thread
+    (monitor/telemetry.py arm/poll — jax.profiler must be driven from
+    the dispatching thread), so any thread may call this against a
+    running job.  Without one, returns a ``_BoundedCapture`` context
+    manager for the caller's own step loop.  Either way the artifacts
+    under ``out_dir`` feed ``monitor.perf.load_trace_op_times`` /
+    ``op_report(trace_dir=...)``."""
+    if telemetry is None:
+        from ..monitor import get_telemetry
+
+        telemetry = get_telemetry()
+    if telemetry is not None:
+        return telemetry.arm_trace(steps, trace_dir=out_dir)
+    return _BoundedCapture(steps, out_dir)
+
+
 _trace_dir = None
 
 
@@ -191,6 +248,41 @@ class Profiler:
     def reset(self):
         from .. import core as _native
         _native.trace_clear()
+
+    def export_chrome_tracing(self, path: str,
+                              include_spans: bool = True) -> int:
+        """Chrome-trace export with the monitor tracer's request/fit
+        spans merged in: native host RecordEvent scopes AND
+        monitor/tracing.py spans land in ONE perfetto-loadable file
+        (the /debug/spans?format=chrome document, offline).  Returns
+        the total event count."""
+        import json
+        import os
+
+        from .. import core as _native
+
+        doc = {"traceEvents": [], "displayTimeUnit": "ms"}
+        if _native.available() and _native.trace_export(path) > 0:
+            try:
+                with open(path) as fh:
+                    loaded = json.load(fh)
+                doc = ({"traceEvents": loaded, "displayTimeUnit": "ms"}
+                       if isinstance(loaded, list) else loaded)
+            except (OSError, ValueError):
+                pass
+        if include_spans:
+            from ..monitor.tracing import default_tracer
+
+            span_doc = default_tracer().chrome_trace()
+            doc.setdefault("traceEvents", []).extend(
+                span_doc.get("traceEvents", ()))
+            if span_doc.get("metadata"):
+                doc.setdefault("metadata", {}).update(span_doc["metadata"])
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh)
+        os.replace(tmp, path)
+        return len(doc.get("traceEvents", ()))
 
     def __enter__(self):
         return self.start()

@@ -1,0 +1,13 @@
+"""PTA007 fixture: bad namespace, missing unit suffix, kind conflict."""
+
+
+def build(reg):
+    reg.counter("paddle_Serving_Errors")           # FINDING: uppercase
+    reg.histogram("paddle_serving_batch")          # FINDING: no unit
+    reg.reservoir("paddle_decode_gap")             # FINDING: no unit
+    reg.gauge("paddle_train_loss")
+    reg.counter("paddle_train_loss")               # FINDING: kind conflict
+
+
+def build_fstring(reg, phase):
+    reg.histogram(f"paddle_fit_{phase}")           # FINDING: no unit

@@ -1,0 +1,26 @@
+"""PTA007 near-misses: clean names, f-string placeholders, the legal
+histogram+reservoir name share, and non-metric histogram() calls."""
+import numpy as np
+
+
+def build(reg):
+    reg.counter("paddle_serving_errors_total")
+    reg.histogram("paddle_serving_batch_latency_ms")
+    # same name as histogram AND reservoir is LEGAL: reservoirs are
+    # keyed separately from rendered metrics
+    reg.histogram("paddle_train_step_ms")
+    reg.reservoir("paddle_train_step_ms")
+    # second registration with the SAME kind is get-or-create, not a
+    # conflict
+    reg.histogram("paddle_train_step_ms")
+
+
+def build_fstring(reg, phase):
+    # placeholder substitutes as a well-formed segment; suffix literal
+    reg.histogram(f"paddle_fit_{phase}_ms")
+
+
+def not_a_metric(values):
+    # numpy histogram: first arg is not a string literal
+    h, edges = np.histogram(values, bins=10)
+    return h, edges

@@ -88,6 +88,15 @@ class TestRuleFixtures:
         assert {f.line for f in bad} == {6, 10}, [f.text() for f in res.new]
         assert not findings_in(res, "good.py")
 
+    def test_pta007_names_units_and_kind_conflicts(self):
+        res = fixture_run("PTA007")
+        bad = findings_in(res, "bad.py")
+        assert {f.line for f in bad} == {5, 6, 7, 9, 13}, \
+            [f.text() for f in res.new]
+        conflict = [f for f in bad if f.line == 9]
+        assert "gauge" in conflict[0].message  # names the first kind
+        assert not findings_in(res, "good.py")
+
 
 # -- suppression + baseline machinery ---------------------------------------
 

@@ -353,9 +353,18 @@ assert overhead <= budget, \
 print("OVERHEAD OK")
 EOF
 
-echo "== obs_smoke: monitor + trace pytest suites =="
+echo "== obs_smoke: perf-regression gate =="
+# one bert smoke bench vs the committed noise-banded baseline
+# (tools/perf_baseline.json); PADDLE_SKIP_PERF_GATE=1 skips
+if [ "${PADDLE_SKIP_PERF_GATE:-0}" != "1" ]; then
+    python bench.py --config bert > "$WORK/bench_bert.jsonl"
+    python tools/perf_gate.py --run "$WORK/bench_bert.jsonl" --subset \
+        || { echo "obs_smoke: perf gate FAILED"; exit 1; }
+fi
+
+echo "== obs_smoke: monitor + trace + perf pytest suites =="
 python -m pytest tests/test_monitor.py tests/test_profiler.py \
-    tests/test_tracing.py -q -m "not slow" \
+    tests/test_tracing.py tests/test_perf.py -q -m "not slow" \
     -p no:cacheprovider "$@"
 
 echo "obs_smoke: ALL OK"

@@ -1,0 +1,243 @@
+#!/usr/bin/env python3
+"""Noise-aware perf-regression gate over bench.py JSONL output.
+
+Compares one or more bench runs against the committed baseline
+(tools/perf_baseline.json) and exits nonzero when a baseline-known
+metric regressed beyond its noise band or went missing.  Stdlib-only
+and jax-free: importing bench.py pulls no jax, and GATE_METRICS there
+is the single source of metric directions and default noise bands.
+
+Noise handling:
+  * min-of-N — pass several --run files (or one file with repeated
+    runs of the same config); per metric the gate keeps the BEST
+    observation (min for lower-better, max for higher-better), so a
+    single noisy sample can't fail a healthy build.
+  * relative thresholds — each baseline entry stores rel_tol, chosen
+    at --write-baseline time from GATE_METRICS (wide cpu_rel_tol on
+    CPU, tighter tpu_rel_tol on TPU) and hand-editable afterwards.
+  * zero baselines compare exact (a 0.0 device_mem_peak_mb on CPU
+    stays 0.0; any nonzero best still passes with a warning since
+    there is no ratio to band).
+
+Policy:
+  * config or metric present in the RUN but not in the baseline →
+    warning only (new configs/metrics are adopted via the ratchet).
+  * metric present in the BASELINE but missing/null/errored in every
+    run → FAIL (a metric that silently vanishes is a regression).
+  * --write-baseline ratchets: existing entries only move in the
+    improving direction (use --force to reset after an accepted
+    regression, e.g. a feature that legitimately costs memory).
+
+Usage:
+  python tools/perf_gate.py --run bench_out.jsonl [--run more.jsonl]
+  python tools/perf_gate.py --run bench_out.jsonl --write-baseline
+  PADDLE_SKIP_PERF_GATE=1 ...   # smokes honor this and skip the gate
+
+Exit codes: 0 pass, 1 regression/missing metric, 2 usage/schema error.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from bench import BENCH_SCHEMA_VERSION, GATE_METRICS  # noqa: E402
+
+BASELINE_DEFAULT = os.path.join(_REPO, "tools", "perf_baseline.json")
+# config lines only — infrastructure lines never carry gate metrics
+_NON_CONFIG = {"bench_summary", "tpu_outage_diagnostic"}
+
+
+def load_runs(paths):
+    """Parse bench JSONL files into {config: {metric: [observations]}}
+    plus the platform seen ('tpu' if ANY line ran on one)."""
+    obs, platform, parsed = {}, "cpu", 0
+    for path in paths:
+        try:
+            fh = sys.stdin if path == "-" else open(path)
+        except OSError as e:
+            raise SystemExit(f"perf_gate: cannot read run file: {e}")
+        with fh:
+            for raw in fh:
+                raw = raw.strip()
+                if not raw.startswith("{"):
+                    continue
+                try:
+                    line = json.loads(raw)
+                except json.JSONDecodeError:
+                    continue
+                cfg = line.get("metric")
+                if (not isinstance(line, dict) or not cfg
+                        or cfg in _NON_CONFIG or line.get("partial")):
+                    continue
+                sv = line.get("schema_version")
+                if sv is not None and sv > BENCH_SCHEMA_VERSION:
+                    print(f"perf_gate: WARN {cfg}: line schema_version "
+                          f"{sv} > gate's {BENCH_SCHEMA_VERSION}")
+                parsed += 1
+                if str(line.get("platform", "cpu")).lower() != "cpu":
+                    platform = "tpu"
+                bucket = obs.setdefault(cfg, {})
+                for metric in GATE_METRICS:
+                    val = line.get(metric)
+                    if isinstance(val, (int, float)):
+                        bucket.setdefault(metric, []).append(float(val))
+    if not parsed:
+        raise SystemExit("perf_gate: no config lines found in run file(s)")
+    return obs, platform
+
+
+def best_of(values, direction):
+    return (max if direction == "higher" else min)(values)
+
+
+def check(obs, baseline, subset=False):
+    """Returns (failures, warnings) comparing best-of-N obs against the
+    committed baseline.  With ``subset``, baseline configs absent from
+    the run are skipped (a smoke that deliberately runs one config);
+    without it a vanished config is a failure."""
+    failures, warnings = [], []
+    base_cfgs = baseline.get("configs", {})
+    for cfg in obs:
+        if cfg not in base_cfgs:
+            warnings.append(f"{cfg}: not in baseline (ratchet to adopt)")
+    for cfg, metrics in base_cfgs.items():
+        got = obs.get(cfg, {})
+        if not got:
+            if subset:
+                continue
+            failures.append(f"{cfg}: config missing from run "
+                            "(errored or removed)")
+            continue
+        for metric, spec in metrics.items():
+            direction = spec.get("direction", "lower")
+            base = float(spec["value"])
+            tol = float(spec.get("rel_tol", 0.25))
+            vals = got.get(metric)
+            if not vals:
+                failures.append(f"{cfg}.{metric}: in baseline but "
+                                "missing/null in every run")
+                continue
+            abs_tol = float(spec.get("abs_tol", 0.0))
+            best = best_of(vals, direction)
+            if base == 0.0 and abs_tol == 0.0:
+                if best != 0.0:
+                    warnings.append(f"{cfg}.{metric}: baseline 0, "
+                                    f"measured {best:g} (no band; "
+                                    "re-ratchet to adopt)")
+                continue
+            if direction == "higher":
+                limit = max(0.0, base * (1.0 - tol) - abs_tol)
+                bad = best < limit
+            else:
+                limit = base * (1.0 + tol) + abs_tol
+                bad = best > limit
+            if bad:
+                failures.append(
+                    f"{cfg}.{metric}: best-of-{len(vals)} {best:g} vs "
+                    f"baseline {base:g} (allowed {'>=' if direction == 'higher' else '<='} "
+                    f"{limit:g}, rel_tol {tol:g})")
+    return failures, warnings
+
+
+def write_baseline(obs, platform, path, old, force):
+    tol_key = "tpu_rel_tol" if platform == "tpu" else "cpu_rel_tol"
+    old_cfgs = old.get("configs", {}) if not force else {}
+    configs = {}
+    for cfg, metrics in sorted(obs.items()):
+        entry = {}
+        for metric, vals in sorted(metrics.items()):
+            spec = GATE_METRICS[metric]
+            direction = spec["direction"]
+            best = best_of(vals, direction)
+            prev = old_cfgs.get(cfg, {}).get(metric)
+            if prev is not None:
+                # ratchet: keep the better of old and new so a noisy
+                # lucky/unlucky re-baseline can't loosen the gate
+                best = best_of([best, float(prev["value"])], direction)
+            entry[metric] = {
+                "value": round(best, 6),
+                "direction": direction,
+                "rel_tol": (prev or {}).get("rel_tol", spec[tol_key]),
+            }
+            abs_default = spec.get(f"{platform}_abs_tol", 0.0)
+            abs_tol = (prev or {}).get("abs_tol", abs_default)
+            if abs_tol:
+                entry[metric]["abs_tol"] = abs_tol
+        if entry:
+            configs[cfg] = entry
+    doc = {"schema_version": BENCH_SCHEMA_VERSION, "platform": platform,
+           "configs": configs}
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "w") as fh:
+        json.dump(doc, fh, indent=2, sort_keys=True)
+        fh.write("\n")
+    os.replace(tmp, path)
+    return doc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--run", action="append", required=True,
+                    help="bench JSONL output file ('-' for stdin); "
+                         "repeat for min-of-N across runs")
+    ap.add_argument("--baseline", default=BASELINE_DEFAULT)
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="ratchet the baseline from this run instead "
+                         "of gating against it")
+    ap.add_argument("--force", action="store_true",
+                    help="with --write-baseline: overwrite instead of "
+                         "ratcheting (accept a regression)")
+    ap.add_argument("--subset", action="store_true",
+                    help="gate only the configs present in the run "
+                         "(for smokes that deliberately run a subset); "
+                         "a missing config is otherwise a failure")
+    args = ap.parse_args(argv)
+
+    obs, platform = load_runs(args.run)
+
+    old = {}
+    if os.path.exists(args.baseline):
+        try:
+            with open(args.baseline) as fh:
+                old = json.load(fh)
+        except (OSError, ValueError) as e:
+            raise SystemExit(f"perf_gate: unreadable baseline: {e}")
+
+    if args.write_baseline:
+        doc = write_baseline(obs, platform, args.baseline, old, args.force)
+        n = sum(len(m) for m in doc["configs"].values())
+        print(f"perf_gate: baseline written to {args.baseline} "
+              f"({len(doc['configs'])} configs, {n} metrics, "
+              f"platform={platform})")
+        return 0
+
+    if not old:
+        print(f"perf_gate: no baseline at {args.baseline} — run with "
+              "--write-baseline first (pass)")
+        return 0
+    if old.get("platform", "cpu") != platform:
+        print(f"perf_gate: WARN baseline platform "
+              f"{old.get('platform')!r} != run platform {platform!r} — "
+              "bands may not fit; re-baseline on this platform")
+
+    failures, warnings = check(obs, old, subset=args.subset)
+    for w in warnings:
+        print(f"perf_gate: WARN {w}")
+    if failures:
+        for f in failures:
+            print(f"perf_gate: FAIL {f}")
+        print(f"perf_gate: {len(failures)} regression(s) vs "
+              f"{args.baseline}")
+        return 1
+    n = sum(len(m) for m in old.get("configs", {}).values())
+    print(f"perf_gate: PASS ({n} baseline metrics within bands)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

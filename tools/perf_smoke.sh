@@ -58,5 +58,14 @@ done
 [ "$(ls -A "$CACHE_DIR")" ] || { echo "cache dir is empty"; fail=1; }
 [ "$fail" -eq 0 ] || { echo "[perf_smoke] FAILED"; exit 1; }
 
+# perf-regression gate over the four bench runs above (min-of-N per
+# metric) vs the committed baseline; PADDLE_SKIP_PERF_GATE=1 skips
+if [ "${PADDLE_SKIP_PERF_GATE:-0}" != "1" ]; then
+    gate_args=()
+    for out in "$OUT_DIR"/bench_*.out; do gate_args+=(--run "$out"); done
+    python tools/perf_gate.py "${gate_args[@]}" \
+        || { echo "[perf_smoke] perf gate FAILED"; exit 1; }
+fi
+
 exec python -m pytest tests/ -q -m perf \
     -p no:cacheprovider -p no:randomly "$@"
