@@ -381,7 +381,14 @@ def _run_config(cfg, on_tpu, cpu_fallback=None):
     if line is None and cpu_fallback is not None:
         return cpu_fallback
     if line is None:
-        rc, out, err = _run(["--config", cfg], _cpu_env(),
+        env = _cpu_env()
+        if cfg == "genserve":
+            # the tp=2 parity sub-measure needs a second device; a
+            # virtual CPU pair costs nothing on the smoke path
+            env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                                + " --xla_force_host_platform_device_count=2"
+                                ).strip()
+        rc, out, err = _run(["--config", cfg], env,
                             CONFIG_TIMEOUT_CPU_S)
         line = _extract(out)
         if line is not None and on_tpu:
@@ -501,6 +508,19 @@ GATE_METRICS = {
                         "tpu_rel_tol": 0.50,
                         "cpu_abs_tol": 10.0, "tpu_abs_tol": 60.0,
                         "help": "AOT compile wall time where measured"},
+    # paged-KV serving efficiency (genserve only; null elsewhere):
+    # cache HBM per concurrently-resident token, and the prefix-cache
+    # hit ratio under the shared-system-prompt wave — both are
+    # deterministic on the smoke geometry (eos never fires, every
+    # request decodes its full max_new), hence the tight bands
+    "kv_bytes_per_active_token": {
+        "direction": "lower", "cpu_rel_tol": 0.25, "tpu_rel_tol": 0.25,
+        "help": "KV-cache pool bytes per resident token at peak "
+                "concurrency (paged serving efficiency)"},
+    "prefix_cache_hit_ratio": {
+        "direction": "higher", "cpu_rel_tol": 0.25, "tpu_rel_tol": 0.25,
+        "help": "prefix-cache hits/(hits+misses) under the bench's "
+                "shared-prefix load wave"},
 }
 
 
@@ -1827,17 +1847,25 @@ def body_genserve(on_tpu):
     """Continuous-batching generation serving (paddle_tpu.serving.
     generation): a GPT well past 100M params behind GenerationEngine —
     prefill per admitted prompt, ONE donated decode executable advancing
-    every in-flight slot a token per iteration, KV cache device-resident
-    throughout.  Reports steady-decode tokens/s (the headline),
-    time-to-first-token, inter-token p50/p99, and a decode-phase MFU
-    estimate (~2*params FLOPs per generated token).  Reference analog =
-    fused_multi_transformer CacheKV decode behind AnalysisPredictor's
-    generation loop, which had no continuous batching at all."""
+    every in-flight slot a token per iteration, PAGED KV cache
+    device-resident throughout.  Reports steady-decode tokens/s (the
+    headline), ttft + inter-token p50/p99, a decode-phase MFU estimate
+    (~2*params FLOPs per generated token) — and the paged-cache wins:
+    the engine runs 2x the slots a dense [slots, S_max] layout could
+    fit in the SAME cache HBM (active_slots_vs_dense), cache bytes per
+    resident token at peak concurrency (kv_bytes_per_active_token), a
+    nonzero prefix-cache hit ratio under a shared-system-prompt wave,
+    a long-prompt variant, and (given >= 2 devices) a tp=2-sharded
+    engine decoding token-identical to the unsharded one with zero
+    steady-state compiles.  Reference analog = fused_multi_transformer
+    CacheKV decode behind AnalysisPredictor's generation loop, which
+    had no continuous batching (or paging) at all."""
     import numpy as np
 
     import paddle_tpu as paddle
     from paddle_tpu.models import GPTConfig, GPTForCausalLM
     from paddle_tpu.serving.generation import GenerationEngine
+    from paddle_tpu.serving.kv_cache import CacheGeometry
 
     # ~124M params (wte 38.6M + 12 blocks x ~7.1M + tied head) on BOTH
     # backends — the config exists to time a real model's decode path;
@@ -1847,9 +1875,23 @@ def body_genserve(on_tpu):
                      max_position_embeddings=512 if on_tpu else 128,
                      dropout=0.0, attn_dropout=0.0)
     if on_tpu:
-        slots, max_new, n_req, bucket = 8, 64, 16, 64
+        # dense baseline geometry: 8 slots x S_max=512 of KV HBM; the
+        # paged engine spends the SAME pool on 16 slots (requests only
+        # touch the pages they use)
+        slots_dense, max_new, n_req, page_size = 8, 64, 24, 16
+        bucket, long_bucket = 64, 128
     else:
-        slots, max_new, n_req, bucket = 4, 12, 6, 16
+        slots_dense, max_new, n_req, page_size = 4, 12, 12, 8
+        bucket, long_bucket = 16, 32
+    S_max = gcfg.max_position_embeddings
+    slots = 2 * slots_dense
+    dense_geom = CacheGeometry(
+        num_layers=gcfg.num_layers, max_slots=slots_dense,
+        max_seq_len=S_max, num_heads=gcfg.num_heads,
+        head_dim=gcfg.hidden_size // gcfg.num_heads,
+        vocab_size=gcfg.vocab_size, page_size=page_size,
+        dtype="bfloat16" if on_tpu else "float32")
+    num_pages = dense_geom.num_pages        # FIXED cache HBM
 
     paddle.seed(0)
     model = GPTForCausalLM(gcfg)
@@ -1859,31 +1901,102 @@ def body_genserve(on_tpu):
     n_params = sum(int(np.prod(p.shape)) for p in model.parameters())
     _phase("model_built")
 
-    eng = GenerationEngine(model, max_slots=slots,
-                           max_seq_len=gcfg.max_position_embeddings,
-                           prompt_buckets=str(bucket))
+    eng = GenerationEngine(model, max_slots=slots, max_seq_len=S_max,
+                           prompt_buckets=f"{bucket},{long_bucket}",
+                           page_size=page_size, num_pages=num_pages,
+                           prefix_cache=True)
+    assert eng.geometry.kv_bytes() == dense_geom.kv_bytes()
     t0 = time.perf_counter()
     eng.start()
     warmup_s = time.perf_counter() - t0
     _phase("warmup_done", warmup_s)
 
+    def run_wave(prompts, seeds=None, track_peak=False):
+        t0 = time.perf_counter()
+        handles = [eng.submit(p, max_new, do_sample=(i % 2 == 1),
+                              temperature=0.8, top_k=8,
+                              seed=seeds[i] if seeds else i)
+                   for i, p in enumerate(prompts)]
+        peak = 0
+        while track_peak and any(not h.done for h in handles):
+            peak = max(peak, len(eng._sched.occupied))
+            time.sleep(0.005)
+        total = sum(len(h.result(timeout=1800)) for h in handles)
+        return total, time.perf_counter() - t0, peak
+
+    # wave 1 — capacity: 2x dense-slot-count distinct prompts; the
+    # dense layout could hold at most slots_dense of them in this HBM
     rs = np.random.RandomState(0)
     prompts = [rs.randint(1, gcfg.vocab_size, bucket).astype(np.int32)
                for _ in range(n_req)]
-    t0 = time.perf_counter()
-    handles = [eng.submit(p, max_new, do_sample=(i % 2 == 1),
-                          temperature=0.8, top_k=8, seed=i)
-               for i, p in enumerate(prompts)]
-    total_tokens = sum(len(h.result(timeout=1800)) for h in handles)
-    gen_s = time.perf_counter() - t0
+    total_tokens, gen_s, peak_active = run_wave(prompts, track_peak=True)
     snap = eng.metrics.snapshot()
+    _phase("generate_done", gen_s)
+
+    # wave 2 — shared system prompt: every request opens with the same
+    # fixed prefix (page-aligned share), suffix random -> after the
+    # first admission every admission is a prefix hit
+    shared = rs.randint(1, gcfg.vocab_size, bucket).astype(np.int32)
+    n_suffix = max(1, bucket - (bucket // page_size) * page_size + 1)
+    pfx_prompts = [np.concatenate([
+        shared[:bucket - n_suffix],
+        rs.randint(1, gcfg.vocab_size, n_suffix).astype(np.int32)])
+        for _ in range(n_req)]
+    pfx_tokens, pfx_s, _ = run_wave(pfx_prompts, seeds=[7] * n_req)
+    snap2 = eng.metrics.snapshot()
+    _phase("prefix_wave_done", pfx_s)
+
+    # wave 3 — long prompts through the second bucket
+    long_prompts = [rs.randint(1, gcfg.vocab_size,
+                               long_bucket).astype(np.int32)
+                    for _ in range(max(2, n_req // 4))]
+    long_tokens, long_s, _ = run_wave(long_prompts)
+    snap3 = eng.metrics.snapshot()
+    long_ttft = snap3["ttft_p99_ms"]
     eng.drain(timeout=60)
     eng.stop()
-    _phase("generate_done", gen_s)
+    _phase("long_prompt_done", long_s)
+
+    # tp=2 parity sub-check on a small model (correctness + compile-
+    # flatness claim, not throughput): needs a second device
+    import jax
+
+    tp2_parity = tp2_compile_flat = None
+    if len(jax.devices()) >= 2:
+        paddle.seed(0)
+        small_cfg = GPTConfig(vocab_size=1024, hidden_size=128,
+                              num_layers=2, num_heads=4,
+                              max_position_embeddings=64, dropout=0.0,
+                              attn_dropout=0.0)
+        small = GPTForCausalLM(small_cfg)
+        small.eval()
+        outs = {}
+        for tag, mesh in (("tp2", {"tp": 2}), ("solo", None)):
+            e2 = GenerationEngine(small, max_slots=2, max_seq_len=48,
+                                  prompt_buckets="8", page_size=8,
+                                  mesh=mesh)
+            e2.start()
+            c0 = e2.compile_count
+            outs[tag] = [
+                e2.generate(list(range(3, 10)), 12, timeout=300,
+                            do_sample=True, seed=11),
+                e2.generate([5, 9, 2], 12, timeout=300, seed=1)]
+            if tag == "tp2":
+                tp2_compile_flat = e2.compile_count == c0
+            e2.stop()
+        tp2_parity = outs["tp2"] == outs["solo"]
+        _phase("tp2_done")
 
     tps = total_tokens / gen_s
     mfu = 2.0 * n_params * tps / peak_flops_per_chip()
     step_dt = (snap["inter_token_p50_ms"] or 0.0) / 1e3
+    # cache HBM per resident token at peak concurrency, paged vs what
+    # the dense [slots, S_max] layout costs for the same requests
+    resident = max(1, peak_active) * (bucket + max_new)
+    kv_per_tok = eng.geometry.kv_bytes() / resident
+    dense_per_tok = dense_geom.kv_bytes() / (slots_dense
+                                             * (bucket + max_new))
+    pfx_hits = snap2["prefix_cache_hits"] - snap["prefix_cache_hits"]
     return {
         **_obs_fields(dt=step_dt or None, mfu=mfu),
         "metric": "genserve_decode_tokens_per_sec",
@@ -1902,9 +2015,24 @@ def body_genserve(on_tpu):
         "requests": n_req,
         "max_new_tokens": max_new,
         "total_tokens": total_tokens,
-        "compile_count": snap["compile_count"],
-        "retired": snap["retired"],
+        "compile_count": snap3["compile_count"],
+        "retired": snap3["retired"],
         "warmup_seconds": round(warmup_s, 1),
+        # paged-KV efficiency surface
+        "page_size": page_size,
+        "num_pages": num_pages,
+        "cache_hbm_mb": round(eng.geometry.kv_bytes() / 1048576, 1),
+        "peak_active_slots": peak_active,
+        "dense_baseline_slots": slots_dense,
+        "active_slots_vs_dense": round(peak_active / slots_dense, 2),
+        "kv_bytes_per_active_token": round(kv_per_tok, 1),
+        "dense_kv_bytes_per_token": round(dense_per_tok, 1),
+        "prefix_cache_hits": pfx_hits,
+        "prefix_cache_hit_ratio": snap2["prefix_cache_hit_ratio"],
+        "long_prompt_tokens_per_sec": round(long_tokens / long_s, 1),
+        "long_prompt_ttft_p99_ms": long_ttft,
+        "tp2_token_parity": tp2_parity,
+        "tp2_compile_flat": tp2_compile_flat,
     }
 
 

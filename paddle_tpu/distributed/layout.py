@@ -243,6 +243,14 @@ class SpecLayout:
     def norm(self) -> P:
         return P(self.fsdp_axis)
 
+    def kv_page_spec(self) -> P:
+        """Placement of the serving engine's KV page pool
+        ``[layers, num_pages, page_size, nh, hd]``: heads follow the
+        qkv column shards over tp, everything else replicated — the
+        page table / free-list registers stay replicated so in-graph
+        page allocation is identical on every device."""
+        return P(None, None, None, self.tp_axis, None)
+
     def spec_for(self, name, shape):
         """PartitionSpec for one named param, or None when unmatched
         (caller replicates + warns).  Pure pattern table — mesh pruning

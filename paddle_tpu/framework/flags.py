@@ -81,6 +81,18 @@ define_flag("FLAGS_genserve_prompt_buckets", "16,32,64",
 define_flag("FLAGS_genserve_queue_depth", 128,
             "bounded generation admission queue; submit() raises "
             "QueueFullError beyond this")
+define_flag("FLAGS_genserve_page_size", 16,
+            "tokens per KV-cache page; the page pool is allocated as "
+            "[layers, num_pages, page_size, heads, head_dim]")
+define_flag("FLAGS_genserve_num_pages", 0,
+            "KV page-pool capacity; 0 sizes it dense-equivalently "
+            "(max_slots * ceil(max_seq_len / page_size)) — smaller pools "
+            "oversubscribe slots against actual footprint and queue "
+            "admissions the pool cannot reserve")
+define_flag("FLAGS_genserve_prefix_cache", 1,
+            "1 shares identical tokenized prompt prefixes as refcounted "
+            "read-only KV pages (hits skip prefill for shared pages); "
+            "0 disables sharing")
 # -- runtime telemetry (paddle_tpu.monitor) --------------------------------
 define_flag("FLAGS_telemetry_dir", "",
             "directory for the per-step JSONL training event log "

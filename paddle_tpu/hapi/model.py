@@ -1074,14 +1074,23 @@ class Model:
     def serve_generate(self, host="127.0.0.1", port=8866, *,
                        max_slots=None, max_seq_len=None,
                        prompt_buckets=None, queue_depth=None,
+                       page_size=None, num_pages=None, prefix_cache=None,
+                       mesh=None, layout=None,
                        blocking=True, install_signal_handlers=True):
         """Serve autoregressive generation over HTTP with continuous
         batching (paddle_tpu.serving.generation): prefill seeds a
-        device-resident KV cache, one donated decode executable advances
-        every in-flight request a token per iteration, and POST
+        device-resident PAGED KV cache, one donated decode executable
+        advances every in-flight request a token per iteration, and POST
         /generate streams tokens as they decode (SSE).  The network must
         expose the slot-batched decode path (``slot_prefill`` /
-        ``slot_decode``, e.g. models.GPTForCausalLM).
+        ``slot_decode_paged``, e.g. models.GPTForCausalLM).
+
+        ``page_size`` / ``num_pages`` size the KV page pool (0 pages =
+        dense-equivalent), ``prefix_cache`` shares identical tokenized
+        prompt prefixes as read-only pages, and ``mesh``/``layout``
+        (a ``{"tp": 2}``-style dict or jax Mesh + optional SpecLayout)
+        serve a tensor-parallel model from this one process — all
+        forwarded to :class:`serving.generation.GenerationEngine`.
 
         With `blocking=False` returns the started `ServingServer` (use
         `.url`, `.shutdown()`); otherwise blocks until SIGTERM and
@@ -1093,7 +1102,9 @@ class Model:
         self.network.eval()
         engine = GenerationEngine(
             self.network, max_slots=max_slots, max_seq_len=max_seq_len,
-            prompt_buckets=prompt_buckets, queue_depth=queue_depth)
+            prompt_buckets=prompt_buckets, queue_depth=queue_depth,
+            page_size=page_size, num_pages=num_pages,
+            prefix_cache=prefix_cache, mesh=mesh, layout=layout)
         server = ServingServer(
             None, host=host, port=port,
             install_signal_handlers=install_signal_handlers,

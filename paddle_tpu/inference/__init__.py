@@ -385,7 +385,7 @@ def spec_tree(tree):
         tree)
 
 
-def aot_compile(fn, arg_specs, *, donate_argnums=()):
+def aot_compile(fn, arg_specs, *, donate_argnums=(), out_shardings=None):
     """Lower + compile ``fn`` for one EXACT argument signature, ahead of
     traffic (the Predictor bucket-cache discipline, factored out for
     engines that manage their own executables — the generation engine's
@@ -397,8 +397,17 @@ def aot_compile(fn, arg_specs, *, donate_argnums=()):
     Calling the result with a mismatched shape/dtype raises instead of
     recompiling — steady-state serving performs zero XLA compiles, and a
     signature drift is a loud error rather than a silent compile storm.
+
+    ``out_shardings`` (optional, a pytree of NamedShardings matching the
+    outputs) pins result placements — the layout-aware generation engine
+    passes its state shardings so a donated, tp-sharded decode state
+    comes back exactly where it went in (donation requires in == out).
     """
-    jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    if out_shardings is None:
+        jitted = jax.jit(fn, donate_argnums=donate_argnums)
+    else:
+        jitted = jax.jit(fn, donate_argnums=donate_argnums,
+                         out_shardings=out_shardings)
     return jitted.lower(*arg_specs).compile()
 
 

@@ -150,6 +150,115 @@ class GPTAttention(Layer):
         out = self.out(Tensor(ctx.reshape(B, 1, cfg.hidden_size)))
         return out, Tensor(k_cache), Tensor(v_cache)
 
+    def decode_pages(self, x, k_pages, v_pages, rows, pos, active,
+                     seq_cap):
+        """Paged continuous-batching decode: like ``decode_slots`` but
+        each lane's KV lives in fixed-size pool pages indirected through
+        its page-table row (serving/kv_cache.py) instead of a dense
+        ``[slots, S_max]`` stripe.
+
+        x: [slots, 1, H]; k_pages/v_pages: [num_pages, page_size, nh,
+        hd] (this layer's pool plane); rows: [slots, pages_per_slot]
+        int32 page table (-1 = unmapped); pos: [slots] write index;
+        active: [slots]; seq_cap: STATIC attention extent (the engine's
+        S_max) — the gathered view is sliced to it so the softmax
+        reduction shape matches the dense path exactly, which is what
+        keeps an engine lane bitwise-equal to a solo ``generate`` run.
+        Unmapped (-1) table entries gather an arbitrary resident page
+        whose positions sit past the validity mask, so they contribute
+        exactly 0 to the softmax (exp of finfo.min underflows).
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..tensor import unwrap
+
+        cfg = self.cfg
+        B = x.shape[0]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = T.reshape(self.qkv(x), [B, 1, 3, nh, hd])
+        q = unwrap(qkv[:, :, 0])                     # [slots, 1, nh, hd]
+        k = unwrap(qkv[:, :, 1])[:, 0]               # [slots, nh, hd]
+        v = unwrap(qkv[:, :, 2])[:, 0]
+        pos = jnp.asarray(unwrap(pos), jnp.int32)
+        active = jnp.asarray(unwrap(active), bool)
+        k_pages, v_pages = unwrap(k_pages), unwrap(v_pages)
+        rows = jnp.asarray(unwrap(rows), jnp.int32)
+        num_pages, ps = k_pages.shape[0], k_pages.shape[1]
+        lane = jnp.arange(B)
+        # per-lane scatter: lane b writes its token's K/V at
+        # (rows[b, pos[b]//ps], pos[b]%ps); inactive lanes target
+        # one-past-the-pool and are dropped
+        page = rows[lane, jnp.clip(pos // ps, 0, rows.shape[1] - 1)]
+        page = jnp.where(active, page, num_pages)
+        off = pos % ps
+        k_pages = k_pages.at[page, off].set(k.astype(k_pages.dtype),
+                                            mode="drop")
+        v_pages = v_pages.at[page, off].set(v.astype(v_pages.dtype),
+                                            mode="drop")
+        # gather each lane's pages into a contiguous [seq_cap] view
+        gidx = jnp.clip(rows, 0, num_pages - 1)
+        kg = k_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
+        vg = v_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
+        kg, vg = kg[:, :seq_cap], vg[:, :seq_cap]
+        scores = jnp.einsum("bqnd,bsnd->bnqs", q, kg) \
+            * (1.0 / float(hd) ** 0.5)
+        valid = jnp.arange(seq_cap)[None, :] <= pos[:, None]
+        scores = jnp.where(valid[:, None, None, :], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jnp.exp(scores - lax.stop_gradient(
+            scores.max(axis=-1, keepdims=True)))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, vg)
+        out = self.out(Tensor(ctx.reshape(B, 1, cfg.hidden_size)))
+        return out, Tensor(k_pages), Tensor(v_pages)
+
+    def prefill_prefix(self, x, prefix_k, prefix_v, prefix_len):
+        """Suffix-only prefill attending over a cached prefix: queries
+        are the suffix tokens (absolute positions ``prefix_len + i``),
+        keys are [prefix ++ suffix] with the prefix entries valid below
+        ``prefix_len`` and the suffix causal — the attention that lets a
+        prefix-cache hit skip recomputing the shared pages entirely.
+
+        x: [1, Ss, H] suffix hidden; prefix_k/prefix_v: [C, nh, hd]
+        gathered prefix K/V (C static, entries >= prefix_len garbage);
+        prefix_len: traced scalar.  Returns (out, k_suf, v_suf) with
+        k_suf/v_suf [1, Ss, nh, hd] — the engine pages them in at the
+        (page-aligned) prefix boundary.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..tensor import unwrap
+
+        cfg = self.cfg
+        S = x.shape[1]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = T.reshape(self.qkv(x), [1, S, 3, nh, hd])
+        q = unwrap(qkv[:, :, 0])                     # [1, Ss, nh, hd]
+        k = unwrap(qkv[:, :, 1])
+        v = unwrap(qkv[:, :, 2])
+        prefix_len = jnp.asarray(unwrap(prefix_len), jnp.int32)
+        pk = jnp.asarray(unwrap(prefix_k))[None]     # [1, C, nh, hd]
+        pv = jnp.asarray(unwrap(prefix_v))[None]
+        C = pk.shape[1]
+        kk = jnp.concatenate([pk.astype(k.dtype), k], axis=1)
+        vv = jnp.concatenate([pv.astype(v.dtype), v], axis=1)
+        scores = jnp.einsum("bqnd,bsnd->bnqs", q, kk) \
+            * (1.0 / float(hd) ** 0.5)
+        i = jnp.arange(S)[:, None]
+        j = jnp.arange(C + S)[None, :]
+        ok = (j < prefix_len) | ((j >= C) & (j - C <= i))
+        scores = jnp.where(ok[None, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jnp.exp(scores - lax.stop_gradient(
+            scores.max(axis=-1, keepdims=True)))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, vv)
+        out = self.dropout(self.out(Tensor(
+            ctx.reshape(1, S, cfg.hidden_size))))
+        return out, Tensor(k), Tensor(v)
+
     def decode_step(self, x, k_cache, v_cache, pos):
         """One-token cached attention (the KV-cache serving path; the
         reference's analog is fused_multi_transformer's CacheKV decode,
@@ -250,6 +359,21 @@ class GPTBlock(Layer):
         x = x + a
         x = x + self.mlp(self.ln_2(x))
         return x, k_cache, v_cache
+
+    def decode_pages(self, x, k_pages, v_pages, rows, pos, active,
+                     seq_cap):
+        a, k_pages, v_pages = self.attn.decode_pages(
+            self.ln_1(x), k_pages, v_pages, rows, pos, active, seq_cap)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_pages, v_pages
+
+    def prefill_prefix(self, x, prefix_k, prefix_v, prefix_len):
+        a, k, v = self.attn.prefill_prefix(
+            self.ln_1(x), prefix_k, prefix_v, prefix_len)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k, v
 
 
 class GPTModel(Layer):
@@ -449,6 +573,88 @@ class GPTForCausalLM(Layer):
         k2 = jnp.stack([k for k, _ in new_caches])
         v2 = jnp.stack([v for _, v in new_caches])
         return unwrap(logits)[:, 0], k2, v2
+
+    def slot_decode_paged(self, tokens, pos, active, k_pages, v_pages,
+                          rows, seq_cap):
+        """Serving decode iteration over the PAGED slot-batched KV cache
+        (serving/kv_cache.py): tokens [slots] int32, pos [slots] write
+        positions, active [slots] bool, pools [layers, num_pages,
+        page_size, nh, hd], rows [slots, pages_per_slot] int32 page
+        table, seq_cap the static attention extent (engine S_max).
+        Returns (logits [slots, V], k_pages', v_pages') — ONE
+        fixed-shape program regardless of which lanes are live or how
+        pages are scattered through the pool.
+        """
+        import jax.numpy as jnp
+
+        from ..tensor import unwrap
+
+        if self.training:
+            raise RuntimeError(
+                "slot_prefill/slot_decode are eval-only serving paths; "
+                "call model.eval() first")
+        gpt = self.gpt
+        tokens = jnp.asarray(unwrap(tokens), jnp.int32)
+        k_pages, v_pages = unwrap(k_pages), unwrap(v_pages)
+        x = gpt.wte(Tensor(tokens[:, None])) \
+            + gpt.wpe(T.reshape(Tensor(unwrap(pos)), [-1, 1]))
+        ks, vs = [], []
+        for i, blk in enumerate(gpt.h):
+            x, kp, vp = blk.decode_pages(x, k_pages[i], v_pages[i], rows,
+                                         pos, active, seq_cap)
+            ks.append(unwrap(kp))
+            vs.append(unwrap(vp))
+        logits = self._head(gpt.ln_f(x))             # [slots, 1, V]
+        return unwrap(logits)[:, 0], jnp.stack(ks), jnp.stack(vs)
+
+    def slot_prefill_prefix(self, input_ids, prefix_k, prefix_v,
+                            prefix_len, length):
+        """Prefix-cache-hit prefill: run ONLY the prompt's suffix
+        through the model, attending over the cached prefix K/V — the
+        shared pages are never recomputed.
+
+        input_ids [1, Ss]: suffix tokens (positions ``prefix_len ..``)
+        right-padded to the suffix bucket; prefix_k/prefix_v
+        [layers, C, nh, hd]: prefix K/V gathered from the page pool
+        (entries >= prefix_len are garbage the mask hides);
+        ``prefix_len`` (traced) the shared-prefix length, ``length`` the
+        FULL prompt length.  Returns (k_suf [layers, Ss, nh, hd], v_suf,
+        logits [V] at suffix index length - prefix_len - 1).  Token-
+        (not bitwise-) equivalent to the full ``slot_prefill`` path:
+        the math matches up to float reassociation of the explicit
+        softmax vs the fused causal kernel.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..tensor import unwrap
+
+        if self.training:
+            raise RuntimeError(
+                "slot_prefill/slot_decode are eval-only serving paths; "
+                "call model.eval() first")
+        gpt = self.gpt
+        cfg = self.cfg
+        S = input_ids.shape[1]
+        prefix_len = jnp.asarray(unwrap(prefix_len), jnp.int32)
+        length = jnp.asarray(unwrap(length), jnp.int32)
+        # absolute positions of the suffix tokens; the padded tail may
+        # run past max_position_embeddings — clip it into the table
+        # (garbage rows the causal mask and length slice never expose)
+        pos = jnp.clip(prefix_len + jnp.arange(S, dtype=jnp.int32),
+                       0, cfg.max_position_embeddings - 1)
+        x = gpt.drop(gpt.wte(input_ids) + gpt.wpe(Tensor(pos)))
+        ks, vs = [], []
+        for i, blk in enumerate(gpt.h):
+            x, k, v = blk.prefill_prefix(x, prefix_k[i], prefix_v[i],
+                                         prefix_len)
+            ks.append(unwrap(k)[0])
+            vs.append(unwrap(v)[0])
+        hidden = gpt.ln_f(x)                         # [1, Ss, H]
+        last = lax.dynamic_slice_in_dim(
+            unwrap(hidden), length - prefix_len - 1, 1, axis=1)
+        logits = self._head(Tensor(last))
+        return jnp.stack(ks), jnp.stack(vs), unwrap(logits)[0, 0]
 
     def _beam_traced(self, input_ids, max_new_tokens, num_beams,
                      eos_token_id):

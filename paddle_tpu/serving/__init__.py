@@ -35,7 +35,8 @@ from .metrics import GenerationMetrics, ServingMetrics
 __all__ = ["ServingEngine", "ServingServer", "ServingClient", "BucketSpec",
            "ServingMetrics", "GenerationMetrics", "GenerationEngine",
            "GenerationHandle", "CacheGeometry", "SlotScheduler",
-           "QueueFullError", "DeadlineExceededError", "EngineStoppedError"]
+           "PrefixCache", "QueueFullError", "DeadlineExceededError",
+           "EngineStoppedError"]
 
 
 def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
@@ -54,4 +55,7 @@ def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
     if name == "SlotScheduler":
         from .scheduler import SlotScheduler
         return SlotScheduler
+    if name == "PrefixCache":
+        from .prefix_cache import PrefixCache
+        return PrefixCache
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

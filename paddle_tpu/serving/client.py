@@ -232,8 +232,21 @@ def main(argv=None):
     parser.add_argument("--sample", action="store_true",
                         help="generate traffic: temperature/top-k "
                              "sampling instead of greedy")
+    parser.add_argument("--shared-prefix-len", type=int, default=0,
+                        help="generate traffic: every prompt starts with "
+                             "the SAME fixed-seed token prefix of this "
+                             "length (exercises the server's prefix "
+                             "cache), followed by a random suffix")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
+
+    shared_prefix = []
+    if args.shared_prefix_len > 0:
+        if args.shared_prefix_len >= args.prompt_len:
+            parser.error("--shared-prefix-len must be < --prompt-len "
+                         "(at least one random suffix token)")
+        shared_prefix = [int(t) for t in np.random.RandomState(1234)
+                         .randint(1, args.vocab, args.shared_prefix_len)]
 
     shape = tuple(int(d) for d in args.shape.split(",") if d.strip())
     client = ServingClient(args.url)
@@ -248,8 +261,9 @@ def main(argv=None):
         client.predict([x])
 
     def generate_once(rs):
-        prompt = [int(t) for t in rs.randint(1, args.vocab,
-                                             args.prompt_len)]
+        n_rand = args.prompt_len - len(shared_prefix)
+        prompt = shared_prefix + [int(t) for t in rs.randint(1, args.vocab,
+                                                             n_rand)]
         t0 = last = time.perf_counter()
         ntok = 0
         my_ttft, my_gaps, err = None, [], None

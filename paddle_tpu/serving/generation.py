@@ -8,20 +8,34 @@ shape discipline, in the Orca iteration-level-scheduling shape:
 
   * **prefill/decode split** — each admitted prompt runs ONE prefill
     (compiled per prompt-length bucket through the same AOT machinery as
-    the Predictor's shape buckets) that seeds its slot's rows of the
-    device-resident KV cache; then a single donated, jitted **decode
-    step** advances ALL in-flight sequences one token per iteration.
+    the Predictor's shape buckets) that seeds its slot's pages of the
+    device-resident PAGED KV cache; then a single donated, jitted
+    **decode step** advances ALL in-flight sequences one token per
+    iteration, allocating fresh tail pages in-graph off the free-list
+    register as lanes cross page boundaries.
   * **continuous batching** — the scheduler admits queued requests into
     free slots at iteration boundaries (no waiting for the batch to
-    drain), retires lanes on EOS/max_new_tokens, and preempts lanes on
+    drain) once the page pool can reserve their worst case, retires
+    lanes on EOS/max_new_tokens (their private pages return to the pool
+    in the same decode step), and preempts lanes on
     deadline/cancellation; a request admitted mid-decode produces tokens
     bitwise-identical to running alone (tested).
+  * **prefix sharing** — identical tokenized prompt prefixes occupy the
+    pool ONCE (serving/prefix_cache.py): a hit maps the cached
+    read-only pages into the slot's page table and prefills only the
+    suffix, attending over the cached prefix K/V.
   * **zero steady-state compiles, zero cache round-trips** — every
-    executable (decode, release, per-bucket prefill/insert) is AOT
-    lowered+compiled at ``start()`` via ``inference.aot_compile``; the
-    decode state pytree (serving/kv_cache.py) is donated on every
-    transition, so the KV cache lives on device across iterations and
+    executable (decode, release, reclaim, per-bucket prefill/insert) is
+    AOT lowered+compiled at ``start()`` via ``inference.aot_compile``;
+    the decode state pytree (serving/kv_cache.py) is donated on every
+    transition, so the KV pool lives on device across iterations and
     only the sampled token ids are fetched (under ``host_fetch()``).
+  * **layout-aware** — pass ``mesh=`` (+ an optional PR-8 ``SpecLayout``)
+    and the engine serves a tensor-parallel model from one process:
+    params resolve through the layout's PartitionSpec table, the page
+    pool's head axis shards over ``tp``, and every executable is
+    compiled with NamedSharding in/out (out-shardings pinned to
+    in-shardings, so donation holds under GSPMD).
 
 Per-slot sampling (greedy / temperature / top-k, per-request seed)
 reproduces ``GPTForCausalLM.generate``'s exact PRNG chain — one
@@ -45,9 +59,11 @@ from ..utils import chaos
 from ..utils.profiler import RecordEvent
 from .engine import (DeadlineExceededError, EngineStoppedError,
                      QueueFullError)
-from .kv_cache import (CacheGeometry, admit_slot, make_state, release_slots,
-                       state_specs, write_prompt)
+from .kv_cache import (CacheGeometry, admit_slot, make_state, push_pages,
+                       reclaim_pages, release_slots, state_specs,
+                       take_pages, write_prompt)
 from .metrics import GenerationMetrics
+from .prefix_cache import PrefixCache
 from .scheduler import SlotScheduler
 
 logger = logging.getLogger("paddle_tpu.serving")
@@ -191,15 +207,16 @@ class _GenRequest:
 
 
 class GenerationEngine:
-    """Continuous-batching decode over a device-resident KV cache.
+    """Continuous-batching decode over a device-resident paged KV cache.
 
     Args:
-      model: a causal-LM Layer exposing ``slot_prefill``/``slot_decode``
-        (models/gpt.py GPTForCausalLM) and a ``cfg`` with num_layers /
-        num_heads / hidden_size / vocab_size / max_position_embeddings.
+      model: a causal-LM Layer exposing ``slot_prefill`` /
+        ``slot_decode_paged`` (models/gpt.py GPTForCausalLM) and a
+        ``cfg`` with num_layers / num_heads / hidden_size / vocab_size /
+        max_position_embeddings.
       max_slots: in-flight sequences per decode iteration
         (``FLAGS_genserve_max_slots``).
-      max_seq_len: per-slot cache length S_max >= prompt + new tokens
+      max_seq_len: per-slot sequence cap S_max >= prompt + new tokens
         (``FLAGS_genserve_max_seq_len``).
       prompt_buckets: admitted prompt-length grid, list or "8,16,32"
         (``FLAGS_genserve_prompt_buckets``); one prefill+insert
@@ -209,6 +226,19 @@ class GenerationEngine:
         :class:`QueueFullError` beyond it.
       max_top_k: largest per-request top_k accepted (the sampling
         executable carries a static top-k width).
+      page_size: tokens per KV page (``FLAGS_genserve_page_size``).
+      num_pages: page-pool capacity (``FLAGS_genserve_num_pages``);
+        0 sizes it dense-equivalently (max_slots * pages_per_slot) —
+        smaller pools oversubscribe slots against actual footprint and
+        the scheduler queues admissions that cannot reserve their
+        worst case.
+      prefix_cache: share identical tokenized prompt prefixes as
+        refcounted read-only pages (``FLAGS_genserve_prefix_cache``);
+        hits skip prefill for the shared pages.
+      mesh: optional jax Mesh (or a {"tp": 2}-style dict) — serve a
+        tensor-parallel model from one engine.
+      layout: optional distributed.SpecLayout resolving param placements
+        (defaults to ``SpecLayout()`` when a mesh is given).
 
     Lifecycle mirrors ServingEngine: ``start()`` compiles every
     executable (steady state never compiles), ``submit()`` returns a
@@ -217,17 +247,19 @@ class GenerationEngine:
     """
 
     def __init__(self, model, *, max_slots=None, max_seq_len=None,
-                 prompt_buckets=None, queue_depth=None, max_top_k=64):
+                 prompt_buckets=None, queue_depth=None, max_top_k=64,
+                 page_size=None, num_pages=None, prefix_cache=None,
+                 mesh=None, layout=None):
         from ..hapi.model import Model as _HapiModel
 
         if isinstance(model, _HapiModel):
             model = model.network
-        for req_attr in ("slot_prefill", "slot_decode", "cfg"):
+        for req_attr in ("slot_prefill", "slot_decode_paged", "cfg"):
             if not hasattr(model, req_attr):
                 raise TypeError(
                     f"GenerationEngine needs a model with `{req_attr}` "
-                    "(a causal LM with the slot-batched KV-cache decode "
-                    "path, e.g. models.GPTForCausalLM); got "
+                    "(a causal LM with the slot-batched paged KV-cache "
+                    "decode path, e.g. models.GPTForCausalLM); got "
                     f"{type(model).__name__}")
         self.model = model
         cfg = model.cfg
@@ -255,16 +287,49 @@ class GenerationEngine:
         self.queue_depth = int(
             queue_depth or _flags.flag("FLAGS_genserve_queue_depth", 128))
         self.max_top_k = int(max_top_k)
+        page_size = int(page_size
+                        or _flags.flag("FLAGS_genserve_page_size", 16))
+        if num_pages is None:
+            num_pages = int(_flags.flag("FLAGS_genserve_num_pages", 0))
+        if prefix_cache is None:
+            prefix_cache = bool(int(
+                _flags.flag("FLAGS_genserve_prefix_cache", 1)))
 
         self.geometry = CacheGeometry(
             num_layers=cfg.num_layers, max_slots=self.max_slots,
             max_seq_len=self.max_seq_len, num_heads=cfg.num_heads,
             head_dim=cfg.hidden_size // cfg.num_heads,
-            vocab_size=cfg.vocab_size)
-        self.metrics = GenerationMetrics(max_slots=self.max_slots)
+            vocab_size=cfg.vocab_size, page_size=page_size,
+            num_pages=int(num_pages))
+        self.metrics = GenerationMetrics(
+            max_slots=self.max_slots, num_pages=self.geometry.num_pages)
+        self._prefix = (PrefixCache(page_size) if prefix_cache else None)
+        self._slot_pins: dict[int, list] = {}   # slot -> pinned page ids
         self._queue: queue.Queue = queue.Queue(self.queue_depth)
         self._backlog: collections.deque = collections.deque()
-        self._sched = SlotScheduler(self.max_slots)
+        self._sched = SlotScheduler(self.max_slots,
+                                    num_pages=self.geometry.num_pages)
+        if mesh is not None and not hasattr(mesh, "axis_names"):
+            # {"tp": 2}-style dict: build a mesh over exactly the
+            # devices the shape needs (the process may expose more)
+            import jax
+
+            from ..distributed.mesh import build_mesh
+
+            dims = [int(v) for v in dict(mesh).values()]
+            devices = None
+            if all(d > 0 for d in dims):
+                n = 1
+                for d in dims:
+                    n *= d
+                devices = jax.devices()[:n]
+            mesh = build_mesh(dict(mesh), devices=devices)
+        self._mesh = mesh
+        if layout is None and mesh is not None:
+            from ..distributed.layout import SpecLayout
+
+            layout = SpecLayout()
+        self._layout = layout
         self._thread = None
         self._started = False
         self._draining = False
@@ -278,8 +343,10 @@ class GenerationEngine:
         self._buffers = None
         self._decode_exec = None
         self._release_exec = None
+        self._reclaim_exec = None
         self._prefill_execs = {}
         self._insert_execs = {}
+        self._insert_prefix_execs = {}
 
     # -- warmup: build + AOT-compile every executable ----------------------
     def start(self) -> "GenerationEngine":
@@ -294,11 +361,37 @@ class GenerationEngine:
 
         self.model.eval()
         params, buffers = state_pytrees(self.model)
-        self._params, self._buffers = params, buffers
         geom = self.geometry
         V = geom.vocab_size
         k_max = min(self.max_top_k, V)
-        finfo_min = None  # resolved inside traces
+        ps, pps = geom.page_size, geom.pages_per_slot
+        num_pages, seq_cap = geom.num_pages, geom.max_seq_len
+        # static prefix extent of the hit-path executables: the largest
+        # full-page prefix any admitted prompt can share
+        pfx_pages = min(pps, -(-self.prompt_buckets[-1] // ps))
+
+        # sharding plan: None entries (no mesh) keep today's lowering
+        mesh, layout = self._mesh, self._layout
+        if mesh is not None:
+            from jax.sharding import NamedSharding
+            from jax.sharding import PartitionSpec as P
+
+            rep = NamedSharding(mesh, P())
+            pool_sh = NamedSharding(mesh, layout.prune(
+                layout.kv_page_spec(), geom.pool_shape, mesh))
+            kv_sh = NamedSharding(mesh, layout.prune(
+                P(None, None, layout.tp_axis, None),
+                (geom.num_layers, 1, geom.num_heads, geom.head_dim), mesh))
+            pspecs = layout.resolve(
+                {n: np.shape(a) for n, a in params.items()}, mesh,
+                warn=False)
+            params = {n: jax.device_put(a, NamedSharding(mesh, pspecs[n]))
+                      for n, a in params.items()}
+            buffers = {n: jax.device_put(a, rep)
+                       for n, a in buffers.items()}
+        else:
+            rep = pool_sh = kv_sh = None
+        self._params, self._buffers = params, buffers
 
         def sample_token(lg, key, do_sample, temp, top_k):
             """Per-lane sampling, chain-compatible with generate():
@@ -324,74 +417,174 @@ class GenerationEngine:
             return out                     # (k [L,Sp,nh,hd], v, logits [V])
 
         def insert_step(state, slot, k_new, v_new, logits, length, seed,
-                        do_sample, temp, top_k, stop_pos, eos):
-            state = write_prompt(state, slot, k_new, v_new)
+                        do_sample, temp, top_k, stop_pos, eos, pinned):
+            # prefix-miss admission: every mapped page is freshly
+            # allocated and written (shared_n = 0)
+            no_shared = jnp.full((pps,), -1, jnp.int32)
+            state, row = write_prompt(state, slot, k_new, v_new, length,
+                                      no_shared, jnp.int32(0))
             key, sub = jax.random.split(jax.random.PRNGKey(seed))
             tok1 = sample_token(logits, sub, do_sample, temp, top_k)
             state = admit_slot(state, slot, tok1, length, key, do_sample,
-                               temp, top_k, stop_pos, eos)
-            return state, tok1
+                               temp, top_k, stop_pos, eos, pinned)
+            return state, tok1, row
+
+        def insert_prefix_step(params, state, slot, ids, shared_ids,
+                               shared_n, length, seed, do_sample, temp,
+                               top_k, stop_pos, eos, pinned):
+            # prefix-hit admission: gather the cached prefix K/V from
+            # the pool, prefill ONLY the suffix, page the suffix in at
+            # the (page-aligned) boundary
+            gidx = jnp.clip(shared_ids[:pfx_pages], 0, num_pages - 1)
+            pk = state["kp"][:, gidx].reshape(
+                geometry.num_layers, pfx_pages * ps, geometry.num_heads,
+                geometry.head_dim)
+            pv = state["vp"][:, gidx].reshape(
+                geometry.num_layers, pfx_pages * ps, geometry.num_heads,
+                geometry.head_dim)
+            (k_suf, v_suf, logits), _ = functional_call(
+                model, params,
+                (Tensor(ids), pk, pv, shared_n * ps, length),
+                buffers=buffers, mutable=False,
+                method="slot_prefill_prefix")
+            state, row = write_prompt(state, slot, k_suf, v_suf, length,
+                                      shared_ids, shared_n)
+            key, sub = jax.random.split(jax.random.PRNGKey(seed))
+            tok1 = sample_token(logits, sub, do_sample, temp, top_k)
+            state = admit_slot(state, slot, tok1, length, key, do_sample,
+                               temp, top_k, stop_pos, eos, pinned)
+            return state, tok1, row
 
         def decode_step(params, state):
-            (logits, kc, vc), _ = functional_call(
+            lane = jnp.arange(geometry.max_slots)
+            pos, active = state["pos"], state["active"]
+            ptab = state["ptab"]
+            # (1) pop a fresh tail page for lanes whose write position
+            # crossed into an unmapped page — in-graph allocation off
+            # the free-list register (host reserved the worst case)
+            pidx = jnp.clip(pos // ps, 0, pps - 1)
+            cur = ptab[lane, pidx]
+            need = active & (cur < 0)
+            pages, free_count = take_pages(state["free_stack"],
+                                           state["free_count"], need)
+            ptab = ptab.at[lane, pidx].set(jnp.where(need, pages, cur))
+            # (2) one paged-attention token per lane
+            (logits, kp, vp), _ = functional_call(
                 model, params,
-                (state["tok"], state["pos"], state["active"],
-                 state["k"], state["v"]),
-                buffers=buffers, mutable=False, method="slot_decode")
+                (state["tok"], pos, active, state["kp"], state["vp"],
+                 ptab, seq_cap),
+                buffers=buffers, mutable=False, method="slot_decode_paged")
             pair = jax.vmap(jax.random.split)(state["rng"])
             new_keys, subs = pair[:, 0], pair[:, 1]
             toks = jax.vmap(sample_token)(
                 logits, subs, state["do_sample"], state["temp"],
                 state["top_k"])
-            active = state["active"]
             toks = jnp.where(active, toks, state["tok"])
-            new_pos = jnp.where(active, state["pos"] + 1, state["pos"])
+            new_pos = jnp.where(active, pos + 1, pos)
             finished = active & ((toks == state["eos"])
                                  | (new_pos + 1 >= state["stop_pos"]))
-            new_state = dict(state, k=kc, v=vc, tok=toks, pos=new_pos,
-                             rng=new_keys, active=active & ~finished)
+            # (3) retire in-graph: finished lanes' PRIVATE pages (table
+            # index >= pinned) go back on the free stack; shared prefix
+            # pages stay resident for the prefix cache
+            col = jnp.arange(pps, dtype=jnp.int32)[None, :]
+            freeable = finished[:, None] & (ptab >= 0) \
+                & (col >= state["pinned"][:, None])
+            free_stack, free_count = push_pages(
+                state["free_stack"], free_count,
+                jnp.where(freeable, ptab, -1).reshape(-1))
+            ptab = jnp.where(finished[:, None], -1, ptab)
+            new_state = dict(state, kp=kp, vp=vp, ptab=ptab,
+                             free_stack=free_stack, free_count=free_count,
+                             tok=toks, pos=new_pos, rng=new_keys,
+                             active=active & ~finished)
             return new_state, toks, finished
 
         def release_step(state, mask):
             return release_slots(state, mask)
 
+        def reclaim_step(state, pages):
+            return reclaim_pages(state, pages)
+
         self._state = make_state(geom)
-        sspec = state_specs(self._state)
-        pspec = inference.spec_tree(params)
-        i32 = jax.ShapeDtypeStruct((), np.int32)
-        f32 = jax.ShapeDtypeStruct((), np.float32)
-        b1 = jax.ShapeDtypeStruct((), np.bool_)
+        if mesh is not None:
+            state_sh = {k: (pool_sh if k in ("kp", "vp") else rep)
+                        for k in self._state}
+            self._state = {k: jax.device_put(a, state_sh[k])
+                           for k, a in self._state.items()}
+        else:
+            state_sh = None
+        sspec = state_specs(self._state, shardings=state_sh)
+        if mesh is not None:
+            pspec = {n: jax.ShapeDtypeStruct(a.shape, a.dtype,
+                                             sharding=a.sharding)
+                     for n, a in params.items()}
+
+            def sds(shape, dtype, sh=rep):
+                return jax.ShapeDtypeStruct(shape, dtype, sharding=sh)
+        else:
+            pspec = inference.spec_tree(params)
+
+            def sds(shape, dtype, sh=None):
+                return jax.ShapeDtypeStruct(shape, dtype)
+        i32 = sds((), np.int32)
+        f32 = sds((), np.float32)
+        b1 = sds((), np.bool_)
+        pvec = sds((pps,), np.int32)
         kv_dt = np.dtype(geometry.dtype)
+        out_state = state_sh if mesh is not None else None
+
+        def outs(*tail):
+            # out-shardings pinned to in-shardings (donation contract);
+            # None (no mesh) keeps the default lowering
+            if mesh is None:
+                return None
+            return (out_state,) + tail
 
         with RecordEvent("paddle.genserve/warmup"):
             self._decode_exec = inference.aot_compile(
-                decode_step, (pspec, sspec), donate_argnums=(1,))
+                decode_step, (pspec, sspec), donate_argnums=(1,),
+                out_shardings=outs(rep, rep))
             self.compile_count += 1
             self._release_exec = inference.aot_compile(
-                release_step,
-                (sspec, jax.ShapeDtypeStruct((self.max_slots,), np.bool_)),
-                donate_argnums=(0,))
+                release_step, (sspec, sds((self.max_slots,), np.bool_)),
+                donate_argnums=(0,), out_shardings=out_state)
             self.compile_count += 1
+            if self._prefix is not None:
+                self._reclaim_exec = inference.aot_compile(
+                    reclaim_step, (sspec, pvec), donate_argnums=(0,),
+                    out_shardings=out_state)
+                self.compile_count += 1
             for sp in self.prompt_buckets:
-                ids = jax.ShapeDtypeStruct((1, sp), np.int32)
-                kv = jax.ShapeDtypeStruct(
-                    (geom.num_layers, sp, geom.num_heads, geom.head_dim),
-                    kv_dt)
-                lg = jax.ShapeDtypeStruct((V,), np.float32)
+                ids = sds((1, sp), np.int32)
+                kv = sds((geom.num_layers, sp, geom.num_heads,
+                          geom.head_dim), kv_dt, kv_sh)
+                lg = sds((V,), np.float32)
                 self._prefill_execs[sp] = inference.aot_compile(
-                    prefill_step, (pspec, ids, i32))
+                    prefill_step, (pspec, ids, i32),
+                    out_shardings=(kv_sh, kv_sh, rep)
+                    if mesh is not None else None)
                 self._insert_execs[sp] = inference.aot_compile(
                     insert_step,
                     (sspec, i32, kv, kv, lg, i32, i32, b1, f32, i32, i32,
-                     i32),
-                    donate_argnums=(0,))
+                     i32, i32),
+                    donate_argnums=(0,), out_shardings=outs(rep, rep))
                 self.compile_count += 2
+                if self._prefix is not None:
+                    self._insert_prefix_execs[sp] = inference.aot_compile(
+                        insert_prefix_step,
+                        (pspec, sspec, i32, ids, pvec, i32, i32, i32, b1,
+                         f32, i32, i32, i32, i32),
+                        donate_argnums=(1,), out_shardings=outs(rep, rep))
+                    self.compile_count += 1
         self.metrics.set_compile_count(self.compile_count)
         logger.info(
             "generation warmup compiled %d executable(s): slots=%d "
-            "S_max=%d prompt buckets=%s cache=%.1f MB", self.compile_count,
-            self.max_slots, self.max_seq_len, self.prompt_buckets,
-            self.geometry.kv_bytes() / 1048576)
+            "S_max=%d prompt buckets=%s pages=%dx%d cache=%.1f MB%s",
+            self.compile_count, self.max_slots, self.max_seq_len,
+            self.prompt_buckets, geom.num_pages, geom.page_size,
+            geom.kv_bytes() / 1048576,
+            f" mesh={dict(zip(mesh.axis_names, mesh.devices.shape))}"
+            if mesh is not None else "")
 
         # publish introspection surfaces (monitor/perf.py): the decode
         # op table over /debug/perf, and owner tags so the buffer
@@ -470,6 +663,14 @@ class GenerationEngine:
             raise ValueError(
                 f"prompt {L} + max_new_tokens {max_new_tokens} exceeds "
                 f"max_seq_len {self.max_seq_len}")
+        worst_pages = self.geometry.pages_for(L + max_new_tokens)
+        if worst_pages > self.geometry.num_pages:
+            # could NEVER be admitted: even an empty pool is too small
+            self.metrics.count("rejected_pages_exhausted")
+            raise ValueError(
+                f"request needs {worst_pages} KV pages worst-case; the "
+                f"pool holds {self.geometry.num_pages} (raise num_pages "
+                f"or page_size)")
         top_k = int(top_k)
         if top_k > self.max_top_k:
             raise ValueError(f"top_k {top_k} exceeds max_top_k "
@@ -530,6 +731,8 @@ class GenerationEngine:
                 self._preempt_swept()
                 occupied = self._sched.occupied
                 self.metrics.set_occupancy(len(occupied))
+                self.metrics.set_page_occupancy(
+                    self.geometry.num_pages - self._sched.pages_available)
                 if occupied and not self._stopped:
                     toks, fin = self.step()
                     self._distribute(toks, fin)
@@ -593,44 +796,85 @@ class GenerationEngine:
         self._backlog = keep
 
     def _admit_ready(self):
-        while self._backlog and self._sched.has_free() \
-                and not self._stopped:
-            req = self._backlog.popleft()
-            slot = self._sched.admit(req)
+        while self._backlog and not self._stopped:
+            req = self._backlog[0]
+            j_hit, shared = (self._prefix.lookup(req.prompt)
+                             if self._prefix is not None else (0, ()))
+            need = self.geometry.pages_for(
+                len(req.prompt) + req.max_new_tokens) - j_hit
+            if not self._sched.can_admit(need):
+                # no free lane, or the pool cannot reserve the worst
+                # case — FIFO head-of-line wait until a retirement
+                # frees lanes/pages (admit-and-crash is not an option)
+                break
+            self._backlog.popleft()
+            slot = self._sched.admit(req, n_pages=need)
             try:
-                self._admit(req, slot)
+                self._admit(req, slot, j_hit, shared)
             except Exception as e:  # noqa: BLE001 - fail THIS request,
                 # keep the decode loop alive for the others
                 logger.exception("generation admission failed")
                 self.metrics.count("errors")
-                self._sched.retire(slot)
+                self._host_retire(slot)
                 req.end_spans("error")
                 req.handle._finish(e)
 
-    def _admit(self, req: _GenRequest, slot: int):
-        """Prefill + insert: seed the slot's cache rows and arm the lane
-        with its first sampled token — the request joins the in-flight
-        batch at this iteration boundary."""
+    def _admit(self, req: _GenRequest, slot: int, j_hit: int, shared):
+        """Prefill + insert: map the slot's cache pages (reusing any
+        cached prefix pages) and arm the lane with its first sampled
+        token — the request joins the in-flight batch at this iteration
+        boundary."""
+        geom = self.geometry
         L = len(req.prompt)
         if req.span_queue is not None:
             req.span_queue.end(status="ok")
             req.span_queue = None
+        j_reg = (self._prefix.shareable_pages(L)
+                 if self._prefix is not None else 0)
+        pinned = max(j_hit, j_reg)
         sp_prefill = (req.span.child("gen.prefill", bucket=req.bucket,
-                                     prompt_len=L, slot=slot)
+                                     prompt_len=L, slot=slot,
+                                     prefix_pages=j_hit)
                       if req.span is not None else None)
-        ids = np.zeros((1, req.bucket), np.int32)
-        ids[0, :L] = req.prompt
+        stop = np.int32(L + req.max_new_tokens)
         with RecordEvent("paddle.genserve/prefill"):
-            k_new, v_new, logits = self._prefill_execs[req.bucket](
-                self._params, ids, np.int32(L))
-            state, tok1 = self._insert_execs[req.bucket](
-                self._state, np.int32(slot), k_new, v_new, logits,
-                np.int32(L), np.int32(req.seed), np.bool_(req.do_sample),
-                np.float32(req.temperature), np.int32(req.top_k),
-                np.int32(L + req.max_new_tokens), np.int32(req.eos))
+            if j_hit > 0:
+                # prefix hit: prefill ONLY the suffix
+                suffix = req.prompt[j_hit * geom.page_size:]
+                sb = self._bucket_for(len(suffix))
+                ids = np.zeros((1, sb), np.int32)
+                ids[0, :len(suffix)] = suffix
+                shared_vec = np.full((geom.pages_per_slot,), -1, np.int32)
+                shared_vec[:j_hit] = shared[:j_hit]
+                state, tok1, row = self._insert_prefix_execs[sb](
+                    self._params, self._state, np.int32(slot), ids,
+                    shared_vec, np.int32(j_hit), np.int32(L),
+                    np.int32(req.seed), np.bool_(req.do_sample),
+                    np.float32(req.temperature), np.int32(req.top_k),
+                    stop, np.int32(req.eos), np.int32(pinned))
+            else:
+                ids = np.zeros((1, req.bucket), np.int32)
+                ids[0, :L] = req.prompt
+                k_new, v_new, logits = self._prefill_execs[req.bucket](
+                    self._params, ids, np.int32(L))
+                state, tok1, row = self._insert_execs[req.bucket](
+                    self._state, np.int32(slot), k_new, v_new, logits,
+                    np.int32(L), np.int32(req.seed),
+                    np.bool_(req.do_sample), np.float32(req.temperature),
+                    np.int32(req.top_k), stop, np.int32(req.eos),
+                    np.int32(pinned))
         self._state = state
         with host_fetch():
             t1 = int(np.array(tok1, copy=True))
+            row_np = np.array(row, copy=True)
+        if self._prefix is not None:
+            self.metrics.count_prefix(hit=j_hit > 0)
+            pin_pages = [int(p) for p in row_np[:pinned]]
+            self._prefix.pin(pin_pages)
+            self._slot_pins[slot] = pin_pages
+            self._reclaim(self._prefix.register(req.prompt, row_np,
+                                                j_hit, j_reg))
+            self._sched.set_shared_resident(self._prefix.resident_pages)
         if sp_prefill is not None:
             sp_prefill.end(status="ok")
         now = time.monotonic()
@@ -642,7 +886,7 @@ class GenerationEngine:
         self.metrics.observe_tokens(1)
         if req.max_new_tokens == 1 or t1 == req.eos:
             self._release([slot])
-            self._sched.retire(slot)
+            self._host_retire(slot)
             self.metrics.count("retired")
             req.end_spans("ok")
             req.handle._finish()
@@ -655,13 +899,38 @@ class GenerationEngine:
             mask[s] = True
         self._state = self._release_exec(self._state, mask)
 
+    def _host_retire(self, slot: int):
+        """Host-side retirement: drop the slot's scheduler reservation
+        and its prefix-cache pins, reclaiming shared pages whose
+        refcount hit zero.  The device-side page free happened in-graph
+        (decode/release).  Returns the slot's request."""
+        req = self._sched.retire(slot)
+        pages = self._slot_pins.pop(slot, None)
+        if pages and self._prefix is not None:
+            self._reclaim(self._prefix.unpin(pages))
+        if self._prefix is not None:
+            self._sched.set_shared_resident(self._prefix.resident_pages)
+        return req
+
+    def _reclaim(self, pages):
+        """Return evicted/orphaned prefix-cache pages to the device free
+        stack (chunked through the fixed-width reclaim executable)."""
+        if not pages:
+            return
+        pps = self.geometry.pages_per_slot
+        for i in range(0, len(pages), pps):
+            vec = np.full((pps,), -1, np.int32)
+            chunk = pages[i:i + pps]
+            vec[:len(chunk)] = chunk
+            self._state = self._reclaim_exec(self._state, vec)
+
     def _preempt_swept(self):
         swept = self._sched.sweep()
         if not swept:
             return
         self._release([slot for slot, _, _ in swept])
         for slot, req, reason in swept:
-            self._sched.retire(slot)
+            self._host_retire(slot)
             self.metrics.count(reason)
             self.metrics.count("preempted")
             req.end_spans(reason)
@@ -672,8 +941,9 @@ class GenerationEngine:
     def step(self):
         """ONE decode iteration: every in-flight lane advances a token.
         The state pytree is donated to the compiled executable (the KV
-        cache is rewritten on device, never fetched); only the sampled
-        token ids and finished mask cross to host, under host_fetch()."""
+        page pool is rewritten on device, never fetched); only the
+        sampled token ids and finished mask cross to host, under
+        host_fetch()."""
         self._iter += 1
         chaos.on_step(self._iter)   # fault-injection seam (utils/chaos)
         with RecordEvent("paddle.genserve/decode"):
@@ -698,7 +968,9 @@ class GenerationEngine:
                 # host ints only — toks/fin were fetched in step()
                 req.span_decode.event("token", i=len(req.handle.tokens))
             if bool(fin_np[slot]):
-                self._sched.retire(slot)
+                # the decode step already pushed the lane's private
+                # pages back in-graph; this drops the host bookkeeping
+                self._host_retire(slot)
                 self.metrics.count("retired")
                 req.end_spans("ok")
                 req.handle._finish()
@@ -719,6 +991,7 @@ class GenerationEngine:
                 req.handle._finish(exc)
         for slot in list(self._sched.occupied):
             req = self._sched.retire(slot)
+            self._slot_pins.pop(slot, None)
             req.end_spans("error")
             req.handle._finish(exc)
 
@@ -789,7 +1062,7 @@ def main(argv=None):
 
     parser = argparse.ArgumentParser(
         description="paddle_tpu generation server (continuous-batching "
-                    "decode with a device-resident KV cache)")
+                    "decode with a device-resident paged KV cache)")
     parser.add_argument("--layers", type=int, default=2)
     parser.add_argument("--hidden", type=int, default=64)
     parser.add_argument("--heads", type=int, default=4)
@@ -797,6 +1070,13 @@ def main(argv=None):
     parser.add_argument("--max-seq-len", type=int, default=64)
     parser.add_argument("--slots", type=int, default=4)
     parser.add_argument("--prompt-buckets", default="8,16")
+    parser.add_argument("--page-size", type=int, default=16)
+    parser.add_argument("--num-pages", type=int, default=0,
+                        help="KV page pool size; 0 = dense-equivalent "
+                             "(slots * pages_per_slot)")
+    parser.add_argument("--prefix-cache", type=int, default=1,
+                        help="1 shares identical prompt prefixes as "
+                             "read-only pages; 0 disables")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8867,
@@ -819,7 +1099,10 @@ def main(argv=None):
     model.eval()
     engine = GenerationEngine(model, max_slots=args.slots,
                               max_seq_len=args.max_seq_len,
-                              prompt_buckets=args.prompt_buckets)
+                              prompt_buckets=args.prompt_buckets,
+                              page_size=args.page_size,
+                              num_pages=args.num_pages,
+                              prefix_cache=bool(args.prefix_cache))
     server = ServingServer(None, gen_engine=engine, host=args.host,
                            port=args.port).start()
     # parse-friendly readiness line (tools/serve_smoke.sh greps it)

@@ -1,17 +1,30 @@
-"""Device-resident slot-batched KV cache for continuous-batching decode.
+"""Device-resident PAGED KV cache for continuous-batching decode.
 
 The generation engine's whole mutable decode state is ONE pytree of
-fixed-shape jax arrays — the stacked per-layer KV cache
-(``[layers, slots, S_max, nh, hd]``, the fused_multi_transformer CacheKV
-layout turned TPU-native) plus the per-slot lane registers (pending
-token, write position, active mask, sampling params, per-slot PRNG
-keys).  Every jitted transition (insert / decode / release) takes the
-state as its first argument with ``donate_argnums=(0,)`` — the
+fixed-shape jax arrays: a page pool ``[layers, num_pages, page_size,
+nh, hd]`` (the fused_multi_transformer CacheKV layout broken into
+fixed-size pages, vLLM-style), an int32 per-slot page table
+``[max_slots, pages_per_slot]`` (-1 = unmapped), a free-list register
+(``free_stack`` + scalar ``free_count``), and the per-slot lane
+registers (pending token, write position, active mask, sampling params,
+per-slot PRNG keys, pinned shared-page count).
+
+Every jitted transition (insert / decode / release / reclaim) takes the
+state as its first state-argument with ``donate_argnums`` — the
 TrainEngine donation contract from hapi/engine.py — so XLA rewrites the
-cache in place and the KV bytes NEVER round-trip to host between
-iterations.  The engine thread owns the single live reference; a
-consumed (donated) state is immediately replaced by the transition's
-output.
+pool in place and the KV bytes NEVER round-trip to host.  Page
+allocation happens IN-GRAPH: admission maps ``ceil(len/page_size)``
+pages off the free stack, decode pops a fresh tail page the iteration a
+lane's write position crosses a page boundary, and retirement pushes a
+lane's private pages back — so cache HBM is set by actual token
+footprint (``num_pages``), not ``max_slots * S_max`` worst case.
+
+Pages with table index below a lane's ``pinned`` register are SHARED
+(prefix-cache pages, serving/prefix_cache.py): the device never frees
+them; the host returns them through ``reclaim_pages`` once their
+refcount drops to zero.  The free-list discipline assumes the host
+admits only requests whose worst-case page demand is reserved
+(serving/scheduler.py) — ``take_pages`` underflows silently otherwise.
 
 This module is layout + traced transitions only; scheduling policy lives
 in serving/scheduler.py and the compiled-executable lifecycle in
@@ -21,42 +34,76 @@ from __future__ import annotations
 
 from dataclasses import dataclass
 
-__all__ = ["CacheGeometry", "make_state", "state_specs", "write_prompt",
-           "admit_slot", "release_slots"]
+__all__ = ["CacheGeometry", "make_state", "state_specs", "take_pages",
+           "push_pages", "write_prompt", "admit_slot", "release_slots",
+           "reclaim_pages"]
 
 
 @dataclass(frozen=True)
 class CacheGeometry:
     """Static shape of the decode state — one geometry == one decode
-    executable (the zero-steady-state-compile invariant)."""
+    executable (the zero-steady-state-compile invariant).
+
+    ``num_pages`` bounds cache HBM: 0 (the default) sizes the pool
+    dense-equivalently at ``max_slots * pages_per_slot`` so every slot
+    can always hold S_max tokens; smaller pools oversubscribe slots
+    against actual footprint (the scheduler queues admissions that
+    cannot reserve their worst case)."""
     num_layers: int
     max_slots: int
     max_seq_len: int       # S_max: prompt + generated tokens per slot
     num_heads: int
     head_dim: int
     vocab_size: int
+    page_size: int = 16
+    num_pages: int = 0     # 0 = max_slots * pages_per_slot
     dtype: str = "float32"
 
+    def __post_init__(self):
+        if self.page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {self.page_size}")
+        if self.num_pages == 0:
+            object.__setattr__(self, "num_pages",
+                               self.max_slots * self.pages_per_slot)
+        if self.num_pages < 1:
+            raise ValueError(
+                f"num_pages must be >= 1, got {self.num_pages}")
+
     @property
-    def kv_shape(self):
-        return (self.num_layers, self.max_slots, self.max_seq_len,
+    def pages_per_slot(self) -> int:
+        return -(-self.max_seq_len // self.page_size)
+
+    @property
+    def pool_shape(self):
+        return (self.num_layers, self.num_pages, self.page_size,
                 self.num_heads, self.head_dim)
 
-    def kv_bytes(self) -> int:
+    def page_bytes(self) -> int:
+        """Bytes ONE page costs across k+v and all layers — the HBM
+        sizing unit: cache bytes = num_pages * page_bytes()."""
         import numpy as np
 
-        n = 2  # k and v
-        for d in self.kv_shape:
-            n *= d
-        return n * np.dtype(self.dtype).itemsize
+        return (2 * self.num_layers * self.page_size * self.num_heads
+                * self.head_dim * np.dtype(self.dtype).itemsize)
+
+    def kv_bytes(self) -> int:
+        return self.num_pages * self.page_bytes()
+
+    def pages_for(self, n_tokens: int) -> int:
+        """Pages an ``n_tokens``-long sequence occupies."""
+        return -(-int(n_tokens) // self.page_size)
 
 
 def make_state(geom: CacheGeometry):
-    """Fresh all-lanes-free decode state (device arrays).
+    """Fresh all-pages-free decode state (device arrays).
 
-    Keys: ``k``/``v`` the stacked cache; per-slot lanes ``tok`` (pending
-    token, written at ``pos`` next iteration), ``pos`` (absolute write
-    index), ``active``, ``rng`` (per-slot PRNG key), and the per-slot
+    Keys: ``kp``/``vp`` the page pools; ``ptab`` the per-slot page
+    table (-1 = unmapped); ``free_stack``/``free_count`` the free-list
+    register (free page ids live at ``free_stack[:free_count]``, popped
+    from the top); per-slot lanes ``tok`` (pending token, written at
+    ``pos`` next iteration), ``pos`` (absolute write index), ``active``,
+    ``rng`` (per-slot PRNG key), ``pinned`` (table indices below it are
+    shared prefix pages the device must not free), and the per-slot
     sampling registers ``do_sample``/``temp``/``top_k``/``eos``/
     ``stop_pos`` (stop_pos = prompt_len + max_new_tokens; a lane retires
     when its next write position would reach it, or on eos).
@@ -67,8 +114,12 @@ def make_state(geom: CacheGeometry):
     S = geom.max_slots
     key_shape = jax.random.PRNGKey(0).shape  # (2,) for threefry
     return {
-        "k": jnp.zeros(geom.kv_shape, jnp.dtype(geom.dtype)),
-        "v": jnp.zeros(geom.kv_shape, jnp.dtype(geom.dtype)),
+        "kp": jnp.zeros(geom.pool_shape, jnp.dtype(geom.dtype)),
+        "vp": jnp.zeros(geom.pool_shape, jnp.dtype(geom.dtype)),
+        "ptab": jnp.full((S, geom.pages_per_slot), -1, jnp.int32),
+        "free_stack": jnp.arange(geom.num_pages, dtype=jnp.int32),
+        "free_count": jnp.int32(geom.num_pages),
+        "pinned": jnp.zeros((S,), jnp.int32),
         "tok": jnp.zeros((S,), jnp.int32),
         "pos": jnp.zeros((S,), jnp.int32),
         "active": jnp.zeros((S,), bool),
@@ -81,44 +132,113 @@ def make_state(geom: CacheGeometry):
     }
 
 
-def state_specs(state):
-    """ShapeDtypeStructs mirroring a state pytree (AOT lowering input)."""
+def state_specs(state, shardings=None):
+    """ShapeDtypeStructs mirroring a state pytree (AOT lowering input).
+    ``shardings``: optional matching pytree of NamedShardings — attached
+    so the layout-aware engine lowers its executables with the page
+    pool's head axis pinned over tp."""
     import jax
 
+    if shardings is None:
+        return jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
     return jax.tree_util.tree_map(
-        lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), state)
+        lambda a, s: jax.ShapeDtypeStruct(a.shape, a.dtype, sharding=s),
+        state, shardings)
 
 
-def write_prompt(state, slot, k_new, v_new):
-    """Scatter one request's prefill K/V (``[layers, Sp, nh, hd]``) into
-    cache row ``slot``, zero-filling positions Sp..S_max-1 (clears the
-    previous occupant's tail — slot-reuse isolation by construction, not
-    just by masking).  Traced; ``slot`` is a traced scalar so ONE
-    executable per prompt bucket serves every slot index."""
+# -- in-graph free-list register ops ----------------------------------------
+
+def take_pages(free_stack, free_count, need):
+    """Pop one page per True lane of ``need`` off the free stack.
+    Returns (pages, free_count') — lanes with need=False get -1.  The
+    stack array itself is untouched (entries above free_count are
+    stale); the host guarantees free_count never underflows by
+    reserving worst-case demand at admission."""
     import jax.numpy as jnp
-    from jax import lax
 
-    k_cache = state["k"]
-    L, _, S_max = k_cache.shape[0], k_cache.shape[1], k_cache.shape[2]
+    need = need.astype(bool)
+    ranks = jnp.cumsum(need.astype(jnp.int32)) - 1
+    idx = jnp.clip(free_count - 1 - ranks, 0, free_stack.shape[0] - 1)
+    pages = jnp.where(need, free_stack[idx], -1)
+    return pages, free_count - need.sum(dtype=jnp.int32)
+
+
+def push_pages(free_stack, free_count, pages):
+    """Push the valid (>= 0) entries of ``pages`` onto the free stack;
+    -1 entries are skipped.  Returns (free_stack', free_count')."""
+    import jax.numpy as jnp
+
+    valid = pages >= 0
+    ranks = jnp.cumsum(valid.astype(jnp.int32)) - 1
+    # invalid entries target one-past-the-end and are dropped
+    idx = jnp.where(valid, free_count + ranks, free_stack.shape[0])
+    free_stack = free_stack.at[idx].set(pages, mode="drop")
+    return free_stack, free_count + valid.sum(dtype=jnp.int32)
+
+
+# -- traced transitions ------------------------------------------------------
+
+def write_prompt(state, slot, k_new, v_new, length, shared_ids, shared_n):
+    """Map + fill one admitted request's cache pages.
+
+    ``k_new``/``v_new`` ``[layers, Sb, nh, hd]`` hold prefill K/V for
+    absolute positions ``[shared_n * page_size, shared_n * page_size +
+    Sb)`` (a full-prompt bucket on a prefix miss, the suffix bucket on a
+    prefix hit — full-page-only sharing keeps the boundary aligned).
+    Pages ``[0, shared_n)`` of the slot's table row come from
+    ``shared_ids`` (already resident read-only prefix pages); pages
+    ``[shared_n, ceil(length / page_size))`` are popped off the free
+    stack and written — so insert costs O(prompt_len) pages, never
+    O(S_max).  Traced; ``slot``/``length``/``shared_n`` are traced
+    scalars so ONE executable per bucket serves every slot and every
+    prefix split.  Returns ``(state, row)`` — the row is fetched by the
+    engine to register/refcount pages host-side."""
+    import jax.numpy as jnp
+
+    kp, vp = state["kp"], state["vp"]
+    L, num_pages, ps = kp.shape[0], kp.shape[1], kp.shape[2]
+    pps = state["ptab"].shape[1]
+    Sb = k_new.shape[1]
+    n_pb = -(-Sb // ps)                     # static: pages k_new spans
     slot = jnp.asarray(slot, jnp.int32)
-    zero = jnp.int32(0)
+    length = jnp.asarray(length, jnp.int32)
+    shared_n = jnp.asarray(shared_n, jnp.int32)
 
-    def pad(x):
-        full = jnp.zeros((L, S_max) + x.shape[2:], k_cache.dtype)
-        return full.at[:, :x.shape[1]].set(x.astype(k_cache.dtype))
+    n_total = (length + ps - 1) // ps       # traced: pages the prompt needs
+    j = jnp.arange(pps, dtype=jnp.int32)
+    priv = (j >= shared_n) & (j < n_total)
+    pages, free_count = take_pages(state["free_stack"],
+                                   state["free_count"], priv)
+    row = jnp.where(j < shared_n, shared_ids, pages)
 
-    k_cache = lax.dynamic_update_slice(
-        k_cache, pad(k_new)[:, None], (zero, slot, zero, zero, zero))
-    v_cache = lax.dynamic_update_slice(
-        state["v"], pad(v_new)[:, None], (zero, slot, zero, zero, zero))
-    return dict(state, k=k_cache, v=v_cache)
+    # scatter k_new's page view into the freshly mapped private pages;
+    # chunk t covers table index shared_n + t, chunks past the prompt's
+    # last page target one-past-the-pool and are dropped
+    t = jnp.arange(n_pb, dtype=jnp.int32)
+    pj = shared_n + t
+    tgt = jnp.where(pj < n_total,
+                    row[jnp.clip(pj, 0, pps - 1)], num_pages)
+
+    def to_pages(x):
+        pad = jnp.zeros((L, n_pb * ps) + x.shape[2:], kp.dtype)
+        pad = pad.at[:, :Sb].set(x.astype(kp.dtype))
+        return pad.reshape((L, n_pb, ps) + x.shape[2:])
+
+    kp = kp.at[:, tgt].set(to_pages(k_new), mode="drop")
+    vp = vp.at[:, tgt].set(to_pages(v_new), mode="drop")
+    ptab = state["ptab"].at[slot].set(row)
+    state = dict(state, kp=kp, vp=vp, ptab=ptab, free_count=free_count)
+    return state, row
 
 
 def admit_slot(state, slot, tok, length, rng_key, do_sample, temp, top_k,
-               stop_pos, eos):
+               stop_pos, eos, pinned):
     """Arm lane ``slot``: pending token ``tok`` (the first generated
     token, sampled from the prefill logits) will be written at position
-    ``length`` on the next decode iteration.  Traced scalar args."""
+    ``length`` on the next decode iteration; table indices below
+    ``pinned`` are shared prefix pages the device never frees.  Traced
+    scalar args."""
     import jax.numpy as jnp
 
     slot = jnp.asarray(slot, jnp.int32)
@@ -128,6 +248,8 @@ def admit_slot(state, slot, tok, length, rng_key, do_sample, temp, top_k,
         pos=state["pos"].at[slot].set(jnp.asarray(length, jnp.int32)),
         active=state["active"].at[slot].set(True),
         rng=state["rng"].at[slot].set(rng_key),
+        pinned=state["pinned"].at[slot].set(
+            jnp.asarray(pinned, jnp.int32)),
         do_sample=state["do_sample"].at[slot].set(
             jnp.asarray(do_sample, bool)),
         temp=state["temp"].at[slot].set(jnp.asarray(temp, jnp.float32)),
@@ -139,7 +261,28 @@ def admit_slot(state, slot, tok, length, rng_key, do_sample, temp, top_k,
 
 
 def release_slots(state, mask):
-    """Deactivate the masked lanes (retire / cancel / deadline-preempt).
-    The cache rows keep their bytes — the next occupant's write_prompt
-    overwrites them and the position mask hides them meanwhile."""
-    return dict(state, active=state["active"] & ~mask)
+    """Deactivate the masked lanes (retire / cancel / deadline-preempt)
+    and push their PRIVATE pages (table index >= the lane's ``pinned``
+    register) back onto the free stack; shared prefix pages stay
+    resident for the prefix cache, returned later via
+    ``reclaim_pages`` when their host refcount drops to zero."""
+    import jax.numpy as jnp
+
+    ptab = state["ptab"]
+    col = jnp.arange(ptab.shape[1], dtype=jnp.int32)[None, :]
+    freeable = mask[:, None] & (ptab >= 0) & (col >= state["pinned"][:, None])
+    free_stack, free_count = push_pages(
+        state["free_stack"], state["free_count"],
+        jnp.where(freeable, ptab, -1).reshape(-1))
+    ptab = jnp.where(mask[:, None], -1, ptab)
+    return dict(state, ptab=ptab, free_stack=free_stack,
+                free_count=free_count, active=state["active"] & ~mask)
+
+
+def reclaim_pages(state, pages):
+    """Return evicted prefix-cache pages (int32, -1-padded) to the free
+    stack — the host calls this once a shared page's refcount hits zero
+    (entry evicted AND no slot still reading it)."""
+    free_stack, free_count = push_pages(
+        state["free_stack"], state["free_count"], pages)
+    return dict(state, free_stack=free_stack, free_count=free_count)

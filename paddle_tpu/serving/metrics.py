@@ -182,19 +182,24 @@ class GenerationMetrics:
       paddle_genserve_inter_token_p50_ms / _p99_ms
                                              gap between a slot's tokens
       paddle_genserve_slot_occupancy         occupied / max_slots
+      paddle_genserve_page_occupancy         KV pages in use / num_pages
       paddle_genserve_tokens_total           generated tokens
       paddle_genserve_requests_total{result} admitted/retired/preempted/…
+      paddle_genserve_prefix_cache_hits_total / _misses_total
+                                             prefix-cache admissions
+      paddle_genserve_prefix_cache_hit_ratio hits / (hits + misses)
       paddle_genserve_compile_count          executables built at warmup
     """
 
     WINDOW_S = 60.0
     RESERVOIR = 4096
 
-    def __init__(self, max_slots: int = 1):
+    def __init__(self, max_slots: int = 1, num_pages: int = 1):
         self.registry = MetricsRegistry()
         self._lock = self.registry._lock
         self.started_at = time.monotonic()
         self.max_slots = max(1, int(max_slots))
+        self.num_pages = max(1, int(num_pages))
         reg = self.registry
         reg.gauge("paddle_genserve_decode_tokens_per_sec",
                   "generated tokens per second over the trailing window",
@@ -214,6 +219,13 @@ class GenerationMetrics:
         reg.gauge("paddle_genserve_slot_occupancy",
                   "occupied decode slots / max_slots",
                   fn=lambda: self._occupied / self.max_slots)
+        reg.gauge("paddle_genserve_page_occupancy",
+                  "KV cache pages in use (reserved + prefix-shared) / "
+                  "num_pages",
+                  fn=lambda: self._pages_in_use / self.num_pages)
+        reg.gauge("paddle_genserve_prefix_cache_hit_ratio",
+                  "prefix-cache hits / (hits + misses) since start",
+                  fn=self._prefix_ratio_locked)
         reg.gauge("paddle_genserve_compile_count",
                   "decode/prefill/insert executables compiled at warmup "
                   "(must not grow under traffic)",
@@ -223,14 +235,22 @@ class GenerationMetrics:
             "generation request outcomes by result", label="result",
             preset=("admitted", "retired", "preempted",
                     "rejected_queue_full", "rejected_draining",
-                    "deadline_expired", "cancelled", "errors"),
+                    "rejected_pages_exhausted", "deadline_expired",
+                    "cancelled", "errors"),
             fixed=True)
         self._tokens = reg.counter(
             "paddle_genserve_tokens_total", "generated tokens streamed")
+        self._prefix_hits = reg.counter(
+            "paddle_genserve_prefix_cache_hits_total",
+            "admissions that reused cached prefix pages")
+        self._prefix_misses = reg.counter(
+            "paddle_genserve_prefix_cache_misses_total",
+            "admissions that found no cached prefix")
         self._ttft = collections.deque(maxlen=self.RESERVOIR)
         self._gaps = collections.deque(maxlen=self.RESERVOIR)
         self._token_stamps = collections.deque()   # (monotonic, count)
         self._occupied = 0
+        self._pages_in_use = 0
         self.compile_count = 0
 
     @property
@@ -262,11 +282,23 @@ class GenerationMetrics:
         with self._lock:
             self._occupied = int(occupied)
 
+    def set_page_occupancy(self, pages_in_use: int):
+        with self._lock:
+            self._pages_in_use = int(pages_in_use)
+
+    def count_prefix(self, hit: bool):
+        (self._prefix_hits if hit else self._prefix_misses).inc()
+
     def set_compile_count(self, n: int):
         with self._lock:
             self.compile_count = int(n)
 
     # -- derived values ----------------------------------------------------
+    def _prefix_ratio_locked(self):
+        hits = self._prefix_hits.value
+        total = hits + self._prefix_misses.value
+        return hits / total if total else 0.0
+
     def _quantile_locked(self, deque_, q: float):
         if not deque_:
             return 0.0
@@ -297,6 +329,12 @@ class GenerationMetrics:
                 "inter_token_p99_ms": round(
                     self._quantile_locked(self._gaps, 0.99), 3),
                 "slot_occupancy": round(self._occupied / self.max_slots, 3),
+                "page_occupancy": round(
+                    self._pages_in_use / self.num_pages, 3),
+                "prefix_cache_hits": self._prefix_hits.value,
+                "prefix_cache_misses": self._prefix_misses.value,
+                "prefix_cache_hit_ratio": round(
+                    self._prefix_ratio_locked(), 4),
                 "compile_count": self.compile_count,
                 **{k: v for k, v in sorted(self.counters.items())},
             }

@@ -1,0 +1,122 @@
+"""Host-side prefix cache: tokenized prompt prefix -> resident KV pages.
+
+The shared-system-prompt-times-a-million-users pattern: identical
+prompt prefixes should occupy the page pool ONCE.  This module is pure
+host bookkeeping over the device-resident pool of serving/kv_cache.py —
+it never touches a jax array and is owned by the engine's single decode
+thread, so it needs no lock.
+
+Sharing is full-page-only: a prompt of length L can share at most
+``floor((L - 1) / page_size)`` pages (the -1 guarantees at least one
+suffix token so admission always has a position to compute logits at,
+and full-page alignment means the copy-on-write boundary page is always
+the slot's own freshly allocated page — shared pages are strictly
+read-only).  On a miss the admitting request registers one entry per
+prefix page count (keys are the raw token bytes of each full-page
+prefix), so a later request sharing ANY page-aligned prefix hits
+regardless of how the two prompts' lengths differ.
+
+Lifetime is refcount-per-page: a page is referenced by every cache
+entry containing it plus every active slot pinned to it.  LRU eviction
+(bounded entry count) and slot release decrement; pages reaching zero
+are handed back to the engine, which returns them to the device free
+stack through the ``reclaim`` executable.
+"""
+from __future__ import annotations
+
+import collections
+
+__all__ = ["PrefixCache"]
+
+
+class PrefixCache:
+    """Refcounted read-only shared KV pages keyed by prompt prefix."""
+
+    def __init__(self, page_size: int, capacity: int = 1024):
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        self.page_size = int(page_size)
+        self.capacity = int(capacity)
+        self._entries = collections.OrderedDict()  # key -> tuple(page ids)
+        self._rc: dict[int, int] = {}              # page id -> refcount
+
+    # -- introspection -----------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    @property
+    def resident_pages(self) -> int:
+        """Pages currently held resident by entries and/or slot pins —
+        the scheduler subtracts these from the allocatable pool."""
+        return len(self._rc)
+
+    def shareable_pages(self, prompt_len: int) -> int:
+        """Max pages of an L-token prompt that may ever be shared."""
+        return max(0, (int(prompt_len) - 1) // self.page_size)
+
+    # -- lookup / registration (engine decode thread only) -----------------
+    def _key(self, prompt, n_pages: int) -> bytes:
+        return prompt[:n_pages * self.page_size].tobytes()
+
+    def lookup(self, prompt):
+        """Longest cached page-aligned prefix of ``prompt`` (np.int32
+        1-D).  Returns (n_shared_pages, page_ids tuple) — (0, ()) on a
+        miss.  LRU-touches the hit entry; the caller pins the returned
+        pages before any device work.  Idempotent and side-effect-free
+        on a miss: the engine probes the backlog head every loop
+        iteration while waiting for pages, so hit/miss METRICS are
+        counted at actual admission (metrics.count_prefix), not here."""
+        for j in range(self.shareable_pages(len(prompt)), 0, -1):
+            pages = self._entries.get(self._key(prompt, j))
+            if pages is not None:
+                self._entries.move_to_end(self._key(prompt, j))
+                return j, pages
+        return 0, ()
+
+    def register(self, prompt, row, j_hit: int, j_reg: int):
+        """Register entries for every unshared full-page prefix of an
+        admitted prompt: prefix page counts ``j_hit+1 .. j_reg`` map to
+        ``row[:j]`` (the slot's just-fetched page-table row).  Returns
+        pages freed by LRU eviction whose refcount reached zero — the
+        caller reclaims them on device."""
+        reclaim = []
+        for j in range(j_hit + 1, j_reg + 1):
+            key = self._key(prompt, j)
+            if key in self._entries:
+                continue
+            pages = tuple(int(p) for p in row[:j])
+            self._entries[key] = pages
+            for p in pages:
+                self._rc[p] = self._rc.get(p, 0) + 1
+            while len(self._entries) > self.capacity:
+                _, old = self._entries.popitem(last=False)
+                reclaim.extend(self._unref(old))
+        return reclaim
+
+    # -- per-slot pinning --------------------------------------------------
+    def pin(self, pages):
+        """A slot started reading ``pages`` (its shared prefix + any
+        pages it just registered): hold them resident until unpin."""
+        for p in pages:
+            p = int(p)
+            self._rc[p] = self._rc.get(p, 0) + 1
+
+    def unpin(self, pages):
+        """The slot retired: drop its holds.  Returns pages whose
+        refcount hit zero (their entries were evicted mid-flight) for
+        device reclaim."""
+        return self._unref(int(p) for p in pages)
+
+    def _unref(self, pages):
+        freed = []
+        for p in pages:
+            p = int(p)
+            n = self._rc.get(p, 0) - 1
+            if n <= 0:
+                self._rc.pop(p, None)
+                freed.append(p)
+            else:
+                self._rc[p] = n
+        return freed

@@ -1,11 +1,21 @@
-"""Iteration-level slot scheduler for continuous-batching generation.
+"""Iteration-level slot + page scheduler for continuous-batching
+generation.
 
 Host-side bookkeeping only (the Orca-style scheduling half of the
 generation engine): which decode lane holds which request, which lanes
-are free, and which occupied lanes must be swept (client cancellation,
-deadline expiry).  All device state lives in serving/kv_cache.py; the
-scheduler never touches a jax array, so it needs no lock beyond the
-engine's single decode thread owning it.
+are free, which occupied lanes must be swept (client cancellation,
+deadline expiry) — and, since the paged KV cache, whether the PAGE POOL
+can absorb a request's worst case.  Admission reserves
+``ceil((prompt + max_new) / page_size)`` pages minus any shared prefix
+pages; a free slot with an exhausted pool queues the request instead of
+admitting it into an in-graph free-list underflow.  The invariant the
+reservation buys: the device's ``free_count`` register never drops
+below ``pages_available`` here, so decode's in-graph tail-page
+allocation cannot underflow.
+
+All device state lives in serving/kv_cache.py; the scheduler never
+touches a jax array, so it needs no lock beyond the engine's single
+decode thread owning it.
 """
 from __future__ import annotations
 
@@ -15,10 +25,11 @@ __all__ = ["SlotScheduler"]
 
 
 class SlotScheduler:
-    """Fixed-capacity slot table: ``admit`` at iteration boundaries,
-    ``retire`` on EOS/length, ``sweep`` for mid-decode preemption."""
+    """Fixed-capacity slot + page table: ``admit`` at iteration
+    boundaries, ``retire`` on EOS/length, ``sweep`` for mid-decode
+    preemption."""
 
-    def __init__(self, max_slots: int):
+    def __init__(self, max_slots: int, num_pages: int | None = None):
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.max_slots = int(max_slots)
@@ -26,6 +37,9 @@ class SlotScheduler:
         # occupied lanes dense at low load (cache locality on TPU)
         self._free = list(range(self.max_slots - 1, -1, -1))
         self._occupants: dict[int, object] = {}   # slot -> request
+        self.num_pages = None if num_pages is None else int(num_pages)
+        self._reserved: dict[int, int] = {}       # slot -> pages reserved
+        self._shared_resident = 0                 # prefix-cache pages
 
     @property
     def free_slots(self) -> int:
@@ -38,17 +52,48 @@ class SlotScheduler:
     def has_free(self) -> bool:
         return bool(self._free)
 
-    def admit(self, request) -> int:
-        """Claim a free slot for ``request``; raises when full (the
-        engine checks ``has_free()`` first — a raise is a logic bug)."""
+    # -- page accounting ---------------------------------------------------
+    @property
+    def pages_reserved(self) -> int:
+        return sum(self._reserved.values())
+
+    @property
+    def pages_available(self) -> int:
+        """Pages the pool can still promise to a new admission: total
+        minus active worst-case reservations minus prefix-cache
+        residents (conservative — a slot's own registered pages may be
+        counted in both, never under)."""
+        if self.num_pages is None:
+            return 1 << 30
+        return self.num_pages - self.pages_reserved - self._shared_resident
+
+    def set_shared_resident(self, n_pages: int):
+        """Pages currently held by the prefix cache (refcount > 0) —
+        the engine refreshes this after register/unpin/evict."""
+        self._shared_resident = int(n_pages)
+
+    def can_admit(self, n_pages: int) -> bool:
+        """True when a free slot exists AND the pool can reserve the
+        request's worst-case ``n_pages`` — an exhausted pool queues the
+        request even with lanes free (admit-and-crash is the failure
+        mode this check exists to prevent)."""
+        return bool(self._free) and n_pages <= self.pages_available
+
+    def admit(self, request, n_pages: int = 0) -> int:
+        """Claim a free slot for ``request`` and reserve its worst-case
+        page demand; raises when full (the engine checks ``can_admit``
+        first — a raise is a logic bug)."""
         slot = self._free.pop()
         self._occupants[slot] = request
+        self._reserved[slot] = int(n_pages)
         return slot
 
     def retire(self, slot: int):
-        """Release ``slot`` back to the free list; returns its request."""
+        """Release ``slot`` (and its page reservation) back to the free
+        lists; returns its request."""
         req = self._occupants.pop(slot)
         self._free.append(slot)
+        self._reserved.pop(slot, None)
         return req
 
     def sweep(self, now=None):

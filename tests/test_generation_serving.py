@@ -95,7 +95,8 @@ class TestBitwiseParity:
     def test_slot_reuse_isolation(self, model, engine):
         """More requests than slots: every retirement hands its slot to
         a new occupant; stale KV from the previous occupant must never
-        leak into the next (write_prompt zero-fills the tail)."""
+        leak into the next (the attention validity mask exposes only
+        positions <= pos, and freed pages are re-written before reuse)."""
         refs = {
             "a": solo(model, PROMPT_A, 12),
             "b": solo(model, PROMPT_B, 12, seed=7, **SAMPLE_KW),
@@ -279,9 +280,223 @@ class TestUnits:
 
     def test_geometry(self):
         g = CacheGeometry(num_layers=2, max_slots=4, max_seq_len=8,
-                          num_heads=2, head_dim=4, vocab_size=100)
-        assert g.kv_shape == (2, 4, 8, 2, 4)
-        assert g.kv_bytes() == 2 * 2 * 4 * 8 * 2 * 4 * 4
+                          num_heads=2, head_dim=4, vocab_size=100,
+                          page_size=4)
+        assert g.pages_per_slot == 2
+        assert g.num_pages == 8                    # dense-equivalent
+        assert g.pool_shape == (2, 8, 4, 2, 4)
+        # HBM formula: num_pages * page_bytes, page_bytes = 2(k+v) *
+        # layers * page_size * heads * head_dim * itemsize
+        assert g.page_bytes() == 2 * 2 * 4 * 2 * 4 * 4
+        assert g.kv_bytes() == g.num_pages * g.page_bytes()
+        assert g.pages_for(1) == 1 and g.pages_for(4) == 1 \
+            and g.pages_for(5) == 2
+        small = CacheGeometry(num_layers=2, max_slots=4, max_seq_len=8,
+                              num_heads=2, head_dim=4, vocab_size=100,
+                              page_size=4, num_pages=3)
+        assert small.num_pages == 3                # oversubscribed pool
+
+    def test_scheduler_page_accounting(self):
+        """A free slot with an exhausted pool must NOT admit — the
+        admit-and-crash (in-graph free-list underflow) failure mode."""
+
+        class R:
+            cancelled = False
+            deadline = None
+
+        s = SlotScheduler(3, num_pages=10)
+        assert s.pages_available == 10
+        assert s.can_admit(10) and not s.can_admit(11)
+        a = s.admit(R(), n_pages=4)
+        b = s.admit(R(), n_pages=4)
+        assert s.pages_available == 2
+        assert s.has_free() and not s.can_admit(4)   # slot free, pages not
+        assert s.can_admit(2)
+        s.set_shared_resident(1)                     # prefix-cache pages
+        assert s.pages_available == 1 and not s.can_admit(2)
+        s.retire(a)
+        assert s.pages_available == 5 and s.can_admit(4)
+        s.retire(b)
+        s.set_shared_resident(0)
+        assert s.pages_available == 10
+
+
+class TestPagedPool:
+    """The paged tentpole: a pool smaller than slots * pages_per_slot
+    oversubscribes lanes against actual footprint; admission must queue
+    (never crash) on pool exhaustion, and retirement must genuinely
+    recycle pages."""
+
+    def test_pool_exhaustion_queues_not_crashes(self, model):
+        """Deterministic pool exhaustion with lanes free: a 5-page pool
+        and 4-page requests serialize — the second request waits for the
+        first retirement, then decodes its exact solo stream."""
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                               prompt_buckets="8,16", page_size=4,
+                               num_pages=5, prefix_cache=False).start()
+        try:
+            # pages_for(7 + 6) = 4 <= 5: admits alone, not alongside
+            hs = [eng.submit(PROMPT_A, 6, seed=i) for i in range(3)]
+            ref = solo(model, PROMPT_A, 6)
+            assert hs[0].result(60) == ref
+            assert hs[1].result(60) == ref and hs[2].result(60) == ref
+            snap = eng.metrics.snapshot()
+            assert snap["retired"] == 3 and snap.get("errors", 0) == 0
+        finally:
+            eng.stop()
+
+    def test_request_larger_than_pool_rejected(self, model):
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                               prompt_buckets="8,16", page_size=4,
+                               num_pages=5, prefix_cache=False).start()
+        try:
+            with pytest.raises(ValueError, match="KV pages"):
+                eng.submit(PROMPT_C, 12)    # pages_for(24) = 6 > 5
+            assert eng.metrics.snapshot()["rejected_pages_exhausted"] == 1
+        finally:
+            eng.stop()
+
+    def test_page_reuse_after_retirement(self, model):
+        """Many waves through a minimal pool: every wave's pages are
+        recycled from the previous wave's retirement and decode exactly
+        the solo stream (stale-KV leak across page reuse would break
+        parity)."""
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                               prompt_buckets="8,16", page_size=4,
+                               num_pages=8, prefix_cache=False).start()
+        try:
+            refs = {"a": solo(model, PROMPT_A, 6),
+                    "b": solo(model, PROMPT_B, 6, seed=7, **SAMPLE_KW)}
+            for _ in range(3):
+                ha = eng.submit(PROMPT_A, 6)
+                hb = eng.submit(PROMPT_B, 6, seed=7, **SAMPLE_KW)
+                assert ha.result(60) == refs["a"]
+                assert hb.result(60) == refs["b"]
+        finally:
+            eng.stop()
+
+
+class TestPrefixCache:
+    @pytest.fixture(scope="class")
+    def peng(self, model):
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                               prompt_buckets="8,16", page_size=4,
+                               prefix_cache=True).start()
+        yield eng
+        eng.stop()
+
+    def test_hit_tokens_identical_to_miss(self, model, peng):
+        """The acceptance bar: a prefix-cache hit (suffix-only prefill
+        over shared pages) decodes the SAME tokens as the cold miss."""
+        ref = solo(model, PROMPT_C, 8, seed=7, **SAMPLE_KW)
+        miss = peng.submit(PROMPT_C, 8, seed=7, **SAMPLE_KW).result(60)
+        snap0 = peng.metrics.snapshot()
+        hit = peng.submit(PROMPT_C, 8, seed=7, **SAMPLE_KW).result(60)
+        snap1 = peng.metrics.snapshot()
+        assert miss == ref and hit == ref
+        assert snap1["prefix_cache_hits"] == snap0["prefix_cache_hits"] + 1
+        assert snap1["prefix_cache_hit_ratio"] > 0
+
+    def test_partial_prefix_hit(self, model, peng):
+        """A prompt sharing only SOME leading full pages of a cached
+        prompt still hits (longest page-aligned prefix) and still
+        matches its own solo stream."""
+        p = PROMPT_C[:8] + [7, 3, 11, 13]   # shares 2 of C's 2 pages?
+        before = peng.metrics.snapshot()["prefix_cache_hits"]
+        got = peng.submit(p, 8, seed=2).result(60)
+        assert got == solo(model, p, 8, seed=2)
+        assert peng.metrics.snapshot()["prefix_cache_hits"] == before + 1
+
+    def test_no_hit_for_short_prompt(self, model, peng):
+        """Prompts shorter than one full page + 1 token can never
+        share; they run the plain prefill path."""
+        before = peng.metrics.snapshot()["prefix_cache_misses"]
+        got = peng.submit(PROMPT_B, 6, seed=7, **SAMPLE_KW).result(60)
+        assert got == solo(model, PROMPT_B, 6, seed=7, **SAMPLE_KW)
+        assert peng.metrics.snapshot()["prefix_cache_misses"] == before + 1
+
+    def test_hit_path_never_compiles(self, peng):
+        """The insert_prefix executables are warmed at start(): a hit
+        admission mid-steady-state must not trigger XLA."""
+        peng.generate(PROMPT_C, 4, timeout=60)      # ensure registered
+        before = peng.compile_count
+        with _CompileTripwire():
+            assert len(peng.generate(PROMPT_C, 6, timeout=120)) == 6
+        assert peng.compile_count == before
+
+    def test_prefix_cache_units(self):
+        from paddle_tpu.serving.prefix_cache import PrefixCache
+
+        pc = PrefixCache(page_size=4)
+        assert pc.shareable_pages(4) == 0       # needs >= 1 suffix token
+        assert pc.shareable_pages(5) == 1
+        assert pc.shareable_pages(12) == 2
+        prompt = np.arange(12, dtype=np.int32)
+        assert pc.lookup(prompt) == (0, ())
+        row = np.array([10, 11, 12], np.int32)
+        pc.pin([10, 11])
+        assert pc.register(prompt, row, 0, 2) == []
+        j, pages = pc.lookup(prompt)
+        assert j == 2 and pages == (10, 11)
+        # a prompt sharing one page hits the shorter entry
+        other = np.array([0, 1, 2, 3, 9, 9], np.int32)
+        assert pc.lookup(other) == (1, (10,))
+        assert pc.resident_pages == 2
+        # unpin: entries still reference both pages -> nothing reclaimed
+        assert pc.unpin([10, 11]) == []
+        assert pc.resident_pages == 2
+
+    def test_prefix_cache_eviction_reclaims(self):
+        from paddle_tpu.serving.prefix_cache import PrefixCache
+
+        pc = PrefixCache(page_size=2, capacity=2)
+        a = np.array([1, 2, 3], np.int32)       # 1 shareable page
+        b = np.array([4, 5, 6], np.int32)
+        c = np.array([7, 8, 9], np.int32)
+        assert pc.register(a, np.array([0], np.int32), 0, 1) == []
+        assert pc.register(b, np.array([1], np.int32), 0, 1) == []
+        # third entry LRU-evicts a's entry; page 0 is unreferenced
+        assert pc.register(c, np.array([2], np.int32), 0, 1) == [0]
+        assert pc.lookup(a) == (0, ()) and pc.lookup(c) == (1, (2,))
+
+
+class TestTensorParallel:
+    def test_tp2_token_parity_and_zero_compiles(self, model):
+        """One engine, tp=2 mesh: the page pool's head axis shards over
+        tp, every executable compiles under NamedSharding at start(),
+        steady state never compiles, and tokens match the unsharded
+        engine exactly."""
+        import jax
+
+        if len(jax.devices()) < 2:
+            pytest.skip("needs >= 2 devices")
+        paddle.seed(0)
+        eng = GenerationEngine(model, max_slots=3, max_seq_len=40,
+                               prompt_buckets="8,16", page_size=4,
+                               mesh={"tp": 2}).start()
+        try:
+            assert eng._mesh.devices.size == 2
+            ref_a = solo(model, PROMPT_A, 8)
+            ref_b = solo(model, PROMPT_B, 8, seed=7, **SAMPLE_KW)
+            ref_c = solo(model, PROMPT_C, 6, seed=1)
+            before = eng.compile_count
+            with _CompileTripwire():
+                ha = eng.submit(PROMPT_A, 8)
+                hb = eng.submit(PROMPT_B, 8, seed=7, **SAMPLE_KW)
+                assert ha.result(120) == ref_a
+                assert hb.result(120) == ref_b
+                # prefix hit under the mesh too
+                hc = eng.submit(PROMPT_C, 6, seed=1)
+                hc2 = eng.submit(PROMPT_C, 6, seed=1)
+                assert hc.result(120) == hc2.result(120) == ref_c
+            assert eng.compile_count == before
+            assert eng.metrics.snapshot()["prefix_cache_hits"] >= 1
+        finally:
+            eng.stop()
 
 
 @pytest.fixture(scope="module")

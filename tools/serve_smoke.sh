@@ -207,5 +207,80 @@ grep -q "serving drain clean" "$WORK/genserver.log" \
          cat "$WORK/genserver.log"; exit 1; }
 echo "[serve_smoke] generation clean drain OK"
 
+# ---- paged KV + prefix-cache section ----------------------------------
+# an oversubscribed page pool (40 pages < 4 slots * 12 pages/slot) and
+# the prefix cache on: every client prompt opens with the SAME 8-token
+# system prefix (2 full 4-token pages), so after the first admission
+# every admission is a prefix hit
+echo "[serve_smoke] starting paged generation server (prefix cache on)..."
+python -m paddle_tpu.serving.generation --port 0 --slots 4 \
+    --prompt-buckets 8,16 --max-seq-len 48 --page-size 4 --num-pages 40 \
+    --prefix-cache 1 > "$WORK/pagedserver.log" 2>&1 &
+SERVER_PID=$!
+
+PURL=""
+for _ in $(seq 1 600); do
+    PURL=$(sed -n 's/.*listening on \(http[^ ]*\).*/\1/p' \
+           "$WORK/pagedserver.log" | head -1)
+    [ -n "$PURL" ] && break
+    kill -0 "$SERVER_PID" 2>/dev/null \
+        || { echo "paged server died:"; cat "$WORK/pagedserver.log"; exit 1; }
+    sleep 0.1
+done
+[ -n "$PURL" ] || { echo "paged server never came up"; \
+    cat "$WORK/pagedserver.log"; exit 1; }
+echo "[serve_smoke] paged server up at $PURL"
+
+echo "[serve_smoke] firing shared-system-prompt load..."
+python -m paddle_tpu.serving.client --url "$PURL" --mode generate \
+    --requests 12 --concurrency 6 --prompt-len 12 --shared-prefix-len 8 \
+    --max-new 12 --vocab 200 --sample
+
+echo "[serve_smoke] scraping paged /metrics..."
+python - "$PURL" <<'EOF'
+import sys
+import urllib.request
+
+text = urllib.request.urlopen(sys.argv[1] + "/metrics",
+                              timeout=10).read().decode()
+needed = ["paddle_genserve_prefix_cache_hits_total",
+          "paddle_genserve_prefix_cache_misses_total",
+          "paddle_genserve_prefix_cache_hit_ratio",
+          "paddle_genserve_page_occupancy",
+          "paddle_genserve_ttft_p99_ms"]
+missing = [n for n in needed if n not in text]
+assert not missing, f"missing metrics: {missing}"
+
+
+def value(name):
+    line = [l for l in text.splitlines() if l.startswith(name + " ")][0]
+    return float(line.split()[1])
+
+
+ratio = value("paddle_genserve_prefix_cache_hit_ratio")
+hits = value("paddle_genserve_prefix_cache_hits_total")
+ttft = value("paddle_genserve_ttft_p99_ms")
+assert ratio > 0, f"prefix hit ratio not positive under shared load: {ratio}"
+assert hits > 0, f"no prefix hits under shared-prefix load: {hits}"
+assert ttft > 0, f"ttft p99 not positive: {ttft}"
+print(f"paged metrics OK: prefix_hit_ratio={ratio:g} hits={hits:g} "
+      f"ttft_p99_ms={ttft:g}")
+EOF
+
+echo "[serve_smoke] SIGTERM -> paged graceful drain..."
+kill -TERM "$SERVER_PID"
+rc=0
+wait "$SERVER_PID" || rc=$?
+SERVER_PID=""
+if [ "$rc" -ne 0 ]; then
+    echo "[serve_smoke] paged server exit code $rc (want 0)"
+    cat "$WORK/pagedserver.log"
+    exit 1
+fi
+grep -q "serving drain clean" "$WORK/pagedserver.log" \
+    || { echo "no clean-drain marker in paged server log"; \
+         cat "$WORK/pagedserver.log"; exit 1; }
+echo "[serve_smoke] paged clean drain OK"
+
 exec python -m pytest tests/ -q -m "serving or genserve" \
     -p no:cacheprovider -p no:randomly "$@"
