@@ -521,6 +521,13 @@ GATE_METRICS = {
         "direction": "higher", "cpu_rel_tol": 0.25, "tpu_rel_tol": 0.25,
         "help": "prefix-cache hits/(hits+misses) under the bench's "
                 "shared-prefix load wave"},
+    # decode throughput of the generation engine (genserve only; null
+    # elsewhere) — THE serving headline the paged Pallas decode kernel
+    # moves; wall-clock-based, so the CPU band stays wide
+    "decode_tokens_per_sec": {
+        "direction": "higher", "cpu_rel_tol": 0.60, "tpu_rel_tol": 0.20,
+        "help": "generated tokens per second sustained by the "
+                "continuous-batching engine over the bench window"},
 }
 
 
@@ -1535,58 +1542,250 @@ def _naive_causal_attention(q, k, v):
 
 
 def body_kernels(on_tpu):
-    """Validate Pallas flash-attention (fwd + bwd) and fused layer_norm
-    numerics against the plain-XLA path on the REAL device (VERDICT round-1
-    Weak #1: round 1 only ever ran these in CPU interpret mode)."""
+    """Validate every Pallas kernel (masked flash fwd+bwd, paged decode,
+    softmax-xent, bias-gelu, layer_norm) against the plain-XLA path on
+    the REAL device, then time one flag-on vs flag-off masked training
+    step with per-op attribution (monitor.perf op_report).
+
+    Numerics hygiene: under jax_enable_x64 a bare numpy scalar promotes
+    the XLA reference to f64 while the kernels accumulate in f32 — every
+    reference below is CAST TO THE KERNEL'S COMPUTE DTYPE before the
+    error is taken, and each kernel gets its own tolerance instead of
+    one shared 2e-2 band."""
     import jax
     import jax.numpy as jnp
     import numpy as np
 
+    from paddle_tpu.ops import fused as _fused
+    from paddle_tpu.ops.pallas.bias_gelu import bias_gelu as pl_bias_gelu
     from paddle_tpu.ops.pallas.flash_attention import flash_attention
     from paddle_tpu.ops.pallas.layer_norm import layer_norm as fused_layer_norm
+    from paddle_tpu.ops.pallas.paged_attention import paged_decode_attention
+    from paddle_tpu.ops.pallas.softmax_xent import softmax_xent
+
+    def _err(out, ref):
+        # cast the XLA reference to the kernel's compute dtype FIRST:
+        # comparing a f64-promoted reference against an f32 kernel
+        # reports the reference's own rounding as kernel error
+        ref = jnp.asarray(ref, out.dtype)
+        return float(jnp.abs(out.astype(jnp.float32)
+                             - ref.astype(jnp.float32)).max())
+
+    # per-kernel (cpu_interpret, tpu_mosaic) max-abs-err tolerances
+    TOLS = {
+        "flash_fwd": (1e-5, 2e-2), "flash_bwd": (1e-4, 2e-2),
+        "masked_fwd": (1e-5, 2e-2), "masked_bwd": (1e-4, 2e-2),
+        "paged": (1e-5, 2e-2), "xent_fwd": (1e-5, 1e-2),
+        "xent_bwd": (1e-4, 1e-2), "bias_gelu_fwd": (1e-5, 1e-2),
+        "bias_gelu_bwd": (1e-4, 1e-2), "layer_norm": (1e-3, 1e-3),
+    }
+    ti = 1 if on_tpu else 0
+    errs = {}
 
     rs = np.random.RandomState(0)
     B, S, H, D = (2, 512, 8, 64) if on_tpu else (1, 128, 2, 32)
-    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
-    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
-    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * 0.1
+    scale = jnp.float32(0.1)
+    q = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * scale
+    k = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * scale
+    v = jnp.asarray(rs.randn(B, S, H, D), jnp.float32) * scale
+    mask = jnp.asarray(rs.rand(B, 1, 1, S) > 0.15)
 
-    ref_attn = _naive_causal_attention
+    def ref_attn(q, k, v, m=None):
+        out = _naive_causal_attention(q, k, v)
+        if m is None:
+            return out
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32)
+        logits = logits * jnp.float32(1.0 / np.sqrt(D))
+        cm = jnp.tril(jnp.ones((S, S), bool))
+        logits = jnp.where(cm & m, logits, jnp.float32(-1e30))
+        p = jax.nn.softmax(logits, -1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v)
 
-    def loss_fa(q, k, v):
-        return (flash_attention(q, k, v, causal=True) ** 2).mean()
+    out_fa = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
+    errs["flash_fwd"] = _err(out_fa, jax.jit(ref_attn)(q, k, v))
+    g_fa = jax.jit(jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal=True) ** 2).mean(),
+        argnums=(0, 1, 2)))(q, k, v)
+    g_ref = jax.jit(jax.grad(
+        lambda q, k, v: (ref_attn(q, k, v) ** 2).mean(),
+        argnums=(0, 1, 2)))(q, k, v)
+    errs["flash_bwd"] = max(_err(a, b) for a, b in zip(g_fa, g_ref))
 
-    def loss_ref(q, k, v):
-        return (ref_attn(q, k, v) ** 2).mean()
+    out_m = jax.jit(lambda q, k, v: flash_attention(
+        q, k, v, causal=True, mask=mask))(q, k, v)
+    errs["masked_fwd"] = _err(out_m, jax.jit(
+        lambda q, k, v: ref_attn(q, k, v, mask))(q, k, v))
+    gm_fa = jax.jit(jax.grad(
+        lambda q, k, v: (flash_attention(q, k, v, causal=True,
+                                         mask=mask) ** 2).mean(),
+        argnums=(0, 1, 2)))(q, k, v)
+    gm_ref = jax.jit(jax.grad(
+        lambda q, k, v: (ref_attn(q, k, v, mask) ** 2).mean(),
+        argnums=(0, 1, 2)))(q, k, v)
+    errs["masked_bwd"] = max(_err(a, b) for a, b in zip(gm_fa, gm_ref))
 
-    out_fa = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))(q, k, v)
-    out_ref = jax.jit(ref_attn)(q, k, v)
-    fwd_err = float(jnp.abs(out_fa - out_ref).max())
+    # paged decode vs dense gather (ragged rows, -1 tails)
+    slots, pps, ps = (8, 8, 16) if on_tpu else (4, 4, 8)
+    nhp, hdp = (8, 64) if on_tpu else (2, 16)
+    npages, cap = slots * pps + 2, pps * ps
+    qd = jnp.asarray(rs.randn(slots, nhp, hdp), jnp.float32) * scale
+    kp = jnp.asarray(rs.randn(npages, ps, nhp, hdp), jnp.float32) * scale
+    vp = jnp.asarray(rs.randn(npages, ps, nhp, hdp), jnp.float32) * scale
+    rows_np = np.full((slots, pps), -1, np.int32)
+    perm = rs.permutation(npages - 1) + 1
+    pos_np = np.zeros(slots, np.int32)
+    pi = 0
+    for i in range(slots):
+        n_used = 1 + rs.randint(pps)
+        rows_np[i, :n_used] = perm[pi:pi + n_used]
+        pi += n_used
+        pos_np[i] = n_used * ps - 1 - rs.randint(ps)
+    rows, pos = jnp.asarray(rows_np), jnp.asarray(pos_np)
 
-    g_fa = jax.jit(jax.grad(loss_fa, argnums=(0, 1, 2)))(q, k, v)
-    g_ref = jax.jit(jax.grad(loss_ref, argnums=(0, 1, 2)))(q, k, v)
-    bwd_err = max(float(jnp.abs(a - b).max()) for a, b in zip(g_fa, g_ref))
+    def paged_ref():
+        gidx = jnp.clip(rows, 0, npages - 1)
+        kg = kp[gidx].reshape(slots, cap, nhp, hdp)
+        vg = vp[gidx].reshape(slots, cap, nhp, hdp)
+        s = jnp.einsum("bnd,bsnd->bns", qd, kg) \
+            * jnp.float32(1.0 / np.sqrt(hdp))
+        valid = jnp.arange(cap)[None, :] <= pos[:, None]
+        s = jnp.where(valid[:, None, :], s, jnp.float32(-1e30))
+        return jnp.einsum("bns,bsnd->bnd", jax.nn.softmax(s, -1), vg)
 
+    out_pd = jax.jit(lambda *a: paged_decode_attention(*a, cap))(
+        qd, kp, vp, rows, pos)
+    errs["paged"] = _err(out_pd, jax.jit(paged_ref)())
+
+    # softmax-xent (odd rows + vocab exercise the padding path)
+    N, V = (256, 8192) if on_tpu else (37, 1000)
+    z = jnp.asarray(rs.randn(N, V), jnp.float32)
+    lab = jnp.asarray(rs.randint(0, V, N), jnp.int32).at[0].set(-100)
+
+    def xent_ref(z):
+        lp = jax.nn.log_softmax(z.astype(jnp.float32), -1)
+        pick = jnp.take_along_axis(lp, lab[:, None].clip(0), 1)[:, 0]
+        return jnp.where(lab == -100, jnp.float32(0.0), -pick)
+
+    errs["xent_fwd"] = _err(jax.jit(lambda z: softmax_xent(z, lab))(z),
+                            jax.jit(xent_ref)(z))
+    errs["xent_bwd"] = _err(
+        jax.jit(jax.grad(lambda z: softmax_xent(z, lab).sum()))(z),
+        jax.jit(jax.grad(lambda z: xent_ref(z).sum()))(z))
+
+    # bias-gelu
+    xg = jnp.asarray(rs.randn(256, 1024 if on_tpu else 256), jnp.float32)
+    bg = jnp.asarray(rs.randn(xg.shape[-1]), jnp.float32)
+
+    def bg_ref(x, b):
+        return jax.nn.gelu(x + b, approximate=False)
+
+    errs["bias_gelu_fwd"] = _err(jax.jit(pl_bias_gelu)(xg, bg),
+                                 jax.jit(bg_ref)(xg, bg))
+    gb1 = jax.jit(jax.grad(
+        lambda x, b: (pl_bias_gelu(x, b) ** 2).mean(), (0, 1)))(xg, bg)
+    gb2 = jax.jit(jax.grad(
+        lambda x, b: (bg_ref(x, b) ** 2).mean(), (0, 1)))(xg, bg)
+    errs["bias_gelu_bwd"] = max(_err(a, b) for a, b in zip(gb1, gb2))
+
+    # layer norm
     x = jnp.asarray(rs.randn(64, 1024 if on_tpu else 128), jnp.float32)
     w = jnp.asarray(rs.randn(x.shape[-1]), jnp.float32)
     b = jnp.asarray(rs.randn(x.shape[-1]), jnp.float32)
     ln_fused = jax.jit(lambda x: fused_layer_norm(x, w, b, 1e-5))(x)
     mu = x.mean(-1, keepdims=True)
     var = x.var(-1, keepdims=True)
-    ln_ref = (x - mu) / jnp.sqrt(var + 1e-5) * w + b
-    ln_err = float(jnp.abs(ln_fused - ln_ref).max())
+    errs["layer_norm"] = _err(ln_fused,
+                              (x - mu) / jnp.sqrt(var + 1e-5) * w + b)
 
-    ok = fwd_err < 2e-2 and bwd_err < 2e-2 and ln_err < 1e-3
+    ok = all(errs[kname] < TOLS[kname][ti] for kname in TOLS)
+    _phase("numerics_done")
+
+    # -- flag-on vs flag-off masked training step, per-op attribution ------
+    # one step = masked+causal sdpa -> linear+bias-gelu -> softmax-xent,
+    # fwd+bwd, routed through the ops/fused dispatch exactly as models
+    # route it; the ONLY difference between variants is _use_pallas()
+    from paddle_tpu.monitor import perf as _perf
+    from paddle_tpu.tensor import unwrap as _unwrap
+
+    Vc = 2048 if on_tpu else 512
+    wv = jnp.asarray(rs.randn(H * D, Vc) * 0.05, jnp.float32)
+    bv = jnp.asarray(rs.randn(Vc) * 0.05, jnp.float32)
+    labels = jnp.asarray(rs.randint(0, Vc, (B, S)), jnp.int32)
+
+    def step(q, k, v, wv, bv):
+        ctx = _unwrap(_fused.scaled_dot_product_attention(
+            q, k, v, attn_mask=mask, is_causal=True))
+        h = _unwrap(_fused.linear_bias_gelu(
+            ctx.reshape(B * S, H * D), wv, bv))
+        loss = _unwrap(_fused.softmax_cross_entropy(
+            h.reshape(B, S, Vc), labels))
+        return loss.mean()
+
+    reps = 5 if on_tpu else 1
+
+    def run_variant(flag_on):
+        old = _fused._use_pallas
+        _fused._use_pallas = (lambda: True) if flag_on else (lambda: False)
+        try:
+            f = jax.jit(jax.value_and_grad(step, argnums=(0, 3, 4)))
+            compiled = f.lower(q, k, v, wv, bv).compile()
+        finally:
+            _fused._use_pallas = old
+        jax.block_until_ready(compiled(q, k, v, wv, bv))
+        best = float("inf")
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            jax.block_until_ready(compiled(q, k, v, wv, bv))
+            best = min(best, time.perf_counter() - t0)
+        text = compiled.as_text()
+        report = _perf.build_report(
+            compiled, name=f"kernels_{'on' if flag_on else 'off'}",
+            measured_step_ms=best * 1e3)
+        return best, report, text.count("custom-call")
+
+    base_fb = dict(_fused.fallback_counter().values)
+    t_on, rep_on, cc_on = run_variant(True)
+    fb_delta = {",".join(kk): vv - base_fb.get(kk, 0)
+                for kk, vv in _fused.fallback_counter().values.items()
+                if vv - base_fb.get(kk, 0)}
+    t_off, rep_off, cc_off = run_variant(False)
+    _phase("flag_ab_done")
+
+    # on TPU the three fused ops must surface as single Mosaic custom
+    # calls (fwd; their VJPs add more) instead of XLA fusions; in CPU
+    # interpret mode pallas lowers to inlined HLO, so only check there
+    fused_single = (cc_on - cc_off) >= 3 if on_tpu else None
+    if on_tpu:
+        ok = ok and bool(fused_single) and not fb_delta
+    flops = rep_on["totals"]["flops"]
+    mfu = (flops / t_on) / peak_flops_per_chip() if on_tpu else 0.0
+
     return {
-        **_obs_fields(),
+        **_obs_fields(step_times_s=[t_on], mfu=mfu),
         "metric": "pallas_kernels_validated_on_tpu" if on_tpu
                   else "pallas_kernels_validated_cpu_interpret",
         "value": 1.0 if ok else 0.0,
         "unit": "bool",
         "vs_baseline": 1.0 if ok else 0.0,
-        "flash_attn_fwd_max_err": fwd_err,
-        "flash_attn_bwd_max_err": bwd_err,
-        "fused_ln_max_err": ln_err,
+        # back-compat headline errors + the per-kernel table
+        "flash_attn_fwd_max_err": errs["flash_fwd"],
+        "flash_attn_bwd_max_err": errs["flash_bwd"],
+        "fused_ln_max_err": errs["layer_norm"],
+        "kernel_max_errs": {kk: float(f"{vv:.3e}")
+                            for kk, vv in errs.items()},
+        # flag A/B: wall time + per-op attribution totals; interpret-mode
+        # pallas on CPU is expected to be SLOWER than XLA — the speedup
+        # number only means something on TPU
+        "flag_on_step_ms": round(t_on * 1e3, 3),
+        "flag_off_step_ms": round(t_off * 1e3, 3),
+        "kernels_speedup_flag_on": round(t_off / t_on, 3),
+        "flag_on_op_count": rep_on["totals"]["n_ops"],
+        "flag_off_op_count": rep_off["totals"]["n_ops"],
+        "flag_on_top_op": (rep_on["ops"][0]["op"]
+                           if rep_on["ops"] else None),
+        "fused_ops_single_fusion": fused_single,
+        "pallas_fallbacks_during_flag_on": fb_delta or None,
     }
 
 

@@ -196,20 +196,31 @@ class GPTAttention(Layer):
                                             mode="drop")
         v_pages = v_pages.at[page, off].set(v.astype(v_pages.dtype),
                                             mode="drop")
-        # gather each lane's pages into a contiguous [seq_cap] view
-        gidx = jnp.clip(rows, 0, num_pages - 1)
-        kg = k_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
-        vg = v_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
-        kg, vg = kg[:, :seq_cap], vg[:, :seq_cap]
-        scores = jnp.einsum("bqnd,bsnd->bnqs", q, kg) \
-            * (1.0 / float(hd) ** 0.5)
-        valid = jnp.arange(seq_cap)[None, :] <= pos[:, None]
-        scores = jnp.where(valid[:, None, None, :], scores,
-                           jnp.finfo(scores.dtype).min)
-        probs = jnp.exp(scores - lax.stop_gradient(
-            scores.max(axis=-1, keepdims=True)))
-        probs = probs / probs.sum(axis=-1, keepdims=True)
-        ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, vg)
+        # hot path: the Pallas ragged kernel walks each lane's page-table
+        # row and reads the pool in place — no dense [slots, seq_cap]
+        # gather is materialized.  None => flag off / untileable geometry
+        # (counted in paddle_pallas_fallbacks_total); the dense gather
+        # below stays as the reference and fallback.
+        ctx = fused.paged_decode_attention(
+            q, k_pages, v_pages, rows, pos, seq_cap,
+            tp_axis="mp" if cfg.tensor_parallel else None)
+        if ctx is None:
+            # gather each lane's pages into a contiguous [seq_cap] view
+            gidx = jnp.clip(rows, 0, num_pages - 1)
+            kg = k_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
+            vg = v_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
+            kg, vg = kg[:, :seq_cap], vg[:, :seq_cap]
+            scores = jnp.einsum("bqnd,bsnd->bnqs", q, kg) \
+                * (1.0 / float(hd) ** 0.5)
+            valid = jnp.arange(seq_cap)[None, :] <= pos[:, None]
+            scores = jnp.where(valid[:, None, None, :], scores,
+                               jnp.finfo(scores.dtype).min)
+            probs = jnp.exp(scores - lax.stop_gradient(
+                scores.max(axis=-1, keepdims=True)))
+            probs = probs / probs.sum(axis=-1, keepdims=True)
+            ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, vg)
+        else:
+            ctx = unwrap(ctx)
         out = self.out(Tensor(ctx.reshape(B, 1, cfg.hidden_size)))
         return out, Tensor(k_pages), Tensor(v_pages)
 
@@ -322,10 +333,17 @@ class GPTMLP(Layer):
         else:
             self.fc1 = Linear(H, FF, weight_attr=_init(cfg))
             self.fc2 = Linear(FF, H, weight_attr=_init(cfg))
+        self._tp = cfg.tensor_parallel
         self.dropout = Dropout(cfg.dropout)
 
     def forward(self, x):
-        return self.dropout(self.fc2(F.gelu(self.fc1(x))))
+        # expansion matmul with fused bias+GeLU epilogue (exact erf, same
+        # as F.gelu's default) instead of fc1 -> separate gelu
+        h = fused.linear_bias_gelu(x, self.fc1.weight, self.fc1.bias)
+        if self._tp:
+            # re-pin the column shards fc1.forward would have pinned
+            h = shard_constraint(h, *([None] * (len(h.shape) - 1) + ["mp"]))
+        return self.dropout(self.fc2(h))
 
 
 class GPTBlock(Layer):

@@ -832,6 +832,9 @@ def cross_entropy(input, label, weight=None, ignore_index=-100, reduction="mean"
     from ...ops import fused as _fused
     if (flag("FLAGS_use_pallas_kernels") and use_softmax and not soft_label
             and weight is None and axis in (-1, None)):
+        # routes to ops/fused.softmax_cross_entropy, which on TPU runs the
+        # fused Pallas log-softmax+gather kernel (ops/pallas/softmax_xent)
+        # and otherwise the stable XLA composite
         raw = _fused.softmax_cross_entropy(input, label, ignore_index)
         return _reduce_loss(raw, reduction) if reduction != "none" else raw
 

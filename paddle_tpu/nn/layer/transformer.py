@@ -16,6 +16,7 @@ import collections
 import numpy as np
 
 from ... import tensor_ops as T
+from ...ops import fused as _fused
 from ...tensor import Tensor
 from ..layer_base import Layer
 from .. import functional as F
@@ -148,8 +149,14 @@ class TransformerEncoderLayer(Layer):
         residual = src
         if self.normalize_before:
             src = self.norm2(src)
-        src = self.linear2(self.dropout(
-            getattr(F, self.activation)(self.linear1(src))))
+        if self.activation == "gelu":
+            # expansion matmul with the Pallas-fused bias+GeLU epilogue
+            # (ops/fused.py; exact erf, same as F.gelu's default)
+            h = _fused.linear_bias_gelu(src, self.linear1.weight,
+                                        self.linear1.bias)
+        else:
+            h = getattr(F, self.activation)(self.linear1(src))
+        src = self.linear2(self.dropout(h))
         src = residual + self.dropout2(src)
         if not self.normalize_before:
             src = self.norm2(src)

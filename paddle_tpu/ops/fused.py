@@ -14,6 +14,7 @@ is on AND the arrays live on a TPU backend.  Selection happens here.
 from __future__ import annotations
 
 import functools
+import warnings
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +36,79 @@ def _use_pallas() -> bool:
 
 
 # ---------------------------------------------------------------------------
+# fallback telemetry: an accidentally-XLA hot path must be VISIBLE
+# ---------------------------------------------------------------------------
+_warned_sites: set = set()
+
+
+def fallback_counter():
+    """The shared-registry `paddle_pallas_fallbacks_total{kernel,reason}`
+    counter (zero-initialized lazily; rendered by /metrics)."""
+    from ..utils.metrics import default_registry
+
+    return default_registry().counter(
+        "paddle_pallas_fallbacks_total",
+        "fused-op calls that fell back to XLA while "
+        "FLAGS_use_pallas_kernels was on, by kernel and reason",
+        label=("kernel", "reason"))
+
+
+def _note_fallback(kernel: str, reason: str):
+    """Record one Pallas->XLA fallback: bump the shared-registry counter
+    and warn ONCE per (kernel, reason) site.  Dispatch happens at trace
+    time, so one recorded fallback means every step of that compiled
+    graph runs the XLA path."""
+    fallback_counter().inc((kernel, reason))
+    site = (kernel, reason)
+    if site not in _warned_sites:
+        _warned_sites.add(site)
+        warnings.warn(
+            f"FLAGS_use_pallas_kernels is on but '{kernel}' fell back to "
+            f"the XLA composite ({reason}); the hot path is NOT running "
+            f"the Pallas kernel (see paddle_pallas_fallbacks_total in "
+            f"/metrics)", RuntimeWarning, stacklevel=3)
+
+
+def _fallback_reason(exc: Exception) -> str:
+    if isinstance(exc, NotImplementedError):
+        return "mask_shape" if "mask" in str(exc) else "shape"
+    return type(exc).__name__
+
+
+def _mesh_axes():
+    """(mesh, batch_axes, tp_axis) for kernel shard_map composition:
+    batch axes are the >1-sized data axes ('dp'/'fsdp'), tp is the
+    >1-sized head/column axis under either naming scheme — the models'
+    in-layer 'mp' pin or SpecLayout's 'tp'."""
+    try:
+        from ..distributed.mesh import get_mesh
+
+        mesh = get_mesh()
+    except Exception:  # noqa: BLE001 - no distributed state, solo jit
+        return None, (), None
+    if mesh is None:
+        return None, (), None
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    batch = tuple(a for a in ("dp", "fsdp") if sizes.get(a, 1) > 1)
+    tp = next((a for a in ("mp", "tp") if sizes.get(a, 1) > 1), None)
+    if not batch and tp is None:
+        return None, (), None
+    return mesh, batch, tp
+
+
+def _axes_size(mesh, axes) -> int:
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    n = 1
+    for a in axes:
+        n *= sizes.get(a, 1)
+    return n
+
+
+def _rows_divisible(dim: int, mesh, axes) -> bool:
+    return dim % _axes_size(mesh, axes) == 0
+
+
+# ---------------------------------------------------------------------------
 # layer norm (fused scale+shift; Pallas row kernel on TPU)
 # ---------------------------------------------------------------------------
 def layer_norm(x, weight, bias, epsilon=1e-5):
@@ -44,8 +118,8 @@ def layer_norm(x, weight, bias, epsilon=1e-5):
         try:
             return apply(lambda v, w, b: pln.layer_norm(v, w, b, epsilon),
                          x, weight, bias)
-        except Exception:
-            pass
+        except Exception as e:  # noqa: BLE001 - counted, then composite
+            _note_fallback("layer_norm", _fallback_reason(e))
 
     def f(v, w, b):
         mean = jnp.mean(v, axis=-1, keepdims=True)
@@ -69,6 +143,34 @@ def skip_layer_norm(x, residual, weight, bias, epsilon=1e-5):
 # softmax cross entropy (fused, numerically stable)
 # ---------------------------------------------------------------------------
 def softmax_cross_entropy(logits, label, ignore_index=-100):
+    if _use_pallas():
+        from .pallas import softmax_xent as sx
+
+        try:
+            mesh, batch, _ = _mesh_axes()
+
+            def pf(z, l):
+                if mesh is not None and batch and z.ndim >= 2 \
+                        and _rows_divisible(z.shape[0], mesh, batch):
+                    from jax.experimental.shard_map import shard_map
+                    from jax.sharding import PartitionSpec as P
+
+                    bspec = batch if len(batch) > 1 else batch[0]
+                    li = l if l.ndim == z.ndim - 1 else jnp.squeeze(l, -1)
+                    body = functools.partial(sx.softmax_xent,
+                                             ignore_index=ignore_index)
+                    return shard_map(
+                        body, mesh=mesh,
+                        in_specs=(P(bspec, *([None] * (z.ndim - 1))),
+                                  P(bspec, *([None] * (li.ndim - 1)))),
+                        out_specs=P(bspec, *([None] * (z.ndim - 2))),
+                        check_rep=False)(z, li)
+                return sx.softmax_xent(z, l, ignore_index=ignore_index)
+
+            return apply(pf, logits, label)
+        except Exception as e:  # noqa: BLE001 - counted, then composite
+            _note_fallback("softmax_xent", _fallback_reason(e))
+
     def f(z, l):
         li = l.astype(jnp.int32)
         if li.ndim == z.ndim:
@@ -194,15 +296,41 @@ def fused_linear_cross_entropy(hidden, weight, labels, chunk_size=8192):
 def scaled_dot_product_attention(query, key, value, attn_mask=None,
                                  dropout_p=0.0, is_causal=False, training=True):
     """[B, S, H, D] in, [B, S, H, D] out (paddle layout)."""
-    if (_use_pallas() and dropout_p == 0.0 and attn_mask is None):
-        from .pallas import flash_attention as fa
+    if _use_pallas():
+        if dropout_p > 0.0 and training:
+            # attention dropout has no kernel path (rng-in-kernel is out of
+            # scope); the one hot loop that sets it (BERT/ERNIE training)
+            # should see this in the fallback counter, not run silently slow
+            _note_fallback("flash_attention", "dropout")
+        else:
+            from .pallas import flash_attention as fa
 
-        try:
-            return apply(
-                lambda q, k, v: fa.flash_attention(q, k, v, causal=is_causal),
-                query, key, value)
-        except Exception:
-            pass
+            try:
+                mesh, batch, tp = _mesh_axes()
+
+                def pf(q, k, v, *mask):
+                    m = mask[0] if mask else None
+                    # an ambient mesh whose axes don't divide this call's
+                    # geometry must not knock it off the kernel path: shed
+                    # non-dividing axes and keep the (replicated) kernel
+                    ba, hx = batch, tp
+                    while ba and q.shape[0] % _axes_size(mesh, ba) != 0:
+                        ba = ba[:-1]
+                    if hx is not None and \
+                            q.shape[2] % _axes_size(mesh, (hx,)) != 0:
+                        hx = None
+                    if mesh is not None and (ba or hx):
+                        return fa.sharded_flash_attention(
+                            q, k, v, mesh, head_axis=hx, batch_axes=ba,
+                            causal=is_causal, mask=m)
+                    return fa.flash_attention(q, k, v, causal=is_causal,
+                                              mask=m)
+
+                args = (query, key, value) + (
+                    (attn_mask,) if attn_mask is not None else ())
+                return apply(pf, *args)
+            except Exception as e:  # noqa: BLE001 - counted, then composite
+                _note_fallback("flash_attention", _fallback_reason(e))
 
     from ..framework import random as _random
 
@@ -237,6 +365,123 @@ def scaled_dot_product_attention(query, key, value, attn_mask=None,
 
 
 # ---------------------------------------------------------------------------
+# paged decode attention (fused_multi_transformer's masked decode analog):
+# ragged Pallas kernel walking each lane's page-table row over the KV pool
+# ---------------------------------------------------------------------------
+def paged_decode_attention(q, k_pages, v_pages, rows, pos, seq_cap,
+                           tp_axis=None):
+    """Pallas paged decode attention over one layer's KV pool plane, or
+    None when the kernel can't run (the caller keeps its dense-gather
+    reference path and this shows up in the fallback counter).
+
+    q [slots, 1, nh, hd] (the step's query, post-scatter); k_pages/v_pages
+    [num_pages, page_size, nh, hd]; rows [slots, pages_per_slot] int32
+    (-1 = unmapped); pos [slots] int32 inclusive extent; seq_cap static.
+    Returns [slots, 1, nh, hd].  `tp_axis` names the mesh axis the pool's
+    head dim is sharded over (the models' "mp" pin), if any.
+    """
+    if not _use_pallas():
+        return None
+    from .pallas import paged_attention as pa
+
+    try:
+        def pf(qv, kp, vp, rw, ps_):
+            q1 = qv[:, 0]
+            mesh = None
+            if tp_axis is not None:
+                mesh, _, _ = _mesh_axes()
+            if mesh is not None and tp_axis in mesh.axis_names:
+                out = pa.sharded_paged_decode_attention(
+                    q1, kp, vp, rw, ps_, seq_cap, mesh, tp_axis)
+            else:
+                out = pa.paged_decode_attention(q1, kp, vp, rw, ps_, seq_cap)
+            return out[:, None]
+
+        return apply(pf, q, k_pages, v_pages, rows, pos)
+    except Exception as e:  # noqa: BLE001 - counted, then dense gather
+        _note_fallback("paged_attention", _fallback_reason(e))
+        return None
+
+
+# ---------------------------------------------------------------------------
+# fused bias + GeLU (fused_gemm_epilogue intent): matmul stays with XLA's
+# MXU scheduling, the bias-add + exact-erf GeLU epilogue runs as one Pallas
+# pass (forward and backward) instead of separate elementwise HLOs
+# ---------------------------------------------------------------------------
+def _sharded_bias_gelu(v, b, mesh, batch, tp):
+    """Pallas bias_gelu under shard_map so GSPMD keeps the FFN activation
+    sharded (rows over dp/fsdp, feature columns over mp/tp) instead of
+    gathering it around an opaque custom call."""
+    from .pallas import bias_gelu as bg
+
+    if batch and (v.ndim < 2 or not _rows_divisible(v.shape[0], mesh, batch)):
+        batch = ()
+    if tp is not None and v.shape[-1] % _axes_size(mesh, (tp,)) != 0:
+        tp = None
+    if not batch and tp is None:
+        return bg.bias_gelu(v, b)
+
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    bspec = (batch if len(batch) > 1 else batch[0]) if batch else None
+    vspec = P(bspec, *([None] * (v.ndim - 2)), tp)
+    return shard_map(bg.bias_gelu, mesh=mesh,
+                     in_specs=(vspec, P(tp)), out_specs=vspec,
+                     check_rep=False)(v, b)
+
+
+def _dropout(y, dropout_p, training):
+    """Wrapper-level dropout keyed by the framework's per-step rng (the
+    keep-mask is XLA elementwise and fuses into the surrounding matmul)."""
+    if dropout_p <= 0.0 or not training:
+        return y
+    from ..framework import random as _random
+
+    key_rng = _random.split_key()
+    return apply(
+        lambda v: jnp.where(
+            jax.random.bernoulli(key_rng, 1.0 - dropout_p, v.shape),
+            v / (1.0 - dropout_p), 0.0), y)
+
+
+def bias_gelu(x, bias, dropout_p=0.0, training=True):
+    """gelu(x + bias) (exact erf form), optionally followed by dropout
+    threaded through the per-step rng.  Pallas-fused on TPU."""
+    if _use_pallas():
+        from .pallas import bias_gelu as bg
+
+        try:
+            mesh, batch, tp = _mesh_axes()
+
+            def pf(v, b):
+                if mesh is not None:
+                    return _sharded_bias_gelu(v, b, mesh, batch, tp)
+                return bg.bias_gelu(v, b)
+
+            return _dropout(apply(pf, x, bias), dropout_p, training)
+        except Exception as e:  # noqa: BLE001 - counted, then composite
+            _note_fallback("bias_gelu", _fallback_reason(e))
+    y = apply(lambda v, b: jax.nn.gelu(v + b.astype(v.dtype),
+                                       approximate=False), x, bias)
+    return _dropout(y, dropout_p, training)
+
+
+def linear_bias_gelu(x, weight, bias, dropout_p=0.0, training=True):
+    """gelu(x @ weight + bias): the FFN expansion matmul with its epilogue
+    fused.  `bias` may be None (plain gelu of the matmul).  The matmul
+    goes through the same AMP white_cast as nn.functional.linear."""
+    from ..amp import white_cast
+
+    y = apply(lambda v, w: jnp.matmul(*white_cast(v, w)), x, weight)
+    if bias is None:
+        return _dropout(
+            apply(lambda v: jax.nn.gelu(v, approximate=False), y),
+            dropout_p, training)
+    return bias_gelu(y, bias, dropout_p=dropout_p, training=training)
+
+
+# ---------------------------------------------------------------------------
 # fused feedforward (fused_feedforward intent): LN -> linear -> act -> linear
 # ---------------------------------------------------------------------------
 def fused_feedforward(x, w1, b1, w2, b2, ln_scale=None, ln_bias=None,
@@ -244,25 +489,28 @@ def fused_feedforward(x, w1, b1, w2, b2, ln_scale=None, ln_bias=None,
                       pre_layer_norm=True, epsilon=1e-5):
     act = {"gelu": jax.nn.gelu, "relu": jax.nn.relu}[activation]
 
-    def f(v, w1_, b1_, w2_, b2_, *ln):
-        h = v
-        if pre_layer_norm and ln:
-            mean = jnp.mean(h, -1, keepdims=True)
-            var = jnp.mean(jnp.square(h - mean), -1, keepdims=True)
-            h = (h - mean) * jax.lax.rsqrt(var + epsilon) * ln[0] + ln[1]
-        h = act(h @ w1_ + b1_)
-        h = h @ w2_ + b2_
-        out = v + h
-        if not pre_layer_norm and ln:
-            mean = jnp.mean(out, -1, keepdims=True)
-            var = jnp.mean(jnp.square(out - mean), -1, keepdims=True)
-            out = (out - mean) * jax.lax.rsqrt(var + epsilon) * ln[0] + ln[1]
-        return out
-
-    args = [x, w1, b1, w2, b2]
-    if ln_scale is not None:
-        args += [ln_scale, ln_bias]
-    return apply(f, *args)
+    h = x
+    if pre_layer_norm and ln_scale is not None:
+        def pre(v, s, b):
+            mean = jnp.mean(v, -1, keepdims=True)
+            var = jnp.mean(jnp.square(v - mean), -1, keepdims=True)
+            return (v - mean) * jax.lax.rsqrt(var + epsilon) * s + b
+        h = apply(pre, x, ln_scale, ln_bias)
+    if activation == "gelu":
+        h = linear_bias_gelu(h, w1, b1, dropout_p=dropout_p,
+                             training=training)
+    else:
+        h = _dropout(apply(lambda v, w1_, b1_: act(v @ w1_ + b1_),
+                           h, w1, b1), dropout_p, training)
+    h = apply(lambda v, w2_, b2_: v @ w2_ + b2_, h, w2, b2)
+    out = apply(lambda v, r: v + r, x, h)
+    if not pre_layer_norm and ln_scale is not None:
+        def post(o, s, b):
+            mean = jnp.mean(o, -1, keepdims=True)
+            var = jnp.mean(jnp.square(o - mean), -1, keepdims=True)
+            return (o - mean) * jax.lax.rsqrt(var + epsilon) * s + b
+        out = apply(post, out, ln_scale, ln_bias)
+    return out
 
 
 def fused_embedding_layernorm(word_ids, pos_ids, type_ids, word_emb, pos_emb,

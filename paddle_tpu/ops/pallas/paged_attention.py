@@ -1,0 +1,195 @@
+"""Pallas TPU paged decode attention (ragged, page-table indirected).
+
+The serving-side half of the fused-attention story (reference analog:
+fused_multi_transformer_op.cu's masked decode attention — that kernel reads
+a dense [B, S_max] cache; this one reads the paged KV pool of
+serving/kv_cache.py directly).
+
+One query token per lane attends over that lane's pages, walked through its
+int32 page-table row — the pool is never gathered into a dense
+``[slots, S_max]`` view.  The page table and per-lane positions ride in as
+scalar-prefetch operands (pltpu.PrefetchScalarGridSpec), so the KV
+BlockSpec index maps pick each grid step's page straight from the table
+and Mosaic can start the HBM->VMEM fetch of page ``rows[lane, p]`` while
+the previous page is still being processed.
+
+Grid is (slots, pages_walked): for each lane the kernel runs the flash
+running-softmax (m/l/acc in VMEM scratch) across its pages; pages that are
+unmapped (table entry -1) or entirely past the lane's position are skipped
+with pl.when (no FLOPs, and the index map clamps their page id to 0 so no
+out-of-bounds fetch is issued).  Within the last live page, tokens beyond
+``pos`` are masked to -1e30 — matching the dense reference's validity mask
+exactly, token by token.
+
+Used by GPTAttention.decode_pages through ops/fused.py when
+FLAGS_use_pallas_kernels is on; the dense-gather path stays as the
+fallback and parity reference.  The kernel only READS the pool (the
+current token's K/V scatter stays an XLA `.at[].set` before the call), so
+it composes with the engine's buffer donation untouched.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+from . import interpret_default as _interpret_default
+
+
+def _kernel(rows_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            acc_ref, m_ref, l_ref, *, sm_scale, page_size, pages_walked):
+    lane, p_idx = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(p_idx == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    page = rows_ref[lane, p_idx]
+    pos = pos_ref[lane]
+    # a page contributes iff it is mapped and starts at or before pos
+    live = (page >= 0) & (p_idx * page_size <= pos)
+
+    @pl.when(live)
+    def _body():
+        q = q_ref[0].astype(jnp.float32) * sm_scale      # [nh, hd]
+        k = k_ref[0].astype(jnp.float32)                 # [ps, nh, hd]
+        # per-head q . k over hd: batch nh, contract hd -> [nh, ps]
+        s = jax.lax.dot_general(
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)
+        tok = p_idx * page_size + jax.lax.broadcasted_iota(
+            jnp.int32, s.shape, 1)
+        s = jnp.where(tok <= pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, :1]
+        l_prev = l_ref[:, :1]
+        m_cur = jnp.max(s, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        p = jnp.exp(s - m_new)                           # [nh, ps]
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        v = v_ref[0].astype(jnp.float32)                 # [ps, nh, hd]
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)          # [nh, hd]
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = jnp.broadcast_to(m_new, m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new, l_ref.shape)
+
+    @pl.when(p_idx == pages_walked - 1)
+    def _finish():
+        l = l_ref[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, ...] = (acc_ref[...] / l_safe).astype(o_ref.dtype)
+
+
+def paged_decode_attention(q, k_pages, v_pages, rows, pos, seq_cap: int,
+                           sm_scale=None, interpret: bool | None = None):
+    """Ragged decode attention over the paged KV pool.
+
+    q: [slots, nh, hd] (one token per lane); k_pages/v_pages:
+    [num_pages, page_size, nh, hd] (one layer's pool plane, AFTER the
+    current token's scatter); rows: [slots, pages_per_slot] int32 page
+    table (-1 = unmapped); pos: [slots] int32 attention extent per lane
+    (inclusive); seq_cap: STATIC max extent — only ceil(seq_cap /
+    page_size) table columns are walked.  Returns [slots, nh, hd] in
+    q's dtype.  Raises NotImplementedError for untileable geometry
+    (caller falls back to the dense gather).
+    """
+    slots, nh, hd = q.shape
+    num_pages, ps = k_pages.shape[0], k_pages.shape[1]
+    if k_pages.shape[2] != nh or k_pages.shape[3] != hd:
+        raise NotImplementedError(
+            f"paged_decode_attention: pool heads {k_pages.shape[2:]} != "
+            f"query heads ({nh}, {hd})")
+    pages_walked = -(-int(seq_cap) // ps)
+    if pages_walked > rows.shape[1]:
+        raise NotImplementedError(
+            f"paged_decode_attention: seq_cap {seq_cap} needs "
+            f"{pages_walked} pages > table width {rows.shape[1]}")
+    if ps < 8:
+        raise NotImplementedError(
+            f"paged_decode_attention: page_size {ps} < 8 sublanes")
+    if sm_scale is None:
+        sm_scale = 1.0 / (hd ** 0.5)
+    if interpret is None:
+        interpret = _interpret_default()
+
+    rows = jnp.asarray(rows, jnp.int32)
+    pos = jnp.asarray(pos, jnp.int32)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(slots, pages_walked),
+        in_specs=[
+            pl.BlockSpec((1, nh, hd),
+                         lambda l, p, rows, pos: (l, 0, 0)),
+            # dead (unmapped / past-pos) pages clamp to page 0: the fetch
+            # target must be in-bounds even though pl.when skips the math
+            pl.BlockSpec((1, ps, nh, hd),
+                         lambda l, p, rows, pos:
+                         (jnp.maximum(rows[l, p], 0), 0, 0, 0)),
+            pl.BlockSpec((1, ps, nh, hd),
+                         lambda l, p, rows, pos:
+                         (jnp.maximum(rows[l, p], 0), 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nh, hd),
+                               lambda l, p, rows, pos: (l, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nh, hd), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+            pltpu.VMEM((nh, 128), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_kernel, sm_scale=float(sm_scale), page_size=ps,
+                          pages_walked=pages_walked),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((slots, nh, hd), q.dtype),
+        interpret=interpret,
+    )(rows, pos, q, k_pages, v_pages)
+    return out
+
+
+def sharded_paged_decode_attention(q, k_pages, v_pages, rows, pos,
+                                   seq_cap: int, mesh, head_axis,
+                                   sm_scale=None,
+                                   interpret: bool | None = None):
+    """paged_decode_attention under shard_map: the pool's head axis is
+    sharded over ``head_axis`` (layout.kv_page_spec() / the models' "mp"
+    pin), the page table and positions are replicated, and each shard
+    runs the kernel on its LOCAL heads — decode attention has no
+    cross-head reduction, so no collectives are needed."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    nh = q.shape[1]
+    sizes = dict(zip(mesh.axis_names, mesh.devices.shape))
+    tp = sizes.get(head_axis, 1)
+    if tp <= 1:
+        return paged_decode_attention(q, k_pages, v_pages, rows, pos,
+                                      seq_cap, sm_scale=sm_scale,
+                                      interpret=interpret)
+    if nh % tp:
+        raise NotImplementedError(
+            f"sharded paged_decode_attention: heads {nh} % tp {tp} != 0")
+
+    def body(ql, kl, vl, rl, pl_):
+        return paged_decode_attention(ql, kl, vl, rl, pl_, seq_cap,
+                                      sm_scale=sm_scale,
+                                      interpret=interpret)
+
+    f = shard_map(
+        body, mesh=mesh,
+        in_specs=(P(None, head_axis, None),
+                  P(None, None, head_axis, None),
+                  P(None, None, head_axis, None),
+                  P(None, None), P(None)),
+        out_specs=P(None, head_axis, None), check_rep=False)
+    return f(q, k_pages, v_pages, rows, pos)

@@ -91,8 +91,13 @@ class _Handler(BaseHTTPRequestHandler):
             else:
                 self._send_json(200, {"status": "ok", **info})
         elif self.path == "/metrics":
+            from ..utils.metrics import default_registry
+
             parts = [e.metrics.prometheus_text() for e in
                      (owner.engine, owner.gen_engine) if e is not None]
+            # process-wide shared registry (e.g. the Pallas fallback
+            # counter paddle_pallas_fallbacks_total from ops/fused.py)
+            parts.append(default_registry().prometheus_text())
             self._send(200, "".join(parts).encode(),
                        ctype="text/plain; version=0.0.4")
         else:

@@ -49,19 +49,23 @@ def _fmt(v) -> str:
 
 
 class Counter:
-    """Monotonic counter; optionally labeled by ONE label key.
+    """Monotonic counter; optionally labeled.
 
-    With `label=` set, values are tracked per label value (a
-    `collections.Counter`); `preset=` pre-creates entries so zero-valued
-    series still render, in declaration order.  `fixed=True` restricts
-    the exposition to exactly the preset series (extra recorded names
-    stay readable programmatically but are not rendered) — the serving
-    exposition contract.
+    `label=` is ONE label key (a str) or a TUPLE of label keys — with a
+    tuple, `inc()` takes a matching tuple of label values and each
+    series renders as `name{k1="v1",k2="v2"}` (the
+    `paddle_pallas_fallbacks_total{kernel,reason}` shape).  Values are
+    tracked per label value (a `collections.Counter`); `preset=`
+    pre-creates entries so zero-valued series still render, in
+    declaration order.  `fixed=True` restricts the exposition to exactly
+    the preset series (extra recorded names stay readable
+    programmatically but are not rendered) — the serving exposition
+    contract.
     """
 
     kind = "counter"
 
-    def __init__(self, name: str, help_: str, lock, label: str = None,
+    def __init__(self, name: str, help_: str, lock, label=None,
                  preset=(), fixed: bool = False):
         self.name = name
         self.help = help_
@@ -85,7 +89,10 @@ class Counter:
             if self.label is None:
                 self.value += int(arg)
                 return
-            key = str(arg)
+            if isinstance(self.label, tuple):
+                key = tuple(str(a) for a in arg)
+            else:
+                key = str(arg)
             if key not in self.values:
                 self._order.append(key)
             self.values[key] += 1 if n is None else \
@@ -103,8 +110,12 @@ class Counter:
             return lines
         keys = self._order[:self._preset_len] if self.fixed else self._order
         for key in keys:
-            lines.append(f'{self.name}{{{self.label}="{key}"}} '
-                         f'{_fmt(self.values[key])}')
+            if isinstance(self.label, tuple):
+                lbl = ",".join(f'{k}="{v}"'
+                               for k, v in zip(self.label, key))
+            else:
+                lbl = f'{self.label}="{key}"'
+            lines.append(f'{self.name}{{{lbl}}} {_fmt(self.values[key])}')
         return lines
 
 
@@ -258,7 +269,7 @@ class MetricsRegistry:
                 f"re-requested as {kind}")
         return m
 
-    def counter(self, name: str, help_: str = "", label: str = None,
+    def counter(self, name: str, help_: str = "", label=None,
                 preset=(), fixed: bool = False) -> Counter:
         with self._lock:
             m = self._existing(name, "counter")
@@ -328,8 +339,14 @@ class MetricsRegistry:
             out = {}
             for name, m in self._metrics.items():
                 if m.kind == "counter":
-                    out[name] = (dict(m.values) if m.label is not None
-                                 else m.value)
+                    if m.label is None:
+                        out[name] = m.value
+                    else:
+                        # tuple-labeled series join their label values so
+                        # the snapshot stays JSON-serializable
+                        out[name] = {
+                            (",".join(k) if isinstance(k, tuple) else k): v
+                            for k, v in m.values.items()}
                 elif m.kind == "gauge":
                     out[name] = m.fn() if m.fn is not None else m.value
                 else:

@@ -51,6 +51,10 @@ def pytest_configure(config):
         "markers", "trace: request-scoped tracing / flight recorder / "
         "goodput ledger test (monitor.tracing, monitor.flightrec, "
         "distributed.goodput) — run via tools/obs_smoke.sh")
+    config.addinivalue_line(
+        "markers", "kernels: Pallas fused-kernel parity/dispatch test "
+        "(masked flash, paged decode, softmax-xent, bias-gelu; CPU "
+        "interpret mode) — run via tools/kernels_smoke.sh")
 
 
 @pytest.fixture(autouse=True)
