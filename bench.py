@@ -46,13 +46,14 @@ CONFIG_TIMEOUT_TPU = {"bert": 1500, "gpt13b": 1800, "ernie": 1200,
 CONFIG_TIMEOUT_CPU = {"mesh3d": 2700, "genserve": 2700}
 
 CONFIGS = ("mnist", "kernels", "longseq", "resnet50", "dp8", "mesh3d",
-           "ckpt", "predictor", "genserve",
+           "ckpt", "pod", "predictor", "genserve",
            "ernie", "gpt13b", "bert")
            # bert last among configs = headline; the aggregate summary
            # line prints after it.  dp8 = SPMD dp-scaling shape, mesh3d
            # = 3D-parallel (dp2×fsdp2×tp2) full-1.3B measured training,
            # both on 8 virtual CPU devices (a single bench chip cannot
-           # be split).
+           # be split).  pod = elastic shrink-and-continue drill (2 real
+           # rank processes, rank 1 SIGKILLed mid-fit).
 
 
 # The driver re-execs itself with the pool IP moved to this stash var so
@@ -339,12 +340,14 @@ def _run_config(cfg, on_tpu, cpu_fallback=None):
     already-computed `cpu_fallback` line (late-TPU pass) instead of
     recomputing it."""
     line, err, phases = None, "", []
-    if cfg in ("dp8", "mesh3d"):
+    if cfg in ("dp8", "mesh3d", "pod"):
         # dp scaling / 3D parallelism need 8 devices: always a virtual
         # CPU mesh here (one bench chip can't be split; a pod run uses
         # the real mesh via tools/{dp,mesh3d}_smoke.sh /
-        # Model.fit(mesh=...)).  The line is backend-independent, so the
-        # late-TPU pass reuses it as-is.
+        # Model.fit(mesh=...)).  pod spawns its own local rank
+        # subprocesses (the drill is about membership + recovery, not
+        # the backend).  The lines are backend-independent, so the
+        # late-TPU pass reuses them as-is.
         if cpu_fallback is not None:
             return cpu_fallback
         env = _cpu_env()
@@ -959,6 +962,85 @@ def body_ckpt(on_tpu):
         "ckpt_async_overlap_ratio": round(
             1.0 - median(async_ms) / max(median(blocking_ms), 1e-9), 4),
         "state_mb": round(nbytes / 1e6, 1),
+    }
+
+
+def body_pod(on_tpu):
+    """Elastic pod drill (distributed/elastic.py): a 2-rank local pod
+    trains under the shrink-and-continue supervisor, rank 1 is SIGKILLed
+    mid-fit by chaos, and the survivor rolls back to its in-memory
+    snapshot and finishes.  Emits the two elasticity headlines:
+
+      elastic_shrink_recovery_s   rank-reported rollback+replay wall time
+      goodput_ratio               from the supervisor's ledger (the
+                                  measured death->resumed gap is the
+                                  only badput of the run)
+
+    plus restart_equivalent_s — a fresh interpreter's jax+paddle import
+    wall time, the FLOOR a restart-from-checkpoint recovery pays before
+    it can even open the checkpoint — so the line itself shows the
+    in-memory continue beating the restart path.  Multi-process
+    localhost + CPU mesh: backend-independent, like dp8/mesh3d."""
+    import subprocess as _sp
+    import tempfile as _tempfile
+    import time as _time
+
+    from paddle_tpu.distributed.podtest import run_elastic_pod
+
+    src = """
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.elastic import PodRuntime
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.hapi.callbacks import Callback
+
+paddle.seed(0)
+net = paddle.nn.Linear(16, 8)
+model = paddle.Model(net)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters()),
+              paddle.nn.MSELoss())
+rs = np.random.RandomState(0)
+x = rs.randn(96, 16).astype("float32")
+y = rs.randn(96, 8).astype("float32")
+pod = PodRuntime.from_env()
+model.fit(TensorDataset([x, y]), batch_size=8, epochs=1, shuffle=False,
+          verbose=0, pod=pod, log_freq=1)
+emit(shrinks=pod.shrink_events)
+pod.close()
+"""
+    with _tempfile.TemporaryDirectory(prefix="bench-pod-") as td:
+        res, pr = run_elastic_pod(
+            src, world=2, env={"PADDLE_CHAOS_RANK_KILL": "1@3"},
+            telemetry_dir=td, timeout=600)
+    recovery = res.recovery_s()
+    if recovery is None or not res.survivors_ok:
+        return {**_obs_fields(),
+                "metric": "elastic_shrink_recovery_s", "value": 0.0,
+                "unit": "error", "vs_baseline": 0.0,
+                "error": "pod drill did not shrink-and-continue "
+                         f"(rcs={res.returncodes} deaths={res.deaths})"}
+    # the restart path's floor: a fresh rank's interpreter + framework
+    # import, before any checkpoint restore / re-compile even starts
+    t0 = _time.perf_counter()
+    _sp.run([sys.executable, "-c", "import jax, paddle_tpu"],
+            env=_cpu_env(), timeout=300, check=False,
+            capture_output=True)
+    restart_floor_s = _time.perf_counter() - t0
+    down_s = max(res.downs) if res.downs else recovery
+    report = res.report or {}
+    return {
+        **_obs_fields(),
+        "metric": "elastic_shrink_recovery_s",
+        "value": round(recovery, 4),
+        "unit": "s",
+        # >1.0 == the in-memory continue beat the restart path's FLOOR
+        "vs_baseline": round(restart_floor_s / max(down_s, 1e-9), 2),
+        "elastic_shrink_recovery_s": round(recovery, 4),
+        "pod_down_s": round(down_s, 4),
+        "restart_equivalent_s": round(restart_floor_s, 2),
+        "goodput_ratio": report.get("goodput_ratio"),
+        "badput_down_s": (report.get("seconds") or {}).get("down"),
     }
 
 
@@ -2251,7 +2333,8 @@ def body_config(name):
             "mnist": body_mnist, "longseq": body_longseq,
             "predictor": body_predictor, "genserve": body_genserve,
             "dp8": body_dp8,
-            "mesh3d": body_mesh3d, "ckpt": body_ckpt}[name]
+            "mesh3d": body_mesh3d, "ckpt": body_ckpt,
+            "pod": body_pod}[name]
     r = body(on_tpu)
     r["platform"] = jax.devices()[0].device_kind if on_tpu else "cpu"
     print(json.dumps(r), flush=True)

@@ -52,6 +52,33 @@ def _in_trace(x) -> bool:
     return isinstance(x, jax.core.Tracer)
 
 
+def _pod_eager_group():
+    """Host-level pod group for eager cross-PROCESS collectives.
+
+    jax 0.4.37 cannot run multiprocess XLA computations on CPU, so when
+    this controller is one rank of a real multi-process pod the eager
+    shard_map route (which spans the global mesh) would die in XLA; the
+    collective rides the pod control plane instead (podcoll: the jax
+    coordination-service KV store, or the elastic supervisor's
+    coordinator).  Single-process runs keep the in-mesh shard_map path."""
+    from . import podcoll
+
+    group = podcoll.default_group()
+    if group is None:
+        return None
+    if jax.process_count() > 1:
+        return group
+    mesh = get_mesh()
+    if mesh is None or mesh.size <= 1:
+        # elastic mode: single-process jax per rank, pod spans processes
+        return group
+    return None
+
+
+_POD_REDUCE_OP = {ReduceOp.SUM: "sum", ReduceOp.MAX: "max",
+                  ReduceOp.MIN: "min", ReduceOp.PROD: "prod"}
+
+
 def _axis_names(group=None):
     """group=None / ring 0 → all mesh axes."""
     if isinstance(group, str):
@@ -89,6 +116,15 @@ def all_reduce(tensor, op=ReduceOp.SUM, group=None, sync_op=True,
         if isinstance(tensor, Tensor):
             tensor._value = out.value
         return out
+    pod = _pod_eager_group()
+    if pod is not None:
+        if op == ReduceOp.AVG:
+            out_np = pod.all_reduce_mean(np.asarray(v))  # noqa: PTA001 - packed via tobytes before the next dispatch
+        else:
+            out_np = pod.all_reduce(np.asarray(v),  # noqa: PTA001 - packed via tobytes before the next dispatch
+                                    _POD_REDUCE_OP[op])
+        tensor._value = jnp.asarray(out_np)
+        return tensor
     mesh = get_mesh()
     if mesh is None or mesh.size == 1:
         return tensor
@@ -109,6 +145,15 @@ def all_gather(tensor_list, tensor, group=None, sync_op=True):
         if tensor_list is not None:
             tensor_list.extend([gathered[i] for i in range(n)])
         return gathered
+    pod = _pod_eager_group()
+    if pod is not None:
+        parts = [Tensor(jnp.asarray(p))
+                 for p in pod.all_gather(np.asarray(v))]  # noqa: PTA001 - packed via tobytes before the next dispatch
+        if tensor_list is not None:
+            tensor_list.extend(parts)
+        from .. import tensor_ops as T
+
+        return T.stack(parts, axis=0)
     mesh = get_mesh()
     if mesh is None or mesh.size == 1:
         if tensor_list is not None:
@@ -138,6 +183,11 @@ def broadcast(tensor, src=0, group=None, sync_op=True):
         out = apply(f, tensor)
         tensor._value = out.value
         return out
+    pod = _pod_eager_group()
+    if pod is not None:
+        tensor._value = jnp.asarray(
+            pod.broadcast(np.asarray(v), src=src))  # noqa: PTA001 - packed via tobytes before the next dispatch
+        return tensor
     mesh = get_mesh()
     if mesh is None or mesh.size == 1:
         return tensor
@@ -248,8 +298,13 @@ def alltoall(in_tensor_list, out_tensor_list=None, group=None, sync_op=True):
 
 
 def barrier(group=None):
-    # eager: block until all local async work completes (XLA has no global
-    # host barrier; jax.distributed rendezvous happens at collective launch)
+    # multi-process: a REAL host barrier over the pod control plane;
+    # single-process: block until all local async work completes (XLA has
+    # no global host barrier inside one controller)
+    pod = _pod_eager_group()
+    if pod is not None:
+        pod.barrier()
+        return
     (jnp.zeros(()) + 0).block_until_ready()
 
 

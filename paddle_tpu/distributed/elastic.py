@@ -1,0 +1,364 @@
+"""Elastic pod runtime: shrink-and-continue instead of restart.
+
+The PR-12 launcher treats any trainer death as pod death: tear down,
+backoff, restart, restore from the newest checkpoint — the whole
+detection→running-again gap lands in the goodput ledger's `badput{down}`
+bucket and the restore replays every step since the last save.  The
+reference fleet did better for its PS runtime (trainer loss was routine,
+SURVEY §2.5/§2.10); this module is that behavior for the pod runtime:
+
+  supervisor (`launch_elastic`)
+      hosts the pod coordinator (podcoord — membership, heartbeats,
+      arbitrated collectives), spawns the rank processes, and watches
+      both process exits (a SIGKILLed rank is declared dead immediately)
+      and heartbeats (a silent-but-alive rank is PARTITIONED and fenced
+      with SIGKILL so it cannot corrupt later collectives).  Rank loss
+      with live survivors is classified `rank_lost_shrunk` in
+      paddle_launch_trainer_failures_total — a distinct reason from the
+      restart path's crash/preempted — and the death→resumed gap feeds
+      the goodput ledger's `down` bucket.
+
+  rank side (`PodRuntime`)
+      plugs into Model.fit(pod=...) / TrainEngine.begin(grad_sync=...):
+      data-parallel grad sync runs as a host callback through the
+      coordinator's arbitrated gather (jax 0.4.37's CPU backend has no
+      multiprocess XLA — see podcoll), so when a peer dies mid-step the
+      collective does not hang: the coordinator freezes a result over
+      the SURVIVING membership and flags `shrunk`.  The runtime then
+      rolls the engine back to its per-step in-memory snapshot
+      (ft_state → ft_restore_shardings → adopt_ft_state, PR-8's
+      any-geometry reshard — no disk round-trip), re-strides the batch
+      over the new membership, and REPLAYS the tainted step, so training
+      continues exactly as if the smaller pod had computed that step in
+      the first place.  With batches strided `X[rank::world]` from
+      replicated data, a shrink to one rank continues bitwise like a
+      single-process run from the same state.
+
+Replay caveat: the replayed dispatch consumes one extra rng key from the
+global stream, so models that USE per-step rng (dropout) lose bitwise
+parity with an uninterrupted run after a shrink — deterministic models
+(the pod drills) keep it.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+
+from ..utils.metrics import default_registry
+from . import podcoll
+from .podcoord import (DEAD_EXIT, DEAD_HEARTBEAT, DEAD_PARTITION,
+                       PodCoordinator, PodPeerLost)
+
+logger = logging.getLogger("paddle_tpu.elastic")
+
+__all__ = ["PodRuntime", "launch_elastic", "ElasticResult",
+           "FAILURE_REASONS"]
+
+# launch.py's restart-path reasons + the elastic one.  The registry dedupes
+# by name, so whichever side registers first owns the Counter and both
+# increment the same instance.
+FAILURE_REASONS = ("preempted", "watchdog", "durability", "crash",
+                   "rank_lost_shrunk")
+
+
+def _failures_counter(reg=None):
+    return (reg or default_registry()).counter(
+        "paddle_launch_trainer_failures_total",
+        "trainer exits the launcher classified, by reason", label="reason",
+        preset=FAILURE_REASONS)
+
+
+class PodRuntime:
+    """Rank-side elastic runtime: grad sync + shrink detection +
+    rollback-and-replay.  Built from the elastic launcher's env
+    (PADDLE_POD_COORD) via the ambient pod group."""
+
+    def __init__(self, group=None, snapshot_every=1):
+        if group is None:
+            group = podcoll.default_group()
+        if group is None:
+            raise RuntimeError(
+                "PodRuntime needs a pod group — run under launch_elastic "
+                "(PADDLE_POD_COORD) or pass a podcoll.PodGroup")
+        self.group = group
+        self.rank = group.rank
+        self.world0 = group.world
+        self.live = list(range(group.world))
+        self.snapshot_every = max(1, int(snapshot_every))
+        self._snap = None
+        self._snap_it = -1
+        self.shrink_events: list[dict] = []
+        reg = default_registry()
+        self._g_live = reg.gauge(
+            "paddle_pod_live_ranks",
+            "pod ranks this rank believes live (shrinks on rank loss)")
+        self._g_epoch = reg.gauge(
+            "paddle_pod_membership_epoch",
+            "membership epoch observed from the pod coordinator")
+        self._g_recovery = reg.gauge(
+            "paddle_pod_shrink_recovery_seconds",
+            "last in-memory shrink-and-continue recovery (rollback + "
+            "replay), seconds")
+        self._g_live.set(len(self.live))
+        self._client = getattr(group.transport, "client", None)
+        if self._client is not None:
+            from ..utils import chaos
+            chaos.register_partition_hook(self._on_partition)
+            self._client.start_heartbeats()
+
+    # -- wiring ------------------------------------------------------------
+    def _on_partition(self):
+        # chaos RANK_PARTITION: stop heartbeating while staying alive —
+        # the supervisor must detect the silence and fence us
+        self._client.partitioned = True
+
+    def grad_sync(self, grads):
+        """Host grad all-reduce-mean over the LIVE membership — the
+        callable Model.fit hands to TrainEngine.begin(grad_sync=).  Runs
+        inside the jitted step via pure_callback, so membership is read
+        at EXECUTION time and a shrink needs no retrace."""
+        import jax
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        out = [np.asarray(self.group.all_reduce_mean(np.asarray(g)))  # noqa: PTA001 - packed via tobytes; result owns its buffer
+               for g in leaves]
+        return jax.tree_util.tree_unflatten(treedef, out)
+
+    def stride(self, arrays):
+        """This rank's slice of a replicated global batch: row-stride by
+        position in the LIVE membership.  After a shrink the survivors
+        re-stride and jointly cover the full batch again."""
+        if self.rank not in self.live:
+            raise PodPeerLost(
+                f"rank {self.rank} is not in the live membership "
+                f"{self.live} (fenced?)")
+        idx = self.live.index(self.rank)
+        n = len(self.live)
+        return [a[idx::n] for a in arrays]
+
+    # -- fit-loop hooks ----------------------------------------------------
+    def before_step(self, engine, it_count):
+        """Per-step host snapshot (cadence: snapshot_every) — the
+        in-memory rollback point a mid-step shrink replays from."""
+        if self._snap is None or it_count % self.snapshot_every == 0:
+            self._snap = engine.ft_state(it_count)
+            self._snap_it = it_count
+        if self._client is not None:
+            try:
+                self._client.heartbeat(step=it_count)
+            except (OSError, ConnectionError):
+                pass  # supervisor gone; the bg thread already gave up
+
+    def after_step(self, engine, raw_inputs, raw_labels, it_count):
+        """Sync the step, check the shrink latch; on shrink: roll back,
+        re-stride, replay.  Returns (losses, replayed) — `losses` are
+        every loss this step settled (the replayed value replaces the
+        tainted one)."""
+        losses = list(engine.drain())  # sync point: grad_sync has run
+        if not self.group.consume_shrunk():
+            return losses, False
+        t0 = time.monotonic()
+        if losses:
+            losses.pop()  # the tainted step's loss — replaced by replay
+        while True:
+            old = list(self.live)
+            self.live = list(self.group.last_ranks)
+            self._g_live.set(len(self.live))
+            if self._client is not None:
+                self._g_epoch.set(self._client.epoch_seen)
+            logger.warning(
+                "pod: membership shrank %s -> %s during step %d — "
+                "rolling back to the step-%d snapshot and replaying "
+                "in memory", old, self.live, it_count, self._snap_it)
+            self._rollback(engine)
+            engine.step(self.stride(raw_inputs), self.stride(raw_labels))
+            losses.extend(engine.drain())
+            if not self.group.consume_shrunk():
+                break  # replay ran clean under the new membership
+            losses.pop()  # another rank died mid-replay: go again
+        recovery_s = time.monotonic() - t0
+        self._g_recovery.set(recovery_s)
+        ev = {"step": it_count, "old": old, "live": list(self.live),
+              "recovery_s": recovery_s}
+        self.shrink_events.append(ev)
+        if self._client is not None:
+            try:
+                self._client.report("resumed", ev)
+            except (OSError, ConnectionError):
+                pass
+        return losses, True
+
+    def _rollback(self, engine):
+        """Restore the pre-step snapshot into the live engine state via
+        PR-8's any-geometry reshard: host leaves device_put straight onto
+        the CURRENT shardings, then adopted without a retrace."""
+        import jax
+
+        snap = self._snap
+        shardings = engine.ft_restore_shardings(snap)
+        if shardings is not None:
+            snap = jax.tree_util.tree_map(jax.device_put, snap, shardings)
+        engine.adopt_ft_state(snap)
+
+    def close(self):
+        if self._client is not None:
+            self._client.stop_heartbeats()
+
+    @classmethod
+    def from_env(cls, snapshot_every=1):
+        return cls(snapshot_every=snapshot_every)
+
+
+class ElasticResult:
+    """What launch_elastic hands back: per-rank exit codes, the
+    supervisor's death classifications, coordinator event reports, and
+    the goodput accounting of the drill."""
+
+    def __init__(self, returncodes, deaths, events, downs, report):
+        self.returncodes = list(returncodes)
+        self.deaths = dict(deaths)        # rank -> (reason, wall_t)
+        self.events = list(events)        # coordinator rank reports
+        self.downs = list(downs)          # death→resumed gaps, seconds
+        self.report = report              # goodput ledger report or None
+
+    @property
+    def survivors_ok(self) -> bool:
+        """Every rank NOT declared dead by the supervisor exited 0."""
+        return all(rc == 0 for r, rc in enumerate(self.returncodes)
+                   if r not in self.deaths)
+
+    def resumed(self):
+        return [e for e in self.events if e.get("kind") == "resumed"]
+
+    def recovery_s(self):
+        """Fastest rank-reported in-memory recovery, or None."""
+        rs = [e["data"].get("recovery_s") for e in self.resumed()
+              if e.get("data", {}).get("recovery_s") is not None]
+        return min(rs) if rs else None
+
+
+def launch_elastic(cmd, world, *, env=None, heartbeat_timeout_s=5.0,
+                   poll_interval_s=0.05, telemetry_dir=None, log_dir=None,
+                   timeout_s=600.0, registry=None):
+    """Supervise `world` rank processes with shrink-and-continue.
+
+    `cmd` is the full argv of ONE rank (e.g. ``[sys.executable,
+    "train.py"]``); each rank gets PADDLE_POD_COORD/RANK/WORLD on top of
+    `env` (default: inherit).  Rank death with survivors left does NOT
+    tear the pod down: the coordinator re-forms membership and the
+    survivors continue in memory.  Returns ElasticResult once every rank
+    has exited."""
+    m_failures = _failures_counter(registry)
+    reg = registry or default_registry()
+    g_live = reg.gauge("paddle_pod_live_ranks",
+                       "pod ranks the supervisor believes live")
+    ledger = None
+    if telemetry_dir:
+        from .goodput import GoodputLedger
+        ledger = GoodputLedger(os.path.abspath(telemetry_dir), registry=reg)
+
+    coord = PodCoordinator(world,
+                           heartbeat_timeout_s=heartbeat_timeout_s).start()
+    procs, logs = [], []
+    base_env = dict(os.environ)
+    if env:
+        base_env.update(env)
+    for r in range(world):
+        e = dict(base_env)
+        e.update({"PADDLE_POD_COORD": coord.address,
+                  "PADDLE_POD_RANK": str(r),
+                  "PADDLE_POD_WORLD": str(world),
+                  "PADDLE_TRAINER_ID": str(r)})
+        if telemetry_dir:
+            # own subdir per rank: JSONL streams never interleave, and a
+            # SIGKILLed rank's events.jsonl is still attributable
+            e["FLAGS_TELEMETRY_DIR"] = os.path.join(
+                os.path.abspath(telemetry_dir), f"rank{r}")
+        out = None
+        if log_dir:
+            os.makedirs(log_dir, exist_ok=True)
+            out = open(os.path.join(log_dir, f"workerlog.{r}"), "wb")
+            logs.append(out)
+        procs.append(subprocess.Popen(
+            list(cmd), env=e, stdout=out or subprocess.DEVNULL,
+            stderr=subprocess.STDOUT if out else subprocess.DEVNULL))
+
+    deaths: dict[int, tuple[str, float]] = {}
+    finished: set[int] = set()
+    deadline = time.monotonic() + float(timeout_s)
+    g_live.set(world)
+    try:
+        while len(finished) < world:
+            if time.monotonic() > deadline:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+                raise TimeoutError(
+                    f"elastic pod did not finish within {timeout_s}s "
+                    f"(finished={sorted(finished)} deaths={deaths})")
+            for r, p in enumerate(procs):
+                rc = p.poll()
+                if rc is None or r in finished:
+                    continue
+                finished.add(r)
+                # any exit leaves the membership (a finished rank stops
+                # answering collectives); only a nonzero one is a failure
+                coord.mark_dead(r, DEAD_EXIT)
+                if rc != 0 and r not in deaths:
+                    deaths[r] = (DEAD_EXIT, time.time())
+                    live = [q for q in range(world) if q not in finished]
+                    m_failures.inc("rank_lost_shrunk" if live else "crash")
+                    logger.warning(
+                        "elastic: rank %d exited %s — %s", r, rc,
+                        "survivors %s shrink and continue" % live
+                        if live else "no survivors left")
+            for r, why in coord.check_heartbeats().items():
+                if procs[r].poll() is None:
+                    # alive but silent: partitioned — fence it so it can
+                    # never rejoin a collective it was evicted from
+                    deaths[r] = (DEAD_PARTITION, time.time())
+                    procs[r].kill()
+                    live = [q for q in range(world)
+                            if q not in finished and q != r
+                            and q not in deaths]
+                    m_failures.inc("rank_lost_shrunk" if live else "crash")
+                    logger.warning(
+                        "elastic: rank %d partitioned (heartbeat silent) "
+                        "— fenced with SIGKILL; survivors %s", r, live)
+                elif r not in deaths:
+                    deaths[r] = (DEAD_HEARTBEAT, time.time())
+            g_live.set(len(coord.live()))
+            time.sleep(poll_interval_s)
+    finally:
+        events = coord.events()
+        # death→resumed gaps = the elastic equivalent of the restart
+        # path's `down` bucket; with in-memory replay this is the poll
+        # interval + rollback + one step, not spawn+restore+fast-forward
+        downs = []
+        for r, (why, t_dead) in sorted(deaths.items()):
+            if why == DEAD_HEARTBEAT:
+                continue  # never produced a gap survivors waited on
+            resumed = [e["t"] for e in events
+                       if e.get("kind") == "resumed" and e["t"] >= t_dead]
+            if resumed:
+                downs.append(min(resumed) - t_dead)
+        report = None
+        if ledger is not None:
+            for d in downs:
+                ledger.add_down(d)
+            try:
+                report = ledger.report()
+            except Exception:  # noqa: BLE001 - teardown must not mask
+                logger.exception("elastic goodput report failed")
+        coord.close()
+        for f in logs:
+            f.close()
+    return ElasticResult([p.returncode for p in procs], deaths, events,
+                         downs, report)

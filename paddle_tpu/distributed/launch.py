@@ -48,10 +48,14 @@ class _LauncherSignaled(Exception):
 # per-rank /metrics, so "how often does this job die, and why" is a
 # scrape instead of a log grep.
 _REG = default_registry()
+# "rank_lost_shrunk" is the ELASTIC supervisor's classification (a rank
+# died but survivors re-formed and continued in memory — elastic.py); it
+# sits in the same counter so one scrape compares shrink vs restart.
 _m_failures = _REG.counter(
     "paddle_launch_trainer_failures_total",
     "trainer exits the launcher classified, by reason", label="reason",
-    preset=("preempted", "watchdog", "durability", "crash"))
+    preset=("preempted", "watchdog", "durability", "crash",
+            "rank_lost_shrunk"))
 _m_restarts = _REG.counter(
     "paddle_launch_restarts_total", "pod restarts performed")
 

@@ -15,6 +15,7 @@ TPU-native: there are no buckets, no comm streams, no TCP bootstrap.
 """
 from __future__ import annotations
 
+import logging
 import os
 import warnings
 
@@ -25,6 +26,8 @@ from ..tensor import Tensor
 from . import collective
 from .env import ParallelEnv
 from .mesh import build_mesh, ensure_mesh, get_mesh, set_mesh
+
+logger = logging.getLogger("paddle_tpu.distributed")
 
 _initialized = False
 _mesh_subsumed_warned = False
@@ -50,8 +53,61 @@ def _mesh_dp_degree(mesh) -> int:
     return int(mesh.shape.get("dp", mesh.size))
 
 
+class CoordinatorAddressError(ValueError):
+    """The coordinator address from PADDLE_MASTER / the endpoint list is
+    malformed.  Named so the launcher/supervisor can tell a config error
+    (fail fast, never retry) from a transient dial failure (retry)."""
+
+
+def _validate_coordinator_address(coord: str) -> str:
+    """host:port with a sane port — misconfig fails BEFORE the retry
+    loop burns its bring-up budget dialing an unusable address."""
+    if not coord or ":" not in coord:
+        raise CoordinatorAddressError(
+            f"coordinator address {coord!r} must be host:port (set "
+            "PADDLE_MASTER or PADDLE_TRAINER_ENDPOINTS)")
+    host, _, port_s = coord.rpartition(":")
+    if not host:
+        raise CoordinatorAddressError(
+            f"coordinator address {coord!r} has an empty host")
+    try:
+        port = int(port_s)
+    except ValueError:
+        raise CoordinatorAddressError(
+            f"coordinator address {coord!r} has a non-numeric port "
+            f"{port_s!r}") from None
+    if not 0 < port < 65536:
+        raise CoordinatorAddressError(
+            f"coordinator address {coord!r} port {port} out of range "
+            "1-65535")
+    return coord
+
+
+def _init_metrics():
+    from ..utils.metrics import default_registry
+
+    reg = default_registry()
+    return reg.counter(
+        "paddle_launch_init_retries_total",
+        "failed jax.distributed.initialize dial attempts that were "
+        "retried with backoff")
+
+
 def init_parallel_env(mesh_shape=None):
-    """Bootstrap multi-process JAX + build the default mesh."""
+    """Bootstrap multi-process JAX + build the default mesh.
+
+    Bring-up hardening (pod robustness):
+      * the coordinator address is validated up front
+        (CoordinatorAddressError — a config error is never retried);
+      * each dial runs the chaos `on_init` hook (PADDLE_CHAOS_INIT_FLAKY
+        drills the retry path with real ConnectionErrors);
+      * retries are bounded BOTH by count (PADDLE_INIT_RETRIES) and by an
+        overall wall-clock deadline (PADDLE_INIT_TIMEOUT seconds, default
+        300) — a flapping coordinator cannot pin the rank in the dial
+        loop forever;
+      * every retried dial increments paddle_launch_init_retries_total in
+        the shared registry.
+    """
     global _initialized
     if _initialized:
         return ParallelEnv()
@@ -64,25 +120,45 @@ def init_parallel_env(mesh_shape=None):
         # After a pod restart the coordination service may come up a beat
         # later than we do — retry the dial with backoff instead of dying
         # (which would burn one of the launcher's --max_restarts).
+        import time as _time
+
+        from ..utils import chaos
         from .resilience import retry_with_backoff
         coord = os.environ.get("PADDLE_MASTER",
                                (env.trainer_endpoints or [""])[0])
+        coord = _validate_coordinator_address(coord)
+        timeout_s = float(os.environ.get("PADDLE_INIT_TIMEOUT", "300"))
+        deadline = _time.monotonic() + timeout_s
+        m_retries = _init_metrics()
+
         def _dial():
             # idempotent: a retry after a half-successful attempt must
             # not mask the first failure with "already initialized"
             if jax.distributed.is_initialized():
                 return
+            chaos.on_init("jax.distributed.initialize")
             jax.distributed.initialize(
                 coordinator_address=coord or None,
                 num_processes=env.world_size,
                 process_id=env.rank)
+
+        def _should_retry(exc):
+            if _time.monotonic() >= deadline:
+                logger.error(
+                    "jax.distributed.initialize: overall bring-up "
+                    "deadline of %.0fs exhausted (%s: %s) — escalating",
+                    timeout_s, type(exc).__name__, exc)
+                return False
+            m_retries.inc()
+            return True
 
         retry_with_backoff(
             _dial,
             retries=int(os.environ.get("PADDLE_INIT_RETRIES", "3")),
             base_delay=float(os.environ.get("PADDLE_INIT_RETRY_DELAY", "1")),
             retry_on=(RuntimeError, OSError, ConnectionError),
-            label="jax.distributed.initialize")
+            label="jax.distributed.initialize",
+            should_retry=_should_retry)
     ensure_mesh(mesh_shape)
     _initialized = True
     return env

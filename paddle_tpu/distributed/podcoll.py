@@ -1,0 +1,289 @@
+"""Host-level pod collectives: cross-process all_reduce/all_gather/
+broadcast/barrier that work where XLA cannot.
+
+jax 0.4.37's CPU backend rejects every multiprocess XLA computation
+("Multiprocess computations aren't implemented on the CPU backend"), so a
+local pod — N real OS processes under jax.distributed.initialize — has
+working device compute *per process* but no cross-process collectives at
+the XLA level.  The reference runtime had exactly this split: in-graph
+collectives ride the interconnect, while bootstrap/eager collectives ride
+the gloo/TCP control plane (SURVEY §2.5).  This module is that control
+plane: numpy-in, numpy-out collectives over a small KV transport, used by
+
+  * `collective.all_reduce` & co in eager mode when `process_count() > 1`
+    on a backend without multiprocess XLA (turns the known-fail
+    multi-process tests into executed coverage), and
+  * the elastic pod runtime (distributed.elastic), where the transport is
+    the supervisor-hosted coordinator (podcoord) and the SAME all_reduce
+    degrades gracefully to the surviving membership when a rank dies
+    mid-collective.
+
+Two transports, one algorithm surface:
+
+  * JaxCoordTransport — the jax coordination-service KV store + barrier
+    (rank 0 hosts it; any rank death aborts the whole pod from C++, so
+    this transport is for the die-together / restart recovery mode).
+  * TcpTransport — podcoord.PodClient against the supervisor's
+    coordinator (survives rank death; collectives are arbitrated by the
+    server and report membership shrink to the caller).
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import threading
+
+import numpy as np
+
+from .podcoord import PodClient, PodPeerLost
+
+__all__ = ["PodGroup", "JaxCoordTransport", "TcpTransport", "PodPeerLost",
+           "default_group", "set_default_group", "reset_default_group"]
+
+
+def _pack(arr: np.ndarray) -> bytes:
+    arr = np.ascontiguousarray(arr)
+    meta = json.dumps({"dtype": arr.dtype.str,
+                       "shape": list(arr.shape)}).encode("utf-8")
+    return struct.pack(">I", len(meta)) + meta + arr.tobytes()
+
+
+def _unpack(blob: bytes) -> np.ndarray:
+    mlen = struct.unpack(">I", blob[:4])[0]
+    meta = json.loads(blob[4:4 + mlen].decode("utf-8"))
+    # frombuffer is a read-only view of the blob and callers hand the
+    # result to jnp.asarray (zero-copy ingest on CPU), so own the bytes
+    view = np.frombuffer(blob[4 + mlen:],  # noqa: PTA001 - copied below
+                         dtype=np.dtype(meta["dtype"]))
+    return np.array(view, copy=True).reshape(meta["shape"])
+
+
+class JaxCoordTransport:
+    """KV + barrier over the jax coordination service client."""
+
+    elastic = False  # any rank death kills the pod (client.h:80 abort)
+
+    def __init__(self, client, rank: int, world: int):
+        self._client = client
+        self.rank = int(rank)
+        self.world = int(world)
+
+    @classmethod
+    def from_global_state(cls):
+        from jax._src import distributed as jdist
+
+        st = jdist.global_state
+        if st.client is None:
+            return None
+        return cls(st.client, st.process_id, st.num_processes)
+
+    def gather(self, name: str, seq: int, part: bytes,
+               timeout_s: float = 30.0):
+        """Symmetric gather: every rank contributes, every rank receives
+        all parts in rank order.  Fixed membership — shrink never happens
+        on this transport (a dead rank aborts everyone first), so the
+        membership epoch is constant 0."""
+        ms = int(timeout_s * 1e3)
+        c = self._client
+        c.key_value_set_bytes(f"podcoll/{name}/{seq}/{self.rank}", part)
+        parts = [c.blocking_key_value_get_bytes(
+            f"podcoll/{name}/{seq}/{r}", ms) for r in range(self.world)]
+        # every rank has read every part before anyone deletes its own
+        c.wait_at_barrier(f"podcoll-done/{name}/{seq}", ms)
+        c.key_value_delete(f"podcoll/{name}/{seq}/{self.rank}")
+        return list(range(self.world)), parts, 0
+
+    def barrier(self, name: str, timeout_s: float = 30.0):
+        self._client.wait_at_barrier(f"podbar/{name}",
+                                     int(timeout_s * 1e3))
+        return 0  # fixed membership: epoch never advances
+
+    def live(self):
+        return list(range(self.world))
+
+
+class TcpTransport:
+    """KV + arbitrated gather over the supervisor's pod coordinator."""
+
+    elastic = True
+
+    def __init__(self, client: PodClient, world: int):
+        self._client = client
+        self.rank = client.rank
+        self.world = int(world)
+
+    @classmethod
+    def from_env(cls, environ=None):
+        env = os.environ if environ is None else environ
+        client = PodClient.from_env(env)
+        if client is None:
+            return None
+        world = int(env.get("PADDLE_POD_WORLD",
+                            env.get("PADDLE_TRAINERS_NUM", "1")))
+        return cls(client, world)
+
+    def gather(self, name: str, seq: int, part: bytes,
+               timeout_s: float = 30.0):
+        ranks, _metas, payloads, epoch, _shrunk = self._client.gather(
+            name, seq, part, timeout_s=timeout_s)
+        return ranks, payloads, epoch
+
+    def barrier(self, name: str, timeout_s: float = 30.0):
+        resp = self._client.barrier(name, timeout_s=timeout_s)
+        return int(resp.get("epoch", 0))
+
+    def live(self):
+        return self._client.membership()["live"]
+
+    @property
+    def client(self) -> PodClient:
+        return self._client
+
+
+_REDUCERS = {
+    "sum": lambda parts: _tree_sum(parts),
+    "max": lambda parts: _elemwise(np.maximum, parts),
+    "min": lambda parts: _elemwise(np.minimum, parts),
+    "prod": lambda parts: _elemwise(np.multiply, parts),
+}
+
+
+def _tree_sum(parts):
+    out = parts[0].astype(np.result_type(parts[0].dtype, np.float64)
+                          if parts[0].dtype.kind == "f" else
+                          parts[0].dtype, copy=True)
+    for p in parts[1:]:
+        out += p
+    return out.astype(parts[0].dtype)
+
+
+def _elemwise(fn, parts):
+    out = parts[0]
+    for p in parts[1:]:
+        out = fn(out, p)
+    return out
+
+
+class PodGroup:
+    """Numpy collectives over a pod transport.
+
+    Collectives are matched across ranks by a per-group monotonically
+    increasing sequence number: every rank must issue the same collectives
+    in the same order (the SPMD contract the in-graph path has anyway).
+
+    Shrink detection is an EPOCH DELTA observed at a collective: the
+    coordinator bumps its membership epoch on every death, each frozen
+    collective result carries the epoch it froze at, and the first
+    collective whose epoch is newer than this group's last-seen epoch
+    latches `last_shrunk` — once, on every survivor, at the same seq
+    (the frozen result is shared).  A death BETWEEN two steps latches on
+    the next step's collective (survivors were still striding data by
+    the stale membership, so that step must replay too), while
+    post-shrink steady state reads clean."""
+
+    def __init__(self, transport, timeout_s: float = 30.0):
+        self.transport = transport
+        self.timeout_s = float(timeout_s)
+        self._seq = 0
+        self._epoch = 0
+        self._lock = threading.Lock()
+        self.last_shrunk = False
+        self.last_ranks: list[int] = list(range(transport.world))
+
+    @property
+    def rank(self) -> int:
+        return self.transport.rank
+
+    @property
+    def world(self) -> int:
+        return self.transport.world
+
+    def _next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def _observe_epoch(self, epoch):
+        with self._lock:
+            if epoch > self._epoch:
+                self._epoch = epoch
+                self.last_shrunk = True
+
+    def _gather_arrays(self, name, arr):
+        seq = self._next_seq()
+        ranks, payloads, epoch = self.transport.gather(
+            name, seq, _pack(np.asarray(arr)),  # noqa: PTA001 - tobytes copies
+            timeout_s=self.timeout_s)
+        self._observe_epoch(epoch)
+        self.last_ranks = list(ranks)
+        return ranks, [_unpack(p) for p in payloads]
+
+    # -- collectives -------------------------------------------------------
+    def all_reduce(self, arr, op: str = "sum") -> np.ndarray:
+        ranks, parts = self._gather_arrays("ar", arr)
+        return _REDUCERS[op](parts)
+
+    def all_reduce_mean(self, arr) -> np.ndarray:
+        """Mean over the LIVE contributors — the dp grad-sync op.  After a
+        shrink the divisor is the surviving world, which is exactly the
+        shrunk-from-start semantics the replayed step needs."""
+        ranks, parts = self._gather_arrays("arm", arr)
+        s = _tree_sum(parts)
+        return (s / len(parts)).astype(parts[0].dtype)
+
+    def all_gather(self, arr) -> list[np.ndarray]:
+        _ranks, parts = self._gather_arrays("ag", arr)
+        return parts
+
+    def broadcast(self, arr, src: int = 0) -> np.ndarray:
+        ranks, parts = self._gather_arrays("bc", arr)
+        if src in ranks:
+            return parts[ranks.index(src)]
+        # src died mid-broadcast: lowest live rank is the deterministic
+        # stand-in every survivor agrees on
+        return parts[0]
+
+    def barrier(self, name: str = None):
+        seq = self._next_seq()
+        epoch = self.transport.barrier(name or f"b{seq}",
+                                       timeout_s=self.timeout_s)
+        self._observe_epoch(epoch)
+
+    def consume_shrunk(self) -> bool:
+        """Read-and-clear the shrink latch (step-boundary check)."""
+        s = self.last_shrunk
+        self.last_shrunk = False
+        return s
+
+
+# -- ambient default group (eager collective routing) -----------------------
+_default: PodGroup | None = None
+_default_lock = threading.Lock()
+
+
+def set_default_group(group: PodGroup | None):
+    global _default
+    with _default_lock:
+        _default = group
+
+
+def reset_default_group():
+    set_default_group(None)
+
+
+def default_group() -> PodGroup | None:
+    """The ambient pod group: explicit if set, else auto-built from the
+    pod coordinator env (PADDLE_POD_COORD), else from a live jax
+    coordination client.  Returns None in single-process runs."""
+    global _default
+    with _default_lock:
+        if _default is not None:
+            return _default
+        t = TcpTransport.from_env()
+        if t is None:
+            t = JaxCoordTransport.from_global_state()
+        if t is None or t.world <= 1:
+            return None
+        _default = PodGroup(t)
+        return _default

@@ -238,7 +238,7 @@ class TrainEngine:
 
     # -- lifecycle ---------------------------------------------------------
     def begin(self, mesh=None, sharding_rule=None, layout=None,
-              recompute=None, accum_steps=1):
+              recompute=None, accum_steps=1, grad_sync=None):
         m = self.model
         if m._optimizer is None or m._loss is None:
             raise RuntimeError("prepare() an optimizer and a loss before "
@@ -266,6 +266,14 @@ class TrainEngine:
         self._accum = int(accum_steps)
         if self._accum < 1:
             raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+        # cross-PROCESS dp grad sync (the pod/DCN seam): a host callable
+        # `grads_pytree -> grads_pytree` spliced between the grad
+        # computation and the optimizer update via jax.pure_callback.
+        # The in-graph mesh collectives cover intra-process devices; this
+        # covers the axis XLA cannot see (other OS processes), and its
+        # membership can SHRINK between dispatches without retracing —
+        # the compiled step closes over the callable, not the world size.
+        self._grad_sync = grad_sync
         if self.mesh is not None and layout is not None:
             self.batch_axes = layout.batch_axes(self.mesh)
         else:
@@ -312,7 +320,8 @@ class TrainEngine:
         rec_key = rec if (rec is None or isinstance(rec, (str, bool))) \
             else id(rec)
         step_key = (step_key, self._accum, rec_key, self.batch_axes,
-                    self._layout is not None)
+                    self._layout is not None,
+                    id(grad_sync) if grad_sync is not None else None)
         self._record_synced_ids()
         self.ring = _LossRing()
         if self._step_fn is None or step_key != self._step_key:
@@ -472,9 +481,21 @@ class TrainEngine:
                 dirty += 1
         return dirty
 
+    def _sync_grads(self, grads):
+        """Route grads through the cross-process grad_sync host callable
+        (pure_callback keeps the step one donated jitted dispatch; the
+        callback's pod membership is read at EXECUTION time, so an
+        elastic shrink needs no retrace)."""
+        if self._grad_sync is None:
+            return grads
+        shapes = jax.tree_util.tree_map(
+            lambda g: jax.ShapeDtypeStruct(g.shape, g.dtype), grads)
+        return jax.pure_callback(self._grad_sync, shapes, grads)
+
     def _build_step(self):
         if (self._accum > 1 or self._recompute is not None
-                or (self._layout is not None and self.mesh is not None)):
+                or (self._layout is not None and self.mesh is not None)
+                or self._grad_sync is not None):
             return self._build_featured_step()
         m = self.model
         pure = build_pure_train_step(m.network, m._loss, m._optimizer)
@@ -582,6 +603,7 @@ class TrainEngine:
                     _layout_mod.microbatch_scan(
                         grad_fn, state["trainable"], state["buffers"],
                         rng, inputs, labels, k, constrain=constrain)
+            grads = self._sync_grads(grads)
             new_params, new_opt = opt.apply_pytree(
                 state["trainable"], grads, state["opt"], lr=state["lr"],
                 step=t)

@@ -410,7 +410,8 @@ class Model:
             drop_last=False, shuffle=True, num_workers=0, callbacks=None,
             accumulate_grad_batches=1, num_iters=None, fault_tolerant=False,
             resume=None, checkpoint_interval=None, mesh=None,
-            sharding_rule=None, layout=None, recompute=None, accum_steps=1):
+            sharding_rule=None, layout=None, recompute=None, accum_steps=1,
+            pod=None):
         """[fault tolerance — opt-in] `resume=<dir>` (or `resume=True`
         with `save_dir`) auto-resumes from the newest checkpoint in that
         directory and checkpoints every `checkpoint_interval` iterations
@@ -446,7 +447,17 @@ class Model:
         (alias: the Paddle-named `accumulate_grad_batches`) accumulates
         gradients over k microbatches via a lax.scan INSIDE the one
         donated step, so `batch_size` stays the GLOBAL batch.  See
-        MIGRATION §5a-ii for the fleet-strategy mapping."""
+        MIGRATION §5a-ii for the fleet-strategy mapping.
+
+        [elastic pod — opt-in] `pod=` a `distributed.elastic.PodRuntime`
+        (under the elastic supervisor, `PodRuntime.from_env()`): every
+        rank feeds the FULL global batch; the runtime strides it over
+        the live membership, syncs grads cross-process through the pod
+        coordinator, snapshots in-memory per step, and on a mid-step
+        rank loss rolls back and REPLAYS the step under the shrunk
+        membership — training continues without a restart or a disk
+        restore.  See README "Pod runtime & elasticity" and MIGRATION
+        §5a-iii."""
         from .callbacks import config_callbacks
 
         if accumulate_grad_batches != 1 and accum_steps == 1:
@@ -490,7 +501,12 @@ class Model:
         engine = self._engine
         _step_fn_before = engine._step_fn
         engine.begin(mesh=mesh, sharding_rule=sharding_rule, layout=layout,
-                     recompute=recompute, accum_steps=accum_steps)
+                     recompute=recompute, accum_steps=accum_steps,
+                     grad_sync=pod.grad_sync if pod is not None else None)
+        if pod is not None:
+            # pod chaos (RANK_KILL/RANK_SLOW/RANK_PARTITION) must fire on
+            # the same step boundary whether or not fault tolerance is on
+            from ..utils import chaos as _pod_chaos
 
         ft_mgr = None
         ft_saver = None
@@ -655,10 +671,19 @@ class Model:
                         # fault-injection hook (crash/preempt/slow) so the
                         # fit() recovery paths are chaos-testable too
                         _chaos.on_step(it_count + 1)
+                    elif pod is not None:
+                        _pod_chaos.on_step(it_count + 1)
                     batch = _to_list(batch)
                     inputs, labels = self._split_batch(batch)
                     inputs = [_as_tensor(x) for x in inputs]
                     labels = [_as_tensor(x) for x in labels]
+                    if pod is not None:
+                        # every rank holds the FULL global batch; the pod
+                        # runtime strides it over the live membership (and
+                        # re-strides on replay after a shrink)
+                        _pod_raw = (inputs, labels)
+                        inputs = pod.stride(inputs)
+                        labels = pod.stride(labels)
                     if user_cbs:
                         # per-batch weight mutations (WGAN-style clipping
                         # callbacks) only possible with user callbacks —
@@ -671,8 +696,20 @@ class Model:
                     _sp_step = (_epoch_span.child("train.step",
                                                   step=it_count + 1)
                                 if _epoch_span is not None else None)
+                    if pod is not None:
+                        # in-memory rollback point for a mid-step shrink
+                        pod.before_step(engine, it_count)
                     with timers.scope("dispatch"):
                         outs = engine.step(inputs, labels)
+                    if pod is not None:
+                        # sync point + shrink check: on a mid-step rank
+                        # loss the runtime rolls back to its in-memory
+                        # snapshot and replays under the new membership
+                        with timers.scope("sync"):
+                            _pod_losses, _ = pod.after_step(
+                                engine, _pod_raw[0], _pod_raw[1],
+                                it_count + 1)
+                            losses.extend(_pod_losses)
                     if telem is not None:
                         telem.step_mark()
                     if _sp_step is not None:

@@ -30,6 +30,22 @@ Two drive modes, composable:
         PADDLE_CHAOS_CKPT_SLOW_IO=S   every checkpoint IO call stalls S
                                       seconds while active (async-save
                                       stall / overlap measurements)
+        PADDLE_CHAOS_RANK_KILL=k@N    pod drill: rank k SIGKILLs itself
+                                      at step N (no cleanup, no dump —
+                                      the flightrec JSONL fallback is
+                                      that rank's only ledger evidence)
+        PADDLE_CHAOS_RANK_SLOW=k@N[:S]  rank k stalls step N for S
+                                      seconds (default SLOW_SECONDS);
+                                      unlike a partition it KEEPS
+                                      heartbeating — the detector must
+                                      not declare it dead
+        PADDLE_CHAOS_RANK_PARTITION=k@N  rank k stops heartbeating from
+                                      step N while continuing to run —
+                                      the failure detector declares it
+                                      dead and the supervisor fences it
+        PADDLE_CHAOS_INIT_FLAKY=K     next K distributed-init dials raise
+                                      ConnectionError (drives
+                                      retry_with_backoff bring-up)
   * `inject(...)` context manager — in-process unit tests push a chaos
     config for the duration of a `with` block.
 
@@ -58,7 +74,8 @@ import time
 logger = logging.getLogger("paddle_tpu.chaos")
 
 __all__ = ["ChaosCrash", "ChaosTorn", "ChaosConfig", "inject", "on_step",
-           "on_io", "active_config", "reset"]
+           "on_io", "on_init", "active_config", "reset",
+           "register_partition_hook", "pod_rank"]
 
 
 class ChaosCrash(RuntimeError):
@@ -84,7 +101,8 @@ class ChaosConfig:
     def __init__(self, crash_at_step=None, nan_at_step=None, slow_step=None,
                  slow_seconds=30.0, preempt_at_step=None, fail_io=0,
                  io_error=None, ckpt_torn=0, ckpt_bitflip=0, ckpt_enospc=0,
-                 ckpt_slow_io=0.0):
+                 ckpt_slow_io=0.0, rank_kill=None, rank_slow=None,
+                 rank_partition=None, init_flaky=0):
         self.crash_at_step = crash_at_step
         # accept a single step or an iterable of steps
         if nan_at_step is None:
@@ -102,6 +120,14 @@ class ChaosConfig:
         self.ckpt_bitflip = int(ckpt_bitflip)
         self.ckpt_enospc = int(ckpt_enospc)
         self.ckpt_slow_io = float(ckpt_slow_io)
+        # pod drills: (rank, step[, seconds]) triggers, one-shot like the
+        # other step injectors.  The rank is matched against THIS
+        # process's pod rank at fire time (PADDLE_POD_RANK /
+        # PADDLE_TRAINER_ID), so one env spec can be handed to every rank.
+        self.rank_kill = rank_kill          # (rank, step)
+        self.rank_slow = rank_slow          # (rank, step, seconds)
+        self.rank_partition = rank_partition  # (rank, step)
+        self.init_flaky = int(init_flaky)
         self.fired: list[str] = []  # audit trail for tests
 
     def is_noop(self):
@@ -109,7 +135,9 @@ class ChaosConfig:
                 and self.slow_step is None and self.preempt_at_step is None
                 and self.fail_io <= 0 and self.ckpt_torn <= 0
                 and self.ckpt_bitflip <= 0 and self.ckpt_enospc <= 0
-                and self.ckpt_slow_io <= 0)
+                and self.ckpt_slow_io <= 0 and self.rank_kill is None
+                and self.rank_slow is None and self.rank_partition is None
+                and self.init_flaky <= 0)
 
     @classmethod
     def from_env(cls, environ=None):
@@ -118,6 +146,20 @@ class ChaosConfig:
         def _int(key):
             v = env.get(key)
             return int(v) if v not in (None, "") else None
+
+        def _rank_at(key, with_seconds=False):
+            """Parse 'rank@step' (optionally ':seconds') pod-drill specs."""
+            v = env.get(key)
+            if not v:
+                return None
+            secs = None
+            if with_seconds and ":" in v:
+                v, secs = v.rsplit(":", 1)
+            rank, step = v.split("@", 1)
+            out = (int(rank), int(step))
+            if with_seconds:
+                out += (float(secs) if secs is not None else None,)
+            return out
 
         nan = env.get("PADDLE_CHAOS_NAN_STEP", "")
         nan_steps = tuple(int(s) for s in nan.split(",") if s.strip())
@@ -132,6 +174,10 @@ class ChaosConfig:
             ckpt_bitflip=_int("PADDLE_CHAOS_CKPT_BITFLIP") or 0,
             ckpt_enospc=_int("PADDLE_CHAOS_CKPT_ENOSPC") or 0,
             ckpt_slow_io=float(env.get("PADDLE_CHAOS_CKPT_SLOW_IO", "0")),
+            rank_kill=_rank_at("PADDLE_CHAOS_RANK_KILL"),
+            rank_slow=_rank_at("PADDLE_CHAOS_RANK_SLOW", with_seconds=True),
+            rank_partition=_rank_at("PADDLE_CHAOS_RANK_PARTITION"),
+            init_flaky=_int("PADDLE_CHAOS_INIT_FLAKY") or 0,
         )
 
 
@@ -158,6 +204,7 @@ def reset():
     """Drop all state; the env base is re-parsed on next use."""
     with _lock:
         _stack.clear()
+        _partition_hooks.clear()
 
 
 @contextlib.contextmanager
@@ -179,6 +226,37 @@ def inject(**kwargs):
                 _stack.remove(cfg)
 
 
+def pod_rank() -> int:
+    """This process's pod rank for rank-targeted drills (elastic pod env
+    first, classic trainer env second, 0 in single-process runs)."""
+    return int(os.environ.get("PADDLE_POD_RANK",
+                              os.environ.get("PADDLE_TRAINER_ID", "0")))
+
+
+# callbacks a pod runtime registers so a RANK_PARTITION drill can silence
+# its heartbeats without chaos importing the pod stack (layering: utils
+# must not depend on distributed)
+_partition_hooks: list = []
+
+
+def register_partition_hook(fn):
+    """Register fn() to run when a RANK_PARTITION drill fires on this
+    rank (the elastic runtime uses it to stop heartbeating).  Hooks are
+    cleared by reset()."""
+    with _lock:
+        _partition_hooks.append(fn)
+
+
+def _fire_partition():
+    with _lock:
+        hooks = list(_partition_hooks)
+    for fn in hooks:
+        try:
+            fn()
+        except Exception:  # noqa: BLE001 - a drill must not crash the rank
+            logger.exception("chaos: partition hook failed")
+
+
 def on_step(step: int) -> bool:
     """Step-boundary hook.  May raise ChaosCrash, sleep, or SIGTERM the
     process; returns True when this step's loss should be poisoned with
@@ -196,6 +274,30 @@ def on_step(step: int) -> bool:
         cfg.fired.append(f"preempt@{step}")
         logger.warning("chaos: SIGTERM self at step %d", step)
         os.kill(os.getpid(), signal.SIGTERM)
+    if cfg.rank_kill is not None and step == cfg.rank_kill[1] \
+            and pod_rank() == cfg.rank_kill[0]:
+        cfg.rank_kill = None
+        cfg.fired.append(f"rank_kill@{step}")
+        logger.warning("chaos: SIGKILL self (pod rank %d) at step %d",
+                       pod_rank(), step)
+        os.kill(os.getpid(), signal.SIGKILL)
+    if cfg.rank_partition is not None and step >= cfg.rank_partition[1] \
+            and pod_rank() == cfg.rank_partition[0]:
+        cfg.rank_partition = None
+        cfg.fired.append(f"rank_partition@{step}")
+        logger.warning("chaos: partitioning pod rank %d from step %d "
+                       "(heartbeats stop; the rank keeps running)",
+                       pod_rank(), step)
+        _fire_partition()
+    if cfg.rank_slow is not None and step == cfg.rank_slow[1] \
+            and pod_rank() == cfg.rank_slow[0]:
+        _, _, secs = cfg.rank_slow
+        cfg.rank_slow = None
+        cfg.fired.append(f"rank_slow@{step}")
+        secs = cfg.slow_seconds if secs is None else secs
+        logger.warning("chaos: stalling pod rank %d at step %d for %.1fs",
+                       pod_rank(), step, secs)
+        time.sleep(secs)
     if cfg.slow_step is not None and step == cfg.slow_step:
         cfg.slow_step = None
         cfg.fired.append(f"slow@{step}")
@@ -208,6 +310,21 @@ def on_step(step: int) -> bool:
         logger.warning("chaos: poisoning step %d loss with NaN", step)
         return True
     return False
+
+
+def on_init(label: str = "distributed.init"):
+    """Bring-up hook: while the init-flaky budget is positive each dial
+    attempt decrements it and raises ConnectionError — the transient
+    class retry_with_backoff retries — BEFORE the real initialize runs,
+    modelling a coordinator that comes up later than its pod."""
+    cfg = active_config()
+    if cfg.init_flaky > 0:
+        cfg.init_flaky -= 1
+        cfg.fired.append(f"init_flaky@{label}")
+        logger.warning("chaos: failing init dial %r (%d more to fail)",
+                       label, cfg.init_flaky)
+        raise ConnectionError(
+            f"chaos: injected flaky init dial ({label})")
 
 
 def _flip_one_bit(gen_dir: str):

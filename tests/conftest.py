@@ -55,6 +55,10 @@ def pytest_configure(config):
         "markers", "kernels: Pallas fused-kernel parity/dispatch test "
         "(masked flash, paged decode, softmax-xent, bias-gelu; CPU "
         "interpret mode) — run via tools/kernels_smoke.sh")
+    config.addinivalue_line(
+        "markers", "pod: multi-process pod test (N real OS processes via "
+        "distributed.podtest — coordinated jax.distributed bring-up or "
+        "the elastic shrink supervisor) — run via tools/pod_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

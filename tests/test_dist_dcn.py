@@ -3,12 +3,15 @@ processes (the "hosts", dp over DCN) × FOUR virtual CPU devices each
 (the "chips", mp over ICI) — the v4-style topology where tensor
 parallelism stays inside a host and data parallelism crosses hosts.
 
-The reference never simulates multi-node either (test_dist_base.py:652
-is multi-process-localhost, one device per process); this goes further:
-jax.distributed.initialize with a GLOBAL 8-device mesh split dp=2 (across
-processes) × mp=4 (within a process), a tensor-parallel MLP train step
-jitted over it, and per-step loss parity against the same step run
-single-process on 8 virtual devices.
+jax 0.4.37's CPU backend rejects multiprocess XLA computations, so the
+DCN axis cannot be a global in-graph mesh dimension here.  That split is
+exactly the reference runtime's (SURVEY §2.5): tensor parallelism rides
+the interconnect IN-GRAPH (a local mp=4 mesh per process), while the
+cross-host dp grad sync rides the control plane — podcoll's host-level
+all_reduce_mean over the jax coordination KV, the same transport the
+elastic pod runtime uses.  Parity oracle: per-step loss and parameters
+against the same model trained single-process on a global dp=2 x mp=4
+mesh of 8 virtual devices, where XLA inserts the dp all-reduce itself.
 """
 import os
 import re
@@ -21,11 +24,11 @@ import numpy as np
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-# Shared by both modes: multi-process (PADDLE_TRAINER_ID set -> jax
-# .distributed.initialize, 4 local devices) and single-process reference
-# (8 local devices, no init).  jax.devices() orders globals by process,
-# so reshape(dp=2, mp=4) puts each process's 4 devices in one dp row:
-# dp crosses processes (DCN), mp stays inside one (ICI).
+# Shared by both modes.  Multi-process (PADDLE_TRAINER_ID set): jax
+# .distributed.initialize, a LOCAL {"mp": 4} mesh per process, the dp
+# half-batch strided by rank, and host-level grad averaging through
+# podcoll.  Single-process reference: a global {"dp": 2, "mp": 4} mesh
+# over 8 virtual devices, full batch, in-graph dp all-reduce.
 TRAINER = textwrap.dedent("""
     import json
     import os
@@ -47,11 +50,19 @@ TRAINER = textwrap.dedent("""
 
     import paddle_tpu as paddle
     import paddle_tpu.distributed as dist
+    from paddle_tpu.distributed import podcoll
     from paddle_tpu.distributed.mesh import build_mesh, mesh_guard
     from paddle_tpu.nn.layer_base import functional_call, state_pytrees
 
-    assert jax.device_count() == 8
-    mesh = build_mesh({"dp": 2, "mp": 4})
+    if multi:
+        # mp (ICI) is in-graph over the LOCAL devices; dp (DCN) is a
+        # host-level collective — no global mesh on the CPU backend
+        mesh = build_mesh({"mp": 4}, devices=jax.local_devices())
+        group = podcoll.default_group()
+        assert group is not None and group.world == 2
+    else:
+        assert jax.device_count() == 8
+        mesh = build_mesh({"dp": 2, "mp": 4})
     with mesh_guard(mesh):
         paddle.seed(0)
         net = paddle.nn.Sequential(
@@ -65,24 +76,37 @@ TRAINER = textwrap.dedent("""
         rs = np.random.RandomState(7)
         X = rs.randn(16, 8).astype(np.float32)
         Y = (X @ rs.randn(8, 1).astype(np.float32))
-        xsh = NamedSharding(mesh, P("dp"))
-        Xg = jax.make_array_from_callback(X.shape, xsh, lambda i: X[i])
-        Yg = jax.make_array_from_callback(Y.shape, xsh, lambda i: Y[i])
+        if multi:
+            # this host's dp shard, replicated over the local mp mesh
+            Xg, Yg = X[rank::2], Y[rank::2]
+        else:
+            xsh = NamedSharding(mesh, P("dp"))
+            Xg = jax.make_array_from_callback(X.shape, xsh,
+                                              lambda i: X[i])
+            Yg = jax.make_array_from_callback(Y.shape, xsh,
+                                              lambda i: Y[i])
 
-        def step(p, x, y):
+        def fwd(p, x, y):
             def loss_fn(p):
                 out, _ = functional_call(net, p, (paddle.Tensor(x),),
                                          buffers=buffers)
                 return ((out.value - y) ** 2).mean()
-            loss, g = jax.value_and_grad(loss_fn)(p)
-            return {k: v - 0.05 * g[k] for k, v in p.items()}, loss
+            return jax.value_and_grad(loss_fn)(p)
 
-        jstep = jax.jit(step, donate_argnums=(0,))
+        jfwd = jax.jit(fwd)
         losses = []
         for _ in range(5):
-            params, loss = jstep(params, Xg, Yg)
-            losses.append(float(np.asarray(
-                loss.addressable_shards[0].data)))
+            loss, g = jfwd(params, Xg, Yg)
+            if multi:
+                # DCN hop: average grads (and the reported loss) across
+                # hosts on the control plane; equal dp shards make the
+                # mean of local means the full-batch value
+                g = {k: jax.device_put(
+                        np.asarray(group.all_reduce_mean(np.asarray(v))),
+                        shardings[k]) for k, v in g.items()}
+                loss = group.all_reduce_mean(np.asarray(loss))
+            params = {k: params[k] - 0.05 * g[k] for k in params}
+            losses.append(float(np.asarray(loss)))
     print("DCN_LOSSES_RANK%d " % rank + json.dumps(losses), flush=True)
 """)
 

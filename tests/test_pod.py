@@ -1,0 +1,655 @@
+"""Multi-process pod suite: N REAL OS processes on the CPU backend.
+
+Two harness modes (distributed.podtest):
+
+  * coordinated — real `jax.distributed.initialize` (die-together):
+    bring-up hardening, eager collectives over the coordination KV, the
+    multi-host checkpoint gates (writer-only quarantine, single-process-
+    gated dedup), 3D-layout Model.fit per rank.
+  * elastic — the shrink-and-continue supervisor (elastic.launch_elastic):
+    rank-loss chaos drills where the pod must SURVIVE a SIGKILL, roll
+    back in memory, and keep training.
+
+Multi-process tests are `pod + slow` (run via tools/pod_smoke.sh —
+spawning jax interpreters is seconds each, too heavy for tier-1); the
+pure-logic failure-detector / coordinator / address-validation tests are
+`pod` only and ride in tier-1 as well.
+"""
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from paddle_tpu.distributed import podcoll
+from paddle_tpu.distributed.elastic import (ElasticResult, FAILURE_REASONS,
+                                            PodRuntime)
+from paddle_tpu.distributed.parallel import (CoordinatorAddressError,
+                                             _validate_coordinator_address)
+from paddle_tpu.distributed.podcoord import (DEAD_EXIT, DEAD_HEARTBEAT,
+                                             DEAD_PARTITION,
+                                             FailureDetector, PodClient,
+                                             PodCoordinator, PodPeerLost)
+from paddle_tpu.distributed.podtest import run_elastic_pod, run_pod
+
+from conftest import cpu_subprocess_env
+
+pytestmark = pytest.mark.pod
+
+mp = pytest.mark.slow  # multi-process: excluded from tier-1, pod_smoke runs it
+
+
+# ---------------------------------------------------------------------------
+# pure-logic units (tier-1 speed)
+# ---------------------------------------------------------------------------
+
+class FakeClock:
+    def __init__(self, t=0.0):
+        self.t = float(t)
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestFailureDetector:
+    def test_timeout_boundary_is_strict(self):
+        clk = FakeClock()
+        det = FailureDetector(2, timeout_s=5.0, clock=clk)
+        det.beat(0)
+        det.beat(1)
+        clk.advance(5.0)  # exactly the budget: still live
+        assert det.check() == {}
+        assert det.live() == [0, 1]
+        det.beat(0)
+        clk.advance(0.1)  # rank 1 is now past it
+        assert det.check() == {1: DEAD_HEARTBEAT}
+        assert det.live() == [0]
+        # a second check reports nothing NEW
+        assert det.check() == {}
+
+    def test_bringup_grace_for_never_beaten_rank(self):
+        clk = FakeClock()
+        det = FailureDetector(2, timeout_s=2.0, clock=clk,
+                              bringup_timeout_s=60.0)
+        det.beat(0)
+        clk.advance(10.0)
+        # rank 1 never beat: it is still importing jax — only rank 0,
+        # which DID beat and then went silent, is declared dead
+        assert det.check() == {0: DEAD_HEARTBEAT}
+        clk.advance(55.0)  # 65s > bring-up budget
+        assert det.check() == {1: DEAD_HEARTBEAT}
+
+    def test_bringup_default_is_at_least_steady_timeout(self):
+        det = FailureDetector(1, timeout_s=300.0)
+        assert det.bringup_timeout_s >= det.timeout_s
+
+    def test_dead_rank_cannot_resurrect(self):
+        clk = FakeClock()
+        det = FailureDetector(2, timeout_s=1.0, clock=clk)
+        det.declare_dead(1, DEAD_EXIT)
+        det.beat(1, step=7)  # a zombie's late beat must be ignored
+        assert det.live() == [0]
+        assert det.dead() == {1: DEAD_EXIT}
+        assert det.last_step(1) == -1
+
+    def test_beat_records_step_progress(self):
+        det = FailureDetector(1, timeout_s=1.0, clock=FakeClock())
+        det.beat(0, step=3)
+        det.beat(0, step=5)
+        assert det.last_step(0) == 5
+
+
+class TestCoordinatorAddressValidation:
+    @pytest.mark.parametrize("bad", [
+        "", "nohost", "localhost:", ":8080", "host:port",
+        "host:0", "host:65536", "host:-1",
+    ])
+    def test_malformed_addresses_raise_named_error(self, bad):
+        with pytest.raises(CoordinatorAddressError):
+            _validate_coordinator_address(bad)
+
+    def test_named_error_is_a_config_error_not_transient(self):
+        # the retry loop retries ConnectionError/OSError/RuntimeError;
+        # a malformed address must NOT be in that class
+        assert issubclass(CoordinatorAddressError, ValueError)
+        assert not issubclass(CoordinatorAddressError,
+                              (ConnectionError, OSError))
+
+    @pytest.mark.parametrize("good", [
+        "127.0.0.1:8080", "localhost:1", "[::1]:6007", "host.name:65535",
+    ])
+    def test_valid_addresses_pass_through(self, good):
+        assert _validate_coordinator_address(good) == good
+
+
+class TestPodCoordinatorInProcess:
+    """Real coordinator + clients over localhost TCP, one process."""
+
+    def test_kv_barrier_and_epoch(self):
+        with PodCoordinator(2, heartbeat_timeout_s=30.0) as coord:
+            c0 = PodClient(coord.address, 0)
+            c1 = PodClient(coord.address, 1)
+            c0.kv_set("k", b"v")
+            assert c1.kv_get("k") == b"v"
+            c1.kv_delete("k")
+            assert c0.kv_get("k", timeout_s=0.1) is None
+            done = []
+            t = threading.Thread(
+                target=lambda: done.append(c1.barrier("b0")))
+            t.start()
+            r0 = c0.barrier("b0")
+            t.join(timeout=10)
+            assert done and done[0]["ok"] and r0["ok"]
+            # no membership change while waiting -> clean, epoch 0
+            assert r0["epoch"] == 0 and r0["shrunk"] is False
+
+    def test_gather_freezes_over_survivors_on_death(self):
+        with PodCoordinator(2, heartbeat_timeout_s=30.0) as coord:
+            c0 = PodClient(coord.address, 0)
+            out = {}
+
+            def _g():
+                out["r"] = c0.gather("ar", 1, b"part0")
+            t = threading.Thread(target=_g)
+            t.start()
+            time.sleep(0.2)  # rank 0 is parked waiting for rank 1
+            coord.mark_dead(1, DEAD_EXIT)
+            t.join(timeout=10)
+            ranks, _metas, payloads, epoch, shrunk = out["r"]
+            assert ranks == [0] and payloads == [b"part0"]
+            assert epoch == 1 and shrunk is True
+            assert coord.live() == [0]
+
+    def test_dead_rank_is_rejected_from_collectives(self):
+        with PodCoordinator(2, heartbeat_timeout_s=30.0) as coord:
+            coord.mark_dead(1, DEAD_PARTITION)
+            c1 = PodClient(coord.address, 1)
+            with pytest.raises(PodPeerLost):
+                c1.gather("ar", 1, b"zombie")
+
+    def test_post_shrink_steady_state_reads_clean(self):
+        """The bug class the epoch-delta design exists for: after ONE
+        shrink, later collectives must NOT keep reporting shrunk."""
+        with PodCoordinator(2, heartbeat_timeout_s=30.0) as coord:
+            coord.mark_dead(1, DEAD_EXIT)
+            c0 = PodClient(coord.address, 0)
+            ranks, _m, _p, epoch, shrunk = c0.gather("ar", 1, b"x")
+            assert ranks == [0] and epoch == 1
+            # caller arrived AFTER the death: epoch did not move while
+            # it waited, so steady state is clean
+            assert shrunk is False
+            r = c0.barrier("b1")
+            assert r["shrunk"] is False and r["epoch"] == 1
+
+
+class _FakeTransport:
+    """Scripted transport: drives PodGroup's epoch-delta latch."""
+    elastic = True
+
+    def __init__(self):
+        self.rank, self.world = 0, 2
+        self.epoch = 0
+        self.ranks = [0, 1]
+
+    def gather(self, name, seq, part, timeout_s=30.0):
+        return list(self.ranks), [part] * len(self.ranks), self.epoch
+
+    def barrier(self, name, timeout_s=30.0):
+        return self.epoch
+
+    def live(self):
+        return list(self.ranks)
+
+
+class TestPodGroupShrinkLatch:
+    def test_epoch_advance_latches_once(self):
+        tr = _FakeTransport()
+        g = podcoll.PodGroup(tr)
+        g.all_reduce(np.ones(2))
+        assert g.consume_shrunk() is False
+        # death between steps: the NEXT collective carries the new epoch
+        tr.epoch, tr.ranks = 1, [0]
+        g.all_reduce(np.ones(2))
+        assert g.last_ranks == [0]
+        assert g.consume_shrunk() is True
+        # steady state afterwards is clean — no infinite replay
+        g.all_reduce(np.ones(2))
+        g.barrier()
+        assert g.consume_shrunk() is False
+
+    def test_all_reduce_mean_divides_by_live_contributors(self):
+        tr = _FakeTransport()
+        g = podcoll.PodGroup(tr)
+        assert float(g.all_reduce_mean(np.array([4.0]))[0]) == 4.0
+        tr.epoch, tr.ranks = 1, [0]
+        # one survivor: mean == its own contribution (shrunk-from-start)
+        assert float(g.all_reduce_mean(np.array([6.0]))[0]) == 6.0
+
+
+class TestElasticResultAccounting:
+    def test_survivors_ok_ignores_declared_dead_ranks(self):
+        res = ElasticResult([0, -9], {1: (DEAD_EXIT, 123.0)},
+                            [{"kind": "resumed", "t": 124.0,
+                              "data": {"recovery_s": 0.25}, "rank": 0}],
+                            [1.0], None)
+        assert res.survivors_ok
+        assert res.recovery_s() == 0.25
+        assert len(res.resumed()) == 1
+
+    def test_failure_reasons_include_elastic_class(self):
+        assert "rank_lost_shrunk" in FAILURE_REASONS
+        assert "crash" in FAILURE_REASONS
+
+    def test_pod_runtime_requires_a_group(self):
+        with pytest.raises(RuntimeError, match="pod group"):
+            PodRuntime(group=None)
+
+
+# ---------------------------------------------------------------------------
+# coordinated mode: real jax.distributed.initialize, N processes
+# ---------------------------------------------------------------------------
+
+COORD_COLLECTIVES = """
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+
+env = dist.init_parallel_env()
+import jax
+assert jax.process_count() == WORLD, jax.process_count()
+t = paddle.to_tensor(np.full((3,), float(RANK + 1), dtype="float32"))
+dist.all_reduce(t)
+red = t.numpy().tolist()
+gathered = []
+dist.all_gather(gathered, paddle.to_tensor(
+    np.array([float(RANK)], dtype="float32")))
+ag = [g.numpy().tolist() for g in gathered]
+b = paddle.to_tensor(np.array([7.0 if RANK == 0 else -1.0],
+                              dtype="float32"))
+dist.broadcast(b, src=0)
+dist.barrier()
+emit(rank=RANK, red=red, ag=ag, bcast=b.numpy().tolist())
+"""
+
+
+@mp
+class TestCoordinatedPod:
+    def test_bringup_and_eager_collectives(self):
+        res = run_pod(COORD_COLLECTIVES, world=2).assert_ok()
+        for r in range(2):
+            assert res.record(r, "red") == [3.0, 3.0, 3.0]  # 1+2
+            assert res.record(r, "ag") == [[0.0], [1.0]]
+            assert res.record(r, "bcast") == [7.0]
+
+    def test_init_flaky_dials_are_retried_and_counted(self):
+        src = """
+import paddle_tpu.distributed as dist
+from paddle_tpu.utils.metrics import default_registry
+
+env = dist.init_parallel_env()
+import jax
+n = default_registry().get("paddle_launch_init_retries_total").get()
+emit(rank=RANK, procs=jax.process_count(), retries=n)
+"""
+        res = run_pod(src, world=2,
+                      env={"PADDLE_CHAOS_INIT_FLAKY": "2"}).assert_ok()
+        for r in range(2):
+            # both injected ConnectionErrors were retried, then the real
+            # dial went through — bring-up survived the flake
+            assert res.record(r, "procs") == 2
+            assert res.record(r, "retries") == 2
+
+    def test_fit_3d_layout_inside_pod_rank(self):
+        """Each pod rank trains over its LOCAL dp*fsdp*tp mesh (8 virtual
+        CPU devices) while jax.process_count()==2 — the v4 topology shape
+        where the model-parallel axes stay inside a host."""
+        src = """
+import jax
+import numpy as np
+import paddle_tpu as paddle
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed.layout import SpecLayout
+from paddle_tpu.distributed.mesh import build_mesh
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.hapi.callbacks import Callback
+
+env = dist.init_parallel_env()
+assert jax.process_count() == WORLD
+mesh = build_mesh({"dp": 2, "fsdp": 2, "tp": 2},
+                  devices=jax.local_devices())
+paddle.seed(0)
+net = paddle.nn.Linear(8, 8)
+model = paddle.Model(net)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters()),
+              paddle.nn.MSELoss())
+rs = np.random.RandomState(0)
+x = rs.randn(32, 8).astype("float32")
+y = rs.randn(32, 8).astype("float32")
+losses = []
+class Rec(Callback):
+    def on_train_batch_end(self, step, logs=None):
+        losses.append(float(logs["loss"]))
+model.fit(TensorDataset([x, y]), batch_size=8, epochs=1, shuffle=False,
+          verbose=0, mesh=mesh, layout=SpecLayout(), callbacks=[Rec()])
+emit(rank=RANK, losses=losses)
+"""
+        res = run_pod(src, world=2, local_devices=8,
+                      timeout=240).assert_ok()
+        l0, l1 = res.record(0, "losses"), res.record(1, "losses")
+        assert len(l0) == 4 and np.all(np.isfinite(l0))
+        # same data, same seed, deterministic: ranks agree exactly
+        assert l0 == l1
+
+    def test_checkpoint_writer_process_gate(self):
+        """save_sharded + CheckpointManager on a REAL 2-process pod:
+        process 0 is the only writer, every process restores."""
+        src = """
+import os
+import numpy as np
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import podcoll
+from paddle_tpu.distributed.checkpoint import (CheckpointManager,
+                                               restore_sharded,
+                                               save_sharded)
+
+env = dist.init_parallel_env()
+import jax
+g = podcoll.default_group()
+state = {"w": np.arange(6, dtype=np.float32) + 100 * 0}  # same on all
+path = os.path.join(os.getcwd(), "shared-ckpt")
+ret = save_sharded(state, path)
+g.barrier()  # rank 0's write is durable before anyone reads
+back = restore_sharded(path, template=state)
+wrote_manifest = os.path.exists(os.path.join(path, "MANIFEST.json"))
+
+mdir = os.path.join(os.getcwd(), "shared-mgr")
+mgr = CheckpointManager(mdir)
+assert mgr._single_process is False
+assert mgr._is_writer_process == (RANK == 0)
+ok = mgr.save(1, state, force=True)
+g.barrier()
+step, mback = mgr.restore_latest(template=state)
+emit(rank=RANK, ok=bool(ok), step=step,
+     round_trip=bool(np.array_equal(back["w"], state["w"])),
+     mgr_round_trip=bool(np.array_equal(mback["w"], state["w"])),
+     manifest=wrote_manifest)
+"""
+        res = run_pod(src, world=2, timeout=240).assert_ok()
+        for r in range(2):
+            # non-writer's save() returns True WITHOUT writing; both
+            # ranks restore the same bytes through the shared path
+            assert res.record(r, "ok") is True
+            assert res.record(r, "step") == 1
+            assert res.record(r, "round_trip") is True
+            assert res.record(r, "mgr_round_trip") is True
+
+    def test_checkpoint_dedup_is_single_process_gated(self):
+        """On a pod the already-committed dedup check is SKIPPED (shared-
+        storage visibility can skew across hosts): a second save of the
+        same step rewrites instead of returning False."""
+        src = """
+import os
+import numpy as np
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import podcoll
+from paddle_tpu.distributed.checkpoint import COMMIT_NAME, CheckpointManager
+
+env = dist.init_parallel_env()
+g = podcoll.default_group()
+mgr = CheckpointManager(os.path.join(os.getcwd(), "dedup-ckpt"))
+state = {"w": np.ones(4, dtype=np.float32)}
+first = mgr.save(2, state)
+g.barrier()
+commit = os.path.join(mgr._gen_dir(2), COMMIT_NAME)
+m0 = os.path.getmtime(commit) if RANK == 0 else None
+g.barrier()
+second = mgr.save(2, state)  # force=False: single-process would dedup
+g.barrier()
+m1 = os.path.getmtime(commit) if RANK == 0 else None
+emit(rank=RANK, first=bool(first), second=bool(second),
+     rewrote=(None if RANK != 0 else bool(m1 > m0)))
+"""
+        res = run_pod(src, world=2, timeout=240).assert_ok()
+        for r in range(2):
+            assert res.record(r, "first") is True
+            assert res.record(r, "second") is True
+        assert res.record(0, "rewrote") is True
+
+    def test_quarantine_is_writer_process_only(self):
+        """A non-writer that trips over a corrupt generation cascades
+        past it IN MEMORY; only process 0 renames it aside."""
+        src = """
+import glob, os
+import numpy as np
+import paddle_tpu.distributed as dist
+from paddle_tpu.distributed import podcoll
+from paddle_tpu.distributed.checkpoint import CheckpointManager
+
+env = dist.init_parallel_env()
+g = podcoll.default_group()
+d = os.path.join(os.getcwd(), "quar-ckpt")
+mgr = CheckpointManager(d)
+if RANK == 0:
+    mgr.save(1, {"w": np.ones(4, dtype=np.float32)}, force=True)
+    mgr.save(2, {"w": np.ones(4, dtype=np.float32) * 2}, force=True)
+    # truncate a payload of the NEWEST generation: verify must reject it
+    leaves = sorted(glob.glob(os.path.join(mgr._gen_dir(2),
+                                           "leaves", "*")))
+    with open(leaves[0], "r+b") as f:
+        f.truncate(1)
+g.barrier()
+if RANK == 1:
+    step, _ = mgr.restore_latest(template={"w": np.ones(4, "float32")})
+    gen2_alive = os.path.isdir(mgr._gen_dir(2))
+    quarantined = [n for n, _ in mgr.quarantined()]
+    emit(rank=RANK, step=step, gen2_alive=gen2_alive,
+         quarantined=quarantined)
+g.barrier()  # rank 1's in-memory cascade happens BEFORE rank 0 renames
+if RANK == 0:
+    step, _ = mgr.restore_latest(template={"w": np.ones(4, "float32")})
+    emit(rank=RANK, step=step, gen2_alive=os.path.isdir(mgr._gen_dir(2)),
+         quarantined=[n for n, _ in mgr.quarantined()])
+g.barrier()
+"""
+        res = run_pod(src, world=2, timeout=240).assert_ok()
+        # non-writer: fell back to gen 1 but did NOT touch the bad dir
+        assert res.record(1, "step") == 1
+        assert res.record(1, "gen2_alive") is True
+        assert res.record(1, "quarantined") == []
+        # writer: same fallback, but gen 2 is renamed into quarantine/
+        assert res.record(0, "step") == 1
+        assert res.record(0, "gen2_alive") is False
+        assert any(n.startswith("2.") for n in res.record(0, "quarantined"))
+
+
+# ---------------------------------------------------------------------------
+# elastic mode: shrink-and-continue chaos drills
+# ---------------------------------------------------------------------------
+
+ELASTIC_FIT = """
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.distributed.elastic import PodRuntime
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.hapi.callbacks import Callback
+
+paddle.seed(0)
+net = paddle.nn.Linear(4, 2)
+model = paddle.Model(net)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters()),
+              paddle.nn.MSELoss())
+rs = np.random.RandomState(0)
+x = rs.randn(48, 4).astype("float32")
+y = rs.randn(48, 2).astype("float32")
+losses = []
+class Rec(Callback):
+    def on_train_batch_end(self, step, logs=None):
+        losses.append(float(logs["loss"]))
+pod = PodRuntime.from_env()
+model.fit(TensorDataset([x, y]), batch_size=8, epochs=1, shuffle=False,
+          verbose=0, pod=pod, callbacks=[Rec()], log_freq=1)
+params = [float(np.asarray(p.numpy(), dtype=np.float64).sum())
+          for p in net.parameters()]
+emit(rank=RANK, losses=losses, shrinks=pod.shrink_events, params=params)
+pod.close()
+"""
+
+BASELINE_FIT = """
+import json, sys
+import numpy as np
+import paddle_tpu as paddle
+from paddle_tpu.io import TensorDataset
+from paddle_tpu.hapi.callbacks import Callback
+
+paddle.seed(0)
+net = paddle.nn.Linear(4, 2)
+model = paddle.Model(net)
+model.prepare(paddle.optimizer.SGD(learning_rate=0.1,
+                                   parameters=net.parameters()),
+              paddle.nn.MSELoss())
+rs = np.random.RandomState(0)
+x = rs.randn(48, 4).astype("float32")
+y = rs.randn(48, 2).astype("float32")
+losses = []
+class Rec(Callback):
+    def on_train_batch_end(self, step, logs=None):
+        losses.append(float(logs["loss"]))
+model.fit(TensorDataset([x, y]), batch_size=8, epochs=1, shuffle=False,
+          verbose=0, callbacks=[Rec()])
+params = [float(np.asarray(p.numpy(), dtype=np.float64).sum())
+          for p in net.parameters()]
+print("BASE " + json.dumps({"losses": losses, "params": params}))
+"""
+
+
+@pytest.fixture(scope="module")
+def single_process_baseline():
+    """The full-batch single-process run every parity drill compares
+    against (one subprocess for the whole module)."""
+    out = subprocess.run(
+        [sys.executable, "-c", BASELINE_FIT], env=cpu_subprocess_env(),
+        capture_output=True, text=True, timeout=180)
+    assert out.returncode == 0, out.stdout + out.stderr
+    line = [ln for ln in out.stdout.splitlines() if ln.startswith("BASE ")]
+    return json.loads(line[0][5:])
+
+
+@mp
+class TestElasticPod:
+    def test_two_rank_fit_parity_without_chaos(self, single_process_baseline):
+        res, pr = run_elastic_pod(ELASTIC_FIT, world=2, timeout=240)
+        pr.assert_ok()
+        assert res.deaths == {} and res.downs == []
+        l0 = np.asarray(pr.record(0, "losses"))
+        l1 = np.asarray(pr.record(1, "losses"))
+        assert pr.record(0, "shrinks") == []
+        assert pr.record(1, "shrinks") == []
+        # each rank reports its half-batch loss; with equal halves the
+        # full-batch MSE is their mean, and the averaged gradients give
+        # the full-batch parameter trajectory on every rank
+        base = single_process_baseline
+        np.testing.assert_allclose((l0 + l1) / 2, base["losses"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(pr.record(0, "params"), base["params"],
+                                   rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(pr.record(1, "params"),
+                                   pr.record(0, "params"), rtol=0, atol=0)
+
+    def test_rank_kill_mid_fit_shrinks_and_continues(self):
+        """The tentpole drill: SIGKILL rank 1 at step 2, survivor rolls
+        back in memory, re-strides, and finishes every step."""
+        res, pr = run_elastic_pod(
+            ELASTIC_FIT, world=2,
+            env={"PADDLE_CHAOS_RANK_KILL": "1@2"}, timeout=240)
+        assert res.returncodes[0] == 0
+        assert res.returncodes[1] == -9  # really SIGKILLed
+        assert res.survivors_ok
+        assert res.deaths[1][0] == DEAD_EXIT
+        shrinks = pr.record(0, "shrinks")
+        assert len(shrinks) == 1 and shrinks[0]["live"] == [0]
+        losses = pr.record(0, "losses")
+        assert len(losses) == 6 and np.all(np.isfinite(losses))
+        # the death->resumed gap was measured and is in-memory fast
+        assert res.downs and res.recovery_s() is not None
+        assert res.recovery_s() < 30.0
+
+    def test_shrink_replay_matches_shrunk_from_start_bitwise(
+            self, single_process_baseline):
+        """Kill rank 1 before the first update: the survivor's replayed
+        run IS a single-process full-batch run — bitwise, not approx
+        (the ISSUE's ULP acceptance gate)."""
+        res, pr = run_elastic_pod(
+            ELASTIC_FIT, world=2,
+            env={"PADDLE_CHAOS_RANK_KILL": "1@1"}, timeout=240)
+        assert res.survivors_ok and res.returncodes[1] == -9
+        losses = pr.record(0, "losses")
+        base = single_process_baseline["losses"]
+        assert losses == base, (
+            "shrink-replay diverged from shrunk-from-start:\n"
+            f"  elastic : {losses}\n  baseline: {base}")
+        assert pr.record(0, "params") == single_process_baseline["params"]
+
+    def test_slow_rank_is_not_a_false_positive(self):
+        """A rank stalled longer than the heartbeat timeout must NOT be
+        declared dead: the background heartbeat thread keeps beating
+        through the stall."""
+        res, pr = run_elastic_pod(
+            ELASTIC_FIT, world=2,
+            env={"PADDLE_CHAOS_RANK_SLOW": "1@3:2.5"},
+            heartbeat_timeout_s=1.0, timeout=240)
+        pr.assert_ok()
+        assert res.deaths == {}
+        assert pr.record(0, "shrinks") == []
+        assert pr.record(1, "shrinks") == []
+        assert len(pr.record(0, "losses")) == 6
+
+    def test_partitioned_rank_is_fenced_and_pod_shrinks(self):
+        """RANK_PARTITION stops rank 1's heartbeats while it keeps
+        running (then stalls silently): the supervisor classifies it
+        PARTITIONED, fences it with SIGKILL, and rank 0 continues."""
+        res, pr = run_elastic_pod(
+            ELASTIC_FIT, world=2,
+            env={"PADDLE_CHAOS_RANK_PARTITION": "1@2",
+                 "PADDLE_CHAOS_RANK_SLOW": "1@3:20"},
+            heartbeat_timeout_s=1.5, timeout=240)
+        assert res.deaths.get(1, ("",))[0] == DEAD_PARTITION
+        assert res.returncodes[1] == -9  # fenced, not exited
+        assert res.returncodes[0] == 0 and res.survivors_ok
+        shrinks = pr.record(0, "shrinks")
+        assert len(shrinks) == 1 and shrinks[0]["live"] == [0]
+        assert len(pr.record(0, "losses")) == 6
+
+    def test_sigkilled_rank_leaves_jsonl_for_goodput(self, tmp_path):
+        """The flightrec contract for SIGKILL: no dump (atexit never
+        runs), but the per-step events.jsonl stream survives, and the
+        goodput ledger ingests it alongside the supervisor's measured
+        down-time."""
+        tdir = str(tmp_path / "telemetry")
+        res, pr = run_elastic_pod(
+            ELASTIC_FIT, world=2,
+            env={"PADDLE_CHAOS_RANK_KILL": "1@3"},
+            telemetry_dir=tdir, timeout=240)
+        assert res.survivors_ok and res.returncodes[1] == -9
+        rank1 = os.path.join(tdir, "rank1")
+        assert os.path.exists(os.path.join(rank1, "events.jsonl"))
+        assert not [f for f in os.listdir(rank1)
+                    if f.startswith("flightrec-")]
+        # the killed rank got far enough (log_freq=1) to leave window
+        # wall-time the JSONL fallback can account as goodput
+        with open(os.path.join(rank1, "events.jsonl")) as f:
+            kinds = [json.loads(ln).get("event") for ln in f if ln.strip()]
+        assert "window" in kinds
+        assert res.report is not None
+        assert res.report["seconds"]["down"] > 0
+        assert res.report["sources"] >= 2
+        assert res.report["seconds"]["productive_train"] > 0
+        assert 0 < res.report["goodput_ratio"] < 1

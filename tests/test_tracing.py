@@ -7,6 +7,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 import urllib.request
 
 import numpy as np
@@ -153,8 +154,18 @@ def gen_server():
 
 
 class TestServingTrace:
-    def _tree(self, tracer, trace_id):
-        return {s["name"]: s for s in tracer.spans(trace_id=trace_id)}
+    def _tree(self, tracer, trace_id, want=()):
+        """Spans by name; polls briefly until `want` names appear — the
+        server exports its span AFTER the response body is flushed, so
+        the client can observe completion before the tree is whole
+        (visible on the streaming path, where the final SSE chunk
+        precedes the handler return)."""
+        deadline = time.monotonic() + 2.0
+        while True:
+            by = {s["name"]: s for s in tracer.spans(trace_id=trace_id)}
+            if set(want) <= set(by) or time.monotonic() > deadline:
+                return by
+            time.sleep(0.02)
 
     def test_blocking_generate_tree(self, tracer_on, gen_server):
         from paddle_tpu.serving.client import ServingClient
@@ -163,7 +174,9 @@ class TestServingTrace:
         out = client.generate([1, 2, 3, 4], max_new_tokens=5)
         assert len(out["tokens"]) >= 1
         trace_id = client.last_traceparent.split("-")[1]
-        by = self._tree(tracer_on, trace_id)
+        by = self._tree(tracer_on, trace_id,
+                        want=("client.generate", "server.generate",
+                              "gen.queued", "gen.prefill", "gen.decode"))
         assert {"client.generate", "server.generate", "gen.queued",
                 "gen.prefill", "gen.decode"} <= set(by)
         # parentage: engine children hang off the server span, which
@@ -187,7 +200,9 @@ class TestServingTrace:
         events = list(client.generate_stream([5, 6, 7], max_new_tokens=4))
         assert events[-1].get("done")
         trace_id = client.last_traceparent.split("-")[1]
-        by = self._tree(tracer_on, trace_id)
+        by = self._tree(tracer_on, trace_id,
+                        want=("client.generate_stream", "server.generate",
+                              "gen.queued", "gen.prefill", "gen.decode"))
         assert {"client.generate_stream", "server.generate", "gen.queued",
                 "gen.prefill", "gen.decode"} <= set(by)
         ntok = sum(1 for e in events if "token" in e)
@@ -203,7 +218,9 @@ class TestServingTrace:
         client = ServingClient(gen_server.url)
         client.generate([9, 8, 7], max_new_tokens=2, traceparent=hdr)
         assert client.last_traceparent == hdr  # forwarded as-is
-        by = self._tree(tracer_on, tid)
+        by = self._tree(tracer_on, tid,
+                        want=("server.generate", "gen.queued",
+                              "gen.prefill", "gen.decode"))
         # no client-side root: the caller owns that span; the server
         # adopted the incoming identity for its whole subtree
         assert "client.generate" not in by
