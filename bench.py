@@ -531,6 +531,28 @@ GATE_METRICS = {
         "direction": "higher", "cpu_rel_tol": 0.60, "tpu_rel_tol": 0.20,
         "help": "generated tokens per second sustained by the "
                 "continuous-batching engine over the bench window"},
+    # speculative decode / chunked prefill / fleet router (genserve
+    # only; null elsewhere) — all wall-clock numbers from the small
+    # overhead-bound sub-bench fixture, so the CPU bands stay wide
+    "spec_decode_tokens_per_sec": {
+        "direction": "higher", "cpu_rel_tol": 0.60, "tpu_rel_tol": 0.30,
+        "help": "decode tokens/s of the speculative engine (K-token "
+                "draft chain + one verify dispatch) on the spec "
+                "sub-bench fixture"},
+    "spec_accept_ratio": {
+        "direction": "higher", "cpu_rel_tol": 0.25, "tpu_rel_tol": 0.25,
+        "help": "accepted/proposed draft tokens on the spec sub-bench "
+                "(near 1.0 by fixture construction — the draft IS the "
+                "target's first block)"},
+    "longwave_intertoken_p99_ms": {
+        "direction": "lower", "cpu_rel_tol": 2.00, "tpu_rel_tol": 1.00,
+        "help": "short-stream inter-token p99 while long prompts "
+                "stream in fixed-size chunks (the latency chunked "
+                "prefill exists to hold down)"},
+    "router_tokens_per_sec": {
+        "direction": "higher", "cpu_rel_tol": 0.60, "tpu_rel_tol": 0.30,
+        "help": "fleet tokens/s: 2 speculative replicas behind the "
+                "prefix-aware router at equal total cache HBM"},
 }
 
 
@@ -2268,6 +2290,176 @@ def body_genserve(on_tpu):
         tp2_parity = outs["tp2"] == outs["solo"]
         _phase("tp2_done")
 
+    # ------------------------------------------------------------------
+    # specdec sub-bench (ISSUE 17): speculative decode, chunked-prefill
+    # latency, and the 2-replica fleet router — on a small fixture in
+    # the overhead-bound regime where the speculation mechanics (K+1
+    # tokens per target dispatch) dominate.  The 124M model above at
+    # smoke scale is FLOPs-bound, where speculation can only lose: the
+    # draft strictly ADDS flops, so the win must come from amortizing
+    # per-iteration dispatch.  Acceptance is ~1.0 by construction: an
+    # 8-layer target whose blocks 1..7 are exact residual passthrough
+    # (attn.out / mlp.fc2 zeroed — x + 0.0 is bitwise x) and a 1-layer
+    # draft sharing every shape-matched weight, so the measured speedup
+    # isolates the engine machinery rather than draft quality.
+    import threading
+    import urllib.request
+
+    from paddle_tpu.serving.router import FleetRouter
+    from paddle_tpu.serving.server import ServingServer
+
+    def small_gpt(layers):
+        paddle.seed(0)
+        m = GPTForCausalLM(GPTConfig(
+            vocab_size=1024, hidden_size=64, num_layers=layers,
+            num_heads=4, max_position_embeddings=128, dropout=0.0,
+            attn_dropout=0.0))
+        m.eval()
+        return m
+
+    starget = small_gpt(8)
+    for blk in starget.gpt.h[1:]:
+        for p in (blk.attn.out.weight, blk.attn.out.bias,
+                  blk.mlp.fc2.weight, blk.mlp.fc2.bias):
+            p.set_value(np.zeros(p.shape, np.float32))
+    sdraft = small_gpt(1)
+    tsd, dsd = starget.state_dict(), sdraft.state_dict()
+    sdraft.set_state_dict({k: (tsd[k] if k in tsd and tuple(
+        tsd[k].shape) == tuple(v.shape) else v)
+        for k, v in dsd.items()})
+
+    SPEC_K, SPEC_REQ, SPEC_NEW, SPEC_PAGES = 15, 12, 48, 72
+    sprompts = [rs.randint(1, 1024, 16).astype(np.int32)
+                for _ in range(24)]
+
+    def spec_engine(**kw):
+        # prefix_cache off: the wave is distinct prompts (zero hits),
+        # so the cache would only add register/evict churn noise
+        return GenerationEngine(starget, max_slots=4, max_seq_len=80,
+                                prompt_buckets=(16, 32), page_size=8,
+                                prefix_cache=False, **kw)
+
+    def spec_wave(e):
+        e.generate(sprompts[0], 4, timeout=600)       # warm the path
+        t0 = time.perf_counter()
+        hs = [e.submit(p, SPEC_NEW, seed=i)
+              for i, p in enumerate(sprompts[:SPEC_REQ])]
+        tot = sum(len(h.result(600)) for h in hs)
+        return tot / (time.perf_counter() - t0)
+
+    e_base = spec_engine(num_pages=SPEC_PAGES).start()
+    nonspec_tps = spec_wave(e_base)
+    e_base.stop()
+    e_spec = spec_engine(num_pages=SPEC_PAGES, draft_model=sdraft,
+                         spec_tokens=SPEC_K).start()
+    spec_tps = spec_wave(e_spec)
+    spec_accept = e_spec.metrics.snapshot()["spec_accept_ratio"]
+    e_spec.stop()
+    _phase("spec_wave_done")
+
+    # chunked-prefill latency wave: two 56-token prompts stream in
+    # while four short streams decode — the short streams' inter-token
+    # p99 is the number chunking exists to hold down (unchunked, each
+    # long admission stalls EVERY stream for its full prefill)
+    def longwave(chunk):
+        e = GenerationEngine(starget, max_slots=6, max_seq_len=128,
+                             prompt_buckets=(16, 64), page_size=8,
+                             prefix_cache=False, prefill_chunk=chunk)
+        e.start()
+        e.generate(sprompts[0], 2, timeout=600)       # warm both
+        e.generate(rs.randint(1, 1024, 56).astype(np.int32), 2,
+                   timeout=600)                       # buckets
+        gaps, glock = [], threading.Lock()
+
+        def watch(h):
+            t = None
+            for _ in h:
+                now = time.monotonic()
+                if t is not None:
+                    with glock:
+                        gaps.append((now - t) * 1e3)
+                t = now
+
+        shorts = [e.submit(sprompts[i], 40, seed=i) for i in range(4)]
+        watchers = [threading.Thread(target=watch, args=(h,))
+                    for h in shorts]
+        for w in watchers:
+            w.start()
+        time.sleep(0.05)                  # shorts reach steady decode
+        longs = [e.submit(rs.randint(1, 1024, 56).astype(np.int32), 8)
+                 for _ in range(2)]
+        for w in watchers:
+            w.join()
+        for h in longs:
+            h.result(600)
+        e.stop()
+        gaps.sort()
+        return gaps[int(0.99 * (len(gaps) - 1))]
+
+    chunked_p99 = longwave(8)
+    unchunked_p99 = longwave(0)
+    _phase("longwave_done")
+
+    # fleet wave: 2 speculative replicas behind the prefix-aware router
+    # vs ONE non-speculative engine on the SAME total cache HBM.  A
+    # spec replica's page holds draft KV too (1 draft layer on 8 target
+    # layers: 9/8 page bytes), so equal HBM gives each replica
+    # floor(P * 8 / (2 * 9)) pages.  Both sides serve real HTTP
+    # (non-streaming) under 8 client threads.
+    def http_wave(url, n_req=24):
+        lock, tot, idx = threading.Lock(), [0], [0]
+
+        def worker():
+            while True:
+                with lock:
+                    if idx[0] >= n_req:
+                        return
+                    i = idx[0]
+                    idx[0] += 1
+                body = json.dumps(
+                    {"prompt": sprompts[i].tolist(),
+                     "max_new_tokens": SPEC_NEW,
+                     "stream": False}).encode()
+                req = urllib.request.Request(
+                    url + "/generate", data=body,
+                    headers={"Content-Type": "application/json"})
+                with urllib.request.urlopen(req, timeout=600) as r:
+                    n = len(json.loads(r.read())["tokens"])
+                with lock:
+                    tot[0] += n
+
+        t0 = time.perf_counter()
+        threads = [threading.Thread(target=worker) for _ in range(8)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        return tot[0] / (time.perf_counter() - t0)
+
+    single = ServingServer(None, port=0, gen_engine=spec_engine(
+        num_pages=SPEC_PAGES), install_signal_handlers=False)
+    single.start()
+    http_base_tps = http_wave(f"http://127.0.0.1:{single.port}")
+    single.shutdown()
+    repl_pages = (SPEC_PAGES * 8) // (2 * 9)
+    replicas = []
+    for _ in range(2):
+        srv = ServingServer(None, port=0, gen_engine=spec_engine(
+            num_pages=repl_pages, draft_model=sdraft,
+            spec_tokens=SPEC_K), install_signal_handlers=False)
+        srv.start()
+        replicas.append(srv)
+    router = FleetRouter([f"http://127.0.0.1:{s.port}" for s in replicas],
+                         port=0, page_size=8, probe_interval_s=0.5,
+                         install_signal_handlers=False)
+    router.start()
+    router_tps = http_wave(f"http://127.0.0.1:{router.port}")
+    routed = router.metrics.snapshot()["routed"]
+    router.shutdown()
+    for srv in replicas:
+        srv.shutdown()
+    _phase("router_wave_done")
+
     tps = total_tokens / gen_s
     mfu = 2.0 * n_params * tps / peak_flops_per_chip()
     step_dt = (snap["inter_token_p50_ms"] or 0.0) / 1e3
@@ -2314,6 +2506,27 @@ def body_genserve(on_tpu):
         "long_prompt_ttft_p99_ms": long_ttft,
         "tp2_token_parity": tp2_parity,
         "tp2_compile_flat": tp2_compile_flat,
+        # speculative decode (small-fixture sub-bench)
+        "spec_decode_tokens_per_sec": round(spec_tps, 1),
+        "spec_nonspec_tokens_per_sec": round(nonspec_tps, 1),
+        "spec_speedup": round(spec_tps / nonspec_tps, 2),
+        "spec_accept_ratio": spec_accept,
+        "spec_tokens_k": SPEC_K,
+        # chunked prefill (short-stream latency under long admissions)
+        "longwave_intertoken_p99_ms": round(chunked_p99, 2),
+        "longwave_unchunked_intertoken_p99_ms": round(unchunked_p99, 2),
+        "prefill_chunk": 8,
+        # fleet router at equal total cache HBM (2 spec replicas vs one
+        # non-spec engine); on a single-core host the replicas time-
+        # slice one CPU, so the fleet's parallel term is 1x and the
+        # ratio reflects speculation alone minus router/HTTP overhead
+        "router_tokens_per_sec": round(router_tps, 1),
+        "router_single_nonspec_tokens_per_sec": round(http_base_tps, 1),
+        "router_vs_single_nonspec": round(router_tps / http_base_tps, 2),
+        "router_routed": routed,
+        "router_replicas": 2,
+        "router_replica_pages": repl_pages,
+        "router_host_cores": os.cpu_count(),
     }
 
 

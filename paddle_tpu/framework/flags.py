@@ -93,6 +93,22 @@ define_flag("FLAGS_genserve_prefix_cache", 1,
             "1 shares identical tokenized prompt prefixes as refcounted "
             "read-only KV pages (hits skip prefill for shared pages); "
             "0 disables sharing")
+define_flag("FLAGS_genserve_spec_tokens", 4,
+            "speculative-decode draft proposals per iteration (k); only "
+            "read when a draft model is attached — each iteration drafts "
+            "k tokens and the target verifies all k+1 in one step")
+define_flag("FLAGS_genserve_prefill_chunk", 0,
+            "chunked-prefill slice length in tokens (page_size multiple, "
+            "<= largest prompt bucket); prompts whose un-shared suffix "
+            "exceeds it prefill one chunk per decode iteration instead of "
+            "stalling every lane; 0 disables chunking")
+# -- fleet router (paddle_tpu.serving.router) ------------------------------
+define_flag("FLAGS_router_probe_interval_s", 0.5,
+            "seconds between router health probes of each replica's "
+            "/healthz")
+define_flag("FLAGS_router_dead_after", 3,
+            "consecutive failed health probes before a replica is routed "
+            "around (429 backpressure never counts as a failure)")
 # -- runtime telemetry (paddle_tpu.monitor) --------------------------------
 define_flag("FLAGS_telemetry_dir", "",
             "directory for the per-step JSONL training event log "

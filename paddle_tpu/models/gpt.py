@@ -224,6 +224,76 @@ class GPTAttention(Layer):
         out = self.out(Tensor(ctx.reshape(B, 1, cfg.hidden_size)))
         return out, Tensor(k_pages), Tensor(v_pages)
 
+    def verify_pages(self, x, k_pages, v_pages, rows, positions, active,
+                     seq_cap):
+        """Speculative-decode verification attention: like
+        ``decode_pages`` but each lane carries a CHUNK of C candidate
+        tokens at consecutive positions instead of one — the target
+        model scores every draft proposal in a single batched step.
+
+        x: [slots, C, H]; k_pages/v_pages: [num_pages, page_size, nh,
+        hd] (this layer's pool plane); rows: [slots, pages_per_slot]
+        int32 page table; positions: [slots, C] absolute write index
+        per candidate (consecutive per lane, clamped by the engine so
+        they never run past the slot's reserved extent); active:
+        [slots]; seq_cap: STATIC attention extent.  Causality inside
+        the chunk falls out of the position mask: candidate i's query
+        admits exactly the keys at slots <= positions[b, i], which by
+        construction are the committed history plus candidates 0..i —
+        the same reduction extent the non-speculative decode step would
+        have seen one token at a time, which is what keeps accepted
+        tokens bitwise-equal to the sequential path.
+        """
+        import jax.numpy as jnp
+        from jax import lax
+
+        from ..tensor import unwrap
+
+        cfg = self.cfg
+        B, C = x.shape[0], x.shape[1]
+        nh, hd = cfg.num_heads, cfg.hidden_size // cfg.num_heads
+        qkv = T.reshape(self.qkv(x), [B, C, 3, nh, hd])
+        q = unwrap(qkv[:, :, 0])                     # [slots, C, nh, hd]
+        k = unwrap(qkv[:, :, 1])
+        v = unwrap(qkv[:, :, 2])
+        positions = jnp.asarray(unwrap(positions), jnp.int32)
+        active = jnp.asarray(unwrap(active), bool)
+        k_pages, v_pages = unwrap(k_pages), unwrap(v_pages)
+        rows = jnp.asarray(unwrap(rows), jnp.int32)
+        num_pages, ps = k_pages.shape[0], k_pages.shape[1]
+        lane = jnp.arange(B)
+        # per-element scatter: candidate (b, i) writes its K/V at
+        # (rows[b, positions[b,i]//ps], positions[b,i]%ps); inactive
+        # lanes target one-past-the-pool and are dropped.  Clamped
+        # duplicate positions (end-of-budget) may collide — whichever
+        # write wins is garbage no emitted query's mask ever exposes.
+        page = rows[lane[:, None],
+                    jnp.clip(positions // ps, 0, rows.shape[1] - 1)]
+        page = jnp.where(active[:, None], page, num_pages)
+        off = positions % ps
+        k_pages = k_pages.at[page, off].set(k.astype(k_pages.dtype),
+                                            mode="drop")
+        v_pages = v_pages.at[page, off].set(v.astype(v_pages.dtype),
+                                            mode="drop")
+        # dense per-lane gather (the decode_pages fallback math with a
+        # C-wide query dim); no Pallas path — verification is one step
+        # per K drafted tokens, off the per-token hot loop
+        gidx = jnp.clip(rows, 0, num_pages - 1)
+        kg = k_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
+        vg = v_pages[gidx].reshape(B, rows.shape[1] * ps, nh, hd)
+        kg, vg = kg[:, :seq_cap], vg[:, :seq_cap]
+        scores = jnp.einsum("bqnd,bsnd->bnqs", q, kg) \
+            * (1.0 / float(hd) ** 0.5)
+        valid = jnp.arange(seq_cap)[None, None, :] <= positions[:, :, None]
+        scores = jnp.where(valid[:, None], scores,
+                           jnp.finfo(scores.dtype).min)
+        probs = jnp.exp(scores - lax.stop_gradient(
+            scores.max(axis=-1, keepdims=True)))
+        probs = probs / probs.sum(axis=-1, keepdims=True)
+        ctx = jnp.einsum("bnqs,bsnd->bqnd", probs, vg)
+        out = self.out(Tensor(ctx.reshape(B, C, cfg.hidden_size)))
+        return out, Tensor(k_pages), Tensor(v_pages)
+
     def prefill_prefix(self, x, prefix_k, prefix_v, prefix_len):
         """Suffix-only prefill attending over a cached prefix: queries
         are the suffix tokens (absolute positions ``prefix_len + i``),
@@ -382,6 +452,15 @@ class GPTBlock(Layer):
                      seq_cap):
         a, k_pages, v_pages = self.attn.decode_pages(
             self.ln_1(x), k_pages, v_pages, rows, pos, active, seq_cap)
+        x = x + a
+        x = x + self.mlp(self.ln_2(x))
+        return x, k_pages, v_pages
+
+    def verify_pages(self, x, k_pages, v_pages, rows, positions, active,
+                     seq_cap):
+        a, k_pages, v_pages = self.attn.verify_pages(
+            self.ln_1(x), k_pages, v_pages, rows, positions, active,
+            seq_cap)
         x = x + a
         x = x + self.mlp(self.ln_2(x))
         return x, k_pages, v_pages
@@ -624,6 +703,45 @@ class GPTForCausalLM(Layer):
             vs.append(unwrap(vp))
         logits = self._head(gpt.ln_f(x))             # [slots, 1, V]
         return unwrap(logits)[:, 0], jnp.stack(ks), jnp.stack(vs)
+
+    def slot_verify_paged(self, tokens, positions, active, k_pages,
+                          v_pages, rows, seq_cap):
+        """Speculative-decode target verification over the PAGED cache:
+        score a chunk of C candidate tokens per lane in ONE model step.
+        tokens [slots, C] int32 (committed token ++ draft proposals),
+        positions [slots, C] int32 absolute write indices (consecutive
+        per lane), active [slots] bool, pools [layers, num_pages,
+        page_size, nh, hd], rows [slots, pages_per_slot] int32.
+        Returns (logits [slots, C, V], k_pages', v_pages') — the engine
+        compares argmax(logits[:, i]) against draft proposal i+1 to
+        accept or cut the speculation run.
+        """
+        import jax.numpy as jnp
+
+        from ..tensor import unwrap
+
+        if self.training:
+            raise RuntimeError(
+                "slot_prefill/slot_decode are eval-only serving paths; "
+                "call model.eval() first")
+        gpt = self.gpt
+        cfg = self.cfg
+        tokens = jnp.asarray(unwrap(tokens), jnp.int32)
+        positions = jnp.asarray(unwrap(positions), jnp.int32)
+        k_pages, v_pages = unwrap(k_pages), unwrap(v_pages)
+        # clamped tail positions may sit at the extent edge; clip into
+        # the embedding table (garbage rows the emission mask never
+        # turns into output tokens)
+        pos_emb = jnp.clip(positions, 0, cfg.max_position_embeddings - 1)
+        x = gpt.wte(Tensor(tokens)) + gpt.wpe(Tensor(pos_emb))
+        ks, vs = [], []
+        for i, blk in enumerate(gpt.h):
+            x, kp, vp = blk.verify_pages(x, k_pages[i], v_pages[i], rows,
+                                         positions, active, seq_cap)
+            ks.append(unwrap(kp))
+            vs.append(unwrap(vp))
+        logits = self._head(gpt.ln_f(x))             # [slots, C, V]
+        return unwrap(logits), jnp.stack(ks), jnp.stack(vs)
 
     def slot_prefill_prefix(self, input_ids, prefix_k, prefix_v,
                             prefix_len, length):

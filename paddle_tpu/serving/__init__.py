@@ -30,13 +30,13 @@ or one-shot from the high-level API: ``paddle.Model(net).serve(...)`` /
 """
 from .engine import (BucketSpec, DeadlineExceededError, EngineStoppedError,
                      QueueFullError, ServingEngine)
-from .metrics import GenerationMetrics, ServingMetrics
+from .metrics import GenerationMetrics, RouterMetrics, ServingMetrics
 
 __all__ = ["ServingEngine", "ServingServer", "ServingClient", "BucketSpec",
-           "ServingMetrics", "GenerationMetrics", "GenerationEngine",
-           "GenerationHandle", "CacheGeometry", "SlotScheduler",
-           "PrefixCache", "QueueFullError", "DeadlineExceededError",
-           "EngineStoppedError"]
+           "ServingMetrics", "GenerationMetrics", "RouterMetrics",
+           "GenerationEngine", "GenerationHandle", "CacheGeometry",
+           "SlotScheduler", "PrefixCache", "FleetRouter", "QueueFullError",
+           "DeadlineExceededError", "EngineStoppedError"]
 
 
 def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
@@ -58,4 +58,7 @@ def __getattr__(name):  # lazy: keeps `python -m paddle_tpu.serving.server`
     if name == "PrefixCache":
         from .prefix_cache import PrefixCache
         return PrefixCache
+    if name == "FleetRouter":
+        from .router import FleetRouter
+        return FleetRouter
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -6,7 +6,10 @@ usable from any host that can reach the server).  The __main__ entry is
 the load generator tools/serve_smoke.sh drives: N requests from K
 threads — pure /predict, pure streaming /generate, or a mixed blend —
 then a one-line JSON summary on stdout (with client-side TTFT and
-inter-token quantiles for generation traffic).
+inter-token quantiles for generation traffic).  `--mixed-wave L:S@LL,SL`
+interleaves long and short prompts at a fixed ratio and reports
+per-class percentiles — the one-flag probe for "does chunked prefill
+hold short streams' inter-token p99 while a long prompt streams in".
 
 Tracing: when the process tracer is enabled (FLAGS_trace_sample_rate >
 0) every predict/generate starts a client-side root span and sends its
@@ -237,8 +240,30 @@ def main(argv=None):
                              "the SAME fixed-seed token prefix of this "
                              "length (exercises the server's prefix "
                              "cache), followed by a random suffix")
+    parser.add_argument("--mixed-wave", default=None, metavar="L:S@LL,SL",
+                        help="generate traffic: mix of long and short "
+                             "prompts — 'L:S@LL,SL' sends L long (LL "
+                             "tokens) per S short (SL tokens) prompts, "
+                             "e.g. '1:4@48,8', and the summary reports "
+                             "per-class ttft/inter-token percentiles "
+                             "(the chunked-prefill p99 claim in one "
+                             "flag); overrides --prompt-len")
     parser.add_argument("--seed", type=int, default=0)
     args = parser.parse_args(argv)
+
+    wave = None
+    if args.mixed_wave:
+        try:
+            ratio, lens = args.mixed_wave.split("@")
+            n_long, n_short = (int(x) for x in ratio.split(":"))
+            len_long, len_short = (int(x) for x in lens.split(","))
+            if min(n_long, n_short, len_long, len_short) < 1 \
+                    or len_long <= len_short:
+                raise ValueError
+        except ValueError:
+            parser.error("--mixed-wave must be 'L:S@LONGLEN,SHORTLEN' "
+                         "with LONGLEN > SHORTLEN >= 1, e.g. '1:4@48,8'")
+        wave = (n_long, n_short, len_long, len_short)
 
     shared_prefix = []
     if args.shared_prefix_len > 0:
@@ -251,7 +276,10 @@ def main(argv=None):
     shape = tuple(int(d) for d in args.shape.split(",") if d.strip())
     client = ServingClient(args.url)
     results = {"ok": 0, "backpressure": 0, "errors": 0}
-    ttfts, gaps = [], []
+    ttfts, gaps = {"all": []}, {"all": []}
+    if wave:
+        for cls in ("long", "short"):
+            ttfts[cls], gaps[cls] = [], []
     gen_tokens = [0]
     lock = threading.Lock()
 
@@ -260,8 +288,16 @@ def main(argv=None):
              else rs.randn(*shape)).astype(args.dtype)
         client.predict([x])
 
-    def generate_once(rs):
-        n_rand = args.prompt_len - len(shared_prefix)
+    def wave_class(i: int) -> str:
+        """Deterministic long/short interleave: the first `n_long` of
+        every (n_long + n_short)-request cycle are long."""
+        n_long, n_short = wave[0], wave[1]
+        return "long" if i % (n_long + n_short) < n_long else "short"
+
+    def generate_once(rs, cls=None):
+        plen = args.prompt_len if cls is None \
+            else (wave[2] if cls == "long" else wave[3])
+        n_rand = plen - len(shared_prefix)
         prompt = shared_prefix + [int(t) for t in rs.randint(1, args.vocab,
                                                              n_rand)]
         t0 = last = time.perf_counter()
@@ -283,9 +319,10 @@ def main(argv=None):
                 err = evt.get("error")
         with lock:
             gen_tokens[0] += ntok
-            if my_ttft is not None:
-                ttfts.append(my_ttft * 1e3)
-            gaps.extend(g * 1e3 for g in my_gaps)
+            for k in ("all",) + ((cls,) if cls else ()):
+                if my_ttft is not None:
+                    ttfts[k].append(my_ttft * 1e3)
+                gaps[k].extend(g * 1e3 for g in my_gaps)
         if err:
             raise ServingHTTPError(200, err)
 
@@ -294,8 +331,12 @@ def main(argv=None):
         for i in range(n):
             gen = (args.mode == "generate"
                    or (args.mode == "mixed" and (wid + i) % 2 == 0))
+            cls = wave_class(wid + i) if (wave and gen) else None
             try:
-                (generate_once if gen else predict_once)(rs)
+                if gen:
+                    generate_once(rs, cls)
+                else:
+                    predict_once(rs)
                 key = "ok"
             except ServingHTTPError as e:
                 key = "backpressure" if e.status == 429 else "errors"
@@ -321,12 +362,22 @@ def main(argv=None):
         results["gen_tokens"] = gen_tokens[0]
         results["client_tokens_per_sec"] = round(
             gen_tokens[0] / max(results["elapsed_s"], 1e-9), 1)
-        results["ttft_p50_ms"] = round(
-            float(np.percentile(ttfts, 50)), 3) if ttfts else None
-        results["inter_token_p50_ms"] = round(
-            float(np.percentile(gaps, 50)), 3) if gaps else None
-        results["inter_token_p99_ms"] = round(
-            float(np.percentile(gaps, 99)), 3) if gaps else None
+
+        def pct(xs, q):
+            return round(float(np.percentile(xs, q)), 3) if xs else None
+
+        results["ttft_p50_ms"] = pct(ttfts["all"], 50)
+        results["inter_token_p50_ms"] = pct(gaps["all"], 50)
+        results["inter_token_p99_ms"] = pct(gaps["all"], 99)
+        if wave:
+            # per-class percentiles: the chunked-prefill claim is that
+            # SHORT streams' inter-token p99 stays flat while LONG
+            # prompts prefill — per-class is the only way to see it
+            for cls in ("long", "short"):
+                results[f"{cls}_ttft_p50_ms"] = pct(ttfts[cls], 50)
+                results[f"{cls}_ttft_p99_ms"] = pct(ttfts[cls], 99)
+                results[f"{cls}_inter_token_p50_ms"] = pct(gaps[cls], 50)
+                results[f"{cls}_inter_token_p99_ms"] = pct(gaps[cls], 99)
     print(json.dumps(results), flush=True)
     return 0 if results["errors"] == 0 else 1
 

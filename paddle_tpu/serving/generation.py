@@ -167,7 +167,9 @@ class _GenRequest:
     __slots__ = ("prompt", "bucket", "max_new_tokens", "do_sample",
                  "temperature", "top_k", "seed", "eos", "deadline",
                  "handle", "engine", "cancelled", "t_last_token",
-                 "span", "own_span", "span_queue", "span_decode")
+                 "span", "own_span", "span_queue", "span_decode",
+                 "prefilling", "prefill_cursor", "chunk_row", "j_hit",
+                 "pin_final")
 
     def __init__(self, engine, prompt, bucket, max_new_tokens, do_sample,
                  temperature, top_k, seed, eos, deadline, span=None,
@@ -188,6 +190,11 @@ class _GenRequest:
         self.own_span = own_span           # engine owns span's end()
         self.span_queue = None             # "gen.queued" child
         self.span_decode = None            # "gen.decode" child
+        self.prefilling = False            # chunked prefill in flight
+        self.prefill_cursor = 0            # tokens already prefilled
+        self.chunk_row = None              # slot's page row so far (np)
+        self.j_hit = 0                     # prefix-cache pages mapped
+        self.pin_final = 0                 # pinned count once armed
         self.handle = GenerationHandle(len(prompt), max_new_tokens)
         self.handle._req = self
 
@@ -249,7 +256,8 @@ class GenerationEngine:
     def __init__(self, model, *, max_slots=None, max_seq_len=None,
                  prompt_buckets=None, queue_depth=None, max_top_k=64,
                  page_size=None, num_pages=None, prefix_cache=None,
-                 mesh=None, layout=None):
+                 mesh=None, layout=None, draft_model=None,
+                 spec_tokens=None, prefill_chunk=None):
         from ..hapi.model import Model as _HapiModel
 
         if isinstance(model, _HapiModel):
@@ -295,12 +303,70 @@ class GenerationEngine:
             prefix_cache = bool(int(
                 _flags.flag("FLAGS_genserve_prefix_cache", 1)))
 
+        # speculative decode: a draft model proposes spec_tokens per
+        # iteration, the target verifies them in one batched step
+        if isinstance(draft_model, _HapiModel):
+            draft_model = draft_model.network
+        if draft_model is not None:
+            for req_attr in ("slot_prefill", "slot_decode_paged",
+                             "slot_prefill_prefix", "cfg"):
+                if not hasattr(draft_model, req_attr):
+                    raise TypeError(
+                        f"draft_model needs `{req_attr}`; got "
+                        f"{type(draft_model).__name__}")
+            dcfg = draft_model.cfg
+            if dcfg.vocab_size != cfg.vocab_size:
+                raise ValueError(
+                    f"draft vocab {dcfg.vocab_size} != target vocab "
+                    f"{cfg.vocab_size}")
+            if self.max_seq_len > dcfg.max_position_embeddings:
+                raise ValueError(
+                    f"max_seq_len {self.max_seq_len} exceeds the draft "
+                    "model's max_position_embeddings "
+                    f"{dcfg.max_position_embeddings}")
+            if mesh is not None:
+                raise ValueError(
+                    "speculative decode under a mesh is not supported "
+                    "yet — drop draft_model or mesh")
+        self.draft_model = draft_model
+        if spec_tokens is None:
+            spec_tokens = int(_flags.flag("FLAGS_genserve_spec_tokens", 4))
+        self.spec_tokens = int(spec_tokens) if draft_model is not None \
+            else 0
+        if draft_model is not None and self.spec_tokens < 1:
+            raise ValueError(
+                f"spec_tokens must be >= 1 with a draft model, got "
+                f"{self.spec_tokens}")
+
+        # chunked prefill: long prompts stream into the cache
+        # prefill_chunk tokens per decode iteration (0 = whole-prompt)
+        if prefill_chunk is None:
+            prefill_chunk = int(
+                _flags.flag("FLAGS_genserve_prefill_chunk", 0))
+        self.prefill_chunk = int(prefill_chunk)
+        if self.prefill_chunk:
+            if self.prefill_chunk % page_size:
+                raise ValueError(
+                    f"prefill_chunk {self.prefill_chunk} must be a "
+                    f"multiple of page_size {page_size} (chunk cursors "
+                    "resume at page boundaries)")
+            if self.prefill_chunk > self.prompt_buckets[-1]:
+                raise ValueError(
+                    f"prefill_chunk {self.prefill_chunk} exceeds the "
+                    f"largest prompt bucket {self.prompt_buckets[-1]}")
+
+        draft_kw = {}
+        if draft_model is not None:
+            draft_kw = dict(
+                draft_layers=dcfg.num_layers,
+                draft_num_heads=dcfg.num_heads,
+                draft_head_dim=dcfg.hidden_size // dcfg.num_heads)
         self.geometry = CacheGeometry(
             num_layers=cfg.num_layers, max_slots=self.max_slots,
             max_seq_len=self.max_seq_len, num_heads=cfg.num_heads,
             head_dim=cfg.hidden_size // cfg.num_heads,
             vocab_size=cfg.vocab_size, page_size=page_size,
-            num_pages=int(num_pages))
+            num_pages=int(num_pages), **draft_kw)
         self.metrics = GenerationMetrics(
             max_slots=self.max_slots, num_pages=self.geometry.num_pages)
         self._prefix = (PrefixCache(page_size) if prefix_cache else None)
@@ -342,11 +408,15 @@ class GenerationEngine:
         self._params = None
         self._buffers = None
         self._decode_exec = None
+        self._spec_exec = None
         self._release_exec = None
         self._reclaim_exec = None
         self._prefill_execs = {}
         self._insert_execs = {}
         self._insert_prefix_execs = {}
+        self._chunk_execs = {}
+        self._draft_params = None
+        self._draft_buffers = None
 
     # -- warmup: build + AOT-compile every executable ----------------------
     def start(self) -> "GenerationEngine":
@@ -392,6 +462,14 @@ class GenerationEngine:
         else:
             rep = pool_sh = kv_sh = None
         self._params, self._buffers = params, buffers
+        draft = self.draft_model
+        K = self.spec_tokens
+        if draft is not None:
+            draft.eval()
+            dparams, dbuffers = state_pytrees(draft)
+            self._draft_params, self._draft_buffers = dparams, dbuffers
+        else:
+            dparams = dbuffers = None
 
         def sample_token(lg, key, do_sample, temp, top_k):
             """Per-lane sampling, chain-compatible with generate():
@@ -410,31 +488,44 @@ class GenerationEngine:
 
         model, geometry = self.model, geom
 
-        def prefill_step(params, ids, length):
+        def target_prefill(params, ids, length):
             out, _ = functional_call(
                 model, params, (Tensor(ids), length), buffers=buffers,
                 mutable=False, method="slot_prefill")
             return out                     # (k [L,Sp,nh,hd], v, logits [V])
 
+        if draft is None:
+            prefill_step = target_prefill
+        else:
+            def prefill_step(params, dparams, ids, length):
+                # one executable fills BOTH pools: the draft's KV must
+                # cover the prompt so its proposal chain can attend it
+                k, v, lg = target_prefill(params, ids, length)
+                (dk, dv, _), _ = functional_call(
+                    draft, dparams, (Tensor(ids), length),
+                    buffers=dbuffers, mutable=False,
+                    method="slot_prefill")
+                return k, v, lg, dk, dv
+
         def insert_step(state, slot, k_new, v_new, logits, length, seed,
-                        do_sample, temp, top_k, stop_pos, eos, pinned):
+                        do_sample, temp, top_k, stop_pos, eos, pinned,
+                        *draft_kv):
             # prefix-miss admission: every mapped page is freshly
             # allocated and written (shared_n = 0)
             no_shared = jnp.full((pps,), -1, jnp.int32)
             state, row = write_prompt(state, slot, k_new, v_new, length,
-                                      no_shared, jnp.int32(0))
+                                      no_shared, jnp.int32(0), *draft_kv)
             key, sub = jax.random.split(jax.random.PRNGKey(seed))
             tok1 = sample_token(logits, sub, do_sample, temp, top_k)
             state = admit_slot(state, slot, tok1, length, key, do_sample,
                                temp, top_k, stop_pos, eos, pinned)
             return state, tok1, row
 
-        def insert_prefix_step(params, state, slot, ids, shared_ids,
-                               shared_n, length, seed, do_sample, temp,
-                               top_k, stop_pos, eos, pinned):
-            # prefix-hit admission: gather the cached prefix K/V from
-            # the pool, prefill ONLY the suffix, page the suffix in at
-            # the (page-aligned) boundary
+        def suffix_prefill(params, dparams, state, ids, shared_ids,
+                           shared_n, length):
+            # gather the already-resident prefix K/V from the pool(s)
+            # and prefill ONLY the suffix, attending over it — shared
+            # by the prefix-hit admission path and every prefill chunk
             gidx = jnp.clip(shared_ids[:pfx_pages], 0, num_pages - 1)
             pk = state["kp"][:, gidx].reshape(
                 geometry.num_layers, pfx_pages * ps, geometry.num_heads,
@@ -447,13 +538,73 @@ class GenerationEngine:
                 (Tensor(ids), pk, pv, shared_n * ps, length),
                 buffers=buffers, mutable=False,
                 method="slot_prefill_prefix")
+            if draft is None:
+                return k_suf, v_suf, logits, ()
+            dL, _, _, dnh, dhd = geometry.draft_pool_shape
+            dpk = state["dkp"][:, gidx].reshape(dL, pfx_pages * ps,
+                                                dnh, dhd)
+            dpv = state["dvp"][:, gidx].reshape(dL, pfx_pages * ps,
+                                                dnh, dhd)
+            (dk_suf, dv_suf, _), _ = functional_call(
+                draft, dparams,
+                (Tensor(ids), dpk, dpv, shared_n * ps, length),
+                buffers=dbuffers, mutable=False,
+                method="slot_prefill_prefix")
+            return k_suf, v_suf, logits, (dk_suf, dv_suf)
+
+        def _insert_prefix(params, dparams, state, slot, ids, shared_ids,
+                           shared_n, length, seed, do_sample, temp,
+                           top_k, stop_pos, eos, pinned):
+            # prefix-hit admission: the shared pages are never
+            # recomputed; the suffix pages in at the (page-aligned)
+            # boundary
+            k_suf, v_suf, logits, draft_kv = suffix_prefill(
+                params, dparams, state, ids, shared_ids, shared_n,
+                length)
             state, row = write_prompt(state, slot, k_suf, v_suf, length,
-                                      shared_ids, shared_n)
+                                      shared_ids, shared_n, *draft_kv)
             key, sub = jax.random.split(jax.random.PRNGKey(seed))
             tok1 = sample_token(logits, sub, do_sample, temp, top_k)
             state = admit_slot(state, slot, tok1, length, key, do_sample,
                                temp, top_k, stop_pos, eos, pinned)
             return state, tok1, row
+
+        if draft is None:
+            def insert_prefix_step(params, state, *a):
+                return _insert_prefix(params, None, state, *a)
+        else:
+            insert_prefix_step = _insert_prefix
+
+        def _chunk(params, dparams, state, slot, ids, shared_ids,
+                   shared_n, length, seed, do_sample, temp, top_k,
+                   stop_pos, eos, pin_now, pin_final, arm):
+            # one prefill chunk: scatter this slice's K/V behind the
+            # resumable cursor; ONLY the final chunk (arm=True) samples
+            # a real first token and activates the lane.  Until then
+            # ``pinned`` stays at the prefix-cache hit count (pin_now)
+            # so a cancel/deadline sweep frees every privately written
+            # chunk page — the stale-pinned leak this executable exists
+            # to prevent; the final chunk raises it to pin_final to
+            # protect the pages about to be registered as shared.
+            k_suf, v_suf, logits, draft_kv = suffix_prefill(
+                params, dparams, state, ids, shared_ids, shared_n,
+                length)
+            state, row = write_prompt(state, slot, k_suf, v_suf, length,
+                                      shared_ids, shared_n, *draft_kv)
+            key, sub = jax.random.split(jax.random.PRNGKey(seed))
+            tok1 = sample_token(logits, sub, do_sample, temp, top_k)
+            pinned = jnp.where(jnp.asarray(arm, bool), pin_final,
+                               pin_now)
+            state = admit_slot(state, slot, tok1, length, key, do_sample,
+                               temp, top_k, stop_pos, eos, pinned,
+                               active=arm)
+            return state, tok1, row
+
+        if draft is None:
+            def chunk_step(params, state, *a):
+                return _chunk(params, None, state, *a)
+        else:
+            chunk_step = _chunk
 
         def decode_step(params, state):
             lane = jnp.arange(geometry.max_slots)
@@ -499,6 +650,113 @@ class GenerationEngine:
                              active=active & ~finished)
             return new_state, toks, finished
 
+        def spec_step(params, dparams, state):
+            """ONE speculative iteration: the draft model chains K
+            greedy proposals, the target scores the committed token +
+            all K proposals in one batched verify step, and each greedy
+            lane emits the longest agreeing run + the target's first
+            divergent token (1..K+1 tokens).  Sampling lanes ride the
+            same executable emitting exactly one token from the verify
+            chunk's position-0 logits with the unchanged per-lane PRNG
+            chain — bitwise the non-speculative distribution.
+
+            Rejected proposals need no rollback: their pages stay
+            mapped inside the lane's reservation and the next
+            iteration's chain/verify scatter overwrites the dead K/V at
+            those positions before any emitted query can attend it.
+            """
+            lane = jnp.arange(geometry.max_slots)
+            pos, active = state["pos"], state["active"]
+            stop_pos = state["stop_pos"]
+            greedy_lane = ~state["do_sample"]
+            ptab = state["ptab"]
+            # (1) map every page covering [pos, hi] in one take — the
+            # speculation window never writes past the slot's reserved
+            # extent (positions clamp at stop_pos - 1)
+            hi = jnp.minimum(pos + K, stop_pos - 1)
+            col = jnp.arange(pps, dtype=jnp.int32)[None, :]
+            need = active[:, None] & (ptab < 0) \
+                & (col >= (pos // ps)[:, None]) \
+                & (col <= (hi // ps)[:, None])
+            pages, free_count = take_pages(
+                state["free_stack"], state["free_count"],
+                need.reshape(-1))
+            ptab = jnp.where(need, pages.reshape(ptab.shape), ptab)
+            # (2) draft chain: K+1 sequential one-token steps.  Step i
+            # writes chain token c_i's draft K/V at pos+i and (i < K)
+            # proposes c_{i+1} = argmax; step K only closes the draft
+            # cache for a fully accepted run (its logits are discarded).
+            dkp, dvp = state["dkp"], state["dvp"]
+            t = state["tok"]
+            chain = [t]
+            for i in range(K + 1):
+                p_i = jnp.minimum(pos + i, stop_pos - 1)
+                (dlg, dkp, dvp), _ = functional_call(
+                    draft, dparams,
+                    (t, p_i, active, dkp, dvp, ptab, seq_cap),
+                    buffers=dbuffers, mutable=False,
+                    method="slot_decode_paged")
+                if i < K:
+                    t = jnp.argmax(dlg, axis=-1).astype(jnp.int32)
+                    chain.append(t)
+            tokens = jnp.stack(chain, axis=1)        # [slots, K+1]
+            # (3) target verification: score all K+1 candidates at once
+            P = jnp.minimum(
+                pos[:, None] + jnp.arange(K + 1, dtype=jnp.int32)[None],
+                (stop_pos - 1)[:, None])
+            (logits, kp, vp), _ = functional_call(
+                model, params,
+                (tokens, P, active, state["kp"], state["vp"], ptab,
+                 seq_cap),
+                buffers=buffers, mutable=False,
+                method="slot_verify_paged")
+            # (4) accept/emit: outs[:, i] is what the target generates
+            # after consuming c_0..c_i; position 0 goes through the
+            # full sampling path (== argmax for greedy lanes) so the
+            # PRNG chain advances exactly once per iteration
+            pair = jax.vmap(jax.random.split)(state["rng"])
+            new_keys, subs = pair[:, 0], pair[:, 1]
+            outs = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            out0 = jax.vmap(sample_token)(
+                logits[:, 0], subs, state["do_sample"], state["temp"],
+                state["top_k"])
+            outs = outs.at[:, 0].set(out0)
+            # emitted_i: outs[:, i] is produced this iteration — needs
+            # the previous emission alive (not finished) and draft
+            # proposal c_i to match what the target just generated;
+            # fin_i mirrors the non-speculative stop arithmetic for the
+            # equivalent iteration at write position pos + i
+            em = active
+            emitted, fins = [], []
+            for i in range(K + 1):
+                if i > 0:
+                    em = em & ~fins[i - 1] & greedy_lane \
+                        & (tokens[:, i] == outs[:, i - 1])
+                fin = (outs[:, i] == state["eos"]) \
+                    | (pos + i + 2 >= stop_pos)
+                emitted.append(em)
+                fins.append(fin)
+            emitted = jnp.stack(emitted, axis=1)     # [slots, K+1]
+            fins = jnp.stack(fins, axis=1)
+            n_emit = emitted.sum(axis=1).astype(jnp.int32)
+            new_tok = outs[lane, jnp.maximum(n_emit - 1, 0)]
+            new_tok = jnp.where(active, new_tok, state["tok"])
+            new_pos = jnp.where(active, pos + n_emit, pos)
+            finished = active & (emitted & fins).any(axis=1)
+            # (5) retire in-graph, same as the plain decode step
+            freeable = finished[:, None] & (ptab >= 0) \
+                & (col >= state["pinned"][:, None])
+            free_stack, free_count = push_pages(
+                state["free_stack"], free_count,
+                jnp.where(freeable, ptab, -1).reshape(-1))
+            ptab = jnp.where(finished[:, None], -1, ptab)
+            new_state = dict(state, kp=kp, vp=vp, dkp=dkp, dvp=dvp,
+                             ptab=ptab, free_stack=free_stack,
+                             free_count=free_count, tok=new_tok,
+                             pos=new_pos, rng=new_keys,
+                             active=active & ~finished)
+            return new_state, outs, emitted, finished
+
         def release_step(state, mask):
             return release_slots(state, mask)
 
@@ -526,6 +784,8 @@ class GenerationEngine:
 
             def sds(shape, dtype, sh=None):
                 return jax.ShapeDtypeStruct(shape, dtype)
+        dpspec = (inference.spec_tree(dparams)
+                  if draft is not None else None)  # draft => no mesh
         i32 = sds((), np.int32)
         f32 = sds((), np.float32)
         b1 = sds((), np.bool_)
@@ -540,10 +800,17 @@ class GenerationEngine:
                 return None
             return (out_state,) + tail
 
+        chunk_bucket = (self._bucket_for(self.prefill_chunk)
+                        if self.prefill_chunk else 0)
         with RecordEvent("paddle.genserve/warmup"):
-            self._decode_exec = inference.aot_compile(
-                decode_step, (pspec, sspec), donate_argnums=(1,),
-                out_shardings=outs(rep, rep))
+            if K:
+                self._spec_exec = inference.aot_compile(
+                    spec_step, (pspec, dpspec, sspec),
+                    donate_argnums=(2,))
+            else:
+                self._decode_exec = inference.aot_compile(
+                    decode_step, (pspec, sspec), donate_argnums=(1,),
+                    out_shardings=outs(rep, rep))
             self.compile_count += 1
             self._release_exec = inference.aot_compile(
                 release_step, (sspec, sds((self.max_slots,), np.bool_)),
@@ -554,27 +821,44 @@ class GenerationEngine:
                     reclaim_step, (sspec, pvec), donate_argnums=(0,),
                     out_shardings=out_state)
                 self.compile_count += 1
+            dpre = (dpspec,) if draft is not None else ()
             for sp in self.prompt_buckets:
                 ids = sds((1, sp), np.int32)
                 kv = sds((geom.num_layers, sp, geom.num_heads,
                           geom.head_dim), kv_dt, kv_sh)
                 lg = sds((V,), np.float32)
+                dkv_in = ()
+                if draft is not None:
+                    dkv = sds((geom.draft_layers, sp,
+                               geom.draft_num_heads,
+                               geom.draft_head_dim), kv_dt)
+                    dkv_in = (dkv, dkv)
                 self._prefill_execs[sp] = inference.aot_compile(
-                    prefill_step, (pspec, ids, i32),
+                    prefill_step, (pspec,) + dpre + (ids, i32),
                     out_shardings=(kv_sh, kv_sh, rep)
                     if mesh is not None else None)
                 self._insert_execs[sp] = inference.aot_compile(
                     insert_step,
                     (sspec, i32, kv, kv, lg, i32, i32, b1, f32, i32, i32,
-                     i32, i32),
+                     i32, i32) + dkv_in,
                     donate_argnums=(0,), out_shardings=outs(rep, rep))
                 self.compile_count += 2
+                tail = (i32, ids, pvec, i32, i32, i32, b1, f32, i32, i32,
+                        i32, i32)
                 if self._prefix is not None:
                     self._insert_prefix_execs[sp] = inference.aot_compile(
                         insert_prefix_step,
-                        (pspec, sspec, i32, ids, pvec, i32, i32, i32, b1,
-                         f32, i32, i32, i32, i32),
-                        donate_argnums=(1,), out_shardings=outs(rep, rep))
+                        (pspec,) + dpre + (sspec,) + tail,
+                        donate_argnums=(1 + len(dpre),),
+                        out_shardings=outs(rep, rep))
+                    self.compile_count += 1
+                if self.prefill_chunk and sp <= chunk_bucket:
+                    self._chunk_execs[sp] = inference.aot_compile(
+                        chunk_step,
+                        (pspec,) + dpre + (sspec,) + tail[:-1]
+                        + (i32, i32, b1),
+                        donate_argnums=(1 + len(dpre),),
+                        out_shardings=outs(rep, rep))
                     self.compile_count += 1
         self.metrics.set_compile_count(self.compile_count)
         logger.info(
@@ -608,9 +892,11 @@ class GenerationEngine:
         p50 — in steady state one decode iteration IS the inter-token
         gap.  Reads only the compiled executable's HLO; never touches
         the live (donated) decode state."""
-        if self._decode_exec is None:
+        exe = self._spec_exec if self._spec_exec is not None \
+            else self._decode_exec
+        if exe is None:
             raise RuntimeError("op_report() before start()")
-        ca = self._decode_exec.cost_analysis()
+        ca = exe.cost_analysis()
         ca = ca[0] if isinstance(ca, (list, tuple)) else (ca or {})
         if measured_step_ms is None:
             gaps = sorted(self.metrics._gaps)
@@ -618,7 +904,7 @@ class GenerationEngine:
                 measured_step_ms = gaps[len(gaps) // 2] * 1e3
         from ..monitor import perf as _perf
 
-        return _perf.build_report(self._decode_exec, name="decode",
+        return _perf.build_report(exe, name="decode",
                                   cost_analysis=dict(ca),
                                   measured_step_ms=measured_step_ms,
                                   trace_dir=trace_dir)
@@ -734,8 +1020,17 @@ class GenerationEngine:
                 self.metrics.set_page_occupancy(
                     self.geometry.num_pages - self._sched.pages_available)
                 if occupied and not self._stopped:
-                    toks, fin = self.step()
-                    self._distribute(toks, fin)
+                    # at most ONE prefill chunk per iteration, then a
+                    # decode step for the armed lanes — a long prompt
+                    # streams in without stalling in-flight streams
+                    self._advance_chunk()
+                    if len(self._sched.occupied) > self._sched.prefilling():
+                        if self._spec_exec is not None:
+                            outs, emitted, fin = self.step_spec()
+                            self._distribute_spec(outs, emitted, fin)
+                        else:
+                            toks, fin = self.step()
+                            self._distribute(toks, fin)
                     continue
                 if self._queue.empty() and not self._backlog:
                     self._idle.set()
@@ -803,14 +1098,34 @@ class GenerationEngine:
             need = self.geometry.pages_for(
                 len(req.prompt) + req.max_new_tokens) - j_hit
             if not self._sched.can_admit(need):
-                # no free lane, or the pool cannot reserve the worst
-                # case — FIFO head-of-line wait until a retirement
-                # frees lanes/pages (admit-and-crash is not an option)
-                break
+                # page-pressure escape hatch BEFORE queuing: when a
+                # free lane exists and idle prefix-cache residents are
+                # what exhausts the pool, evict LRU entries until the
+                # head's reservation fits — otherwise a stream of
+                # distinct prompts parks one-reader prefixes over the
+                # whole pool and the backlog never drains
+                if (self._prefix is not None and self._sched.has_free()
+                        and len(self._prefix)
+                        and need > self._sched.pages_available):
+                    short = need - self._sched.pages_available
+                    self._reclaim(self._prefix.evict_idle(short))
+                    self._sched.set_shared_resident(
+                        self._prefix.resident_pages)
+                if not self._sched.can_admit(need):
+                    # no free lane, or the pool cannot reserve the
+                    # worst case even after eviction — FIFO
+                    # head-of-line wait until a retirement frees
+                    # lanes/pages (admit-and-crash is not an option)
+                    break
             self._backlog.popleft()
             slot = self._sched.admit(req, n_pages=need)
             try:
-                self._admit(req, slot, j_hit, shared)
+                suffix_len = len(req.prompt) \
+                    - j_hit * self.geometry.page_size
+                if self.prefill_chunk and suffix_len > self.prefill_chunk:
+                    self._admit_chunked(req, slot, j_hit, shared)
+                else:
+                    self._admit(req, slot, j_hit, shared)
             except Exception as e:  # noqa: BLE001 - fail THIS request,
                 # keep the decode loop alive for the others
                 logger.exception("generation admission failed")
@@ -837,6 +1152,8 @@ class GenerationEngine:
                                      prefix_pages=j_hit)
                       if req.span is not None else None)
         stop = np.int32(L + req.max_new_tokens)
+        dpre = ((self._draft_params,)
+                if self.draft_model is not None else ())
         with RecordEvent("paddle.genserve/prefill"):
             if j_hit > 0:
                 # prefix hit: prefill ONLY the suffix
@@ -847,22 +1164,23 @@ class GenerationEngine:
                 shared_vec = np.full((geom.pages_per_slot,), -1, np.int32)
                 shared_vec[:j_hit] = shared[:j_hit]
                 state, tok1, row = self._insert_prefix_execs[sb](
-                    self._params, self._state, np.int32(slot), ids,
-                    shared_vec, np.int32(j_hit), np.int32(L),
+                    self._params, *dpre, self._state, np.int32(slot),
+                    ids, shared_vec, np.int32(j_hit), np.int32(L),
                     np.int32(req.seed), np.bool_(req.do_sample),
                     np.float32(req.temperature), np.int32(req.top_k),
                     stop, np.int32(req.eos), np.int32(pinned))
             else:
                 ids = np.zeros((1, req.bucket), np.int32)
                 ids[0, :L] = req.prompt
-                k_new, v_new, logits = self._prefill_execs[req.bucket](
-                    self._params, ids, np.int32(L))
+                out = self._prefill_execs[req.bucket](
+                    self._params, *dpre, ids, np.int32(L))
+                k_new, v_new, logits = out[:3]
                 state, tok1, row = self._insert_execs[req.bucket](
                     self._state, np.int32(slot), k_new, v_new, logits,
                     np.int32(L), np.int32(req.seed),
                     np.bool_(req.do_sample), np.float32(req.temperature),
                     np.int32(req.top_k), stop, np.int32(req.eos),
-                    np.int32(pinned))
+                    np.int32(pinned), *out[3:])
         self._state = state
         with host_fetch():
             t1 = int(np.array(tok1, copy=True))
@@ -877,6 +1195,123 @@ class GenerationEngine:
             self._sched.set_shared_resident(self._prefix.resident_pages)
         if sp_prefill is not None:
             sp_prefill.end(status="ok")
+        now = time.monotonic()
+        req.t_last_token = now
+        req.handle._push(t1)
+        if req.span is not None:
+            req.span.event("first_token", slot=slot)
+        self.metrics.observe_ttft(now - req.handle.t_submit)
+        self.metrics.observe_tokens(1)
+        if req.max_new_tokens == 1 or t1 == req.eos:
+            self._release([slot])
+            self._host_retire(slot)
+            self.metrics.count("retired")
+            req.end_spans("ok")
+            req.handle._finish()
+        elif req.span is not None:
+            req.span_decode = req.span.child("gen.decode", slot=slot)
+
+    def _admit_chunked(self, req: _GenRequest, slot: int, j_hit: int,
+                       shared):
+        """Admit a long prompt WITHOUT prefilling it: the slot occupies
+        the scheduler (worst-case pages reserved up front) while
+        ``_advance_chunk`` streams ``prefill_chunk``-token slices into
+        its pages, one per decode iteration.  Only the final chunk arms
+        the lane."""
+        geom = self.geometry
+        L = len(req.prompt)
+        if req.span_queue is not None:
+            req.span_queue.end(status="ok")
+            req.span_queue = None
+        j_reg = (self._prefix.shareable_pages(L)
+                 if self._prefix is not None else 0)
+        req.j_hit = j_hit
+        req.pin_final = max(j_hit, j_reg)
+        req.prefilling = True
+        req.prefill_cursor = j_hit * geom.page_size
+        row = np.full((geom.pages_per_slot,), -1, np.int32)
+        if j_hit > 0:
+            row[:j_hit] = shared[:j_hit]
+        req.chunk_row = row
+        if self._prefix is not None:
+            self.metrics.count_prefix(hit=j_hit > 0)
+            # pin the cache-shared head NOW: it must stay resident for
+            # every later chunk's prefix gather (LRU cannot evict it)
+            pin_pages = [int(p) for p in row[:j_hit]]
+            self._prefix.pin(pin_pages)
+            self._slot_pins[slot] = pin_pages
+            self._sched.set_shared_resident(self._prefix.resident_pages)
+        if req.span is not None:
+            req.span_decode = req.span.child(
+                "gen.prefill", bucket=req.bucket, prompt_len=L,
+                slot=slot, prefix_pages=j_hit, chunked=True)
+
+    def _advance_chunk(self):
+        """Advance ONE prefilling slot by one chunk — bounded work per
+        decode iteration, so armed lanes' inter-token gap stays flat
+        while a long prompt streams in."""
+        if not self.prefill_chunk:
+            return
+        slot = req = None
+        for s, r in self._sched.occupied.items():
+            if r.prefilling:
+                slot, req = s, r
+                break
+        if req is None:
+            return
+        geom = self.geometry
+        L = len(req.prompt)
+        cur = req.prefill_cursor
+        end = min(cur + self.prefill_chunk, L)
+        arm = end >= L
+        chunk = req.prompt[cur:end]
+        sb = self._bucket_for(len(chunk))
+        ids = np.zeros((1, sb), np.int32)
+        ids[0, :len(chunk)] = chunk
+        shared_vec = np.array(req.chunk_row, np.int32)
+        dpre = ((self._draft_params,)
+                if self.draft_model is not None else ())
+        with RecordEvent("paddle.genserve/prefill_chunk"):
+            state, tok1, row = self._chunk_execs[sb](
+                self._params, *dpre, self._state, np.int32(slot), ids,
+                shared_vec, np.int32(cur // geom.page_size),
+                np.int32(end), np.int32(req.seed),
+                np.bool_(req.do_sample), np.float32(req.temperature),
+                np.int32(req.top_k),
+                np.int32(L + req.max_new_tokens), np.int32(req.eos),
+                np.int32(req.j_hit), np.int32(req.pin_final),
+                np.bool_(arm))
+        self._state = state
+        with host_fetch():
+            t1 = int(np.array(tok1, copy=True))
+            row_np = np.array(row, copy=True)
+        req.chunk_row = row_np
+        req.prefill_cursor = end
+        self.metrics.count_chunk()
+        if req.span_decode is not None:
+            req.span_decode.event("chunk", end=end)
+        if arm:
+            self._arm_chunked(req, slot, row_np, t1)
+
+    def _arm_chunked(self, req: _GenRequest, slot: int, row_np, t1: int):
+        """Final chunk ran: register the prompt's shareable prefix,
+        deliver the first token, and hand the lane to the decode step
+        (or retire immediately on eos / max_new_tokens == 1)."""
+        req.prefilling = False
+        j_hit = req.j_hit
+        if self._prefix is not None:
+            j_reg = self._prefix.shareable_pages(len(req.prompt))
+            pin_pages = [int(p) for p in row_np[:req.pin_final]]
+            # the cache-hit head was pinned at admission; pin the
+            # freshly registered tail
+            self._prefix.pin(pin_pages[j_hit:])
+            self._slot_pins[slot] = pin_pages
+            self._reclaim(self._prefix.register(req.prompt, row_np,
+                                                j_hit, j_reg))
+            self._sched.set_shared_resident(self._prefix.resident_pages)
+        if req.span_decode is not None:
+            req.span_decode.end(status="ok")
+            req.span_decode = None
         now = time.monotonic()
         req.t_last_token = now
         req.handle._push(t1)
@@ -954,9 +1389,62 @@ class GenerationEngine:
             fin_np = np.array(fin, copy=True)
         return toks_np, fin_np
 
+    def step_spec(self):
+        """ONE speculative iteration (draft chain + batched target
+        verify, compiled as a single executable): every armed lane
+        advances 1..spec_tokens+1 tokens.  Returns (outs [slots, K+1],
+        emitted [slots, K+1] prefix mask, finished [slots])."""
+        self._iter += 1
+        chaos.on_step(self._iter)
+        with RecordEvent("paddle.genserve/spec_decode"):
+            state, outs, emitted, fin = self._spec_exec(
+                self._params, self._draft_params, self._state)
+        self._state = state
+        with host_fetch():
+            outs_np = np.array(outs, copy=True)
+            emitted_np = np.array(emitted, copy=True)
+            fin_np = np.array(fin, copy=True)
+        return outs_np, emitted_np, fin_np
+
+    def _distribute_spec(self, outs_np, emitted_np, fin_np):
+        now = time.monotonic()
+        emitted_total = accepted = proposed = 0
+        for slot, req in list(self._sched.occupied.items()):
+            if req.prefilling:
+                continue
+            n = int(emitted_np[slot].sum())
+            if n <= 0:
+                continue
+            emitted_total += n
+            if not req.do_sample:
+                # n - 1 of this run's tokens came from accepted draft
+                # proposals (the last one is the target's own next
+                # token, free either way)
+                accepted += n - 1
+                proposed += self.spec_tokens
+            gap = ((now - req.t_last_token) / n
+                   if req.t_last_token is not None else None)
+            for i in range(n):
+                if gap is not None:
+                    self.metrics.observe_inter_token(gap)
+                req.handle._push(int(outs_np[slot, i]))
+                if req.span_decode is not None:
+                    req.span_decode.event("token",
+                                          i=len(req.handle.tokens))
+            req.t_last_token = now
+            if bool(fin_np[slot]):
+                self._host_retire(slot)
+                self.metrics.count("retired")
+                req.end_spans("ok")
+                req.handle._finish()
+        self.metrics.observe_tokens(emitted_total)
+        if proposed:
+            self.metrics.observe_spec(accepted, proposed)
+
     def _distribute(self, toks_np, fin_np):
         now = time.monotonic()
-        occupied = list(self._sched.occupied.items())
+        occupied = [(s, r) for s, r in self._sched.occupied.items()
+                    if not r.prefilling]
         self.metrics.observe_tokens(len(occupied))
         for slot, req in occupied:
             tok = int(toks_np[slot])
@@ -1077,6 +1565,14 @@ def main(argv=None):
     parser.add_argument("--prefix-cache", type=int, default=1,
                         help="1 shares identical prompt prefixes as "
                              "read-only pages; 0 disables")
+    parser.add_argument("--draft-layers", type=int, default=0,
+                        help="layers of the speculative draft model; "
+                             "0 disables speculative decode")
+    parser.add_argument("--spec-tokens", type=int, default=4,
+                        help="draft proposals per speculative iteration")
+    parser.add_argument("--prefill-chunk", type=int, default=0,
+                        help="tokens per prefill chunk (multiple of "
+                             "page-size); 0 prefills whole prompts")
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument("--host", default="127.0.0.1")
     parser.add_argument("--port", type=int, default=8867,
@@ -1097,12 +1593,33 @@ def main(argv=None):
                     dropout=0.0, attn_dropout=0.0)
     model = GPTForCausalLM(cfg)
     model.eval()
+    draft = None
+    if args.draft_layers > 0:
+        dcfg = GPTConfig(vocab_size=args.vocab, hidden_size=args.hidden,
+                         num_layers=args.draft_layers,
+                         num_heads=args.heads,
+                         max_position_embeddings=args.max_seq_len,
+                         dropout=0.0, attn_dropout=0.0)
+        draft = GPTForCausalLM(dcfg)
+        # seed the draft from the target's first layers + embeddings so
+        # the random-weight smoke still accepts some proposals
+        tgt = dict(model.state_dict())
+        dsd = draft.state_dict()
+        for name in list(dsd):
+            if name in tgt and tuple(dsd[name].shape) \
+                    == tuple(tgt[name].shape):
+                dsd[name] = tgt[name]
+        draft.set_state_dict(dsd)
+        draft.eval()
     engine = GenerationEngine(model, max_slots=args.slots,
                               max_seq_len=args.max_seq_len,
                               prompt_buckets=args.prompt_buckets,
                               page_size=args.page_size,
                               num_pages=args.num_pages,
-                              prefix_cache=bool(args.prefix_cache))
+                              prefix_cache=bool(args.prefix_cache),
+                              draft_model=draft,
+                              spec_tokens=args.spec_tokens,
+                              prefill_chunk=args.prefill_chunk)
     server = ServingServer(None, gen_engine=engine, host=args.host,
                            port=args.port).start()
     # parse-friendly readiness line (tools/serve_smoke.sh greps it)

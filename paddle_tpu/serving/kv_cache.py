@@ -58,6 +58,12 @@ class CacheGeometry:
     page_size: int = 16
     num_pages: int = 0     # 0 = max_slots * pages_per_slot
     dtype: str = "float32"
+    # speculative decode: the draft model's KV lives in a parallel pool
+    # indirected through the SAME page table (one allocation decision
+    # covers both models); 0 layers = no draft pool in the state
+    draft_layers: int = 0
+    draft_num_heads: int = 0
+    draft_head_dim: int = 0
 
     def __post_init__(self):
         if self.page_size < 1:
@@ -78,13 +84,22 @@ class CacheGeometry:
         return (self.num_layers, self.num_pages, self.page_size,
                 self.num_heads, self.head_dim)
 
+    @property
+    def draft_pool_shape(self):
+        return (self.draft_layers, self.num_pages, self.page_size,
+                self.draft_num_heads, self.draft_head_dim)
+
     def page_bytes(self) -> int:
-        """Bytes ONE page costs across k+v and all layers — the HBM
-        sizing unit: cache bytes = num_pages * page_bytes()."""
+        """Bytes ONE page costs across k+v and all layers (draft pool
+        included when speculative) — the HBM sizing unit: cache bytes =
+        num_pages * page_bytes()."""
         import numpy as np
 
-        return (2 * self.num_layers * self.page_size * self.num_heads
-                * self.head_dim * np.dtype(self.dtype).itemsize)
+        per_tok = (self.num_layers * self.num_heads * self.head_dim
+                   + self.draft_layers * self.draft_num_heads
+                   * self.draft_head_dim)
+        return (2 * self.page_size * per_tok
+                * np.dtype(self.dtype).itemsize)
 
     def kv_bytes(self) -> int:
         return self.num_pages * self.page_bytes()
@@ -113,7 +128,7 @@ def make_state(geom: CacheGeometry):
 
     S = geom.max_slots
     key_shape = jax.random.PRNGKey(0).shape  # (2,) for threefry
-    return {
+    state = {
         "kp": jnp.zeros(geom.pool_shape, jnp.dtype(geom.dtype)),
         "vp": jnp.zeros(geom.pool_shape, jnp.dtype(geom.dtype)),
         "ptab": jnp.full((S, geom.pages_per_slot), -1, jnp.int32),
@@ -130,6 +145,14 @@ def make_state(geom: CacheGeometry):
         "eos": jnp.full((S,), geom.vocab_size, jnp.int32),  # V = never
         "stop_pos": jnp.zeros((S,), jnp.int32),
     }
+    if geom.draft_layers:
+        # draft-model KV pool, same page ids as kp/vp: one page-table
+        # row addresses both models' cache for a lane
+        state["dkp"] = jnp.zeros(geom.draft_pool_shape,
+                                 jnp.dtype(geom.dtype))
+        state["dvp"] = jnp.zeros(geom.draft_pool_shape,
+                                 jnp.dtype(geom.dtype))
+    return state
 
 
 def state_specs(state, shardings=None):
@@ -179,7 +202,8 @@ def push_pages(free_stack, free_count, pages):
 
 # -- traced transitions ------------------------------------------------------
 
-def write_prompt(state, slot, k_new, v_new, length, shared_ids, shared_n):
+def write_prompt(state, slot, k_new, v_new, length, shared_ids, shared_n,
+                 dk_new=None, dv_new=None):
     """Map + fill one admitted request's cache pages.
 
     ``k_new``/``v_new`` ``[layers, Sb, nh, hd]`` hold prefill K/V for
@@ -193,7 +217,12 @@ def write_prompt(state, slot, k_new, v_new, length, shared_ids, shared_n):
     O(S_max).  Traced; ``slot``/``length``/``shared_n`` are traced
     scalars so ONE executable per bucket serves every slot and every
     prefix split.  Returns ``(state, row)`` — the row is fetched by the
-    engine to register/refcount pages host-side."""
+    engine to register/refcount pages host-side.
+
+    ``dk_new``/``dv_new`` (speculative engines only): the DRAFT model's
+    prefill K/V for the same positions, scattered into ``dkp``/``dvp``
+    at the same page ids — the shared table row keeps both pools'
+    extents in lockstep."""
     import jax.numpy as jnp
 
     kp, vp = state["kp"], state["vp"]
@@ -220,25 +249,34 @@ def write_prompt(state, slot, k_new, v_new, length, shared_ids, shared_n):
     tgt = jnp.where(pj < n_total,
                     row[jnp.clip(pj, 0, pps - 1)], num_pages)
 
-    def to_pages(x):
-        pad = jnp.zeros((L, n_pb * ps) + x.shape[2:], kp.dtype)
+    def to_pages(x, n_layers):
+        pad = jnp.zeros((n_layers, n_pb * ps) + x.shape[2:], kp.dtype)
         pad = pad.at[:, :Sb].set(x.astype(kp.dtype))
-        return pad.reshape((L, n_pb, ps) + x.shape[2:])
+        return pad.reshape((n_layers, n_pb, ps) + x.shape[2:])
 
-    kp = kp.at[:, tgt].set(to_pages(k_new), mode="drop")
-    vp = vp.at[:, tgt].set(to_pages(v_new), mode="drop")
+    kp = kp.at[:, tgt].set(to_pages(k_new, L), mode="drop")
+    vp = vp.at[:, tgt].set(to_pages(v_new, L), mode="drop")
     ptab = state["ptab"].at[slot].set(row)
     state = dict(state, kp=kp, vp=vp, ptab=ptab, free_count=free_count)
+    if dk_new is not None:
+        dL = state["dkp"].shape[0]
+        dkp = state["dkp"].at[:, tgt].set(to_pages(dk_new, dL),
+                                          mode="drop")
+        dvp = state["dvp"].at[:, tgt].set(to_pages(dv_new, dL),
+                                          mode="drop")
+        state = dict(state, dkp=dkp, dvp=dvp)
     return state, row
 
 
 def admit_slot(state, slot, tok, length, rng_key, do_sample, temp, top_k,
-               stop_pos, eos, pinned):
+               stop_pos, eos, pinned, active=True):
     """Arm lane ``slot``: pending token ``tok`` (the first generated
     token, sampled from the prefill logits) will be written at position
     ``length`` on the next decode iteration; table indices below
     ``pinned`` are shared prefix pages the device never frees.  Traced
-    scalar args."""
+    scalar args.  ``active`` (traced bool) lets chunked prefill run the
+    same executable for every chunk while only the FINAL chunk arms the
+    lane — earlier chunks keep it parked with the registers staged."""
     import jax.numpy as jnp
 
     slot = jnp.asarray(slot, jnp.int32)
@@ -246,7 +284,7 @@ def admit_slot(state, slot, tok, length, rng_key, do_sample, temp, top_k,
         state,
         tok=state["tok"].at[slot].set(jnp.asarray(tok, jnp.int32)),
         pos=state["pos"].at[slot].set(jnp.asarray(length, jnp.int32)),
-        active=state["active"].at[slot].set(True),
+        active=state["active"].at[slot].set(jnp.asarray(active, bool)),
         rng=state["rng"].at[slot].set(rng_key),
         pinned=state["pinned"].at[slot].set(
             jnp.asarray(pinned, jnp.int32)),

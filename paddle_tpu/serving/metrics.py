@@ -20,7 +20,8 @@ import time
 
 from ..utils.metrics import Histogram, MetricsRegistry
 
-__all__ = ["Histogram", "ServingMetrics", "GenerationMetrics"]
+__all__ = ["Histogram", "ServingMetrics", "GenerationMetrics",
+           "RouterMetrics"]
 
 
 class ServingMetrics:
@@ -188,6 +189,8 @@ class GenerationMetrics:
       paddle_genserve_prefix_cache_hits_total / _misses_total
                                              prefix-cache admissions
       paddle_genserve_prefix_cache_hit_ratio hits / (hits + misses)
+      paddle_genserve_spec_accept_ratio      accepted / proposed drafts
+      paddle_genserve_prefill_chunks_total   chunked-prefill slices run
       paddle_genserve_compile_count          executables built at warmup
     """
 
@@ -226,6 +229,10 @@ class GenerationMetrics:
         reg.gauge("paddle_genserve_prefix_cache_hit_ratio",
                   "prefix-cache hits / (hits + misses) since start",
                   fn=self._prefix_ratio_locked)
+        reg.gauge("paddle_genserve_spec_accept_ratio",
+                  "accepted / proposed speculative draft tokens since "
+                  "start (greedy lanes only; 0 when not speculating)",
+                  fn=self._spec_ratio_locked)
         reg.gauge("paddle_genserve_compile_count",
                   "decode/prefill/insert executables compiled at warmup "
                   "(must not grow under traffic)",
@@ -246,6 +253,15 @@ class GenerationMetrics:
         self._prefix_misses = reg.counter(
             "paddle_genserve_prefix_cache_misses_total",
             "admissions that found no cached prefix")
+        self._chunks = reg.counter(
+            "paddle_genserve_prefill_chunks_total",
+            "prefill chunks streamed into slot pages")
+        self._spec_accepted = reg.counter(
+            "paddle_genserve_spec_accepted_total",
+            "draft proposals the target verification accepted")
+        self._spec_proposed = reg.counter(
+            "paddle_genserve_spec_proposed_total",
+            "draft proposals offered to target verification")
         self._ttft = collections.deque(maxlen=self.RESERVOIR)
         self._gaps = collections.deque(maxlen=self.RESERVOIR)
         self._token_stamps = collections.deque()   # (monotonic, count)
@@ -289,6 +305,13 @@ class GenerationMetrics:
     def count_prefix(self, hit: bool):
         (self._prefix_hits if hit else self._prefix_misses).inc()
 
+    def count_chunk(self, n: int = 1):
+        self._chunks.inc(n)
+
+    def observe_spec(self, accepted: int, proposed: int):
+        self._spec_accepted.inc(accepted)
+        self._spec_proposed.inc(proposed)
+
     def set_compile_count(self, n: int):
         with self._lock:
             self.compile_count = int(n)
@@ -298,6 +321,10 @@ class GenerationMetrics:
         hits = self._prefix_hits.value
         total = hits + self._prefix_misses.value
         return hits / total if total else 0.0
+
+    def _spec_ratio_locked(self):
+        proposed = self._spec_proposed.value
+        return self._spec_accepted.value / proposed if proposed else 0.0
 
     def _quantile_locked(self, deque_, q: float):
         if not deque_:
@@ -335,8 +362,80 @@ class GenerationMetrics:
                 "prefix_cache_misses": self._prefix_misses.value,
                 "prefix_cache_hit_ratio": round(
                     self._prefix_ratio_locked(), 4),
+                "spec_accept_ratio": round(self._spec_ratio_locked(), 4),
+                "spec_proposed": self._spec_proposed.value,
+                "prefill_chunks": self._chunks.value,
                 "compile_count": self.compile_count,
                 **{k: v for k, v in sorted(self.counters.items())},
+            }
+
+    def prometheus_text(self) -> str:
+        return self.registry.prometheus_text()
+
+
+class RouterMetrics:
+    """Fleet-router observability (`serving/router.py`): per-replica
+    routing decisions, backpressure, and replica health in one private
+    registry, co-exposed through the router's /metrics (and embeddable
+    in a `MonitorServer(extra_registries=...)` when the router rides an
+    existing monitoring process).
+
+    Routing reasons (the `reason` label on requests_total):
+      prefix_hit       affinity table says this replica owns the
+                       prompt's page-aligned prefix
+      least_loaded     no affinity — picked the replica with the fewest
+                       inflight requests
+      health_failover  affinity replica was dead/draining, rerouted
+
+    A 429 from a replica is BACKPRESSURE, not death: it bumps
+    `paddle_router_backpressure_total{replica}` and the request retries
+    elsewhere, but the replica's health-probe failure count is untouched
+    (a loaded replica must not flap in and out of the fleet)."""
+
+    def __init__(self):
+        self.registry = MetricsRegistry()
+        self._lock = self.registry._lock
+        reg = self.registry
+        self._requests = reg.counter(
+            "paddle_router_requests_total",
+            "requests routed, by target replica and routing reason",
+            label=("replica", "reason"))
+        self._backpressure = reg.counter(
+            "paddle_router_backpressure_total",
+            "429s absorbed per replica (request retried elsewhere; "
+            "not a health-probe failure)", label="replica")
+        self._healthy = 0
+        self._inflight = 0
+        reg.gauge("paddle_router_replicas_healthy",
+                  "replicas currently passing health probes",
+                  fn=lambda: self._healthy)
+        reg.gauge("paddle_router_inflight",
+                  "requests currently being proxied",
+                  fn=lambda: self._inflight)
+
+    def count_routed(self, replica: str, reason: str):
+        self._requests.inc((str(replica), str(reason)))
+
+    def count_backpressure(self, replica: str):
+        self._backpressure.inc(str(replica))
+
+    def set_healthy(self, n: int):
+        with self._lock:
+            self._healthy = int(n)
+
+    def add_inflight(self, delta: int):
+        with self._lock:
+            self._inflight += int(delta)
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "replicas_healthy": self._healthy,
+                "inflight": self._inflight,
+                "routed": {"|".join(k): v
+                           for k, v in sorted(self._requests.values.items())},
+                "backpressure": dict(sorted(
+                    self._backpressure.values.items())),
             }
 
     def prometheus_text(self) -> str:

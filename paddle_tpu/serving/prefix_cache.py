@@ -95,6 +95,25 @@ class PrefixCache:
                 reclaim.extend(self._unref(old))
         return reclaim
 
+    def evict_idle(self, n_pages: int):
+        """Pool-pressure eviction: pop LRU entries until at least
+        ``n_pages`` pages have dropped to refcount zero, or the cache
+        is empty.  Returns the freed page ids for device reclaim.
+
+        Entries whose pages are still pinned by active slots free
+        nothing when popped (the slot's unpin returns them later) —
+        under pressure, future sharing is sacrificed before a queued
+        request is starved.  The engine calls this from admission when
+        ``can_admit`` fails on pages while cache residents hold the
+        pool; without it a stream of DISTINCT prompts fills the pool
+        with one-reader prefixes and the backlog head waits forever
+        (entry-count capacity never trips on a small pool)."""
+        reclaim = []
+        while self._entries and len(reclaim) < n_pages:
+            _, old = self._entries.popitem(last=False)
+            reclaim.extend(self._unref(old))
+        return reclaim
+
     # -- per-slot pinning --------------------------------------------------
     def pin(self, pages):
         """A slot started reading ``pages`` (its shared prefix + any

@@ -1,0 +1,500 @@
+"""Prefix-cache-aware fleet router over N GenerationEngine replicas.
+
+One stdlib HTTP endpoint in front of N `ServingServer` replicas, each
+running its own `GenerationEngine` (own page pool, own prefix cache).
+The reference scaled serving by handing every process its own
+AnalysisPredictor behind an external L4 balancer (SURVEY §4c) — blind
+round-robin, so two requests sharing a 4k-token system prompt land on
+different predictors and BOTH pay the full prefill.  This router makes
+the placement decision cache-topology-aware:
+
+  prefix_hit       the prompt's page-aligned prefix (the exact region
+                   `prefix_cache.shareable_pages` would share) hashes to
+                   an affinity entry — route to the replica whose prefix
+                   cache already owns those KV pages, so the replica-side
+                   lookup hits and prefill skips the shared pages
+  least_loaded     no affinity yet — route to the replica with the
+                   fewest router-side inflight requests, then remember
+                   the prefix → replica binding for the next caller
+  health_failover  the affinity replica is dead (>= `dead_after`
+                   consecutive /healthz probe failures) — re-route to
+                   the least-loaded live replica and REBIND the prefix
+                   (its pages are gone with the replica; stickiness to a
+                   corpse would re-miss forever)
+
+Backpressure is not death: a replica answering 429 (generation queue
+full) is healthy-but-loaded.  The router counts it
+(`paddle_router_backpressure_total{replica}`), retries the request on
+the remaining live replicas, and does NOT touch the health-probe
+failure count — a replica must never flap out of the fleet just for
+being busy (the flap would dump its whole prefix-cache working set).
+
+Tracing: the incoming W3C `traceparent` (or a fresh head-sampled root)
+becomes a `router.generate` child span whose context is forwarded to
+the replica, so `/debug/spans?trace_id=` shows client → router →
+replica server.generate → gen.prefill/gen.decode as ONE trace across
+the hop.
+
+`/metrics` federation: the router serves its own `RouterMetrics`
+registry (co-exposable in-process via
+`MonitorServer(extra_registries=[router.metrics.registry])`) followed by
+every live replica's scrape under a `# replica=<name> <url>` banner —
+one curl shows fleet routing counters AND per-replica genserve gauges.
+
+Shutdown mirrors the server's latch-drain contract: SIGTERM stops new
+admissions (healthz flips to draining), inflight proxied requests
+finish, then the listener closes and "router drain clean" is logged
+(tools/serve_smoke.sh greps it, then SIGTERMs the replicas).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..distributed.resilience import PreemptionGuard
+from ..framework import flags as _flags
+from ..monitor import tracing as _tracing
+from .metrics import RouterMetrics
+
+logger = logging.getLogger("paddle_tpu.serving.router")
+
+__all__ = ["FleetRouter", "Replica"]
+
+
+class _HTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    request_queue_size = 128
+
+
+class Replica:
+    """Router-side view of one generation server: health-probe state +
+    inflight accounting.  All mutation happens under the router lock."""
+
+    def __init__(self, name: str, url: str):
+        self.name = name
+        self.url = url.rstrip("/")
+        self.inflight = 0
+        self.fails = 0          # consecutive /healthz probe failures
+        self.alive = True       # optimistic until probes say otherwise
+        self.draining = False
+
+    def snapshot(self) -> dict:
+        return {"name": self.name, "url": self.url,
+                "alive": self.alive, "draining": self.draining,
+                "inflight": self.inflight, "probe_fails": self.fails}
+
+
+class _Handler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def _send(self, code: int, body: bytes, ctype="application/json"):
+        self.send_response(code)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        if code in (429, 503):
+            self.send_header("Retry-After", "1")
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj):
+        self._send(code, json.dumps(obj).encode())
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        router = self.server.owner
+        if self.path == "/healthz":
+            body = {"status": "draining" if router.draining else "ok",
+                    "replicas": [r.snapshot() for r in router.replicas],
+                    "uptime_s": router.uptime_s}
+            self._send_json(503 if router.draining else 200, body)
+        elif self.path == "/metrics":
+            self._send(200, router.federated_metrics().encode(),
+                       ctype="text/plain; version=0.0.4")
+        else:
+            self._send_json(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        router = self.server.owner
+        n = int(self.headers.get("Content-Length", 0))
+        raw = self.rfile.read(n)
+        if self.path != "/generate":
+            self._send_json(404, {"error": f"no route {self.path}"})
+            return
+        if router.draining:
+            self._send_json(503, {"error": "router draining"})
+            return
+        tracer = _tracing.default_tracer()
+        span = tracer.start_span("router.generate",
+                                 traceparent=self.headers.get("traceparent"))
+        try:
+            router._route_generate(self, raw, span)
+        finally:
+            span.end()
+
+    def log_message(self, fmt, *args):  # route through logging, not stderr
+        logger.debug("%s - %s", self.address_string(), fmt % args)
+
+
+class FleetRouter:
+    """N generation replicas behind one endpoint with prefix-affinity,
+    least-loaded fallback, health failover, and SSE pass-through."""
+
+    def __init__(self, replica_urls, host="127.0.0.1", port=0, *,
+                 page_size=None, probe_interval_s=None, dead_after=None,
+                 request_timeout_s=120.0, install_signal_handlers=True,
+                 drain_timeout_s=30.0):
+        if not replica_urls:
+            raise ValueError("FleetRouter needs at least one replica url")
+        self.replicas = [Replica(f"r{i}", u)
+                         for i, u in enumerate(replica_urls)]
+        self.page_size = int(
+            page_size or _flags.flag("FLAGS_genserve_page_size", 16))
+        self.probe_interval_s = float(
+            probe_interval_s
+            or _flags.flag("FLAGS_router_probe_interval_s", 0.5))
+        self.dead_after = int(
+            dead_after or _flags.flag("FLAGS_router_dead_after", 3))
+        self.request_timeout_s = float(request_timeout_s)
+        self.drain_timeout_s = float(drain_timeout_s)
+        self._install_signals = install_signal_handlers
+        self._host = host
+        self._requested_port = int(port)
+        self.metrics = RouterMetrics()
+        self._lock = threading.RLock()
+        self._affinity: dict[str, int] = {}   # prefix hash -> replica idx
+        self._httpd = None
+        self._guard = None
+        self._threads = []
+        self._done = threading.Event()
+        self._stop_probe = threading.Event()
+        self._drain_clean = None
+        self._shutdown_once = threading.Lock()
+        self._started_at = None
+        self.draining = False
+
+    # -- lifecycle ---------------------------------------------------------
+    @property
+    def uptime_s(self) -> float:
+        return round(time.monotonic() - self._started_at, 1) \
+            if self._started_at is not None else 0.0
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1] if self._httpd \
+            else self._requested_port
+
+    @property
+    def url(self) -> str:
+        return f"http://{self._host}:{self.port}"
+
+    def start(self) -> "FleetRouter":
+        self._probe_all()  # synchronous first pass: route correctly from
+        self._httpd = _HTTPServer((self._host, self._requested_port),
+                                  _Handler)  # request #1, not probe #2
+        self._httpd.owner = self
+        self._started_at = time.monotonic()
+        if self._install_signals:
+            self._guard = PreemptionGuard()
+            self._guard.__enter__()
+        t_serve = threading.Thread(target=self._httpd.serve_forever,
+                                   kwargs={"poll_interval": 0.05},
+                                   daemon=True, name="paddle-router-http")
+        t_probe = threading.Thread(target=self._probe_loop, daemon=True,
+                                   name="paddle-router-probe")
+        t_watch = threading.Thread(target=self._watch, daemon=True,
+                                   name="paddle-router-sigwatch")
+        self._threads = [t_serve, t_probe, t_watch]
+        for t in self._threads:
+            t.start()
+        logger.info("router on %s over %d replicas (%s)", self.url,
+                    len(self.replicas),
+                    ", ".join(r.url for r in self.replicas))
+        return self
+
+    def _watch(self):
+        while not self._done.wait(0.05):
+            if self._guard is not None and self._guard.preempted:
+                logger.warning("signal %s latched — draining router",
+                               self._guard.signum)
+                self.shutdown()
+                return
+
+    def shutdown(self) -> bool:
+        """Drain: reject new admissions, let inflight proxied requests
+        finish, close the listener.  Idempotent; True = clean."""
+        with self._shutdown_once:
+            if self._drain_clean is not None:
+                return self._drain_clean
+            self.draining = True
+            self._stop_probe.set()
+            deadline = time.monotonic() + self.drain_timeout_s
+            while time.monotonic() < deadline:
+                with self._lock:
+                    if all(r.inflight == 0 for r in self.replicas):
+                        break
+                time.sleep(0.02)
+            with self._lock:
+                clean = all(r.inflight == 0 for r in self.replicas)
+            if self._httpd is not None:
+                self._httpd.shutdown()
+                self._httpd.server_close()
+            if self._guard is not None:
+                self._guard.__exit__(None, None, None)
+                self._guard = None
+            self._drain_clean = clean
+            self._done.set()
+            logger.info("router drain %s", "clean" if clean else "TIMED OUT")
+            return clean
+
+    def wait(self, timeout=None) -> int:
+        if not self._done.wait(timeout):
+            return -1
+        for t in self._threads:
+            t.join(5.0)
+        return 0 if self._drain_clean else 1
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- health probing ----------------------------------------------------
+    def _probe_one(self, rep: Replica):
+        try:
+            req = urllib.request.Request(rep.url + "/healthz")
+            with urllib.request.urlopen(req, timeout=2.0) as resp:
+                ok = resp.status == 200
+                rep.draining = False
+        except urllib.error.HTTPError as e:
+            # 503 healthz = replica draining: stop routing to it, but it
+            # is answering — not a crash
+            ok = False
+            rep.draining = (e.code == 503)
+        except OSError:
+            ok = False
+            rep.draining = False
+        with self._lock:
+            if ok:
+                rep.fails = 0
+                rep.alive = True
+            else:
+                rep.fails += 1
+                if rep.fails >= self.dead_after or rep.draining:
+                    rep.alive = False
+
+    def _probe_all(self):
+        for rep in self.replicas:
+            self._probe_one(rep)
+        with self._lock:
+            self.metrics.set_healthy(
+                sum(1 for r in self.replicas if r.alive))
+
+    def _probe_loop(self):
+        while not self._stop_probe.wait(self.probe_interval_s):
+            self._probe_all()
+
+    # -- routing policy ----------------------------------------------------
+    def _prefix_key(self, prompt) -> str | None:
+        """Hash of the page-aligned shareable prefix — EXACTLY the
+        region the replica's PrefixCache would share
+        (`shareable_pages`: the last page is never shared because the
+        next generated token writes into it)."""
+        n_pages = max(0, (len(prompt) - 1) // self.page_size)
+        if n_pages == 0:
+            return None
+        head = prompt[:n_pages * self.page_size]
+        return hashlib.sha1(
+            b",".join(b"%d" % int(t) for t in head)).hexdigest()
+
+    def _pick(self, key, exclude=()):
+        """(replica, reason) under the routing policy; None when no live
+        replica remains.  `exclude`: replicas already tried this request
+        (429 backpressure retries)."""
+        with self._lock:
+            live = [r for r in self.replicas
+                    if r.alive and r.name not in exclude]
+            if not live:
+                return None, None
+            if key is not None:
+                idx = self._affinity.get(key)
+                if idx is not None:
+                    aff = self.replicas[idx]
+                    if aff.alive and aff.name not in exclude:
+                        return aff, "prefix_hit"
+                    # affinity points at a dead/busy replica: rebind
+                    reason = "health_failover" if not aff.alive \
+                        else "least_loaded"
+                else:
+                    reason = "least_loaded"
+            else:
+                reason = "least_loaded"
+            rep = min(live, key=lambda r: (r.inflight, r.name))
+            if key is not None:
+                self._affinity[key] = self.replicas.index(rep)
+            return rep, reason
+
+    # -- proxying ----------------------------------------------------------
+    def _route_generate(self, handler, raw, span):
+        try:
+            payload = json.loads(raw or b"{}")
+            prompt = payload.get("prompt") or []
+            stream = bool(payload.get("stream", False))
+        except ValueError:
+            handler._send_json(400, {"error": "bad request: invalid JSON"})
+            return
+        key = self._prefix_key(prompt)
+        tried: set[str] = set()
+        while True:
+            rep, reason = self._pick(key, exclude=tried)
+            if rep is None:
+                if tried:   # every live replica answered 429
+                    span.set_attr("status", "backpressure_exhausted")
+                    handler._send_json(
+                        429, {"error": "all replicas at capacity"})
+                else:
+                    span.set_attr("status", "no_live_replica")
+                    handler._send_json(
+                        503, {"error": "no live replica"})
+                return
+            tried.add(rep.name)
+            status = self._proxy_once(handler, rep, reason, raw, stream,
+                                      span)
+            if status == 429:
+                # backpressure: count it, try the next live replica —
+                # and DO NOT touch rep.fails (a busy replica is healthy)
+                self.metrics.count_backpressure(rep.name)
+                continue
+            return
+
+    def _proxy_once(self, handler, rep, reason, raw, stream, span):
+        """Forward one request to `rep`.  Returns the upstream HTTP
+        status (429 lets the caller retry elsewhere; anything else has
+        already been relayed to the client)."""
+        span.set_attr("replica", rep.name)
+        span.set_attr("reason", reason)
+        headers = {"Content-Type": "application/json",
+                   "traceparent": span.traceparent}
+        req = urllib.request.Request(rep.url + "/generate", data=raw,
+                                     headers=headers, method="POST")
+        with self._lock:
+            rep.inflight += 1
+        self.metrics.add_inflight(1)
+        try:
+            try:
+                resp = urllib.request.urlopen(
+                    req, timeout=self.request_timeout_s)
+            except urllib.error.HTTPError as e:
+                body = e.read()
+                if e.code == 429:
+                    return 429
+                handler._send(e.code, body,
+                              e.headers.get("Content-Type",
+                                            "application/json"))
+                return e.code
+            except OSError as e:
+                # transport failure mid-request: surface as 502; the
+                # probe loop decides whether the replica is dead
+                handler._send_json(
+                    502, {"error": f"replica {rep.name} unreachable: {e}"})
+                return 502
+            self.metrics.count_routed(rep.name, reason)
+            with resp:
+                if stream and resp.status == 200:
+                    self._relay_sse(handler, resp)
+                else:
+                    body = resp.read()
+                    handler._send(resp.status, body,
+                                  resp.headers.get("Content-Type",
+                                                   "application/json"))
+            return resp.status
+        finally:
+            with self._lock:
+                rep.inflight -= 1
+            self.metrics.add_inflight(-1)
+
+    def _relay_sse(self, handler, resp):
+        """Re-frame the replica's SSE stream onto the client connection
+        as it arrives (urllib undoes the upstream chunked framing; we
+        re-chunk) — the router adds no buffering to inter-token
+        latency."""
+        handler.send_response(200)
+        handler.send_header("Content-Type", "text/event-stream")
+        handler.send_header("Cache-Control", "no-cache")
+        handler.send_header("Transfer-Encoding", "chunked")
+        handler.send_header("Connection", "close")
+        handler.end_headers()
+        handler.close_connection = True
+        try:
+            for line in resp:
+                if not line.strip():
+                    continue
+                data = line if line.endswith(b"\n") else line + b"\n"
+                data += b"\n"   # restore the SSE event separator
+                handler.wfile.write(b"%X\r\n" % len(data) + data + b"\r\n")
+                handler.wfile.flush()
+            handler.wfile.write(b"0\r\n\r\n")
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; upstream closes via `with resp`
+
+    # -- metrics federation ------------------------------------------------
+    def federated_metrics(self) -> str:
+        """Router registry + every live replica's /metrics scrape, each
+        replica section under a `# replica=<name> <url>` banner."""
+        parts = [self.metrics.prometheus_text()]
+        for rep in self.replicas:
+            if not rep.alive:
+                parts.append(f"# replica={rep.name} {rep.url} DEAD\n")
+                continue
+            try:
+                with urllib.request.urlopen(
+                        rep.url + "/metrics", timeout=2.0) as resp:
+                    parts.append(f"# replica={rep.name} {rep.url}\n"
+                                 + resp.read().decode())
+            except OSError:
+                parts.append(f"# replica={rep.name} {rep.url} SCRAPE "
+                             "FAILED\n")
+        return "".join(parts)
+
+
+def main(argv=None):
+    import argparse
+
+    parser = argparse.ArgumentParser(
+        description="paddle_tpu generation fleet router (prefix-affinity "
+                    "+ least-loaded + health failover over N replicas)")
+    parser.add_argument("--replicas", required=True,
+                        help="comma-separated replica base urls, e.g. "
+                             "http://127.0.0.1:8870,http://127.0.0.1:8871")
+    parser.add_argument("--host", default="127.0.0.1")
+    parser.add_argument("--port", type=int, default=0,
+                        help="0 picks a free port (printed on stdout)")
+    parser.add_argument("--page-size", type=int, default=None,
+                        help="replica KV page size (prefix hash "
+                             "alignment; must match the replicas)")
+    parser.add_argument("--probe-interval", type=float, default=None)
+    parser.add_argument("--dead-after", type=int, default=None)
+    args = parser.parse_args(argv)
+
+    logging.basicConfig(level=logging.INFO)
+    urls = [u.strip() for u in args.replicas.split(",") if u.strip()]
+    router = FleetRouter(urls, host=args.host, port=args.port,
+                         page_size=args.page_size,
+                         probe_interval_s=args.probe_interval,
+                         dead_after=args.dead_after).start()
+    # parse-friendly readiness line (tools/serve_smoke.sh greps it)
+    print(f"paddle_tpu.serving.router listening on {router.url}",
+          flush=True)
+    return router.wait()
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

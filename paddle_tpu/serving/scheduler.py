@@ -96,10 +96,27 @@ class SlotScheduler:
         self._reserved.pop(slot, None)
         return req
 
+    def prefilling(self) -> int:
+        """Occupied lanes still mid-chunked-prefill (request carries a
+        truthy ``prefilling``) — they hold a slot + full worst-case page
+        reservation but are not yet armed for decode."""
+        return sum(1 for req in self._occupants.values()
+                   if getattr(req, "prefilling", False))
+
     def sweep(self, now=None):
         """Occupied lanes whose request is cancelled or past deadline:
         [(slot, request, reason)].  The engine releases them on-device
-        and retires them here."""
+        and retires them here.
+
+        Mid-chunk prefills are swept EXACTLY like armed decode lanes:
+        a chunked prompt's already-written pages are private table
+        entries above the lane's ``pinned`` register (the shared-prefix
+        head), so the engine's release executable returns every one of
+        them to the free stack the moment the sweep fires — a cancelled
+        32k-token prefill must not strand half its pages until some
+        later decode notices.  tests/test_spec_decode.py pins this with
+        a pool-occupancy tripwire (cancel mid-chunk, assert free_count
+        returns to baseline)."""
         now = time.monotonic() if now is None else now
         out = []
         for slot, req in self._occupants.items():

@@ -59,6 +59,10 @@ def pytest_configure(config):
         "markers", "pod: multi-process pod test (N real OS processes via "
         "distributed.podtest — coordinated jax.distributed bring-up or "
         "the elastic shrink supervisor) — run via tools/pod_smoke.sh")
+    config.addinivalue_line(
+        "markers", "specdec: speculative decode / chunked prefill / fleet "
+        "router test (serving.generation draft path, serving.router) — "
+        "run via tools/serve_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

@@ -20,11 +20,27 @@ fi
 export JAX_PLATFORMS=cpu
 WORK="$(mktemp -d /tmp/paddle_serve_smoke.XXXXXX)"
 SERVER_PID=""
+R0_PID=""
+R1_PID=""
+ROUTER_PID=""
 cleanup() {
-    [ -n "$SERVER_PID" ] && kill -9 "$SERVER_PID" 2>/dev/null || true
+    for pid in "$SERVER_PID" "$ROUTER_PID" "$R0_PID" "$R1_PID"; do
+        [ -n "$pid" ] && kill -9 "$pid" 2>/dev/null || true
+    done
     rm -rf "$WORK"
 }
 trap cleanup EXIT
+
+wait_url() {  # $1=logfile $2=pid -> echoes url once the readiness line lands
+    local url=""
+    for _ in $(seq 1 600); do
+        url=$(sed -n 's/.*listening on \(http[^ ]*\).*/\1/p' "$1" | head -1)
+        [ -n "$url" ] && { echo "$url"; return 0; }
+        kill -0 "$2" 2>/dev/null || return 1
+        sleep 0.1
+    done
+    return 1
+}
 
 echo "[serve_smoke] exporting model..."
 python - "$WORK" <<'EOF'
@@ -282,5 +298,120 @@ grep -q "serving drain clean" "$WORK/pagedserver.log" \
          cat "$WORK/pagedserver.log"; exit 1; }
 echo "[serve_smoke] paged clean drain OK"
 
-exec python -m pytest tests/ -q -m "serving or genserve" \
+# ---- fleet router section ---------------------------------------------
+# two SPECULATIVE replicas (1-layer derived draft, K=3) behind the
+# prefix-aware router: a shared-prefix burst must ride the affinity
+# table onto ONE replica (routed prefix_hit ratio at least as good as a
+# single replica's own cache ratio), then the router drains clean
+# before its replicas do
+echo "[serve_smoke] starting 2 replica generation servers..."
+python -m paddle_tpu.serving.generation --port 0 --slots 2 \
+    --prompt-buckets 8,16 --max-seq-len 48 --page-size 4 --num-pages 40 \
+    --prefix-cache 1 --draft-layers 1 --spec-tokens 3 \
+    > "$WORK/replica0.log" 2>&1 &
+R0_PID=$!
+python -m paddle_tpu.serving.generation --port 0 --slots 2 \
+    --prompt-buckets 8,16 --max-seq-len 48 --page-size 4 --num-pages 40 \
+    --prefix-cache 1 --draft-layers 1 --spec-tokens 3 \
+    > "$WORK/replica1.log" 2>&1 &
+R1_PID=$!
+R0_URL=$(wait_url "$WORK/replica0.log" "$R0_PID") \
+    || { echo "replica0 never came up"; cat "$WORK/replica0.log"; exit 1; }
+R1_URL=$(wait_url "$WORK/replica1.log" "$R1_PID") \
+    || { echo "replica1 never came up"; cat "$WORK/replica1.log"; exit 1; }
+echo "[serve_smoke] replicas up at $R0_URL $R1_URL"
+
+echo "[serve_smoke] starting fleet router..."
+python -m paddle_tpu.serving.router --replicas "$R0_URL,$R1_URL" \
+    --port 0 --page-size 4 --probe-interval 0.2 \
+    > "$WORK/router.log" 2>&1 &
+ROUTER_PID=$!
+RURL=$(wait_url "$WORK/router.log" "$ROUTER_PID") \
+    || { echo "router never came up"; cat "$WORK/router.log"; exit 1; }
+echo "[serve_smoke] router up at $RURL"
+
+echo "[serve_smoke] firing shared-prefix burst through the router..."
+python -m paddle_tpu.serving.client --url "$RURL" --mode generate \
+    --requests 12 --concurrency 4 --prompt-len 12 --shared-prefix-len 8 \
+    --max-new 10 --vocab 200
+
+echo "[serve_smoke] scraping router /metrics (federated)..."
+python - "$RURL" <<'EOF'
+import re
+import sys
+import urllib.request
+
+text = urllib.request.urlopen(sys.argv[1] + "/metrics",
+                              timeout=10).read().decode()
+needed = ["paddle_router_requests_total", "paddle_router_replicas_healthy",
+          "# replica=r0", "# replica=r1",
+          "paddle_genserve_spec_accept_ratio"]
+missing = [n for n in needed if n not in text]
+assert not missing, f"missing from federated metrics: {missing}"
+
+
+def value(name, section):
+    line = [l for l in section.splitlines()
+            if l.startswith(name + " ")][0]
+    return float(line.split()[1])
+
+
+healthy = value("paddle_router_replicas_healthy",
+                text.split("# replica=")[0])
+assert healthy == 2, f"want 2 healthy replicas, got {healthy}"
+
+routed = {}  # (replica, reason) -> count
+for m in re.finditer(r'paddle_router_requests_total\{replica="([^"]+)",'
+                     r'reason="([^"]+)"\} (\d+)', text):
+    routed[(m.group(1), m.group(2))] = int(m.group(3))
+total = sum(routed.values())
+hit_owners = {r for (r, reason) in routed if reason == "prefix_hit"}
+hits = sum(n for (r, reason), n in routed.items()
+           if reason == "prefix_hit")
+assert total == 12, f"want 12 routed requests, got {total}: {routed}"
+assert len(hit_owners) == 1, \
+    f"shared prefix must bind ONE replica, got {hit_owners}: {routed}"
+assert hits >= 8, f"too few prefix_hit routes: {routed}"
+
+# routed hit-ratio must be at least the owning replica's own cache
+# ratio: affinity loses nothing vs pinning every request to one box
+owner = hit_owners.pop()
+section = [s for s in text.split("# replica=") if s.startswith(owner)][0]
+replica_ratio = value("paddle_genserve_prefix_cache_hit_ratio", section)
+router_ratio = hits / total
+assert router_ratio + 1e-3 >= replica_ratio, \
+    f"router hit-ratio {router_ratio} < replica's own {replica_ratio}"
+print(f"router metrics OK: routed={routed} router_hit_ratio="
+      f"{router_ratio:.3f} {owner}_cache_ratio={replica_ratio:g}")
+EOF
+
+echo "[serve_smoke] SIGTERM -> router drain, then replicas..."
+kill -TERM "$ROUTER_PID"
+rc=0
+wait "$ROUTER_PID" || rc=$?
+ROUTER_PID=""
+if [ "$rc" -ne 0 ]; then
+    echo "[serve_smoke] router exit code $rc (want 0 = clean drain)"
+    cat "$WORK/router.log"
+    exit 1
+fi
+grep -q "router drain clean" "$WORK/router.log" \
+    || { echo "no clean-drain marker in router log"; \
+         cat "$WORK/router.log"; exit 1; }
+for pid_var in R0_PID R1_PID; do
+    pid=${!pid_var}
+    kill -TERM "$pid"
+    rc=0
+    wait "$pid" || rc=$?
+    eval "$pid_var=''"
+    [ "$rc" -eq 0 ] || { echo "replica $pid_var exit code $rc (want 0)"; \
+                         exit 1; }
+done
+grep -q "serving drain clean" "$WORK/replica0.log" \
+    || { echo "no clean-drain marker in replica0 log"; exit 1; }
+grep -q "serving drain clean" "$WORK/replica1.log" \
+    || { echo "no clean-drain marker in replica1 log"; exit 1; }
+echo "[serve_smoke] router + replica clean drain OK"
+
+exec python -m pytest tests/ -q -m "serving or genserve or specdec" \
     -p no:cacheprovider -p no:randomly "$@"
