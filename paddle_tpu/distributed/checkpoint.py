@@ -243,6 +243,25 @@ def _write_generation(final_dir: str, state, meta=None, step=None):
     marker → fsync.  Returns the manifest dict."""
     from ..utils import chaos
 
+    if meta:
+        # the manifest is one json.dumps at the END of the write — an
+        # unserializable meta entry (a stray array in a vocab state
+        # dict, say) would otherwise surface as an opaque TypeError
+        # after every leaf's bytes were already written and fsynced
+        try:
+            json.dumps(meta)
+        except (TypeError, ValueError) as e:
+            bad = []
+            for k, v in meta.items():
+                try:
+                    json.dumps(v)
+                except (TypeError, ValueError):
+                    bad.append(k)
+            raise ValueError(
+                f"checkpoint meta keys {bad} are not JSON-serializable "
+                f"({e}) — manifest meta carries small JSON state only "
+                "(mesh geometry, lr schedules, sparse vocab maps); "
+                "arrays belong in the state tree") from None
     parent = os.path.dirname(final_dir) or "."
     os.makedirs(parent, exist_ok=True)
     tmp = os.path.join(parent,

@@ -186,9 +186,12 @@ def _seg(*names):
 
 
 # Transformer weight roles, resolved by name pattern on 2-D params.
-# Checked in order; first match wins.
+# Checked in order; first match wins.  "lookup_table"/"sparse_table" are
+# the reference parameter-server names for embedding weights — the
+# sparse.ShardedEmbeddingTable row-shards through this same rule.
 _EMBED = _seg("wte", "wpe", r"emb\w*", "embedding", "embeddings", "word",
-              "position", "pos_emb", "tok_emb", "token_type", "lm_head")
+              "position", "pos_emb", "tok_emb", "token_type", "lm_head",
+              "lookup_table", "sparse_table")
 _DOWN = _seg("out", "out_proj", "o_proj", "fc2", "linear2", "down_proj",
              "w2", "wo", "proj_out")
 _UP = _seg("qkv", "q_proj", "k_proj", "v_proj", "query", "key", "value",
@@ -227,6 +230,12 @@ class SpecLayout:
     # -- table lookups ------------------------------------------------------
     def embeddings(self) -> P:
         return P((self.fsdp_axis, self.tp_axis), None)
+
+    def sparse_table(self) -> P:
+        """Alias of `embeddings` for `sparse.ShardedEmbeddingTable`:
+        vocab rows split over the combined fsdp×tp device group — the
+        placement that lets vocab×dim exceed one device's HBM."""
+        return self.embeddings()
 
     def qkv_projection(self) -> P:
         return P(self.fsdp_axis, self.tp_axis)

@@ -102,6 +102,14 @@ define_flag("FLAGS_genserve_prefill_chunk", 0,
             "<= largest prompt bucket); prompts whose un-shared suffix "
             "exceeds it prefill one chunk per decode iteration instead of "
             "stalling every lane; 0 disables chunking")
+# -- sparse / recommender (paddle_tpu.sparse) ------------------------------
+define_flag("FLAGS_sparse_admission_threshold", 2,
+            "minimum count-min-estimated id frequency (inclusive) before "
+            "an id earns a dedicated embedding row; below it ids share "
+            "the OOV row")
+define_flag("FLAGS_sparse_evict_after", 0,
+            "batches an id may go unseen before VocabAdmission.evict() "
+            "recycles its row; 0 disables eviction")
 # -- fleet router (paddle_tpu.serving.router) ------------------------------
 define_flag("FLAGS_router_probe_interval_s", 0.5,
             "seconds between router health probes of each replica's "

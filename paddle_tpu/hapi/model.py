@@ -162,6 +162,20 @@ class Model:
         return outs, lv
 
     # -- fault tolerance ---------------------------------------------------
+    def _vocab_layers(self):
+        """(path, layer) pairs carrying checkpointable sparse-vocab
+        state (duck-typed: `sparse.ShardedEmbeddingTable` with an
+        admission policy attached).  The id→row mapping is host-side
+        Python state the array checkpoint cannot see — it rides the
+        manifest meta beside the table leaf so resume keeps it."""
+        out = []
+        for name, sub in self.network.named_sublayers(include_self=True):
+            if callable(getattr(sub, "vocab_state_dict", None)) \
+                    and callable(getattr(sub, "load_vocab_state_dict",
+                                         None)):
+                out.append((name or "<root>", sub))
+        return out
+
     def _ft_state(self, it_count):
         """Checkpointable training state: trainable params + buffers +
         optimizer slots + loop counters, as one pytree of arrays.  When
@@ -262,6 +276,16 @@ class Model:
             # (best/num_bad_epochs/last_lr) that a bare epoch counter
             # cannot reconstruct
             meta["lr_sched"] = sched.state_dict()
+        vocabs = {}
+        for name, sub in self._vocab_layers():
+            state = sub.vocab_state_dict()
+            if state:
+                vocabs[name] = state
+        if vocabs:
+            # sparse admission vocabs: the id→row mapping (JSON) rides
+            # beside the sharded table leaf, so an elastic resume maps
+            # incoming ids to the same rows the restored table trained
+            meta["sparse_vocab"] = vocabs
         if saver is not None and not sync:
             saver.submit(it_count, self._ft_state(it_count), force=force,
                          meta=meta)
@@ -372,6 +396,12 @@ class Model:
             # serves from cache; assignment alone would train at the
             # fresh-init lr until the next scheduler step
             sched.step(epoch=int(back["meta"]["lr_last_epoch"]))
+        vocabs = (man.get("meta") or {}).get("sparse_vocab") or {}
+        if vocabs:
+            for name, sub in self._vocab_layers():
+                state = vocabs.get(name)
+                if state:
+                    sub.load_vocab_state_dict(state)
         restart = os.environ.get("PADDLE_RESTART_COUNT", "0")
         saved_mesh = (man.get("meta") or {}).get("mesh") or {}
         saved_dp = saved_mesh.get("dp")

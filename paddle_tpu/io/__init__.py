@@ -311,6 +311,39 @@ def _worker_loop(dataset, collate_fn, index_queue, result_queue, worker_id,
                                                 traceback.format_exc())))
 
 
+def pad_ragged(seqs, buckets=None, pad_value=0, dtype=np.int64,
+               truncate="tail"):
+    """Ragged per-sample sequences → one dense ``[B, L]`` array.
+
+    ``L`` is the smallest entry of ``buckets`` that fits the batch's
+    longest sequence (so a handful of XLA shapes serve every batch);
+    without buckets, the exact max length.  Sequences beyond the last
+    bucket are truncated — ``truncate="tail"`` keeps the last elements
+    (the recency convention for click logs), ``"head"`` the first.
+    Returns ``(dense, lengths)`` with post-truncation int32 lengths.
+    This is numpy-only on purpose: it runs inside collate_fn on the
+    DataLoader's prefetch thread.
+    """
+    cap = None
+    if buckets:
+        buckets = sorted(int(b) for b in buckets)
+        cap = buckets[-1]
+    lens = [len(s) if cap is None else min(len(s), cap) for s in seqs]
+    width = max(lens) if lens else 1
+    if buckets:
+        for b in buckets:
+            if width <= b:
+                width = b
+                break
+    out = np.full((len(seqs), max(width, 1)), pad_value, dtype)
+    for i, s in enumerate(seqs):
+        arr = np.asarray(s, dtype)
+        if lens[i] < len(arr):
+            arr = arr[-lens[i]:] if truncate == "tail" else arr[:lens[i]]
+        out[i, :lens[i]] = arr
+    return out, np.asarray(lens, np.int32)
+
+
 def default_collate_fn(batch):
     sample = batch[0]
     if isinstance(sample, (tuple, list)):

@@ -562,15 +562,26 @@ def buffer_census(owners=None, top: int = 64) -> dict:
             nb = int(arr.nbytes)
             key = (id2tag.get(id(arr), "activations"),
                    str(arr.dtype), tuple(arr.shape))
+            # the per-device cost of a GSPMD-sharded array is its
+            # largest local shard, not the logical nbytes — this is
+            # the number that proves a mesh-sharded table (or ZeRO
+            # param) fits where the full array would not
+            try:
+                shard_nb = max((int(s.data.nbytes)
+                                for s in arr.addressable_shards),
+                               default=nb)
+            except Exception:  # noqa: BLE001 - backend w/o shards API
+                shard_nb = nb
         except Exception:  # noqa: BLE001 - deleted mid-iteration
             continue
         b = buckets.get(key)
         if b is None:
             b = buckets[key] = {"tag": key[0], "dtype": key[1],
                                 "shape": list(key[2]),
-                                "count": 0, "bytes": 0}
+                                "count": 0, "bytes": 0, "shard_bytes": 0}
         b["count"] += 1
         b["bytes"] += nb
+        b["shard_bytes"] += shard_nb
         by_tag[key[0]] = by_tag.get(key[0], 0) + nb
         total += nb
         count += 1

@@ -334,3 +334,13 @@ class TreeConv(_FluidEraStub):
 
 
 from ..vision import ops as vision  # noqa: F401,E402  (paddle.nn.vision)
+
+
+def __getattr__(name):
+    # lazy: sparse imports nn (layer_base, initializer), so an eager
+    # import here would cycle.  nn.ShardedEmbeddingTable is the
+    # Embedding-compatible face of the sparse subsystem.
+    if name == "ShardedEmbeddingTable":
+        from ..sparse.table import ShardedEmbeddingTable
+        return ShardedEmbeddingTable
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

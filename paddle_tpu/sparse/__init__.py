@@ -1,0 +1,34 @@
+"""paddle_tpu.sparse — the TPU-native recommender/sparse workload.
+
+The reference Paddle served this workload with a parameter-server core
+(`PSServer`/`PSClient`, `CommonSparseTable`); here the same three jobs
+are mesh-native:
+
+* `table` — `ShardedEmbeddingTable` / `embedding_lookup`: the table is
+  row-sharded over the mesh via SpecLayout (`P(('fsdp','tp'), None)`),
+  lookup is an in-graph gather and the gradient a deduped scatter-add
+  inside the one donated jitted step (the PS pull/push round-trip,
+  deleted).
+* `vocab` — `VocabAdmission`: count-min frequency sketch + admission
+  threshold + cold-row eviction on the host input thread; state rides
+  the checkpoint manifest.
+* `stream` — ragged click-log batches → padded/bucketed dense batches
+  on the prefetch thread, pre-sharded via `shard_batch`.
+* `serve` — `SparseLookupPredictor` / `lookup_engine`: sharded lookup
+  behind the serving batcher, AOT-warmed per bucket.
+"""
+from .table import (ShardedEmbeddingTable, dedup_segments,  # noqa: F401
+                    embedding_lookup, table_spec)
+from .vocab import OOV_ROW, CountMinSketch, VocabAdmission  # noqa: F401
+from .stream import (ClickLogDataset, bucket_for,  # noqa: F401
+                     make_stream_loader, ragged_collate,
+                     synthetic_click_log)
+from .serve import SparseLookupPredictor, lookup_engine  # noqa: F401
+
+__all__ = [
+    "ShardedEmbeddingTable", "embedding_lookup", "dedup_segments",
+    "table_spec", "OOV_ROW", "CountMinSketch", "VocabAdmission",
+    "ClickLogDataset", "bucket_for", "make_stream_loader",
+    "ragged_collate", "synthetic_click_log", "SparseLookupPredictor",
+    "lookup_engine",
+]

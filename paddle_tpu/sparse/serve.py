@@ -1,0 +1,129 @@
+"""Serving path for sharded embedding lookup.
+
+`SparseLookupPredictor` wraps a (mesh-sharded) table behind the same
+duck-typed predictor contract the `serving.ServingEngine` batcher
+already speaks — ``.run(list) -> list`` plus ``compile_count`` and
+``_input_specs`` — so the whole serving stack (adaptive batching,
+bucket warmup, queue backpressure, /metrics) works on embedding lookups
+unchanged.  Each (batch × id-list-length) bucket is AOT-compiled via
+``jit(...).lower().compile()`` exactly once; steady-state lookups never
+compile, and per-call device latency feeds the
+``paddle_sparse_lookup_ms`` reservoir (p50/p99 in /metrics).
+"""
+import time
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..framework.transfer import host_fetch
+from ..utils.metrics import default_registry
+from .table import table_spec
+
+__all__ = ["SparseLookupPredictor", "lookup_engine"]
+
+
+def _pooled(table, ids):
+    """Mean-pool embedding rows per request; padded slots (row 0 under
+    an admission vocab) participate like any OOV click — serving has no
+    per-request length channel, and the shared row is trained."""
+    emb = jnp.take(table, ids, axis=0)
+    return jnp.mean(emb, axis=1)
+
+
+class SparseLookupPredictor:
+    """AOT-bucketed sharded-table lookup with the Predictor duck type.
+
+    Args:
+      table: ``[vocab, dim]`` array (numpy or jax).
+      mesh: optional Mesh; the table is placed ONCE, row-sharded on
+        ``spec`` (axes absent from the mesh are dropped), and every
+        lookup gathers from the sharded copy.
+      spec: row-sharding PartitionSpec, default ``P(('fsdp','tp'), None)``.
+      vocab: optional `VocabAdmission` — raw request ids are translated
+        through its read-only ``lookup_rows`` (unknown ids → OOV row).
+      pooled: return the mean-pooled ``[B, dim]`` vector per request
+        (the wide-and-deep serving half) instead of ``[B, L, dim]``.
+    """
+
+    def __init__(self, table, mesh=None, spec=None, vocab=None,
+                 pooled=True, registry=None):
+        spec = spec if spec is not None else table_spec()
+        arr = jnp.asarray(getattr(table, "value", table))
+        if mesh is not None:
+            axes = mesh.axis_names
+            kept = tuple(
+                tuple(a for a in e if a in axes) or None
+                if isinstance(e, tuple) else (e if e in axes else None)
+                for e in spec)
+            arr = jax.device_put(arr, NamedSharding(mesh, P(*kept)))
+        self._table = arr
+        self._mesh = mesh
+        self._vocab = vocab
+        self._pooled = pooled
+        self._cache = {}
+        self.compile_count = 0
+        # ServingEngine reads this for bucket warmup: one int32 input of
+        # [batch, id-list-length], both dims dynamic (bucketed).
+        self._input_specs = [{"shape": (-1, -1), "dtype": "int32"}]
+        reg = registry or default_registry()
+        self._lookup_ms = reg.reservoir("paddle_sparse_lookup_ms")
+
+    def _compiled(self, shape):
+        fn = self._cache.get(shape)
+        if fn is None:
+            fun = _pooled if self._pooled \
+                else lambda t, i: jnp.take(t, i, axis=0)
+            tspec = jax.ShapeDtypeStruct(self._table.shape,
+                                         self._table.dtype,
+                                         sharding=self._table.sharding)
+            ispec = jax.ShapeDtypeStruct(shape, jnp.int32)
+            if self._mesh is not None:
+                ispec = jax.ShapeDtypeStruct(
+                    shape, jnp.int32,
+                    sharding=NamedSharding(self._mesh, P()))
+            fn = jax.jit(fun).lower(tspec, ispec).compile()
+            self._cache[shape] = fn
+            self.compile_count += 1
+        return fn
+
+    def run(self, args):
+        """[ids_batch] -> [embeddings]: the ServingEngine predictor
+        contract (one padded int32 ``[B, L]`` array in, one array out)."""
+        (ids,) = args
+        ids = np.asarray(ids, np.int32)
+        if self._vocab is not None:
+            ids = self._vocab.lookup_rows(ids).astype(np.int32)
+        fn = self._compiled(ids.shape)
+        t0 = time.perf_counter()
+        dev_ids = (jax.device_put(ids, NamedSharding(self._mesh, P()))
+                   if self._mesh is not None else jnp.asarray(ids))
+        out = fn(self._table, dev_ids)
+        with host_fetch():
+            # the latency a client sees includes materializing the
+            # result; blocking here also makes the reservoir honest
+            out.block_until_ready()
+        self._lookup_ms.observe((time.perf_counter() - t0) * 1e3)
+        return [out]
+
+
+def lookup_engine(table, mesh=None, vocab=None, pooled=True,
+                  max_batch_size=8, id_buckets=(4, 8, 16), **kw):
+    """A started-ready `serving.ServingEngine` over a sharded table.
+
+    Requests are single ``[L]`` int32 id lists; the batcher pads L to
+    ``id_buckets`` and the batch dim to its power-of-two buckets, all
+    AOT-warmed on ``start()`` so steady-state lookups never compile.
+    """
+    from ..serving.engine import BucketSpec, ServingEngine
+
+    predictor = SparseLookupPredictor(table, mesh=mesh, vocab=vocab,
+                                      pooled=pooled)
+    batches = [b for b in (1, 2, 4, 8, 16, 32, 64, 128)
+               if b <= max_batch_size] or [max_batch_size]
+    buckets = BucketSpec(batch_sizes=tuple(batches),
+                         seq_lens=tuple(sorted(id_buckets)))
+    return ServingEngine(predictor, max_batch_size=max_batch_size,
+                         buckets=buckets, seq_axis=0, **kw)

@@ -1,0 +1,122 @@
+"""Streaming recommender data plane: click logs → dense sharded batches.
+
+A click-log sample is ragged — ``(user_id, [item_id, ...], label)`` with
+a per-sample item-list length — and the jitted step wants fixed shapes.
+This module turns the former into the latter ON THE PREFETCH THREAD,
+riding the existing `io.DataLoader` seams end to end:
+
+* `ragged_collate(...)` pads each batch's item lists to the smallest
+  configured length bucket (a handful of XLA shapes, not one per batch)
+  and runs vocab admission (`VocabAdmission.map_ids`) on the raw ids —
+  both execute inside `DataLoader._produce`, i.e. on the prefetch
+  thread, overlapped with device compute.
+* `make_stream_loader(...)` wires the collate into a buffered
+  `DataLoader` and installs `framework.transfer.shard_batch` as the
+  placement hook, so every batch lands pre-sharded on the mesh's batch
+  axes.  The loader's bounded prefetch queue IS the backpressure: a
+  slow consumer blocks the producer after `prefetch_factor` batches.
+
+`synthetic_click_log` generates a seeded Zipf-ish stream for tests,
+benches, and the wide-and-deep example.
+"""
+from functools import partial
+
+import numpy as np
+
+from ..framework.transfer import shard_batch
+from ..io import DataLoader, IterableDataset, pad_ragged
+
+__all__ = ["synthetic_click_log", "ClickLogDataset", "bucket_for",
+           "ragged_collate", "make_stream_loader"]
+
+
+def synthetic_click_log(num_events, num_users=10000, num_items=50000,
+                        max_items=12, seed=0):
+    """Seeded synthetic click-log reader-creator.
+
+    Returns a zero-arg callable yielding ``(user_id, item_ids, label)``
+    — the same creator convention as `dataset.movielens`.  Item ids are
+    Zipf-distributed so a head of hot ids exists for the admission
+    policy to find; the label is a noisy function of user/item parity so
+    a model can actually learn it.
+    """
+    def reader():
+        rs = np.random.RandomState(seed)
+        for _ in range(num_events):
+            user = int(rs.randint(0, num_users))
+            n = int(rs.randint(1, max_items + 1))
+            items = np.minimum(rs.zipf(1.3, size=n), num_items - 1) \
+                .astype(np.int64)
+            signal = (user + int(items.sum())) % 2
+            label = signal if rs.rand() > 0.1 else 1 - signal
+            yield user, items.tolist(), float(label)
+    return reader
+
+
+class ClickLogDataset(IterableDataset):
+    """IterableDataset over a reader creator (re-iterable per epoch)."""
+
+    def __init__(self, reader_creator):
+        self._creator = reader_creator
+
+    def __iter__(self):
+        return iter(self._creator())
+
+
+def bucket_for(n, buckets):
+    """Smallest bucket >= n (the last bucket caps — longer lists are
+    truncated to it, keeping the most recent items)."""
+    for b in buckets:
+        if n <= b:
+            return b
+    return buckets[-1]
+
+
+def ragged_collate(batch, user_vocab=None, item_vocab=None,
+                   buckets=(4, 8, 16), pad_value=0):
+    """Collate ``(user_id, item_ids, label)`` samples into dense arrays.
+
+    Returns ``(users [B], items [B, L], lengths [B], labels [B, 1])``
+    with ``L`` the batch's length bucket.  Padded item slots carry
+    ``pad_value`` (row 0 — the OOV row — under an admission vocab, so
+    padding gathers the shared row and the mask, not the table layout,
+    defines semantics).  Vocab admission runs here, on whichever thread
+    drives the loader's producer generator — the prefetch thread.
+    """
+    users = np.asarray([s[0] for s in batch], np.int64)
+    labels = np.asarray([s[2] for s in batch],
+                        np.float32).reshape(-1, 1)
+    items, lens = pad_ragged([s[1] for s in batch], buckets=buckets,
+                             pad_value=pad_value)
+    if user_vocab is not None:
+        users = user_vocab.map_ids(users)
+    if item_vocab is not None:
+        items = item_vocab.map_ids(items)
+    return (users.astype(np.int32), items.astype(np.int32),
+            np.asarray(lens, np.int32), labels)
+
+
+def make_stream_loader(reader_creator, batch_size, user_vocab=None,
+                       item_vocab=None, buckets=(4, 8, 16), pad_value=0,
+                       mesh=None, batch_axis="dp", drop_last=True,
+                       prefetch_factor=2):
+    """Buffered DataLoader over a click-log reader creator.
+
+    With ``mesh=`` the placement hook pre-shards every batch over
+    ``batch_axis`` (an axis name or tuple, e.g.
+    ``SpecLayout.batch_axes(mesh)``) via `shard_batch` — on the prefetch
+    thread, overlapping the device_put with compute.  The bounded
+    prefetch queue (``prefetch_factor`` batches) is the backpressure
+    between the log reader and the training step.
+    """
+    loader = DataLoader(
+        ClickLogDataset(reader_creator), batch_size=batch_size,
+        drop_last=drop_last,
+        collate_fn=partial(ragged_collate, user_vocab=user_vocab,
+                           item_vocab=item_vocab, buckets=buckets,
+                           pad_value=pad_value),
+        prefetch_factor=prefetch_factor)
+    if mesh is not None:
+        loader.placement = partial(shard_batch, mesh=mesh,
+                                   axis=batch_axis)
+    return loader

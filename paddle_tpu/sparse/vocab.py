@@ -1,0 +1,238 @@
+"""Frequency-capped vocab with an admission policy.
+
+The raw id space of a recommender (user ids, item ids, crossed
+features) is unbounded; the device table is not.  `VocabAdmission` maps
+raw ids to table rows on the HOST INPUT THREAD (the DataLoader prefetch
+thread — never inside the jitted step):
+
+* a count-min sketch estimates per-id frequency without storing ids,
+* ids at/above the admission threshold get a dedicated row while free
+  rows last,
+* everything else shares the reserved OOV row 0,
+* an eviction pass recycles rows whose id has not been seen for a
+  configurable number of batches (cold rows), so the table tracks the
+  current head of the distribution.
+
+The whole policy is a deterministic function of the id stream (sketch
+hashing is seeded, admission order is stream order), so two runs over
+the same data produce the same id→row mapping — and the mapping is
+JSON-serializable (`state_dict`) so it rides the checkpoint manifest
+beside the table leaf and survives resume.
+
+Admission telemetry lands in the shared metrics registry:
+`paddle_sparse_admitted_total`, `paddle_sparse_oov_total`,
+`paddle_sparse_evicted_total`.
+"""
+import base64
+
+import numpy as np
+
+from ..framework import flags as _flags
+from ..utils.metrics import default_registry
+
+__all__ = ["CountMinSketch", "VocabAdmission", "OOV_ROW"]
+
+#: Row 0 of every admission-managed table is the shared out-of-vocab row.
+OOV_ROW = 0
+
+_PRIME_A = np.uint64(0x9E3779B97F4A7C15)   # splitmix64 odd constants
+_PRIME_B = np.uint64(0xBF58476D1CE4E5B9)
+
+
+class CountMinSketch:
+    """Fixed-memory frequency estimates over an unbounded id stream.
+
+    `depth` multiply-shift hash rows of `width` uint32 counters
+    (`width` rounded up to a power of two); estimates never
+    undercount, and overcount with probability that shrinks with
+    depth×width.  All ops are vectorized numpy — this runs per batch on
+    the input thread.
+    """
+
+    def __init__(self, width=8192, depth=4, seed=0):
+        self.width = 1 << int(np.ceil(np.log2(max(2, width))))
+        self.depth = int(depth)
+        self._shift = np.uint64(64 - int(np.log2(self.width)))
+        rs = np.random.RandomState(seed)
+        # odd 64-bit multipliers: multiply-shift needs odd a
+        self._a = (rs.randint(0, 2**63 - 1, size=self.depth)
+                   .astype(np.uint64) * np.uint64(2) + np.uint64(1))
+        self._b = rs.randint(0, 2**63 - 1, size=self.depth).astype(np.uint64)
+        self.counts = np.zeros((self.depth, self.width), np.uint32)
+
+    def _rows(self, ids):
+        x = np.asarray(ids, np.uint64) * _PRIME_A
+        x ^= x >> np.uint64(31)
+        x *= _PRIME_B
+        return [((x * self._a[r] + self._b[r]) >> self._shift)
+                .astype(np.int64) for r in range(self.depth)]
+
+    def add(self, ids):
+        for r, idx in enumerate(self._rows(ids)):
+            np.add.at(self.counts[r], idx, 1)
+
+    def estimate(self, ids):
+        """Per-id min-over-rows count estimate (uint32 array)."""
+        rows = self._rows(ids)
+        est = self.counts[0][rows[0]]
+        for r in range(1, self.depth):
+            est = np.minimum(est, self.counts[r][rows[r]])
+        return est
+
+    def state_dict(self):
+        return {"width": int(self.width), "depth": int(self.depth),
+                "counts": base64.b64encode(self.counts.tobytes()).decode()}
+
+    def load_state_dict(self, state):
+        if (int(state["width"]) != self.width
+                or int(state["depth"]) != self.depth):
+            raise ValueError(
+                "sketch geometry mismatch: checkpoint "
+                f"{state['depth']}x{state['width']} vs live "
+                f"{self.depth}x{self.width}")
+        self.counts = np.frombuffer(
+            base64.b64decode(state["counts"]), np.uint32).reshape(
+                self.depth, self.width).copy()
+
+
+class VocabAdmission:
+    """id→row mapping under a row budget, with frequency-gated admission.
+
+    Args:
+      capacity: total table rows, INCLUDING the reserved OOV row 0 —
+        pass the table's ``num_embeddings``.
+      threshold: minimum estimated frequency (inclusive) before an id
+        earns a dedicated row; 1 admits on first sight.
+      evict_after: batches an id may go unseen before `evict()` may
+        recycle its row (None disables eviction).
+      sketch_width / sketch_depth / seed: CountMinSketch geometry.
+    """
+
+    def __init__(self, capacity, threshold=None, evict_after=None,
+                 sketch_width=8192, sketch_depth=4, seed=0,
+                 registry=None):
+        if capacity < 2:
+            raise ValueError("capacity must be >= 2 (row 0 is OOV)")
+        if threshold is None:
+            threshold = int(_flags.flag(
+                "FLAGS_sparse_admission_threshold", 2))
+        if evict_after is None:
+            evict_after = int(_flags.flag(
+                "FLAGS_sparse_evict_after", 0)) or None
+        self.capacity = int(capacity)
+        self.threshold = int(threshold)
+        self.evict_after = evict_after
+        self.sketch = CountMinSketch(sketch_width, sketch_depth, seed)
+        self._rows = {}            # raw id -> row
+        self._row_id = {}          # row -> raw id (for eviction)
+        self._free = list(range(self.capacity - 1, OOV_ROW, -1))
+        self._last_seen = {}       # row -> batch counter at last sighting
+        self.batches = 0
+        reg = registry or default_registry()
+        self._m_admit = reg.counter(
+            "paddle_sparse_admitted_total",
+            "ids granted a dedicated embedding row")
+        self._m_oov = reg.counter(
+            "paddle_sparse_oov_total",
+            "id occurrences routed to the shared OOV row")
+        self._m_evict = reg.counter(
+            "paddle_sparse_evicted_total",
+            "embedding rows recycled by the eviction pass")
+
+    @property
+    def free_rows(self):
+        return len(self._free)
+
+    @property
+    def assigned(self):
+        return len(self._rows)
+
+    def lookup_rows(self, ids):
+        """Read-only id→row mapping (serving path): no counting, no
+        admission; unknown ids go to OOV."""
+        flat = np.asarray(ids).reshape(-1)
+        out = np.fromiter((self._rows.get(int(i), OOV_ROW) for i in flat),
+                          np.int32, count=flat.size)
+        return out.reshape(np.shape(ids))
+
+    def map_ids(self, ids):
+        """Training-path mapping: count every occurrence, admit ids that
+        cross the threshold while rows last, route the rest to OOV.
+        Deterministic in stream order.  Returns int32 rows, same shape
+        as `ids`."""
+        shape = np.shape(ids)
+        flat = np.asarray(ids, np.int64).reshape(-1)
+        self.batches += 1
+        self.sketch.add(flat)
+        # admission decisions on first occurrence per batch, stream order
+        uniq, first_pos = np.unique(flat, return_index=True)
+        order = np.argsort(first_pos)
+        est = self.sketch.estimate(uniq)
+        admitted = 0
+        for k in order:
+            rid = int(uniq[k])
+            row = self._rows.get(rid)
+            if row is None and int(est[k]) >= self.threshold and self._free:
+                row = self._free.pop()
+                self._rows[rid] = row
+                self._row_id[row] = rid
+                admitted += 1
+            if row is not None:
+                self._last_seen[row] = self.batches
+        out = np.fromiter((self._rows.get(int(i), OOV_ROW) for i in flat),
+                          np.int32, count=flat.size)
+        n_oov = int((out == OOV_ROW).sum())
+        if admitted:
+            self._m_admit.inc(admitted)
+        if n_oov:
+            self._m_oov.inc(n_oov)
+        return out.reshape(shape)
+
+    def evict(self, now=None):
+        """Recycle rows unseen for > `evict_after` batches.  Returns the
+        recycled row indices (the caller may zero those table rows).
+        Freed rows are re-admitted lowest-index-first, deterministic."""
+        if self.evict_after is None:
+            return []
+        now = self.batches if now is None else now
+        cold = [row for row, seen in self._last_seen.items()
+                if now - seen > self.evict_after]
+        for row in cold:
+            rid = self._row_id.pop(row)
+            del self._rows[rid]
+            del self._last_seen[row]
+            self._free.append(row)
+        if cold:
+            self._free.sort(reverse=True)
+            self._m_evict.inc(len(cold))
+        return sorted(cold)
+
+    # -- persistence (JSON-safe: rides the checkpoint manifest) ----------
+    def state_dict(self):
+        return {
+            "capacity": self.capacity,
+            "threshold": self.threshold,
+            "evict_after": self.evict_after,
+            "batches": self.batches,
+            "rows": {str(k): int(v) for k, v in self._rows.items()},
+            "last_seen": {str(k): int(v)
+                          for k, v in self._last_seen.items()},
+            "sketch": self.sketch.state_dict(),
+        }
+
+    def load_state_dict(self, state):
+        if int(state["capacity"]) != self.capacity:
+            raise ValueError(
+                f"vocab capacity mismatch: checkpoint {state['capacity']} "
+                f"vs live {self.capacity}")
+        self.threshold = int(state["threshold"])
+        self.evict_after = state.get("evict_after")
+        self.batches = int(state["batches"])
+        self._rows = {int(k): int(v) for k, v in state["rows"].items()}
+        self._row_id = {v: k for k, v in self._rows.items()}
+        self._last_seen = {int(k): int(v)
+                           for k, v in state.get("last_seen", {}).items()}
+        used = set(self._rows.values())
+        self._free = [r for r in range(self.capacity - 1, OOV_ROW, -1)
+                      if r not in used]
+        self.sketch.load_state_dict(state["sketch"])

@@ -63,6 +63,10 @@ def pytest_configure(config):
         "markers", "specdec: speculative decode / chunked prefill / fleet "
         "router test (serving.generation draft path, serving.router) — "
         "run via tools/serve_smoke.sh")
+    config.addinivalue_line(
+        "markers", "sparse: sharded embedding table / vocab admission / "
+        "streaming recommender data plane test (paddle_tpu.sparse) — run "
+        "via tools/sparse_smoke.sh")
 
 
 @pytest.fixture(autouse=True)
