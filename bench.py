@@ -43,11 +43,12 @@ CONFIG_TIMEOUT_TPU = {"bert": 1500, "gpt13b": 1800, "ernie": 1200,
 # Per-config CPU overrides: mesh3d trains the FULL 1.3B-param model on
 # the virtual 3D mesh — its 24-layer GSPMD compile + measured steps on a
 # single host core need more than the default budget.
-CONFIG_TIMEOUT_CPU = {"mesh3d": 2700, "genserve": 2700}
+CONFIG_TIMEOUT_CPU = {"mesh3d": 2700, "genserve": 2700,
+                      "fleetchaos": 1800}
 
 CONFIGS = ("mnist", "kernels", "longseq", "resnet50", "dp8", "mesh3d",
-           "ckpt", "pod", "predictor", "genserve", "sparse",
-           "ernie", "gpt13b", "bert")
+           "ckpt", "pod", "predictor", "genserve", "fleetchaos",
+           "sparse", "ernie", "gpt13b", "bert")
            # bert last among configs = headline; the aggregate summary
            # line prints after it.  dp8 = SPMD dp-scaling shape, mesh3d
            # = 3D-parallel (dp2×fsdp2×tp2) full-1.3B measured training,
@@ -340,14 +341,14 @@ def _run_config(cfg, on_tpu, cpu_fallback=None):
     already-computed `cpu_fallback` line (late-TPU pass) instead of
     recomputing it."""
     line, err, phases = None, "", []
-    if cfg in ("dp8", "mesh3d", "pod"):
+    if cfg in ("dp8", "mesh3d", "pod", "fleetchaos"):
         # dp scaling / 3D parallelism need 8 devices: always a virtual
         # CPU mesh here (one bench chip can't be split; a pod run uses
         # the real mesh via tools/{dp,mesh3d}_smoke.sh /
-        # Model.fit(mesh=...)).  pod spawns its own local rank
-        # subprocesses (the drill is about membership + recovery, not
-        # the backend).  The lines are backend-independent, so the
-        # late-TPU pass reuses them as-is.
+        # Model.fit(mesh=...)).  pod and fleetchaos spawn their own
+        # local subprocesses (the drills are about membership +
+        # recovery, not the backend).  The lines are
+        # backend-independent, so the late-TPU pass reuses them as-is.
         if cpu_fallback is not None:
             return cpu_fallback
         env = _cpu_env()
@@ -553,6 +554,24 @@ GATE_METRICS = {
         "direction": "higher", "cpu_rel_tol": 0.60, "tpu_rel_tol": 0.30,
         "help": "fleet tokens/s: 2 speculative replicas behind the "
                 "prefix-aware router at equal total cache HBM"},
+    # serving fleet resilience (fleetchaos config only; null
+    # elsewhere): availability is a contract (a kill must be invisible
+    # to clients — the band tolerates nothing), recovery and TTFT tail
+    # are wall-clock on a loaded CPU host, so those bands stay wide
+    "fleet_availability_ratio": {
+        "direction": "higher", "cpu_rel_tol": 0.0, "tpu_rel_tol": 0.0,
+        "help": "complete answers / finished requests across the "
+                "mid-stream SIGKILL burst (1.0 = zero client-visible "
+                "failures)"},
+    "failover_recovery_ms": {
+        "direction": "lower", "cpu_rel_tol": 3.00, "tpu_rel_tol": 1.00,
+        "help": "replica death detected under a stream to the "
+                "survivor's connection accepted (must beat the "
+                "probe-timeout floor; epoch-delta eviction)"},
+    "failover_p99_ttft_ms": {
+        "direction": "lower", "cpu_rel_tol": 3.00, "tpu_rel_tol": 1.00,
+        "help": "client-side TTFT p99 over the chaos burst, failover "
+                "re-admissions included"},
     # sparse/recommender plane (sparse config only; null elsewhere):
     # streaming wide-and-deep fit throughput with the row-sharded
     # embedding table, and serving-side pooled-lookup tail latency
@@ -1077,6 +1096,149 @@ pod.close()
         "restart_equivalent_s": round(restart_floor_s, 2),
         "goodput_ratio": report.get("goodput_ratio"),
         "badput_down_s": (report.get("seconds") or {}).get("down"),
+    }
+
+
+def body_fleetchaos(on_tpu):
+    """Fault-tolerant serving fleet drill (serving/fleet.py +
+    serving/router.py): a supervised 2-replica generation fleet takes a
+    REAL mid-stream SIGKILL on the replica that owns every stream's
+    prefix affinity; the router must resume each interrupted stream on
+    the survivor (greedy output bitwise-identical to an uninterrupted
+    oracle) with zero client-visible failures, and the supervisor must
+    respawn the corpse.  Emits the three resilience headlines:
+
+      fleet_availability_ratio  complete answers / finished requests
+                                across the chaos burst (1.0 = the kill
+                                was invisible to clients)
+      failover_recovery_ms      replica death detected under a stream ->
+                                survivor's connection accepted (the
+                                epoch-delta eviction path; must beat the
+                                probe-timeout floor)
+      failover_p99_ttft_ms      client-side TTFT p99 over the burst,
+                                failover re-admissions included
+
+    Multi-process localhost replicas on CPU engines: backend-
+    independent, like pod."""
+    import threading
+    import time as _time
+
+    from paddle_tpu.serving.client import ServingClient
+    from paddle_tpu.serving.fleet import ReplicaSupervisor
+    from paddle_tpu.serving.router import FleetRouter
+
+    PROMPT = [3, 5, 7, 11, 13, 17, 19, 23]
+    MAX_NEW, STREAMS = 24, 6
+    PROBE_INTERVAL_S, DEAD_AFTER = 0.5, 3
+    cmd = [sys.executable, "-m", "paddle_tpu.serving.generation",
+           "--port", "0", "--slots", "8", "--page-size", "4",
+           "--prompt-buckets", "8,16,32", "--max-seq-len", "48",
+           "--seed", "0"]
+    sup = ReplicaSupervisor(cmd, 2, env=_cpu_env(),
+                            heartbeat_timeout_s=10.0,
+                            respawn_backoff_s=0.2).start()
+    router = None
+    try:
+        if not sup.wait_ready(timeout_s=600):
+            raise RuntimeError("fleet bring-up timed out")
+        _phase("fleet_up")
+        # a cold fleet has no success history, so the retry-budget
+        # floor must cover one full burst of mid-stream resumes (the
+        # default floor of 5 would budget-reject the 6th) — sizing the
+        # floor to expected concurrency is the operator contract
+        router = FleetRouter([], coord=sup.coord.address, page_size=4,
+                             probe_interval_s=PROBE_INTERVAL_S,
+                             dead_after=DEAD_AFTER,
+                             retry_budget_min=2 * STREAMS,
+                             install_signal_handlers=False).start()
+        # oracle + affinity bind: the least-loaded tie-break lands the
+        # shared prompt on rank 0, so the SIGKILL below interrupts
+        # every stream of the burst
+        cli = ServingClient(router.url, timeout=300.0)
+        oracle = cli.generate(PROMPT, MAX_NEW)["tokens"]
+        _phase("oracle_done")
+
+        three = threading.Event()
+        ttfts = [None] * STREAMS
+        toks_out = [None] * STREAMS
+        errs = [None] * STREAMS
+
+        def one_stream(i):
+            toks, t0 = [], _time.perf_counter()
+            try:
+                for evt in ServingClient(
+                        router.url, timeout=300.0).generate_stream(
+                        PROMPT, MAX_NEW):
+                    if "token" in evt:
+                        if not toks:
+                            ttfts[i] = (_time.perf_counter() - t0) * 1e3
+                        toks.append(evt["token"])
+                        if len(toks) >= 3:
+                            three.set()
+                    if evt.get("done") and evt.get("error"):
+                        raise RuntimeError(evt["error"])
+                toks_out[i] = toks
+            except Exception as e:  # noqa: BLE001 - any = failed request
+                errs[i] = e
+
+        threads = [threading.Thread(target=one_stream, args=(i,))
+                   for i in range(STREAMS)]
+        t_burst = _time.perf_counter()
+        for t in threads:
+            t.start()
+        three.wait(300)
+        sup.procs[0].kill()               # REAL SIGKILL, mid-stream
+        for t in threads:
+            t.join(600)
+        burst_s = _time.perf_counter() - t_burst
+        _phase("chaos_burst_done")
+
+        snap = router.metrics.snapshot()
+        failures = [e for e in errs if e is not None]
+        resumed_bitwise = all(t == oracle for t in toks_out
+                              if t is not None)
+        sup_respawned = False
+        deadline = _time.monotonic() + 240
+        while _time.monotonic() < deadline:
+            if sup.respawn_count >= 1 and sup.replica_url(0):
+                sup_respawned = True
+                break
+            _time.sleep(0.1)
+        _phase("respawn_done")
+    finally:
+        if router is not None:
+            router.shutdown()
+        sup.shutdown()
+
+    ttft_vals = sorted(t for t in ttfts if t is not None)
+    p99 = (ttft_vals[int(0.99 * (len(ttft_vals) - 1))]
+           if ttft_vals else None)
+    avail = snap["availability_ratio"]
+    recovery = snap["failover_recovery_ms"]
+    floor_ms = PROBE_INTERVAL_S * DEAD_AFTER * 1e3
+    held = (not failures and resumed_bitwise and avail == 1.0
+            and 0 < recovery < floor_ms)
+    return {
+        **_obs_fields(),
+        "metric": "fleet_availability_ratio",
+        "value": round(avail, 4),
+        "unit": "ratio",
+        # 1.0 == the drill held its whole contract (no client-visible
+        # failure, bitwise resume, recovery under the probe floor)
+        "vs_baseline": 1.0 if held else 0.0,
+        "fleet_availability_ratio": round(avail, 4),
+        "failover_recovery_ms": recovery,
+        "failover_p99_ttft_ms": (round(p99, 1)
+                                 if p99 is not None else None),
+        "probe_floor_ms": floor_ms,
+        "recovery_beats_probe_floor": bool(0 < recovery < floor_ms),
+        "streams": STREAMS,
+        "client_failures": len(failures),
+        "resumed_bitwise_greedy": bool(resumed_bitwise),
+        "mid_stream_failovers": snap["failovers"].get("mid_stream", 0),
+        "membership_epoch": snap["membership_epoch"],
+        "supervisor_respawned": bool(sup_respawned),
+        "burst_seconds": round(burst_s, 1),
     }
 
 
@@ -2704,7 +2866,8 @@ def body_config(name):
             "predictor": body_predictor, "genserve": body_genserve,
             "dp8": body_dp8,
             "mesh3d": body_mesh3d, "ckpt": body_ckpt,
-            "pod": body_pod, "sparse": body_sparse}[name]
+            "pod": body_pod, "fleetchaos": body_fleetchaos,
+            "sparse": body_sparse}[name]
     r = body(on_tpu)
     r["platform"] = jax.devices()[0].device_kind if on_tpu else "cpu"
     print(json.dumps(r), flush=True)

@@ -97,7 +97,10 @@ class GoodputLedger:
             except (TypeError, ValueError):
                 return 0.0
         return {GOOD: sec("train_s"), "compile": sec("compile_s"),
-                "ckpt_stall": sec("ckpt_stall_s")}
+                "ckpt_stall": sec("ckpt_stall_s"),
+                # serving supervisors account replica death→respawn gaps
+                # in their `replica_lost` dumps (serving/fleet.py)
+                "down": sec("down_s")}
 
     @staticmethod
     def _jsonl_contribution(path: str) -> dict:

@@ -140,6 +140,18 @@ class FailureDetector:
     def live(self) -> list[int]:
         return [r for r in range(self.world) if r not in self._dead]
 
+    def revive(self, rank: int):
+        """Supervisor-authorized resurrection: a respawned replacement
+        process re-enters the membership under the dead rank's id.  Only
+        the supervisor may call this (it observed the new process start);
+        a zombie's own heartbeat still cannot resurrect it — `beat`
+        keeps refusing dead ranks.  The revived rank gets the bring-up
+        budget again (fresh interpreter, fresh compile)."""
+        self._dead.pop(rank, None)
+        self._beaten.discard(rank)
+        self._last_beat[rank] = self._clock()
+        self._last_step[rank] = -1
+
     def dead(self) -> dict[int, str]:
         return dict(self._dead)
 
@@ -235,6 +247,20 @@ class PodCoordinator:
             self.epoch += 1
             logger.warning("pod: rank %d declared dead (%s) -> epoch %d "
                            "live=%s", rank, reason, self.epoch, self.live())
+            self._cond.notify_all()
+
+    def mark_live(self, rank: int):
+        """Supervisor-authorized re-admission of a respawned rank: the
+        inverse of `mark_dead`, bumping the epoch so every membership
+        subscriber (the fleet router) sees the replacement on the same
+        delta channel it saw the death.  No-op if the rank is live."""
+        with self._cond:
+            if rank not in self.detector.dead():
+                return
+            self.detector.revive(rank)
+            self.epoch += 1
+            logger.info("pod: rank %d revived -> epoch %d live=%s",
+                        rank, self.epoch, self.live())
             self._cond.notify_all()
 
     def check_heartbeats(self) -> dict[int, str]:

@@ -117,6 +117,39 @@ define_flag("FLAGS_router_probe_interval_s", 0.5,
 define_flag("FLAGS_router_dead_after", 3,
             "consecutive failed health probes before a replica is routed "
             "around (429 backpressure never counts as a failure)")
+define_flag("FLAGS_router_healthy_after", 2,
+            "consecutive successful probes before a dead replica is "
+            "marked healthy again (flap damping; a single lucky probe "
+            "must not re-admit a sick replica)")
+define_flag("FLAGS_router_retry_budget_ratio", 0.1,
+            "retry-budget deposit per successful request: retries are "
+            "capped at this fraction of recent successful traffic so a "
+            "sick fleet degrades to fast 503s instead of a retry storm")
+define_flag("FLAGS_router_retry_budget_min", 5.0,
+            "retry-budget floor (and initial balance): a cold or "
+            "low-traffic router can still retry this many times")
+define_flag("FLAGS_router_breaker_threshold", 3,
+            "consecutive request failures that trip a replica's circuit "
+            "breaker (dispatch stops before the health probe catches up)")
+define_flag("FLAGS_router_breaker_cooldown_s", 2.0,
+            "seconds a tripped circuit breaker holds before one trial "
+            "request may probe the replica again")
+define_flag("FLAGS_router_hedge_floor_ms", 0.0,
+            "hedged dispatch for non-streaming requests: when > 0, a "
+            "duplicate is sent to a second replica once the first has "
+            "been outstanding max(this floor, observed p99 latency); "
+            "first answer wins, the loser is discarded; 0 disables")
+define_flag("FLAGS_router_replica_slots", 4,
+            "per-replica concurrent-decode lanes the deadline-aware "
+            "admission estimator assumes when computing queue wait "
+            "(matches the replicas' --slots in the smoke fixture)")
+define_flag("FLAGS_fleet_respawn_backoff_s", 0.5,
+            "base delay before the replica supervisor respawns a "
+            "crashed replica (jittered exponential backoff from here)")
+define_flag("FLAGS_fleet_membership_poll_s", 0.1,
+            "router poll interval against the fleet coordinator's "
+            "membership epoch; an epoch delta evicts dead replicas "
+            "faster than the probe timeout")
 # -- runtime telemetry (paddle_tpu.monitor) --------------------------------
 define_flag("FLAGS_telemetry_dir", "",
             "directory for the per-step JSONL training event log "

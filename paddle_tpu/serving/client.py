@@ -22,6 +22,8 @@ actually sent is kept on `client.last_traceparent`.
 from __future__ import annotations
 
 import json
+import random
+import threading
 import time
 import urllib.error
 import urllib.request
@@ -40,11 +42,29 @@ class ServingHTTPError(RuntimeError):
 
 
 class ServingClient:
-    def __init__(self, url: str, timeout: float = 30.0, tracer=None):
+    def __init__(self, url: str, timeout: float = 30.0, tracer=None,
+                 retries: int = 0, retry_backoff_s: float = 0.05):
+        """`retries` > 0 turns on client-side retry of IDEMPOTENT
+        non-streaming requests (predict, blocking generate, GETs): a
+        connection reset or replica 5xx is retried up to `retries` times
+        with jittered exponential backoff, and a 429's `Retry-After`
+        header is honored as the wait.  504 (deadline) is never retried
+        — the deadline is just as blown on attempt two.  Streaming
+        generate is NOT retried here: mid-stream resume is the router's
+        job (journaled failover), not the client's.  Default 0 keeps the
+        historical raise-on-first-failure behavior."""
         self.base = url.rstrip("/")
         self.timeout = timeout
         self._tracer = tracer
+        self.retries = int(retries)
+        self.retry_backoff_s = float(retry_backoff_s)
         self.last_traceparent = None  # header sent on the last request
+        self._tls = threading.local()  # per-thread attempt accounting
+
+    @property
+    def last_attempts(self) -> int:
+        """Attempts the calling thread's last request took (>=1)."""
+        return getattr(self._tls, "attempts", 1)
 
     @property
     def tracer(self):
@@ -66,20 +86,47 @@ class ServingClient:
         self.last_traceparent = span.traceparent
         return span, span.traceparent
 
+    def _retry_delay(self, attempt: int, retry_after=None) -> float:
+        if retry_after is not None:
+            try:
+                return float(retry_after) * (1.0 + 0.1 * random.random())
+            except (TypeError, ValueError):
+                pass
+        return self.retry_backoff_s * attempt * (0.5 + random.random())
+
     def _request(self, path: str, body=None, traceparent=None):
         headers = {"Content-Type": "application/json"}
         if traceparent:
             headers["traceparent"] = traceparent
-        req = urllib.request.Request(
-            self.base + path,
-            data=(json.dumps(body).encode() if body is not None else None),
-            headers=headers,
-            method="POST" if body is not None else "GET")
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout) as r:
-                return r.status, r.read()
-        except urllib.error.HTTPError as e:  # non-2xx still carries a body
-            return e.code, e.read()
+        data = json.dumps(body).encode() if body is not None else None
+        attempt = 0
+        while True:
+            attempt += 1
+            self._tls.attempts = attempt
+            req = urllib.request.Request(
+                self.base + path, data=data, headers=headers,
+                method="POST" if body is not None else "GET")
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout) as r:
+                    return r.status, r.read()
+            except urllib.error.HTTPError as e:  # non-2xx carries a body
+                raw = e.read()
+                # 429 waits out Retry-After; transient 5xx backs off;
+                # 504 means the deadline is gone either way
+                retryable = e.code == 429 or (e.code >= 500
+                                              and e.code != 504)
+                if attempt <= self.retries and retryable:
+                    time.sleep(self._retry_delay(
+                        attempt, e.headers.get("Retry-After")
+                        if e.code == 429 else None))
+                    continue
+                return e.code, raw
+            except OSError:  # connection reset/refused (URLError too)
+                if attempt <= self.retries:
+                    time.sleep(self._retry_delay(attempt))
+                    continue
+                raise
 
     def predict(self, inputs, dtypes=None, deadline_ms=None,
                 traceparent=None):
@@ -159,6 +206,7 @@ class ServingClient:
             "client.generate_stream", traceparent,
             attrs={"prompt_len": len(prompt),
                    "max_new_tokens": int(max_new_tokens)})
+        self._tls.attempts = 1  # streaming never client-retries
         headers = {"Content-Type": "application/json"}
         if header:
             headers["traceparent"] = header
@@ -249,6 +297,10 @@ def main(argv=None):
                              "(the chunked-prefill p99 claim in one "
                              "flag); overrides --prompt-len")
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument("--retries", type=int, default=0,
+                        help="client-side retries for idempotent "
+                             "non-streaming requests (connection reset, "
+                             "replica 5xx, Retry-After on 429)")
     args = parser.parse_args(argv)
 
     wave = None
@@ -274,8 +326,9 @@ def main(argv=None):
                          .randint(1, args.vocab, args.shared_prefix_len)]
 
     shape = tuple(int(d) for d in args.shape.split(",") if d.strip())
-    client = ServingClient(args.url)
+    client = ServingClient(args.url, retries=args.retries)
     results = {"ok": 0, "backpressure": 0, "errors": 0}
+    attempts: list[int] = []
     ttfts, gaps = {"all": []}, {"all": []}
     if wave:
         for cls in ("long", "short"):
@@ -344,6 +397,7 @@ def main(argv=None):
                 key = "errors"
             with lock:
                 results[key] += 1
+                attempts.append(client.last_attempts)
 
     per = [args.requests // args.concurrency] * args.concurrency
     for i in range(args.requests % args.concurrency):
@@ -358,6 +412,14 @@ def main(argv=None):
     results["elapsed_s"] = round(time.perf_counter() - t0, 3)
     results["client_qps"] = round(results["ok"] /
                                   max(results["elapsed_s"], 1e-9), 1)
+    if attempts:
+        # attempts-per-request percentiles: >1 means the fleet made the
+        # client work for its answer (retried resets / Retry-After)
+        results["attempts_p50"] = round(
+            float(np.percentile(attempts, 50)), 2)
+        results["attempts_p99"] = round(
+            float(np.percentile(attempts, 99)), 2)
+        results["attempts_max"] = int(max(attempts))
     if args.mode in ("generate", "mixed"):
         results["gen_tokens"] = gen_tokens[0]
         results["client_tokens_per_sec"] = round(

@@ -165,15 +165,15 @@ class GenerationHandle:
 
 class _GenRequest:
     __slots__ = ("prompt", "bucket", "max_new_tokens", "do_sample",
-                 "temperature", "top_k", "seed", "eos", "deadline",
-                 "handle", "engine", "cancelled", "t_last_token",
-                 "span", "own_span", "span_queue", "span_decode",
-                 "prefilling", "prefill_cursor", "chunk_row", "j_hit",
-                 "pin_final")
+                 "temperature", "top_k", "seed", "resume_pos", "eos",
+                 "deadline", "handle", "engine", "cancelled",
+                 "t_last_token", "span", "own_span", "span_queue",
+                 "span_decode", "prefilling", "prefill_cursor",
+                 "chunk_row", "j_hit", "pin_final")
 
     def __init__(self, engine, prompt, bucket, max_new_tokens, do_sample,
                  temperature, top_k, seed, eos, deadline, span=None,
-                 own_span=False):
+                 own_span=False, resume_pos=0):
         self.engine = engine
         self.prompt = prompt               # np.int32 [L]
         self.bucket = bucket               # padded prompt length Sp
@@ -182,6 +182,7 @@ class _GenRequest:
         self.temperature = temperature
         self.top_k = top_k
         self.seed = seed
+        self.resume_pos = resume_pos       # tokens a dead replica emitted
         self.eos = eos                     # int; vocab_size == never
         self.deadline = deadline           # absolute monotonic or None
         self.cancelled = False
@@ -486,6 +487,20 @@ class GenerationEngine:
                 key, lg3[None, :])[0].astype(jnp.int32)
             return jnp.where(do_sample, samp, greedy)
 
+        def resume_chain(seed, resume_pos):
+            """Mid-stream failover (router re-admission): fast-forward
+            the per-request PRNG chain past the ``resume_pos`` tokens a
+            dead replica already emitted.  The chain is k_0=PRNGKey(seed)
+            with (k_i, s_i)=split(k_{i-1}) and token i drawn from s_i, so
+            after the fast-forward the admission split below yields
+            exactly (k_{P+1}, s_{P+1}) — the first resumed sample is the
+            token the uninterrupted run would have drawn next, and the
+            chain state is identical thereafter.  resume_pos=0 is the
+            normal (non-resumed) admission, bitwise today's behavior."""
+            key = jax.random.PRNGKey(seed)
+            return jax.lax.fori_loop(
+                0, resume_pos, lambda _, k: jax.random.split(k)[0], key)
+
         model, geometry = self.model, geom
 
         def target_prefill(params, ids, length):
@@ -508,14 +523,14 @@ class GenerationEngine:
                 return k, v, lg, dk, dv
 
         def insert_step(state, slot, k_new, v_new, logits, length, seed,
-                        do_sample, temp, top_k, stop_pos, eos, pinned,
-                        *draft_kv):
+                        resume_pos, do_sample, temp, top_k, stop_pos, eos,
+                        pinned, *draft_kv):
             # prefix-miss admission: every mapped page is freshly
             # allocated and written (shared_n = 0)
             no_shared = jnp.full((pps,), -1, jnp.int32)
             state, row = write_prompt(state, slot, k_new, v_new, length,
                                       no_shared, jnp.int32(0), *draft_kv)
-            key, sub = jax.random.split(jax.random.PRNGKey(seed))
+            key, sub = jax.random.split(resume_chain(seed, resume_pos))
             tok1 = sample_token(logits, sub, do_sample, temp, top_k)
             state = admit_slot(state, slot, tok1, length, key, do_sample,
                                temp, top_k, stop_pos, eos, pinned)
@@ -553,8 +568,8 @@ class GenerationEngine:
             return k_suf, v_suf, logits, (dk_suf, dv_suf)
 
         def _insert_prefix(params, dparams, state, slot, ids, shared_ids,
-                           shared_n, length, seed, do_sample, temp,
-                           top_k, stop_pos, eos, pinned):
+                           shared_n, length, seed, resume_pos, do_sample,
+                           temp, top_k, stop_pos, eos, pinned):
             # prefix-hit admission: the shared pages are never
             # recomputed; the suffix pages in at the (page-aligned)
             # boundary
@@ -563,7 +578,7 @@ class GenerationEngine:
                 length)
             state, row = write_prompt(state, slot, k_suf, v_suf, length,
                                       shared_ids, shared_n, *draft_kv)
-            key, sub = jax.random.split(jax.random.PRNGKey(seed))
+            key, sub = jax.random.split(resume_chain(seed, resume_pos))
             tok1 = sample_token(logits, sub, do_sample, temp, top_k)
             state = admit_slot(state, slot, tok1, length, key, do_sample,
                                temp, top_k, stop_pos, eos, pinned)
@@ -576,8 +591,8 @@ class GenerationEngine:
             insert_prefix_step = _insert_prefix
 
         def _chunk(params, dparams, state, slot, ids, shared_ids,
-                   shared_n, length, seed, do_sample, temp, top_k,
-                   stop_pos, eos, pin_now, pin_final, arm):
+                   shared_n, length, seed, resume_pos, do_sample, temp,
+                   top_k, stop_pos, eos, pin_now, pin_final, arm):
             # one prefill chunk: scatter this slice's K/V behind the
             # resumable cursor; ONLY the final chunk (arm=True) samples
             # a real first token and activates the lane.  Until then
@@ -591,7 +606,7 @@ class GenerationEngine:
                 length)
             state, row = write_prompt(state, slot, k_suf, v_suf, length,
                                       shared_ids, shared_n, *draft_kv)
-            key, sub = jax.random.split(jax.random.PRNGKey(seed))
+            key, sub = jax.random.split(resume_chain(seed, resume_pos))
             tok1 = sample_token(logits, sub, do_sample, temp, top_k)
             pinned = jnp.where(jnp.asarray(arm, bool), pin_final,
                                pin_now)
@@ -839,12 +854,12 @@ class GenerationEngine:
                     if mesh is not None else None)
                 self._insert_execs[sp] = inference.aot_compile(
                     insert_step,
-                    (sspec, i32, kv, kv, lg, i32, i32, b1, f32, i32, i32,
-                     i32, i32) + dkv_in,
+                    (sspec, i32, kv, kv, lg, i32, i32, i32, b1, f32, i32,
+                     i32, i32, i32) + dkv_in,
                     donate_argnums=(0,), out_shardings=outs(rep, rep))
                 self.compile_count += 2
-                tail = (i32, ids, pvec, i32, i32, i32, b1, f32, i32, i32,
-                        i32, i32)
+                tail = (i32, ids, pvec, i32, i32, i32, i32, b1, f32, i32,
+                        i32, i32, i32)
                 if self._prefix is not None:
                     self._insert_prefix_execs[sp] = inference.aot_compile(
                         insert_prefix_step,
@@ -919,8 +934,9 @@ class GenerationEngine:
             f"{self.prompt_buckets[-1]}")
 
     def submit(self, prompt, max_new_tokens=32, *, do_sample=False,
-               temperature=1.0, top_k=0, seed=0, eos_token_id=None,
-               deadline_ms=None, span=None) -> GenerationHandle:
+               temperature=1.0, top_k=0, seed=0, resume_pos=0,
+               eos_token_id=None, deadline_ms=None,
+               span=None) -> GenerationHandle:
         """Enqueue one prompt (1-D int token ids).  Returns a streaming
         :class:`GenerationHandle`.  Raises QueueFullError under
         backpressure, EngineStoppedError once draining/stopped, and
@@ -961,6 +977,9 @@ class GenerationEngine:
         if top_k > self.max_top_k:
             raise ValueError(f"top_k {top_k} exceeds max_top_k "
                              f"{self.max_top_k}")
+        resume_pos = int(resume_pos)
+        if resume_pos < 0:
+            raise ValueError("resume_pos must be >= 0")
         eos = self.geometry.vocab_size if eos_token_id is None \
             else int(eos_token_id)
         deadline = (time.monotonic() + deadline_ms / 1e3
@@ -980,7 +999,7 @@ class GenerationEngine:
         req = _GenRequest(self, prompt, bucket, max_new_tokens,
                           bool(do_sample), float(temperature), top_k,
                           int(seed), eos, deadline, span=span,
-                          own_span=own_span)
+                          own_span=own_span, resume_pos=resume_pos)
         if span is not None:
             # attached BEFORE enqueue: the decode thread may admit the
             # request (and close this child) before put_nowait returns
@@ -1166,7 +1185,8 @@ class GenerationEngine:
                 state, tok1, row = self._insert_prefix_execs[sb](
                     self._params, *dpre, self._state, np.int32(slot),
                     ids, shared_vec, np.int32(j_hit), np.int32(L),
-                    np.int32(req.seed), np.bool_(req.do_sample),
+                    np.int32(req.seed), np.int32(req.resume_pos),
+                    np.bool_(req.do_sample),
                     np.float32(req.temperature), np.int32(req.top_k),
                     stop, np.int32(req.eos), np.int32(pinned))
             else:
@@ -1178,6 +1198,7 @@ class GenerationEngine:
                 state, tok1, row = self._insert_execs[req.bucket](
                     self._state, np.int32(slot), k_new, v_new, logits,
                     np.int32(L), np.int32(req.seed),
+                    np.int32(req.resume_pos),
                     np.bool_(req.do_sample), np.float32(req.temperature),
                     np.int32(req.top_k), stop, np.int32(req.eos),
                     np.int32(pinned), *out[3:])
@@ -1276,6 +1297,7 @@ class GenerationEngine:
                 self._params, *dpre, self._state, np.int32(slot), ids,
                 shared_vec, np.int32(cur // geom.page_size),
                 np.int32(end), np.int32(req.seed),
+                np.int32(req.resume_pos),
                 np.bool_(req.do_sample), np.float32(req.temperature),
                 np.int32(req.top_k),
                 np.int32(L + req.max_new_tokens), np.int32(req.eos),
@@ -1624,6 +1646,25 @@ def main(argv=None):
                            port=args.port).start()
     # parse-friendly readiness line (tools/serve_smoke.sh greps it)
     print(f"paddle_tpu.serving listening on {server.url}", flush=True)
+
+    # elastic fleet membership: when launched under a replica supervisor
+    # (serving/fleet.py exports PADDLE_POD_COORD + PADDLE_POD_RANK) the
+    # replica registers its URL in the coordinator KV and heartbeats so
+    # the router evicts it on the epoch delta — faster than its probe
+    # timeout — when it dies or partitions.  A REPLICA_PARTITION chaos
+    # drill silences the heartbeats while the HTTP server keeps serving.
+    from ..distributed.podcoord import PodClient
+
+    pod = PodClient.from_env()
+    if pod is not None:
+        from ..utils import chaos as _chaos
+
+        pod.kv_set(f"serving/replica/{pod.rank}/url",
+                   server.url.encode("utf-8"))
+        pod.start_heartbeats()
+        _chaos.register_partition_hook(pod.stop_heartbeats)
+        logger.info("replica rank %d registered with fleet coordinator",
+                    pod.rank)
     return server.wait()
 
 

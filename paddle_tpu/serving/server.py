@@ -10,9 +10,13 @@ Endpoints:
                   429 on queue-full backpressure, 503 while draining,
                   504 on deadline expiry
   POST /generate  {"prompt": [ids], "max_new_tokens"?, "do_sample"?,
-                  "temperature"?, "top_k"?, "seed"?, "eos_token_id"?,
-                  "deadline_ms"?, "stream"?} — continuous-batching
-                  generation (requires a mounted GenerationEngine).
+                  "temperature"?, "top_k"?, "seed"?, "resume_pos"?,
+                  "eos_token_id"?, "deadline_ms"?, "stream"?} —
+                  continuous-batching generation (requires a mounted
+                  GenerationEngine).  `resume_pos` is the router's
+                  mid-stream failover hook: the request's PRNG chain is
+                  fast-forwarded past that many already-emitted tokens
+                  so a re-admitted stream resumes deterministically.
                   stream=false → one JSON body {"tokens": [...]};
                   stream=true  → Server-Sent Events over chunked
                   transfer, one `data: {"token": t}` event per decoded
@@ -206,6 +210,7 @@ class _Handler(BaseHTTPRequestHandler):
                 temperature=float(payload.get("temperature", 1.0)),
                 top_k=int(payload.get("top_k", 0)),
                 seed=int(payload.get("seed", 0)),
+                resume_pos=int(payload.get("resume_pos", 0)),
                 eos_token_id=payload.get("eos_token_id"),
                 deadline_ms=payload.get("deadline_ms"),
             )

@@ -46,6 +46,23 @@ Two drive modes, composable:
         PADDLE_CHAOS_INIT_FLAKY=K     next K distributed-init dials raise
                                       ConnectionError (drives
                                       retry_with_backoff bring-up)
+        PADDLE_CHAOS_REPLICA_KILL=k@N serving drill: replica rank k
+                                      SIGKILLs itself at decode
+                                      iteration N (mid-stream death —
+                                      the router must fail inflight
+                                      requests over to a survivor)
+        PADDLE_CHAOS_REPLICA_SLOW=k@N[:S]  replica rank k stalls EVERY
+                                      decode iteration from N onward for
+                                      S seconds (default 0.25) — a sick-
+                                      but-alive replica (hedging bait);
+                                      persistent, unlike the one-shot
+                                      step stalls
+        PADDLE_CHAOS_REPLICA_PARTITION=k@N  replica rank k stops
+                                      heartbeating to the fleet
+                                      coordinator at iteration N while
+                                      continuing to serve — the router's
+                                      epoch subscription must evict it
+                                      faster than the probe timeout
   * `inject(...)` context manager — in-process unit tests push a chaos
     config for the duration of a `with` block.
 
@@ -102,7 +119,8 @@ class ChaosConfig:
                  slow_seconds=30.0, preempt_at_step=None, fail_io=0,
                  io_error=None, ckpt_torn=0, ckpt_bitflip=0, ckpt_enospc=0,
                  ckpt_slow_io=0.0, rank_kill=None, rank_slow=None,
-                 rank_partition=None, init_flaky=0):
+                 rank_partition=None, init_flaky=0, replica_kill=None,
+                 replica_slow=None, replica_partition=None):
         self.crash_at_step = crash_at_step
         # accept a single step or an iterable of steps
         if nan_at_step is None:
@@ -128,6 +146,13 @@ class ChaosConfig:
         self.rank_slow = rank_slow          # (rank, step, seconds)
         self.rank_partition = rank_partition  # (rank, step)
         self.init_flaky = int(init_flaky)
+        # serving-fleet drills: same (rank, step[, seconds]) triggers,
+        # matched against PADDLE_POD_RANK at fire time.  kill/partition
+        # are one-shot; replica_slow is PERSISTENT (a sick-but-alive
+        # replica stays sick until the drill is reset)
+        self.replica_kill = replica_kill          # (rank, step)
+        self.replica_slow = replica_slow          # (rank, step, seconds)
+        self.replica_partition = replica_partition  # (rank, step)
         self.fired: list[str] = []  # audit trail for tests
 
     def is_noop(self):
@@ -137,7 +162,9 @@ class ChaosConfig:
                 and self.ckpt_bitflip <= 0 and self.ckpt_enospc <= 0
                 and self.ckpt_slow_io <= 0 and self.rank_kill is None
                 and self.rank_slow is None and self.rank_partition is None
-                and self.init_flaky <= 0)
+                and self.init_flaky <= 0 and self.replica_kill is None
+                and self.replica_slow is None
+                and self.replica_partition is None)
 
     @classmethod
     def from_env(cls, environ=None):
@@ -178,6 +205,10 @@ class ChaosConfig:
             rank_slow=_rank_at("PADDLE_CHAOS_RANK_SLOW", with_seconds=True),
             rank_partition=_rank_at("PADDLE_CHAOS_RANK_PARTITION"),
             init_flaky=_int("PADDLE_CHAOS_INIT_FLAKY") or 0,
+            replica_kill=_rank_at("PADDLE_CHAOS_REPLICA_KILL"),
+            replica_slow=_rank_at("PADDLE_CHAOS_REPLICA_SLOW",
+                                  with_seconds=True),
+            replica_partition=_rank_at("PADDLE_CHAOS_REPLICA_PARTITION"),
         )
 
 
@@ -298,6 +329,32 @@ def on_step(step: int) -> bool:
         logger.warning("chaos: stalling pod rank %d at step %d for %.1fs",
                        pod_rank(), step, secs)
         time.sleep(secs)
+    if cfg.replica_kill is not None and step >= cfg.replica_kill[1] \
+            and pod_rank() == cfg.replica_kill[0]:
+        cfg.replica_kill = None
+        cfg.fired.append(f"replica_kill@{step}")
+        logger.warning("chaos: SIGKILL self (replica rank %d) at decode "
+                       "iteration %d", pod_rank(), step)
+        os.kill(os.getpid(), signal.SIGKILL)
+    if cfg.replica_partition is not None \
+            and step >= cfg.replica_partition[1] \
+            and pod_rank() == cfg.replica_partition[0]:
+        cfg.replica_partition = None
+        cfg.fired.append(f"replica_partition@{step}")
+        logger.warning("chaos: partitioning replica rank %d from decode "
+                       "iteration %d (coordinator heartbeats stop; the "
+                       "replica keeps serving)", pod_rank(), step)
+        _fire_partition()
+    if cfg.replica_slow is not None and step >= cfg.replica_slow[1] \
+            and pod_rank() == cfg.replica_slow[0]:
+        _, at, secs = cfg.replica_slow
+        secs = 0.25 if secs is None else secs
+        if not any(f.startswith("replica_slow@") for f in cfg.fired):
+            cfg.fired.append(f"replica_slow@{step}")
+            logger.warning("chaos: replica rank %d slow from iteration %d "
+                           "(%.2fs per decode iteration, persistent)",
+                           pod_rank(), at, secs)
+        time.sleep(secs)  # NOT consumed: a sick replica stays sick
     if cfg.slow_step is not None and step == cfg.slow_step:
         cfg.slow_step = None
         cfg.fired.append(f"slow@{step}")

@@ -67,6 +67,10 @@ def pytest_configure(config):
         "markers", "sparse: sharded embedding table / vocab admission / "
         "streaming recommender data plane test (paddle_tpu.sparse) — run "
         "via tools/sparse_smoke.sh")
+    config.addinivalue_line(
+        "markers", "fleetchaos: fault-tolerant serving fleet test "
+        "(elastic membership, mid-stream failover, retry budgets, "
+        "serving chaos drills) — run via tools/serve_smoke.sh")
 
 
 @pytest.fixture(autouse=True)

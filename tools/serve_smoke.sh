@@ -23,10 +23,21 @@ SERVER_PID=""
 R0_PID=""
 R1_PID=""
 ROUTER_PID=""
+SUP_PID=""
 cleanup() {
     for pid in "$SERVER_PID" "$ROUTER_PID" "$R0_PID" "$R1_PID"; do
         [ -n "$pid" ] && kill -9 "$pid" 2>/dev/null || true
     done
+    if [ -n "$SUP_PID" ]; then
+        # the supervisor owns replica subprocesses: TERM (latch-drain)
+        # first so they are reaped, SIGKILL only as a last resort
+        kill -TERM "$SUP_PID" 2>/dev/null || true
+        for _ in $(seq 1 100); do
+            kill -0 "$SUP_PID" 2>/dev/null || break
+            sleep 0.1
+        done
+        kill -9 "$SUP_PID" 2>/dev/null || true
+    fi
     rm -rf "$WORK"
 }
 trap cleanup EXIT
@@ -413,5 +424,212 @@ grep -q "serving drain clean" "$WORK/replica1.log" \
     || { echo "no clean-drain marker in replica1 log"; exit 1; }
 echo "[serve_smoke] router + replica clean drain OK"
 
-exec python -m pytest tests/ -q -m "serving or genserve or specdec" \
+# ---- fleet chaos section ----------------------------------------------
+# the supervised fleet loses a replica under concurrent streaming load:
+# a REAL SIGKILL lands on the affinity-owner replica mid-stream.  The
+# router must resume every interrupted stream on the survivor (greedy
+# output bitwise-identical to an uninterrupted oracle), report ZERO
+# failed requests, measure a failover recovery faster than the
+# probe-timeout floor (epoch-delta eviction), and the supervisor must
+# respawn the corpse back into a 2-healthy fleet without a restart.
+echo "[serve_smoke] starting supervised fleet (world=2)..."
+python -m paddle_tpu.serving.fleet --world 2 --heartbeat-timeout 10 \
+    --backoff 0.2 --telemetry-dir "$WORK/telemetry" \
+    --log-dir "$WORK/fleetlogs" -- \
+    python -m paddle_tpu.serving.generation --port 0 --slots 6 \
+    --prompt-buckets 8,16,32 --max-seq-len 48 --page-size 4 --seed 0 \
+    > "$WORK/fleet.log" 2>&1 &
+SUP_PID=$!
+
+for _ in $(seq 1 1800); do
+    grep -q "supervising 2 replicas" "$WORK/fleet.log" && break
+    kill -0 "$SUP_PID" 2>/dev/null \
+        || { echo "fleet supervisor died:"; cat "$WORK/fleet.log"; exit 1; }
+    sleep 0.1
+done
+grep -q "supervising 2 replicas" "$WORK/fleet.log" \
+    || { echo "fleet never became ready"; cat "$WORK/fleet.log"; exit 1; }
+COORD=$(sed -n 's/^paddle_tpu\.serving\.fleet coord \(.*\)$/\1/p' \
+        "$WORK/fleet.log" | head -1)
+[ -n "$COORD" ] || { echo "no coord address in fleet log"; \
+    cat "$WORK/fleet.log"; exit 1; }
+echo "[serve_smoke] fleet up, coordinator at $COORD"
+
+echo "[serve_smoke] starting router on coordinator membership..."
+python -m paddle_tpu.serving.router --coord "$COORD" --port 0 \
+    --page-size 4 --probe-interval 0.5 --dead-after 3 \
+    > "$WORK/chaosrouter.log" 2>&1 &
+ROUTER_PID=$!
+CRURL=$(wait_url "$WORK/chaosrouter.log" "$ROUTER_PID") \
+    || { echo "chaos router never came up"; cat "$WORK/chaosrouter.log"; \
+         exit 1; }
+echo "[serve_smoke] chaos router up at $CRURL"
+
+echo "[serve_smoke] mid-stream SIGKILL drill (4 streams)..."
+python - "$CRURL" "$SUP_PID" <<'EOF'
+import os
+import signal
+import sys
+import threading
+import urllib.request
+
+from paddle_tpu.serving.client import ServingClient
+
+RURL, SUP = sys.argv[1], int(sys.argv[2])
+PROMPT = [3, 5, 7, 11, 13, 17, 19, 23]
+MAX_NEW = 24
+STREAMS = 4
+# probe floor: the recovery the router must BEAT (dead_after * interval)
+PROBE_FLOOR_MS = 3 * 0.5 * 1000.0
+
+
+def children(pid):
+    out = []
+    task = "/proc/%d/task" % pid
+    for t in os.listdir(task):
+        with open("%s/%s/children" % (task, t)) as f:
+            out += [int(c) for c in f.read().split()]
+    return out
+
+
+def rank_of(pid):
+    with open("/proc/%d/environ" % pid, "rb") as f:
+        for kv in f.read().split(b"\0"):
+            if kv.startswith(b"PADDLE_POD_RANK="):
+                return int(kv.split(b"=", 1)[1])
+    return None
+
+
+replica_pid = {rank_of(p): p for p in children(SUP)}
+assert {0, 1} <= set(replica_pid), "fleet ranks not found: %r" % replica_pid
+
+# oracle binds the shared-prompt affinity to ONE replica (least-loaded
+# tie broken by name -> rank 0); every stream below rides that binding,
+# so SIGKILLing rank 0 interrupts them all mid-decode
+cli = ServingClient(RURL, timeout=180.0)
+oracle = cli.generate(PROMPT, MAX_NEW)["tokens"]
+assert len(oracle) == MAX_NEW, oracle
+
+three_tokens = threading.Event()
+results = [None] * STREAMS
+errors = [None] * STREAMS
+
+
+def run(i):
+    toks, done = [], None
+    try:
+        for evt in ServingClient(RURL, timeout=180.0).generate_stream(
+                PROMPT, MAX_NEW):
+            if "token" in evt:
+                toks.append(evt["token"])
+                if len(toks) >= 3:
+                    three_tokens.set()
+            if evt.get("done"):
+                done = evt
+        results[i] = (toks, done)
+    except Exception as e:  # noqa: BLE001 - any exception = failed request
+        errors[i] = e
+
+
+threads = [threading.Thread(target=run, args=(i,)) for i in range(STREAMS)]
+for t in threads:
+    t.start()
+assert three_tokens.wait(180), "no stream reached 3 tokens"
+os.kill(replica_pid[0], signal.SIGKILL)
+print("[chaos] SIGKILLed rank-0 replica pid %d mid-stream"
+      % replica_pid[0], file=sys.stderr)
+for t in threads:
+    t.join(300)
+assert not any(t.is_alive() for t in threads), "stream hung after kill"
+assert all(e is None for e in errors), \
+    "client-visible failures: %r" % [e for e in errors if e]
+for toks, done in results:
+    assert done is not None and not done.get("error"), done
+    assert toks == oracle, \
+        "resumed stream diverged:\n got  %r\n want %r" % (toks, oracle)
+
+text = urllib.request.urlopen(RURL + "/metrics",
+                              timeout=10).read().decode()
+head = text.split("# replica=")[0]
+
+
+def value(name):
+    line = [l for l in head.splitlines() if l.startswith(name + " ")]
+    assert line, "missing metric %s" % name
+    return float(line[0].split()[-1])
+
+
+failovers = value('paddle_router_failovers_total{reason="mid_stream"}')
+avail = value("paddle_fleet_availability_ratio")
+recovery = value("paddle_router_failover_recovery_ms")
+assert failovers >= 1, "no mid-stream failover recorded: %g" % failovers
+assert avail == 1.0, "availability below 1.0 after drill: %g" % avail
+assert 0 < recovery < PROBE_FLOOR_MS, \
+    "failover recovery %.1fms must beat the %.0fms probe floor" \
+    % (recovery, PROBE_FLOOR_MS)
+print("[chaos] drill OK: %d streams resumed bitwise, failovers=%g "
+      "availability=%g recovery_ms=%g (probe floor %.0fms)"
+      % (STREAMS, failovers, avail, recovery, PROBE_FLOOR_MS))
+EOF
+
+echo "[serve_smoke] waiting for supervisor respawn..."
+for _ in $(seq 1 1800); do
+    grep -q "replica 0 respawned at" "$WORK/fleet.log" && break
+    kill -0 "$SUP_PID" 2>/dev/null \
+        || { echo "fleet supervisor died:"; cat "$WORK/fleet.log"; exit 1; }
+    sleep 0.1
+done
+grep -q "replica 0 respawned at" "$WORK/fleet.log" \
+    || { echo "supervisor never respawned the killed replica"; \
+         cat "$WORK/fleet.log"; exit 1; }
+
+python - "$CRURL" <<'EOF'
+# membership re-admission: the router must see the respawned replica
+# (new url, same rank) and return to 2 healthy WITHOUT a restart
+import sys
+import time
+import urllib.request
+
+deadline = time.time() + 120
+while time.time() < deadline:
+    text = urllib.request.urlopen(sys.argv[1] + "/metrics",
+                                  timeout=10).read().decode()
+    line = [l for l in text.splitlines()
+            if l.startswith("paddle_router_replicas_healthy ")]
+    if line and float(line[0].split()[1]) == 2:
+        print("[chaos] respawned replica re-admitted: 2 healthy again")
+        sys.exit(0)
+    time.sleep(0.25)
+sys.exit("router never re-admitted the respawned replica")
+EOF
+
+echo "[serve_smoke] SIGTERM -> chaos router drain, then fleet..."
+kill -TERM "$ROUTER_PID"
+rc=0
+wait "$ROUTER_PID" || rc=$?
+ROUTER_PID=""
+if [ "$rc" -ne 0 ]; then
+    echo "[serve_smoke] chaos router exit code $rc (want 0)"
+    cat "$WORK/chaosrouter.log"
+    exit 1
+fi
+grep -q "router drain clean" "$WORK/chaosrouter.log" \
+    || { echo "no clean-drain marker in chaos router log"; \
+         cat "$WORK/chaosrouter.log"; exit 1; }
+kill -TERM "$SUP_PID"
+rc=0
+wait "$SUP_PID" || rc=$?
+SUP_PID=""
+if [ "$rc" -ne 0 ]; then
+    echo "[serve_smoke] fleet supervisor exit code $rc (want 0)"
+    cat "$WORK/fleet.log"
+    exit 1
+fi
+grep -q "fleet drain clean" "$WORK/fleet.log" \
+    || { echo "no clean-drain marker in fleet log"; \
+         cat "$WORK/fleet.log"; exit 1; }
+echo "[serve_smoke] fleet chaos drill OK"
+
+exec python -m pytest tests/ -q \
+    -m "serving or genserve or specdec or fleetchaos" \
     -p no:cacheprovider -p no:randomly "$@"
